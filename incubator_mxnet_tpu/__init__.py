@@ -1,0 +1,34 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of Apache MXNet's capabilities (reference:
+seppo0010/incubator-mxnet) for TPU hardware: JAX/XLA/Pallas compute, SPMD
+parallelism over jax.sharding meshes, functional autodiff under an
+imperative (Gluon-style) and symbolic (Module-style) API.
+
+Usage mirrors MXNet::
+
+    import incubator_mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from .ndarray import random as random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import kvstore
+from . import kvstore as kv
+from . import gluon

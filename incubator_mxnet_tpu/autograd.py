@@ -1,0 +1,292 @@
+"""Imperative autograd — tape over eagerly executed JAX ops.
+
+Reference parity: python/mxnet/autograd.py (record/pause scopes :120, backward
+:244, grad :271, custom Function :388) and the C++ tape in
+src/imperative/imperative.cc (RecordOp :193, Backward :280).
+
+TPU-native design: instead of an NNVM graph + engine replay, every recorded op
+stores its *pure JAX function* and inputs. ``backward`` walks the tape in
+reverse and calls ``jax.vjp`` per entry — XLA compiles each op's VJP; no
+hand-written gradient kernels exist anywhere in this framework. The fast path
+(hybridize / jitted train step) bypasses the tape entirely and differentiates
+the whole step with ``jax.grad``.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as onp
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "set_recording",
+    "set_training",
+    "Function",
+]
+
+
+class _TapeEntry:
+    __slots__ = ("fn", "inputs", "in_data", "outputs", "n_outputs", "custom_backward")
+
+    def __init__(self, fn, inputs, outputs):
+        self.fn = fn            # pure function: (*jax arrays) -> jax array or tuple
+        self.inputs = inputs    # list[NDArray]
+        # snapshot input buffers at record time so later in-place writes on the
+        # NDArray (x += y rebinds ._data) don't corrupt the replayed VJP
+        self.in_data = [x._data for x in inputs]
+        self.outputs = outputs  # list[NDArray]
+        self.n_outputs = len(outputs)
+
+
+class _AutogradState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape = []
+
+
+_STATE = _AutogradState()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(is_record):
+    prev = _STATE.recording
+    _STATE.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _STATE.training
+    _STATE.training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._enter_is_record is not None:
+            _STATE.recording = self._enter_is_record
+        if self._enter_train_mode is not None:
+            _STATE.training = self._enter_train_mode
+        return self
+
+    def __exit__(self, *args):
+        _STATE.recording, _STATE.training = self._prev
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are taped (ref: autograd.py:120)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def _record_op(fn, inputs, outputs):
+    """Called from ndarray._apply for every eager op while recording."""
+    tracked = [x for x in inputs if getattr(x, "_in_graph", False)]
+    if not tracked:
+        return
+    for o in outputs:
+        o._in_graph = True
+    _STATE.tape.append(_TapeEntry(fn, list(inputs), list(outputs)))
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """attach_grad: mark arrays as differentiation roots (ref: imperative.cc:123)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradient, req in zip(variables, gradients, grad_reqs):
+        var._in_graph = True
+        var._grad_req = req
+        var.grad_buf = gradient
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables (ref: autograd.py:244).
+
+    Walks the tape in reverse; per-entry cotangents via jax.vjp.
+    """
+    from .ndarray.ndarray import NDArray, array as _nd_array
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulator keyed by array identity
+    cotangent = {}
+    for h, hg in zip(heads, head_grads):
+        g = jax.numpy.ones_like(h._data) if hg is None else hg._data
+        key = id(h)
+        cotangent[key] = cotangent.get(key, 0) + g
+
+    tape = _STATE.tape
+    for entry in reversed(tape):
+        out_cts = [cotangent.get(id(o)) for o in entry.outputs]
+        if all(ct is None for ct in out_cts):
+            continue
+        if hasattr(entry, "custom_backward"):
+            cts_in = entry.custom_backward(out_cts)
+            for x, ct in zip(entry.inputs, cts_in):
+                if ct is None or not getattr(x, "_in_graph", False):
+                    continue
+                key = id(x)
+                cotangent[key] = cotangent.get(key, 0) + ct if key in cotangent else ct
+            continue
+        in_data = entry.in_data
+        primals_out, vjp_fn = jax.vjp(entry.fn, *in_data)
+        if isinstance(primals_out, (tuple, list)):
+            seed = [ct if ct is not None else jax.numpy.zeros_like(p)
+                    for ct, p in zip(out_cts, primals_out)]
+            seed = tuple(seed) if isinstance(primals_out, tuple) else seed
+        else:
+            seed = (out_cts[0] if out_cts[0] is not None
+                    else jax.numpy.zeros_like(primals_out))
+        cts_in = vjp_fn(seed)
+        for x, ct in zip(entry.inputs, cts_in):
+            if ct is None or not getattr(x, "_in_graph", False):
+                continue
+            key = id(x)
+            cotangent[key] = cotangent.get(key, 0) + ct if key in cotangent else ct
+
+    # write into .grad of marked variables
+    seen = set()
+    for entry in tape:
+        for x in entry.inputs:
+            if id(x) in seen:
+                continue
+            seen.add(id(x))
+            _write_grad(x, cotangent)
+    for h in heads:
+        if id(h) not in seen:
+            _write_grad(h, cotangent)
+
+    if not retain_graph:
+        _STATE.tape = []
+
+
+def _write_grad(x, cotangent):
+    buf = getattr(x, "grad_buf", None)
+    if buf is None:
+        return
+    ct = cotangent.get(id(x))
+    if ct is None:
+        return
+    req = getattr(x, "_grad_req", "write")
+    if req == "null":
+        return
+    if req == "add":
+        buf._data = buf._data + ct
+    else:
+        buf._data = jax.numpy.asarray(ct, dtype=buf._data.dtype)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient (ref: autograd.py:271): returns grads, leaves .grad alone."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    # stash existing grad buffers, attach temps
+    saved = [(getattr(v, "grad_buf", None), getattr(v, "_grad_req", None)) for v in variables]
+    temps = []
+    for v in variables:
+        t = NDArray(jax.numpy.zeros_like(v._data), ctx=v.ctx)
+        v._in_graph = True
+        v._grad_req = "write"
+        v.grad_buf = t
+        temps.append(t)
+    backward(heads, head_grads, retain_graph=bool(retain_graph) or create_graph,
+             train_mode=train_mode)
+    for v, (buf, req) in zip(variables, saved):
+        v.grad_buf = buf
+        if req is not None:
+            v._grad_req = req
+    return temps[0] if single else temps
+
+
+class Function:
+    """Custom differentiable function (ref: autograd.py:388-513).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` in terms of NDArray ops.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording() and any(getattr(x, "_in_graph", False) for x in inputs):
+            func = self
+            entry = _TapeEntry(None, list(inputs), outs)
+
+            # monkey-patch: custom entries carry their own backward
+            def run_backward(out_cts):
+                cts = func.backward(
+                    *[NDArray(ct) if ct is not None else None for ct in out_cts]
+                )
+                if isinstance(cts, NDArray):
+                    cts = (cts,)
+                return [c._data if c is not None else None for c in cts]
+
+            entry.custom_backward = run_backward
+            for o in outs:
+                o._in_graph = True
+            _STATE.tape.append(entry)
+        return outputs

@@ -1,0 +1,66 @@
+"""Shared small utilities (ref: python/mxnet/base.py, python/mxnet/registry.py)."""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "registry", "Registry"]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (ref: python/mxnet/base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, onp.generic)
+
+
+class Registry:
+    """Name→class registry with alias support (ref: python/mxnet/registry.py)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._registry = {}
+
+    def register(self, klass, name=None):
+        nm = (name or klass.__name__).lower()
+        self._registry[nm] = klass
+        return klass
+
+    def alias(self, *aliases):
+        def reg(klass):
+            self.register(klass)
+            for a in aliases:
+                self.register(klass, a)
+            return klass
+
+        return reg
+
+    def get(self, name):
+        if isinstance(name, str):
+            key = name.lower()
+            if key not in self._registry:
+                raise ValueError(
+                    "%s %r not registered; known: %s" % (self.name, name, sorted(self._registry))
+                )
+            return self._registry[key]
+        return name
+
+    def create(self, name, *args, **kwargs):
+        if not isinstance(name, str):
+            return name
+        return self.get(name)(*args, **kwargs)
+
+    def __contains__(self, name):
+        return isinstance(name, str) and name.lower() in self._registry
+
+    def keys(self):
+        return self._registry.keys()
+
+
+_registries = {}
+
+
+def registry(name):
+    if name not in _registries:
+        _registries[name] = Registry(name)
+    return _registries[name]

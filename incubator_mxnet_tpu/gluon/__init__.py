@@ -1,0 +1,11 @@
+"""Gluon — imperative NN API (ref python/mxnet/gluon/__init__.py)."""
+from .block import Block, HybridBlock, SymbolBlock  # noqa
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError  # noqa
+from .trainer import Trainer  # noqa
+from . import nn  # noqa
+from . import loss  # noqa
+from . import data  # noqa
+from . import rnn  # noqa
+from . import model_zoo  # noqa
+from . import contrib  # noqa
+from .utils import split_data, split_and_load, clip_global_norm  # noqa

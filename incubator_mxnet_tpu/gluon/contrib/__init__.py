@@ -1,0 +1,2 @@
+"""gluon.contrib (ref python/mxnet/gluon/contrib/) — estimator et al."""
+from . import estimator  # noqa

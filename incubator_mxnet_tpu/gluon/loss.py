@@ -1,0 +1,264 @@
+"""Loss blocks (ref python/mxnet/gluon/loss.py)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
+           "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "CTCLoss",
+           "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
+           "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss"]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    if pred.shape != label.shape:
+        label = label.reshape(pred.shape)
+    return label
+
+
+class Loss(HybridBlock):
+    """Base loss (ref loss.py Loss)."""
+
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return "%s(batch_axis=%s, w=%s)" % (
+            type(self).__name__, self._batch_axis, self._weight)
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                loss = nd.relu(pred) - pred * label + nd.Activation(
+                    -nd.abs(pred), act_type="softrelu")
+            else:
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = pred - pred * label + log_weight * (
+                    nd.Activation(-nd.abs(pred), act_type="softrelu") + nd.relu(-pred))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(nd.log(pred + eps) * label + nd.log(1 - pred + eps) * (1 - label))
+            else:
+                loss = -(nd.log(pred + eps) * label * pos_weight +
+                         nd.log(1 - pred + eps) * (1 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """ref loss.py SoftmaxCrossEntropyLoss."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False, weight=None,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = nd.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -nd.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = _reshape_like(pred, label)
+            loss = -(pred * label).sum(axis=self._axis, keepdims=False)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(loss.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = nd.log_softmax(pred, axis=self._axis)
+        loss = label * (nd.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class CTCLoss(Loss):
+    """ref loss.py CTCLoss → nn/ctc_loss.cc."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        if self._layout == "NTC":
+            pred = pred.swapaxes(0, 1)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+        loss = nd.CTCLoss(pred, label, pred_lengths, label_lengths,
+                          use_data_lengths=pred_lengths is not None,
+                          use_label_lengths=label_lengths is not None,
+                          blank_label="last")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.abs(label - pred)
+        loss = nd.where(loss > self._rho,
+                        loss - 0.5 * self._rho,
+                        (0.5 / self._rho) * nd.square(loss))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.relu(self._margin - pred * label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = nd.square(nd.relu(self._margin - pred * label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = nd.relu(pred) - pred * label + nd.Activation(
+            -nd.abs(pred), act_type="softrelu")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(len(pred.shape)) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(pred, positive)
+        negative = _reshape_like(pred, negative)
+        loss = (nd.square(positive - pred) - nd.square(negative - pred)).sum(
+            axis=tuple(range(1, len(pred.shape))))
+        loss = nd.relu(loss + self._margin)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = _reshape_like(pred, target)
+        if self._from_logits:
+            loss = nd.exp(pred) - target * pred
+        else:
+            loss = pred - target * nd.log(pred + epsilon)
+        if self._compute_full:
+            stirling = target * nd.log(target + 1e-12) - target + 0.5 * nd.log(
+                2 * onp.pi * (target + 1e-12))
+            stirling = stirling * (target > 1)
+            loss = loss + stirling
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        input1 = _reshape_like(input1, input2)
+        cos_sim = (input1 * input2).sum(axis=-1) / (
+            input1.norm(axis=-1) * input2.norm(axis=-1) + 1e-12)
+        label = label.reshape((-1,))
+        loss = nd.where(label == 1, 1.0 - cos_sim,
+                        nd.relu(cos_sim - self._margin))
+        return _apply_weighting(loss, self._weight, sample_weight)

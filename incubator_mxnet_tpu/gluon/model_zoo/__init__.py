@@ -1,0 +1,3 @@
+"""gluon.model_zoo (ref python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import vision  # noqa
+from .vision import get_model  # noqa

@@ -1,0 +1,4 @@
+"""gluon.nn namespace (ref python/mxnet/gluon/nn/__init__.py)."""
+from .basic_layers import *  # noqa
+from .conv_layers import *  # noqa
+from ..block import Block, HybridBlock, SymbolBlock  # noqa

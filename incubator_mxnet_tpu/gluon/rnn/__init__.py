@@ -1,0 +1,3 @@
+"""gluon.rnn (ref python/mxnet/gluon/rnn/__init__.py)."""
+from .rnn_cell import *  # noqa
+from .rnn_layer import RNN, LSTM, GRU  # noqa

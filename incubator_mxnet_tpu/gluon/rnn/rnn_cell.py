@@ -1,0 +1,354 @@
+"""RNN cells (ref python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell (ref rnn_cell.py RecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info.update(kwargs)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape, **info))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """ref rnn_cell.py unroll — python loop over time (cells are for
+        flexibility; the fused rnn_layer scan path is the fast one)."""
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[1 - axis if axis in (0, 1) else 0]
+            seq = [s for s in nd.split(inputs, length, axis=axis, squeeze_axis=True)] \
+                if length > 1 else [inputs.squeeze(axis)]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd.stack(*outputs, axis=axis)
+            stacked = nd.SequenceMask(stacked, valid_length, True,
+                                      axis=axis if axis in (0, 1) else 0)
+            outputs = stacked
+            merge_outputs = True
+        if merge_outputs:
+            if not isinstance(outputs, nd.NDArray):
+                outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return self.forward(inputs, states)
+
+
+class RNNCell(RecurrentCell):
+    """Elman RNN cell (ref rnn_cell.py RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _ensure_init(self, x):
+        if self.i2h_weight._data is None:
+            self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias, self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._ensure_init(inputs)
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=self._hidden_size, flatten=False)
+        output = nd.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    """LSTM cell, gate order i,f,g,o like MXNet (ref rnn_cell.py LSTMCell)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(4 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(4 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _ensure_init(self, x):
+        if self.i2h_weight._data is None:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias, self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._ensure_init(inputs)
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=4 * self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=4 * self._hidden_size, flatten=False)
+        gates = i2h + h2h
+        slice_gates = nd.split(gates, 4, axis=-1)
+        in_gate = nd.sigmoid(slice_gates[0])
+        forget_gate = nd.sigmoid(slice_gates[1])
+        in_transform = nd.tanh(slice_gates[2])
+        out_gate = nd.sigmoid(slice_gates[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * nd.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    """GRU cell, gate order r,z,n like MXNet (ref rnn_cell.py GRUCell)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(3 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(3 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _ensure_init(self, x):
+        if self.i2h_weight._data is None:
+            self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias, self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._ensure_init(inputs)
+        prev_h = states[0]
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=3 * self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(prev_h, self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=3 * self._hidden_size, flatten=False)
+        i2h_r, i2h_z, i2h_n = nd.split(i2h, 3, axis=-1)
+        h2h_r, h2h_z, h2h_n = nd.split(h2h, 3, axis=-1)
+        reset_gate = nd.sigmoid(i2h_r + h2h_r)
+        update_gate = nd.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = nd.tanh(i2h_n + reset_gate * h2h_n)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells (ref rnn_cell.py SequentialRNNCell)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, func, **kwargs))
+        return states
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, s = cell(inputs, states[p: p + n])
+            next_states.extend(s)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        return self.base_cell.begin_state(batch_size, func, **kwargs)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = nd.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        if self.zoneout_outputs > 0.0:
+            mask = nd.Dropout(nd.ones_like(next_output), p=self.zoneout_outputs)
+            prev = self._prev_output if self._prev_output is not None \
+                else nd.zeros_like(next_output)
+            next_output = nd.where(mask, next_output, prev)
+        if self.zoneout_states > 0.0:
+            out_states = []
+            for ns, s in zip(next_states, states):
+                mask = nd.Dropout(nd.ones_like(ns), p=self.zoneout_states)
+                out_states.append(nd.where(mask, ns, s))
+            next_states = out_states
+        self._prev_output = next_output
+        return next_output, next_states
+
+
+class ResidualCell(ModifierCell):
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """ref rnn_cell.py BidirectionalCell."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix=None, params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size) +
+                self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size, func, **kwargs) +
+                self._children["r_cell"].begin_state(batch_size, func, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        axis = layout.find("T")
+        n_l = len(l_cell.state_info())
+        states = begin_state if begin_state is not None else self.begin_state(
+            inputs.shape[1 - axis if axis in (0, 1) else 0])
+        l_out, l_states = l_cell.unroll(length, inputs, states[:n_l], layout, True,
+                                        valid_length)
+        rev = nd.SequenceReverse(inputs.swapaxes(0, axis) if axis != 0 else inputs,
+                                 valid_length, valid_length is not None, axis=0)
+        if axis != 0:
+            rev = rev.swapaxes(0, axis)
+        r_out, r_states = r_cell.unroll(length, rev, states[n_l:], layout, True,
+                                        valid_length)
+        r_out_rev = nd.SequenceReverse(r_out.swapaxes(0, axis) if axis != 0 else r_out,
+                                       valid_length, valid_length is not None, axis=0)
+        if axis != 0:
+            r_out_rev = r_out_rev.swapaxes(0, axis)
+        outputs = nd.concat(l_out, r_out_rev, dim=2)
+        return outputs, l_states + r_states

@@ -1,0 +1,225 @@
+"""Fused RNN layers (ref python/mxnet/gluon/rnn/rnn_layer.py + src/operator/rnn-inl.h).
+
+TPU-native design: the monolithic cuDNN RNN op becomes a ``lax.scan`` over the
+time axis — gate matmuls batched onto the MXU, the scan compiled by XLA into a
+single fused loop (BASELINE config 5). Multi-layer + bidirectional supported.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import ndarray as nd
+from ...ndarray import NDArray, _apply
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+def _lstm_step(h, c, x_t, wi, wh, bi, bh):
+    gates = x_t @ wi.T + h @ wh.T + bi + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(h, x_t, wi, wh, bi, bh):
+    gi = x_t @ wi.T + bi
+    gh = h @ wh.T + bh
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_step(h, x_t, wi, wh, bi, bh, act):
+    pre = x_t @ wi.T + h @ wh.T + bi + bh
+    return jnp.tanh(pre) if act == "tanh" else jax.nn.relu(pre)
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, activation=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._activation = activation
+        ng = {"rnn": 1, "lstm": 4, "gru": 3}[mode]
+        self._gates = ng
+        self._i2h, self._h2h, self._i2hb, self._h2hb = [], [], [], []
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d, suffix in zip(range(self._dir), ["l", "r"]):
+                    in_sz = input_size if layer == 0 else hidden_size * self._dir
+                    shape_known = in_sz > 0
+                    args = dict(allow_deferred_init=True)
+                    w_i2h = self.params.get("%s%d_i2h_weight" % (suffix, layer),
+                                            shape=(ng * hidden_size, in_sz),
+                                            init=i2h_weight_initializer, **args)
+                    w_h2h = self.params.get("%s%d_h2h_weight" % (suffix, layer),
+                                            shape=(ng * hidden_size, hidden_size),
+                                            init=h2h_weight_initializer, **args)
+                    b_i2h = self.params.get("%s%d_i2h_bias" % (suffix, layer),
+                                            shape=(ng * hidden_size,),
+                                            init=i2h_bias_initializer, **args)
+                    b_h2h = self.params.get("%s%d_h2h_bias" % (suffix, layer),
+                                            shape=(ng * hidden_size,),
+                                            init=h2h_bias_initializer, **args)
+                    self._i2h.append(w_i2h)
+                    self._h2h.append(w_h2h)
+                    self._i2hb.append(b_i2h)
+                    self._h2hb.append(b_h2h)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        n_state = 2 if self._mode == "lstm" else 1
+        for _ in range(n_state):
+            states.append(func((self._num_layers * self._dir, batch_size,
+                                self._hidden_size), **kwargs))
+        return states if n_state > 1 else states
+
+    def _ensure_init(self, x):
+        if self._i2h[0]._data is None:
+            in_sz = x.shape[-1]
+            for layer in range(self._num_layers):
+                for d in range(self._dir):
+                    idx = layer * self._dir + d
+                    lin = in_sz if layer == 0 else self._hidden_size * self._dir
+                    self._i2h[idx].shape = (self._gates * self._hidden_size, lin)
+                    for p in (self._i2h[idx], self._h2h[idx], self._i2hb[idx],
+                              self._h2hb[idx]):
+                        p._finish_deferred_init()
+
+    def forward(self, inputs, states=None):
+        self._ensure_init(inputs if self._layout == "TNC"
+                          else inputs.swapaxes(0, 1))
+        batch = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch)
+        if isinstance(states, NDArray):
+            states = [states]
+        x = inputs if self._layout == "TNC" else inputs.swapaxes(0, 1)
+
+        mode, act = self._mode, self._activation
+        num_layers, ndir, hid = self._num_layers, self._dir, self._hidden_size
+        has_cell = mode == "lstm"
+
+        def fused(x_d, h0_d, c0_d, *wts):
+            # wts: i2h*, h2h*, i2hb*, h2hb* each num_layers*ndir
+            L = num_layers * ndir
+            wi, wh, bi, bh = wts[:L], wts[L:2 * L], wts[2 * L:3 * L], wts[3 * L:]
+            out = x_d
+            h_out, c_out = [], []
+            for layer in range(num_layers):
+                dir_outs = []
+                for d in range(ndir):
+                    idx = layer * ndir + d
+                    seq = out if d == 0 else jnp.flip(out, 0)
+                    h0 = h0_d[idx]
+                    if has_cell:
+                        c0 = c0_d[idx]
+
+                        def step(carry, x_t, _wi=wi[idx], _wh=wh[idx], _bi=bi[idx], _bh=bh[idx]):
+                            h, c = carry
+                            h2, c2 = _lstm_step(h, c, x_t, _wi, _wh, _bi, _bh)
+                            return (h2, c2), h2
+
+                        (hT, cT), ys = lax.scan(step, (h0, c0), seq)
+                        c_out.append(cT)
+                    elif mode == "gru":
+                        def step(h, x_t, _wi=wi[idx], _wh=wh[idx], _bi=bi[idx], _bh=bh[idx]):
+                            h2 = _gru_step(h, x_t, _wi, _wh, _bi, _bh)
+                            return h2, h2
+
+                        hT, ys = lax.scan(step, h0, seq)
+                    else:
+                        def step(h, x_t, _wi=wi[idx], _wh=wh[idx], _bi=bi[idx], _bh=bh[idx]):
+                            h2 = _rnn_step(h, x_t, _wi, _wh, _bi, _bh, act)
+                            return h2, h2
+
+                        hT, ys = lax.scan(step, h0, seq)
+                    h_out.append(hT)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                out = dir_outs[0] if ndir == 1 else jnp.concatenate(dir_outs, axis=-1)
+                if self._dropout and layer != num_layers - 1:
+                    from ...ndarray import random as _rnd
+                    from ... import autograd as _ag
+                    if _ag.is_training():
+                        keep = 1.0 - self._dropout
+                        mask = jax.random.bernoulli(
+                            _rnd._next_key(), keep, out.shape).astype(out.dtype)
+                        out = out * mask / keep
+            hs = jnp.stack(h_out, 0)
+            if has_cell:
+                return out, hs, jnp.stack(c_out, 0)
+            return out, hs
+
+        weights = ([p.data() for p in self._i2h] + [p.data() for p in self._h2h] +
+                   [p.data() for p in self._i2hb] + [p.data() for p in self._h2hb])
+        if has_cell:
+            res = _apply(lambda xd, h0, c0, *w: fused(xd, h0, c0, *w),
+                         x, states[0], states[1], *weights)
+            out, hT, cT = res
+            out_states = [hT, cT]
+        else:
+            res = _apply(lambda xd, h0, *w: fused(xd, h0, None, *w),
+                         x, states[0], *weights)
+            out, hT = res
+            out_states = [hT]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        if skip_states:
+            return out
+        return out, out_states
+
+    def __repr__(self):
+        return "%s(%d, num_layers=%d)" % (type(self).__name__, self._hidden_size,
+                                          self._num_layers)
+
+
+class RNN(_RNNLayer):
+    """ref rnn_layer.py RNN."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "rnn", activation, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """ref rnn_layer.py LSTM (cuDNN RNN → lax.scan, BASELINE config 5)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    """ref rnn_layer.py GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)

@@ -1,0 +1,203 @@
+"""Weight initializers (ref python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from . import ndarray as nd
+from .base import registry
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "Orthogonal",
+           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "register", "create"]
+
+_REG = registry("initializer")
+register = _REG.register
+
+
+class Initializer:
+    """Base initializer (ref initializer.py:95). Call with (name, arr) or use
+    init_weight/init_bias style dispatch by name suffix."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr):
+        self.init_weight_by_name(str(name), arr)
+
+    def init_weight_by_name(self, name, arr):
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif "running_mean" in name or "moving_mean" in name:
+            self._init_zero(arr)
+        elif "running_var" in name or "moving_var" in name:
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_zero(self, arr):
+        arr._data = nd.zeros(arr.shape, dtype=arr.dtype)._data
+
+    def _init_one(self, arr):
+        arr._data = nd.ones(arr.shape, dtype=arr.dtype)._data
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+_REG.register(Zero, "zeros")
+_REG.register(One, "ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._data = nd.full(arr.shape, self.value, dtype=arr.dtype)._data
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._data = nd.random.uniform(-self.scale, self.scale, arr.shape).astype(arr.dtype)._data
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._data = nd.random.normal(0, self.sigma, arr.shape).astype(arr.dtype)._data
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = nd.random.uniform(-1.0, 1.0, (nout, nin)).asnumpy()
+        else:
+            tmp = nd.random.normal(0.0, 1.0, (nout, nin)).asnumpy()
+        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr._data = nd.array(self.scale * q.reshape(arr.shape)).astype(arr.dtype)._data
+
+
+@register
+class Xavier(Initializer):
+    """ref initializer.py Xavier (gaussian/uniform, avg/in/out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier requires ndim >= 2, got %s for %s" % (shape, name))
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr._data = nd.random.uniform(-scale, scale, shape).astype(arr.dtype)._data
+        else:
+            arr._data = nd.random.normal(0, scale, shape).astype(arr.dtype)._data
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = onp.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = shape[3] / 2.0
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        size = int(onp.prod(shape))
+        for i in range(size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = nd.array(weight).astype(arr.dtype)._data
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (ref initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._data = nd.array(b).astype(arr.dtype)._data
+
+
+class Mixed:
+    """Patterned initializer dispatch (ref initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError("no initializer pattern matches %r" % name)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REG.create(name, **kwargs)

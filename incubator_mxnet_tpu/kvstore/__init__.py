@@ -1,0 +1,9 @@
+"""KVStore — Push/Pull API facade (ref python/mxnet/kvstore/, src/kvstore/).
+
+TPU-native design (SURVEY §2.5 north-star): the reference's device/NCCL/dist
+synchronisation becomes *in-program* XLA collectives over the ICI mesh; this
+module keeps the KVStore Push/Pull/PushPull/Broadcast API as a compatibility
+facade. ``local``/``device`` hold one logical copy (SPMD replication is a
+sharding decision); ``dist_*`` map onto jax.distributed multi-host psum.
+"""
+from .kvstore import KVStore, KVStoreBase, create, LocalKVStore, DistKVStore  # noqa

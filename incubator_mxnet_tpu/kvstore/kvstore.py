@@ -1,0 +1,170 @@
+"""KVStore implementations (ref python/mxnet/kvstore/kvstore.py:54,
+src/kvstore/kvstore_local.h:69, src/kvstore/kvstore_dist.h:44)."""
+from __future__ import annotations
+
+import pickle
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["KVStore", "KVStoreBase", "create", "LocalKVStore", "DistKVStore"]
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+class KVStoreBase:
+    """Registry base for custom stores (ref python/mxnet/kvstore/base.py)."""
+
+    kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        KVStoreBase.kv_registry[klass.__name__.lower()] = klass
+        return klass
+
+
+class KVStore(KVStoreBase):
+    """Abstract Push/Pull API (ref include/mxnet/kvstore.h:59-466)."""
+
+    def __init__(self, name="local"):
+        self.name = name
+        self._updater = None
+        self._optimizer = None
+        self._data = {}
+        self._compression = None
+
+    # ---- core API ----------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._data[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            agg = self._aggregate(v)
+            if self._updater is not None:
+                self._updater(_key_int(k), agg, self._data[k])
+            else:
+                self._data[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                oo._data = self._data[k]._data
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense-only TPU build: full pull (sparse stypes deferred, SURVEY §7f)
+        self.pull(key, out, priority)
+
+    # ---- optimizer ----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    @property
+    def type(self):
+        return self.name
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def set_gradient_compression(self, compression_params):
+        from ..parallel.compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states without an optimizer"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        nd.waitall()
+
+    # ---- helpers -------------------------------------------------------
+    def _normalize(self, key, value):
+        if isinstance(key, (str, int)):
+            return [key], [value]
+        return list(key), list(value)
+
+    def _aggregate(self, v):
+        """Sum gradients from a list of per-device values (ref comm.h Reduce)."""
+        if isinstance(v, (list, tuple)):
+            if len(v) == 1:
+                return v[0]
+            if self._compression is not None:
+                v = [self._compression.compress_decompress(x) for x in v]
+            acc = v[0]
+            for x in v[1:]:
+                acc = acc + x
+            return acc
+        if self._compression is not None:
+            return self._compression.compress_decompress(v)
+        return v
+
+
+@KVStoreBase.register
+class LocalKVStore(KVStore):
+    """'local'/'device' store (ref src/kvstore/kvstore_local.h)."""
+
+
+@KVStoreBase.register
+class DistKVStore(KVStore):
+    """'dist_sync'/'dist_device_sync'/'dist_async' over jax.distributed.
+
+    Multi-host: every host pushes its local gradient; aggregation is an ICI/DCN
+    all-reduce executed in-program by the sharded trainer. This class carries
+    rank/num_workers plumbing (ref src/kvstore/kvstore_dist.h:44).
+    """
+
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        import jax
+        self._rank = jax.process_index() if jax.process_count() > 1 else 0
+        self._num_workers = jax.process_count()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+
+def create(name="local"):
+    """ref python/mxnet/kvstore/kvstore.py create / src/kvstore/kvstore.cc Create."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name.startswith("dist"):
+        return DistKVStore(name)
+    return LocalKVStore(name)

@@ -1,0 +1,400 @@
+"""Evaluation metrics (ref python/mxnet/metric.py:67 EvalMetric + ~20 metrics)."""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from .base import registry
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC",
+           "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+           "PearsonCorrelation", "Loss", "Torch", "Caffe", "CustomMetric", "np", "create"]
+
+_REG = registry("metric")
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    if not shape:
+        lshape, pshape = len(labels), len(preds)
+    else:
+        lshape, pshape = labels.shape, preds.shape
+    if lshape != pshape:
+        raise ValueError("Shape of labels %s does not match shape of predictions %s"
+                         % (lshape, pshape))
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric:
+    """Base metric with global + per-batch accumulators (ref metric.py:67)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[n] for n in self.output_names if n in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[n] for n in self.label_names if n in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.global_sum_metric / self.global_num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def _add(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kw):
+        super().__init__(name, **kw)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+        super().reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+def register(klass):
+    return _REG.register(klass)
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kw):
+        super().__init__(name, axis=axis, **kw)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label)
+            if p.ndim > l.ndim:
+                p = p.argmax(axis=self.axis)
+            p = p.astype("int32").flatten()
+            l = l.astype("int32").flatten()
+            check_label_shapes(l, p, shape=True)
+            self._add(float((p == l).sum()), len(p))
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kw):
+        super().__init__(name + "_%d" % top_k, top_k=top_k, **kw)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label).astype("int32")
+            idx = onp.argpartition(p, -self.top_k, axis=-1)[..., -self.top_k:]
+            hit = (idx == l[..., None]).any(axis=-1)
+            self._add(float(hit.sum()), hit.size)
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kw):
+        super().__init__(name, **kw)
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0.0
+        super().reset()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label).astype("int32").flatten()
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(axis=-1)
+            else:
+                p = (p.flatten() > 0.5).astype("int32")
+            p = p.astype("int32").flatten()
+            self._tp += float(((p == 1) & (l == 1)).sum())
+            self._fp += float(((p == 1) & (l == 0)).sum())
+            self._fn += float(((p == 0) & (l == 1)).sum())
+            prec = self._tp / (self._tp + self._fp) if self._tp + self._fp else 0.0
+            rec = self._tp / (self._tp + self._fn) if self._tp + self._fn else 0.0
+            f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+            self.sum_metric = f1
+            self.num_inst = 1
+            self.global_sum_metric = f1
+            self.global_num_inst = 1
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient."""
+
+    def __init__(self, name="mcc", **kw):
+        super().__init__(name, **kw)
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def reset(self):
+        self._tp = self._fp = self._fn = self._tn = 0.0
+        super().reset()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label).astype("int32").flatten()
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(axis=-1)
+            else:
+                p = (p.flatten() > 0.5)
+            p = p.astype("int32").flatten()
+            self._tp += float(((p == 1) & (l == 1)).sum())
+            self._fp += float(((p == 1) & (l == 0)).sum())
+            self._fn += float(((p == 0) & (l == 1)).sum())
+            self._tn += float(((p == 0) & (l == 0)).sum())
+            num = self._tp * self._tn - self._fp * self._fn
+            den = math.sqrt((self._tp + self._fp) * (self._tp + self._fn) *
+                            (self._tn + self._fp) * (self._tn + self._fn))
+            mcc = num / den if den else 0.0
+            self.sum_metric = mcc
+            self.num_inst = 1
+            self.global_sum_metric = mcc
+            self.global_num_inst = 1
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kw):
+        super().__init__(name, **kw)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label).astype("int32")
+            l = l.flatten()
+            p = p.reshape(-1, p.shape[-1])
+            probs = p[onp.arange(len(l)), l]
+            if self.ignore_label is not None:
+                ignore = (l == self.ignore_label)
+                probs = onp.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= float(onp.log(onp.maximum(probs, 1e-10)).sum())
+            num += len(l)
+        self._add(loss, num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label)
+            if l.ndim == 1:
+                l = l.reshape(l.shape[0], 1) if p.ndim != 1 else l
+            self._add(float(onp.abs(l - p).mean()), 1)
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred), _as_np(label)
+            if l.ndim == 1 and p.ndim != 1:
+                l = l.reshape(l.shape[0], 1)
+            self._add(float(((l - p) ** 2).mean()), 1)
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kw):
+        EvalMetric.__init__(self, name, **kw)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kw):
+        super().__init__(name, **kw)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l = _as_np(label).astype("int32").ravel()
+            p = _as_np(pred)
+            p = p.reshape(-1, p.shape[-1])
+            prob = p[onp.arange(l.shape[0]), l]
+            self._add(float((-onp.log(prob + self.eps)).sum()), l.shape[0])
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kw):
+        EvalMetric.__init__(self, name, **kw)
+        self.eps = eps
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p, l = _as_np(pred).ravel(), _as_np(label).ravel()
+            r = onp.corrcoef(p, l)[0, 1]
+            self._add(float(r), 1)
+
+
+@register
+class Loss(EvalMetric):
+    """Dummy metric reporting the mean of predictions (ref metric.py Loss)."""
+
+    def __init__(self, name="loss", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            p = _as_np(pred)
+            self._add(float(p.sum()), p.size)
+
+
+@register
+class Torch(Loss):
+    def __init__(self, name="torch", **kw):
+        EvalMetric.__init__(self, name, **kw)
+
+
+@register
+class Caffe(Loss):
+    def __init__(self, name="caffe", **kw):
+        EvalMetric.__init__(self, name, **kw)
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False, **kw):
+        name = name if name is not None else getattr(feval, "__name__", "custom")
+        super().__init__("custom(%s)" % name, **kw)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l, p = _as_np(label), _as_np(pred)
+            reval = self._feval(l, p)
+            if isinstance(reval, tuple):
+                m, n = reval
+                self._add(m, n)
+            else:
+                self._add(reval, 1)
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = getattr(numpy_feval, "__name__", "custom")
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    return _REG.create(metric, *args, **kwargs)

@@ -1,0 +1,21 @@
+"""nd namespace — eager ops on NDArray (ref python/mxnet/ndarray/__init__.py)."""
+from .ndarray import *  # noqa
+from .ndarray import NDArray, _apply, _to_nd, _np_dtype  # noqa
+from . import random  # noqa
+from . import linalg  # noqa
+from .ndarray import sum, max, min, mean, prod, sort, argsort, topk, norm, clip  # noqa
+from .ndarray import (  # noqa
+    reshape, reshape_like, flatten, transpose, swapaxes, expand_dims, squeeze,
+    broadcast_to, broadcast_like, broadcast_axis, tile, repeat, pad, flip, reverse,
+    split, slice_axis, slice_like, take, pick, one_hot, gather_nd, scatter_nd,
+    where, cast, amp_cast, amp_multicast, diag, shuffle, identity, moments,
+    zeros_like, ones_like, argmax, argmin,
+    FullyConnected, Convolution, Deconvolution, Activation, LeakyReLU,
+    softmax, log_softmax, softmin, SoftmaxActivation, SoftmaxOutput, Pooling,
+    Dropout, BatchNorm, LayerNorm, GroupNorm, InstanceNorm, L2Normalization, LRN,
+    UpSampling, BilinearResize2D, sequence_mask, SequenceMask, SequenceLast,
+    SequenceReverse, make_loss, BlockGrad, stop_gradient, Embedding, CTCLoss,
+    ctc_loss, save, load, Cast, Concat, SliceChannel, SwapAxis,
+    elemwise_add, elemwise_sub, elemwise_mul, elemwise_div,
+)
+from .ndarray import slice_op as slice  # noqa  (MXNet nd.slice)

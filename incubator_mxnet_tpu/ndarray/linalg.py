@@ -1,0 +1,111 @@
+"""Linear-algebra ops (ref src/operator/tensor/la_op.cc — potrf/gemm/trsm/...)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .ndarray import _apply
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk", "gelqf",
+           "sumlogdiag", "extractdiag", "makediag", "inverse", "det", "slogdet", "svd"]
+
+
+def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False, axis=-2):
+    def fn(a, b, c):
+        aa = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        bb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return alpha * jnp.matmul(aa, bb) + beta * c
+    return _apply(fn, A, B, C)
+
+
+def gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False, axis=-2):
+    def fn(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        bb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return alpha * jnp.matmul(aa, bb)
+    return _apply(fn, A, B)
+
+
+def potrf(A, lower=True):
+    return _apply(lambda a: jnp.linalg.cholesky(a) if lower
+                  else jnp.swapaxes(jnp.linalg.cholesky(a), -1, -2), A)
+
+
+def potri(A, lower=True):
+    def fn(a):
+        inv = jnp.linalg.inv(jnp.matmul(a, jnp.swapaxes(a, -1, -2)) if not lower
+                             else jnp.matmul(a, jnp.swapaxes(a, -1, -2)))
+        return inv
+    return _apply(fn, A)
+
+
+def trsm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True):
+    def fn(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        if rightside:
+            x = jnp.swapaxes(jsl.solve_triangular(
+                jnp.swapaxes(aa, -1, -2), jnp.swapaxes(b, -1, -2),
+                lower=not lower if transpose else lower), -1, -2)
+        else:
+            x = jsl.solve_triangular(aa, b, lower=not lower if transpose else lower)
+        return alpha * x
+    return _apply(fn, A, B)
+
+
+def trmm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True):
+    def fn(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        return alpha * (jnp.matmul(b, aa) if rightside else jnp.matmul(aa, b))
+    return _apply(fn, A, B)
+
+
+def syrk(A, alpha=1.0, transpose=False):
+    def fn(a):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        return alpha * jnp.matmul(aa, jnp.swapaxes(aa, -1, -2))
+    return _apply(fn, A)
+
+
+def gelqf(A):
+    def fn(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return _apply(fn, A)
+
+
+def sumlogdiag(A):
+    return _apply(lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1), A)
+
+
+def extractdiag(A, offset=0):
+    return _apply(lambda a: jnp.diagonal(a, offset, axis1=-2, axis2=-1), A)
+
+
+def makediag(A, offset=0):
+    return _apply(lambda a: _mkdiag(a, offset), A)
+
+
+def _mkdiag(a, offset):
+    import jax
+    n = a.shape[-1] + abs(offset)
+    if a.ndim == 1:
+        return jnp.diag(a, k=offset)
+    flat = a.reshape((-1, a.shape[-1]))
+    out = jax.vmap(lambda v: jnp.diag(v, k=offset))(flat)
+    return out.reshape(a.shape[:-1] + (n, n))
+
+
+def inverse(A):
+    return _apply(jnp.linalg.inv, A)
+
+
+def det(A):
+    return _apply(jnp.linalg.det, A)
+
+
+def slogdet(A):
+    return _apply(lambda a: tuple(jnp.linalg.slogdet(a)), A)
+
+
+def svd(A):
+    return _apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=False)), A)

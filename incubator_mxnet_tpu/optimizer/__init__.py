@@ -1,0 +1,3 @@
+"""Optimizer API (ref python/mxnet/optimizer/__init__.py)."""
+from .optimizer import *  # noqa
+from .optimizer import Optimizer, create, register, Updater, get_updater  # noqa

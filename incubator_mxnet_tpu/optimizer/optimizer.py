@@ -1,0 +1,554 @@
+"""Optimizers (ref python/mxnet/optimizer/optimizer.py + src/operator/optimizer_op.cc).
+
+Reference parity: the 17-optimizer registry, ``create_state``,
+``update_multi_precision`` (fp32 master weights for low-precision params),
+lr/wd multipliers, rescale_grad and clip_gradient.
+
+TPU-native design: each update rule is a pure JAX function; the eager path
+applies it per-parameter (XLA-compiled, cached), while the jitted train-step
+path (gluon.Trainer hybridized / module fast path) fuses ALL parameter updates
+into the single compiled step program with donated buffers — the analog of the
+reference's fused ``multi_sgd``-style update-as-op design.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import registry
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad", "AdaDelta",
+           "Adamax", "Nadam", "Ftrl", "FTML", "LAMB", "LARS", "Signum", "SGLD", "DCASGD",
+           "Test", "create", "register", "Updater", "get_updater"]
+
+_REG = registry("optimizer")
+
+
+def register(klass):
+    return _REG.register(klass)
+
+
+class Optimizer:
+    """Base optimizer (ref optimizer.py:29)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0, clip_gradient=None,
+                 learning_rate=0.01, lr_scheduler=None, sym=None, begin_num_update=0,
+                 multi_precision=False, param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self._states = {}
+
+    # -- registry ------------------------------------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _REG.create(name, **kwargs)
+
+    # -- state ---------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (jnp.bfloat16, onp.float16):
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- schedules -----------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler is not None else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_gamma_beta = n.endswith(("_gamma", "_beta", "gamma", "beta"))
+            if n.endswith("_bias") or n.endswith("bias") or is_gamma_beta:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("lr_scheduler is set; cannot set learning rate directly")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    # -- the update rule (pure function; override in subclasses) -------
+    def update_rule(self, weight, grad, state, lr, wd, t):
+        """Pure: (w, g, state, lr, wd, step) -> (new_w, new_state)."""
+        raise NotImplementedError
+
+    def _preprocess_grad(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    # -- eager entry points (kvstore/Trainer call these) ---------------
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad._data).astype(jnp.float32)
+        w = weight._data
+        new_w, new_state = self.update_rule(w.astype(jnp.float32), g, state, lr, wd, t)
+        weight._data = new_w.astype(w.dtype)
+        return new_state
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """fp32 master-weight update for bf16/fp16 params (ref optimizer.py:320)."""
+        if self.multi_precision and weight.dtype in (jnp.bfloat16, onp.float16):
+            master, inner = state
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            t = self._index_update_count[index]
+            g = self._preprocess_grad(grad._data).astype(jnp.float32)
+            new_master, new_inner = self.update_rule(master._data, g, inner, lr, wd, t)
+            master._data = new_master
+            weight._data = new_master.astype(weight.dtype)
+            return (master, new_inner)
+        return self.update(index, weight, grad, state)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        return w + g * self.rescale_grad, state
+
+
+@register
+class SGD(Optimizer):
+    """SGD w/ momentum (ref src/operator/optimizer_op.cc sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, jnp.float32))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if state is None:
+            return w - lr * g, None
+        mom = self.momentum * state._data - lr * g
+        state._data = mom
+        return w + mom, state
+
+
+@register
+class NAG(SGD):
+    """Nesterov (ref optimizer.py NAG / nag_mom_update)."""
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if state is None:
+            return w - lr * g, None
+        mom = self.momentum * state._data + g
+        state._data = mom
+        return w - lr * (g + self.momentum * mom), state
+
+
+@register
+class Signum(Optimizer):
+    """signSGD w/ momentum (ref optimizer.py Signum / signum_update)."""
+
+    def __init__(self, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, jnp.float32))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        if state is None:
+            return w * (1 - lr * self.wd_lh) - lr * jnp.sign(g + wd * w), None
+        mom = self.momentum * state._data - (1 - self.momentum) * (g + wd * w)
+        state._data = mom
+        return w * (1 - lr * self.wd_lh) + lr * jnp.sign(mom), state
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref optimizer.py SGLD)."""
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        from ..ndarray import random as _rnd
+        noise = jax.random.normal(_rnd._next_key(), w.shape, w.dtype) * math.sqrt(lr)
+        return w - lr / 2 * (g + wd * w) + noise, state
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.array(weight._data, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        mom, prev_w = state
+        m = self.momentum * mom._data - lr * (
+            g + wd * w + self.lamda * g * g * (w - prev_w._data))
+        mom._data = m
+        prev_w._data = w + m
+        return w + m, state
+
+
+@register
+class Adam(Optimizer):
+    """ref optimizer.py Adam / adam_update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.zeros(weight.shape, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        return w - lr_t * m._data / (jnp.sqrt(v._data) + self.epsilon), state
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (GluonNLP-style bertadam/adamw)."""
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        return w - lr_t * (m._data / (jnp.sqrt(v._data) + self.epsilon) + wd * w), state
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.zeros(weight.shape, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        m, u = state
+        g = g + wd * w
+        lr_t = lr / (1 - self.beta1 ** t)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        return w - lr_t * m._data / (u._data + 1e-8), state
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.zeros(weight.shape, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_sched_next = self.m_schedule * momentum_t1
+        g_prime = g / (1 - self.m_schedule)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        m_prime = m._data / (1 - m_sched_next)
+        v_prime = v._data / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
+        return w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon), state
+
+
+@register
+class RMSProp(Optimizer):
+    """ref optimizer.py RMSProp (centered variant = Graves)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.centered:
+            n, gm, delta = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            gm._data = (1 - self.gamma1) * g + self.gamma1 * gm._data
+            delta._data = self.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n._data - gm._data * gm._data + self.epsilon)
+            w = w + delta._data
+        else:
+            (n,) = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            w = w - lr * g / jnp.sqrt(n._data + self.epsilon)
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return w, state
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, jnp.float32))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        state._data = state._data + g * g
+        return w - lr * g / jnp.sqrt(state._data + self.float_stable_eps), state
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.zeros(weight.shape, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        acc_g, acc_delta = state
+        g = g + wd * w
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * delta * delta
+        return w - delta, state
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.zeros(weight.shape, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        z, n = state
+        sigma = (jnp.sqrt(n._data + g * g) - jnp.sqrt(n._data)) / lr
+        z._data = z._data + g - sigma * w
+        n._data = n._data + g * g
+        new_w = (jnp.sign(z._data) * self.lamda1 - z._data) / (
+            (self.beta + jnp.sqrt(n._data)) / lr + wd) * (jnp.abs(z._data) > self.lamda1)
+        return new_w, state
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))
+        return (z(), z(), z())
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        d, v, z = state
+        g = g + wd * w
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v._data / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._data
+        z._data = self.beta1 * z._data + (1 - self.beta1) * g - sigma * w
+        d._data = d_t
+        return -z._data / d_t, state
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (ref optimizer.py LAMB / lamb_update_phase1/2)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                NDArray(jnp.zeros(weight.shape, jnp.float32)))
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        mh, vh = m._data, v._data
+        if self.bias_correction:
+            mh = mh / (1 - self.beta1 ** t)
+            vh = vh / (1 - self.beta2 ** t)
+        r = mh / (jnp.sqrt(vh) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        if self.lower_bound:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return w - lr * ratio * r, state
+
+
+@register
+class LARS(SGD):
+    """Layer-wise adaptive rate scaling (ref optimizer.py LARS)."""
+
+    def __init__(self, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.eta, self.epsilon = eta, epsilon
+
+    def update_rule(self, w, g, state, lr, wd, t):
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                          self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        return super().update_rule(w, g * trust, state, lr, wd, t)
+
+
+def create(name, **kwargs):
+    return _REG.create(name, **kwargs)
+
+
+class Updater:
+    """KVStore server-side updater (ref python/mxnet/optimizer/updater.py)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.states[index] = self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index]) or self.states[index]
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        st = {k: _state_to_np(v) for k, v in self.states.items()}
+        return pickle.dumps((st, self.optimizer.__class__.__name__)
+                            if dump_optimizer else st)
+
+    def set_states(self, states):
+        import pickle
+        st = pickle.loads(states)
+        if isinstance(st, tuple):
+            st = st[0]
+        self.states = {k: _state_from_np(v) for k, v in st.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def _state_to_np(s):
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s.asnumpy()
+    if isinstance(s, (tuple, list)):
+        return tuple(_state_to_np(x) for x in s)
+    return s
+
+
+def _state_from_np(s):
+    if s is None:
+        return None
+    if isinstance(s, onp.ndarray):
+        return nd.array(s)
+    if isinstance(s, tuple):
+        return tuple(_state_from_np(x) for x in s)
+    return s
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
