@@ -1,0 +1,214 @@
+"""Fused training step — whole-step compilation with donated buffers.
+
+This is the TPU-native analog of the reference's hot path: GraphExecutor op
+bulking (src/executor/graph_executor.cc:1368 BulkOpSegs + :1449 bulk segments)
+plus optimizer-as-op (src/operator/optimizer_op.cc multi_sgd): ONE XLA program
+computes forward, backward, and every parameter/optimizer-state update, with
+input buffers donated so updates are in-place on device (kWriteInplace analog).
+
+Usage::
+
+    step = TrainStep(net, loss_fn, trainer)
+    loss = step(x, y)          # one compiled step; params/state updated
+
+Data-parallel over a mesh: see parallel.DataParallelTrainStep, which shards
+the batch axis of this same program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .gluon import _functional
+from .ndarray import NDArray
+from .ndarray import random as _rnd
+
+__all__ = ["TrainStep", "EvalStep"]
+
+
+def _tree_to_data(state):
+    """Nested optimizer state (NDArrays in tuples) -> pytree of jax arrays."""
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state._data
+    if isinstance(state, (tuple, list)):
+        return tuple(_tree_to_data(s) for s in state)
+    return state
+
+
+def _tree_wrap(data):
+    """pytree of jax arrays -> nested NDArrays (fresh wrappers)."""
+    if data is None:
+        return None
+    if isinstance(data, (tuple, list)):
+        return tuple(_tree_wrap(d) for d in data)
+    return NDArray(data)
+
+
+class TrainStep:
+    """Compile net forward + loss + backward + optimizer update into one program."""
+
+    def __init__(self, net, loss_fn, trainer, batch_axis=0, grad_postprocess=None):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.trainer = trainer
+        self._grad_postprocess = grad_postprocess
+        self._cache = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _split_params(self):
+        params = list(self.net.collect_params().values())
+        trainable = [p for p in params if p.grad_req != "null"]
+        frozen = [p for p in params if p.grad_req == "null"]
+        return trainable, frozen
+
+    def _build(self, meta, n_inputs):
+        trainable, frozen = self._split_params()
+        t_arrs = [p.data() for p in trainable]
+        f_arrs = [p.data() for p in frozen]
+        net, loss_fn = self.net, self.loss_fn
+        optimizer = self.trainer._optimizer
+        aux_box = []
+
+        def inner(t_datas, f_datas, input_datas, key):
+            saved_t = [a._data for a in t_arrs]
+            saved_f = [a._data for a in f_arrs]
+            for a, d in zip(t_arrs, t_datas):
+                a._data = d
+            for a, d in zip(f_arrs, f_datas):
+                a._data = d
+            try:
+                with _functional.FunctionalScope(key) as st:
+                    with autograd.pause(train_mode=True):
+                        nd_inputs = [NDArray(d) for d in input_datas]
+                        # bypass hybridize's own cache: trace the eager forward
+                        out = net.forward(*nd_inputs[:n_inputs])
+                        outs = out if isinstance(out, (list, tuple)) else (out,)
+                        loss = loss_fn.forward(outs[0] if len(outs) == 1 else outs,
+                                               *nd_inputs[n_inputs:])
+                    # seed-of-ones semantics: grads of the SUM; Trainer's
+                    # rescale_grad (1/batch) then normalises — matches eager
+                    loss_scalar = loss._data.sum()
+                    aux_pairs = list(st.aux_updates)
+            finally:
+                for a, s in zip(t_arrs, saved_t):
+                    a._data = s
+                for a, s in zip(f_arrs, saved_f):
+                    a._data = s
+            aux_box[:] = [a for a, _ in aux_pairs]
+            return loss_scalar, (loss._data, [v for _, v in aux_pairs])
+
+        def step_fn(t_datas, f_datas, opt_states, input_datas, key, lrs, wds, t,
+                    rescale):
+            (loss_scalar, (loss_full, aux_vals)), grads = jax.value_and_grad(
+                inner, argnums=0, has_aux=True)(t_datas, f_datas, input_datas, key)
+            if self._grad_postprocess is not None:
+                grads = self._grad_postprocess(grads)
+            new_t, new_opt = [], []
+            for i, (w, g, s) in enumerate(zip(t_datas, grads, opt_states)):
+                g = g * rescale
+                if optimizer.clip_gradient is not None:
+                    g = jnp.clip(g, -optimizer.clip_gradient, optimizer.clip_gradient)
+                state_nd = _tree_wrap(s)
+                wf = w.astype(jnp.float32)
+                gf = g.astype(jnp.float32)
+                new_w, new_state_nd = optimizer.update_rule(wf, gf, state_nd,
+                                                            lrs[i], wds[i], t)
+                new_t.append(new_w.astype(w.dtype))
+                new_opt.append(_tree_to_data(new_state_nd))
+            return loss_full, new_t, new_opt, aux_vals
+
+        jitted = jax.jit(step_fn, donate_argnums=(0, 2))
+        return jitted, trainable, frozen, t_arrs, f_arrs, aux_box
+
+    # ------------------------------------------------------------------
+    def __call__(self, *inputs, batch_size=None, n_net_inputs=1):
+        """inputs = (*net_inputs, *loss_extra_args); returns per-sample loss."""
+        arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in inputs]
+        if batch_size is None:
+            batch_size = arrs[0].shape[0]
+        trainer = self.trainer
+        # trigger any deferred parameter init with one eager forward
+        if any(p._data is None for p in self.net.collect_params().values()):
+            with autograd.pause(train_mode=True):
+                self.net.forward(*arrs[:n_net_inputs])
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        if not trainer._states_initialized:
+            trainer._init_states()
+
+        meta = (n_net_inputs, tuple((a.shape, str(a.dtype)) for a in arrs))
+        if meta not in self._cache:
+            self._cache[meta] = self._build(meta, n_net_inputs)
+        jitted, trainable, frozen, t_arrs, f_arrs, aux_box = self._cache[meta]
+
+        optimizer = trainer._optimizer
+        # python-side schedule state (lr scheduler, update counts) advances here
+        self._step_count += 1
+        lrs, wds = [], []
+        for i, p in enumerate(trainable):
+            idx = trainer._param2idx.get(p.name, i)
+            optimizer._update_count(idx)
+            lrs.append(optimizer._get_lr(idx))
+            wds.append(optimizer._get_wd(idx))
+        t = self._step_count
+        rescale = optimizer.rescale_grad / batch_size
+
+        opt_states = []
+        for i, p in enumerate(trainable):
+            idx = trainer._param2idx.get(p.name, i)
+            opt_states.append(_tree_to_data(trainer._states[idx]))
+
+        key = _rnd._next_key()
+        loss_full, new_t, new_opt, aux_vals = jitted(
+            [a._data for a in t_arrs], [a._data for a in f_arrs], opt_states,
+            [a._data for a in arrs], key,
+            jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
+            jnp.asarray(t, jnp.int32), jnp.asarray(rescale, jnp.float32))
+
+        for a, d in zip(t_arrs, new_t):
+            a._data = d
+        for i, p in enumerate(trainable):
+            idx = trainer._param2idx.get(p.name, i)
+            trainer._states[idx] = _rewrap_state(trainer._states[idx], new_opt[i])
+        for a, v in zip(aux_box, aux_vals):
+            a._data = v
+        return NDArray(loss_full)
+
+
+def _rewrap_state(old, new_data):
+    """Write new jax arrays back into the existing NDArray state structure."""
+    if old is None:
+        return None
+    if isinstance(old, NDArray):
+        old._data = new_data
+        return old
+    if isinstance(old, (tuple, list)):
+        return tuple(_rewrap_state(o, n) for o, n in zip(old, new_data))
+    return new_data
+
+
+class EvalStep:
+    """Compiled inference step (train_mode=False): net(*inputs) in one program."""
+
+    def __init__(self, net):
+        self.net = net
+        self._cache = {}
+
+    def __call__(self, *inputs):
+        arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in inputs]
+        meta = tuple((a.shape, str(a.dtype)) for a in arrs)
+        if meta not in self._cache:
+            params, param_arrs, pure_fn, aux_box = _functional.make_pure_fn(
+                self.net, train_mode=False)
+            jitted = jax.jit(pure_fn)
+            self._cache[meta] = (jitted, param_arrs)
+        jitted, param_arrs = self._cache[meta]
+        key = jax.random.PRNGKey(0)
+        out_datas, _aux = jitted([a._data for a in param_arrs],
+                                 [a._data for a in arrs], key)
+        outs = [NDArray(o) for o in out_datas]
+        return outs[0] if len(outs) == 1 else tuple(outs)

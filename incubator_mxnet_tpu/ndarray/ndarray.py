@@ -511,7 +511,7 @@ def waitall():
 _UNARY = {
     "abs": jnp.abs, "sign": jnp.sign, "round": jnp.round, "rint": jnp.rint,
     "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
-    "fix": jnp.fix, "square": jnp.square, "sqrt": jnp.sqrt,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
     "rsqrt": lambda x: lax.rsqrt(x), "cbrt": jnp.cbrt,
     "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
     "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
@@ -582,6 +582,9 @@ broadcast_div = _g["broadcast_divide"]
 broadcast_mod = _g["broadcast_modulo"]
 broadcast_plus = _g["broadcast_add"]
 broadcast_minus = _g["broadcast_subtract"]
+__all__ += ["broadcast_sub", "broadcast_mul", "broadcast_div", "broadcast_mod",
+            "broadcast_plus", "broadcast_minus", "mod",
+            "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div"]
 elemwise_add = _g["add"]
 elemwise_sub = _g["subtract"]
 elemwise_mul = _g["multiply"]
@@ -1001,18 +1004,34 @@ def Convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
 def Deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 1),
                   pad=(0, 0), adj=(0, 0), num_filter=None, num_group=1, no_bias=False,
                   target_shape=None, **kw):
-    """ref src/operator/nn/deconvolution-inl.h — transposed conv via lax."""
+    """ref src/operator/nn/deconvolution-inl.h — transposed conv expressed as
+    the gradient-of-conv: input dilation by stride + flipped kernel, which XLA
+    lowers to the same MXU conv kernels as the forward pass."""
     n = len(kernel)
-    stride = tuple(stride)[:n] or (1,) * n
-    pad_ = tuple(pad)[:n] or (0,) * n
+    stride = tuple(stride)[:n] if stride else (1,) * n
+    if len(stride) < n:
+        stride = stride + (1,) * (n - len(stride))
+    dilate = tuple(dilate)[:n] if dilate else (1,) * n
+    if len(dilate) < n:
+        dilate = dilate + (1,) * (n - len(dilate))
+    pad_ = tuple(pad)[:n] if pad else (0,) * n
+    if len(pad_) < n:
+        pad_ = pad_ + (0,) * (n - len(pad_))
+    adj_ = tuple(adj)[:n] if adj else (0,) * n
+    if len(adj_) < n:
+        adj_ = adj_ + (0,) * (n - len(adj_))
     spatial = "".join("DHW"[3 - n:][i] for i in range(n))
     dn_str = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
 
     def conv_t(x, w):
         dn = lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
-        return lax.conv_transpose(
-            x, w, strides=stride, padding=[(p, p) for p in pad_],
-            dimension_numbers=dn_str, transpose_kernel=True)
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        pads = [(d * (k - 1) - p, d * (k - 1) - p + a)
+                for k, p, a, d in zip(kernel, pad_, adj_, dilate)]
+        return lax.conv_general_dilated(
+            x, w_flip, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=num_group)
 
     def fn(x, w, *maybe_b):
         y = conv_t(x, w)

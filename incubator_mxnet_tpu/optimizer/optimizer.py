@@ -229,7 +229,7 @@ class SGLD(Optimizer):
 
     def update_rule(self, w, g, state, lr, wd, t):
         from ..ndarray import random as _rnd
-        noise = jax.random.normal(_rnd._next_key(), w.shape, w.dtype) * math.sqrt(lr)
+        noise = jax.random.normal(_rnd._next_key(), w.shape, w.dtype) * jnp.sqrt(lr)
         return w - lr / 2 * (g + wd * w) + noise, state
 
 
@@ -270,7 +270,7 @@ class Adam(Optimizer):
     def update_rule(self, w, g, state, lr, wd, t):
         m, v = state
         g = g + wd * w
-        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        lr_t = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
         m._data = self.beta1 * m._data + (1 - self.beta1) * g
         v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
         return w - lr_t * m._data / (jnp.sqrt(v._data) + self.epsilon), state
@@ -282,7 +282,7 @@ class AdamW(Adam):
 
     def update_rule(self, w, g, state, lr, wd, t):
         m, v = state
-        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        lr_t = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
         m._data = self.beta1 * m._data + (1 - self.beta1) * g
         v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
         return w - lr_t * (m._data / (jnp.sqrt(v._data) + self.epsilon) + wd * w), state

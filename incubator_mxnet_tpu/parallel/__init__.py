@@ -1,0 +1,18 @@
+"""Parallelism over TPU meshes (SURVEY §2.5 — the kvstore/NCCL/ps-lite stack
+re-expressed as SPMD sharding + XLA collectives over ICI/DCN).
+
+- mesh:              device mesh construction (dp/tp/pp/sp/ep axes)
+- data_parallel:     sharded fused train step (≙ dist_device_sync kvstore)
+- tensor_parallel:   row/col-sharded layers (NEW capability vs reference)
+- ring_attention:    sequence/context parallelism over the ring (NEW)
+- pipeline:          GPipe-style microbatch pipeline parallelism (NEW)
+- moe:               expert parallel mixture-of-experts (NEW)
+- compression:       2-bit gradient compression analog (ref gradient_compression.h)
+"""
+from .mesh import make_mesh, current_mesh, set_current_mesh, replicated, shard_spec  # noqa
+from .data_parallel import DataParallelTrainStep  # noqa
+from .tensor_parallel import ColParallelDense, RowParallelDense, shard_params  # noqa
+from .ring_attention import ring_attention, local_attention  # noqa
+from .pipeline import PipelineParallel  # noqa
+from .moe import MoELayer  # noqa
+from .compression import GradientCompression  # noqa

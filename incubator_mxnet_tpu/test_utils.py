@@ -1,0 +1,157 @@
+"""Test utilities shipped with the package (ref python/mxnet/test_utils.py, 2,599 LoC).
+
+Reference parity: assert_almost_equal, check_numeric_gradient (finite
+differences vs autograd), check_consistency (cross-device/dtype), rand_ndarray,
+default_context switching — the fixtures the whole reference test suite reuses.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import autograd, context as ctx_mod
+from . import ndarray as nd
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
+           "rand_shape_nd", "check_numeric_gradient", "check_consistency",
+           "numeric_grad", "simple_forward", "same", "random_seed"]
+
+_default_ctx = [None]
+
+
+def default_context():
+    return _default_ctx[0] if _default_ctx[0] is not None else current_context()
+
+
+def set_default_context(ctx):
+    _default_ctx[0] = ctx
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def same(a, b):
+    return onp.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return onp.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"), equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    if not onp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        index = onp.unravel_index(onp.argmax(onp.abs(a - b)), a.shape) if a.shape else ()
+        rel = onp.abs(a - b) / (onp.abs(b) + atol + 1e-30)
+        raise AssertionError(
+            "Error %f exceeds tolerance rtol=%g atol=%g. Worst at %s: %s vs %s"
+            % (float(rel.max()) if rel.size else 0.0, rtol, atol, index,
+               a[index] if a.shape else a, b[index] if b.shape else b))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None,
+                 scale=1.0):
+    if stype != "default":
+        raise NotImplementedError("dense-only TPU build")
+    return nd.array(onp.random.uniform(-scale, scale, size=shape).astype(dtype), ctx=ctx)
+
+
+def simple_forward(sym_or_fn, ctx=None, is_train=False, **inputs):
+    outs = sym_or_fn(**{k: nd.array(v) for k, v in inputs.items()})
+    if isinstance(outs, (list, tuple)):
+        return [o.asnumpy() for o in outs]
+    return outs.asnumpy()
+
+
+def numeric_grad(f, xs, eps=1e-4):
+    """Central finite differences of scalar-valued f over list of np arrays."""
+    grads = []
+    for i, x in enumerate(xs):
+        g = onp.zeros_like(x, dtype=onp.float64)
+        flat = x.reshape(-1)
+        gf = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(f(xs))
+            flat[j] = orig - eps
+            fm = float(f(xs))
+            flat[j] = orig
+            gf[j] = (fp - fm) / (2 * eps)
+        grads.append(g.astype(x.dtype))
+    return grads
+
+
+def check_numeric_gradient(fn, inputs, rtol=1e-2, atol=1e-3, eps=1e-3):
+    """Finite differences vs autograd (ref test_utils.py check_numeric_gradient).
+
+    fn: callable taking NDArrays, returning one NDArray (summed to scalar).
+    inputs: list of numpy arrays (float32/float64).
+    """
+    xs = [onp.asarray(x, dtype=onp.float64) for x in inputs]
+
+    def f(arrs):
+        vals = [nd.array(a.astype(onp.float32)) for a in arrs]
+        return fn(*vals).sum().asscalar()
+
+    expected = numeric_grad(f, xs, eps)
+
+    arrs = [nd.array(x.astype(onp.float32)) for x in xs]
+    for a in arrs:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*arrs).sum()
+    out.backward()
+    for a, e in zip(arrs, expected):
+        assert_almost_equal(a.grad.asnumpy(), e.astype(onp.float32), rtol=rtol, atol=atol)
+
+
+def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",), rtol=1e-3,
+                      atol=1e-4):
+    """Run fn under several contexts/dtypes and compare outputs
+    (ref test_utils.py check_consistency — the de-facto cross-backend check)."""
+    if ctx_list is None:
+        ctx_list = [cpu(0), default_context()]
+    results = []
+    for ctx in ctx_list:
+        for dt in dtypes:
+            with ctx:
+                arrs = [nd.array(onp.asarray(x, dtype=dt), ctx=ctx) for x in inputs]
+                results.append(fn(*arrs).asnumpy().astype("float32"))
+    base = results[0]
+    for r in results[1:]:
+        assert_almost_equal(r, base, rtol=rtol, atol=atol)
+
+
+class random_seed:
+    """Context manager fixing framework + numpy seeds (ref common.py with_seed)."""
+
+    def __init__(self, seed=None):
+        self.seed = seed
+
+    def __enter__(self):
+        self._np_state = onp.random.get_state()
+        s = self.seed if self.seed is not None else onp.random.randint(0, 2 ** 31)
+        onp.random.seed(s)
+        nd.random.seed(s)
+        return s
+
+    def __exit__(self, *a):
+        onp.random.set_state(self._np_state)

@@ -1,0 +1,150 @@
+"""Autograd tests (ref tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * y).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * onp.exp(2 * x.asnumpy()), rtol=1e-4, atol=1e-4)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, [30.0, 60.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    grad = nd.zeros((2,))
+    autograd.mark_variables([x], [grad], "add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(grad, [6.0, 6.0])
+
+
+def test_detach_and_pause():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach()
+        w = z * x
+    w.backward()
+    assert_almost_equal(x.grad, [4.0])  # z treated as constant
+
+    with autograd.record():
+        with autograd.pause():
+            c = x * 5
+        out = c * x
+    out.backward()
+    assert_almost_equal(x.grad, [10.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    g = autograd.grad(y, x, retain_graph=False)
+    assert_almost_equal(g, 3 * x.asnumpy() ** 2, rtol=1e-4, atol=1e-4)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, [4.0])
+    y.backward()
+    assert_almost_equal(x.grad, [4.0])
+
+
+def test_multiple_inputs_outputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        s = (a * b).sum() + (a + b).sum()
+    s.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy() + 1)
+
+
+def test_inplace_safety():
+    # in-place modification after recording must not corrupt the vjp replay
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    x += 100  # rebinds data; tape snapshot must keep the original
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 4.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.5, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4, atol=1e-5)
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 2) * x
+    y.backward()
+    assert_almost_equal(x.grad, [6.0])

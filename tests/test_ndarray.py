@@ -1,0 +1,229 @@
+"""NDArray tests (ref tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    assert nd.zeros((2, 3)).shape == (2, 3)
+    assert nd.ones((4,)).asnumpy().sum() == 4
+    assert nd.full((2, 2), 7).asnumpy()[0, 0] == 7
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.dtype == onp.float32
+    assert nd.arange(0, 10, 2).shape == (5,)
+    assert nd.eye(3).asnumpy()[1, 1] == 1
+    assert nd.array(onp.ones((2, 2), dtype="int32")).dtype == onp.int32
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, onp.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, -onp.array([[4, 4], [4, 4]]))
+    assert_almost_equal(a * b, onp.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, onp.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal(a ** 2, onp.array([[1, 4], [9, 16]]))
+    assert_almost_equal(2 + a, onp.array([[3, 4], [5, 6]]))
+    assert_almost_equal(2 - a, onp.array([[1, 0], [-1, -2]]))
+    assert_almost_equal(-a, -a.asnumpy())
+    c = a.copy()
+    c += b
+    assert_almost_equal(c, (a + b).asnumpy())
+
+
+def test_comparison():
+    a = nd.array([1, 2, 3])
+    b = nd.array([3, 2, 1])
+    assert_almost_equal(a == b, [0, 1, 0])
+    assert_almost_equal(a < b, [1, 0, 0])
+    assert_almost_equal(a >= b, [0, 1, 1])
+
+
+def test_dot():
+    a = onp.random.rand(3, 4).astype("float32")
+    b = onp.random.rand(4, 5).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a.dot(b), rtol=1e-4, atol=1e-5)
+    # transpose flags
+    assert_almost_equal(nd.dot(nd.array(a.T), nd.array(b), transpose_a=True),
+                        a.dot(b), rtol=1e-4, atol=1e-5)
+    # batch_dot
+    x = onp.random.rand(2, 3, 4).astype("float32")
+    y = onp.random.rand(2, 4, 5).astype("float32")
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)),
+                        onp.matmul(x, y), rtol=1e-4, atol=1e-5)
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, 0, 2, 2)).shape == (2, 3, 2, 2)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+
+
+def test_indexing():
+    a = nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[1], onp.arange(12, 24).reshape(3, 4))
+    assert_almost_equal(a[0, 1, 2], 6)
+    assert_almost_equal(a[:, 1], onp.array([[4, 5, 6, 7], [16, 17, 18, 19]]))
+    a[0, 0, 0] = 100
+    assert a.asnumpy()[0, 0, 0] == 100
+    # boolean-free slice with step
+    assert_almost_equal(a[:, ::2, 1].asnumpy(), a.asnumpy()[:, ::2, 1])
+
+
+def test_reductions():
+    x = onp.random.rand(3, 4, 5).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(a.mean(axis=(0, 2)), x.mean(axis=(0, 2)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(a.max(axis=2, keepdims=True), x.max(axis=2, keepdims=True))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(nd.prod(a, axis=0), x.prod(axis=0), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(a.argmax(axis=1), x.argmax(axis=1))
+    assert_almost_equal(a.norm(), onp.sqrt((x ** 2).sum()), rtol=1e-4, atol=1e-5)
+    # exclude
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), x.sum(axis=(0, 2)),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_shape_ops():
+    x = onp.random.rand(2, 3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a.transpose(), x.T)
+    assert_almost_equal(a.transpose((1, 0, 2)), x.transpose(1, 0, 2))
+    assert_almost_equal(a.swapaxes(0, 2), x.swapaxes(0, 2))
+    assert_almost_equal(a.expand_dims(1), x[:, None])
+    assert_almost_equal(nd.flip(a, 1), x[:, ::-1])
+    assert_almost_equal(nd.tile(a, (2, 1, 1)), onp.tile(x, (2, 1, 1)))
+    assert_almost_equal(nd.repeat(a, 2, axis=1), onp.repeat(x, 2, axis=1))
+    assert a.flatten().shape == (2, 12)
+    b = nd.concat(a, a, dim=2)
+    assert b.shape == (2, 3, 8)
+    s = nd.stack(a, a, axis=0)
+    assert s.shape == (2, 2, 3, 4)
+    parts = nd.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    parts = nd.split(a, 3, axis=1, squeeze_axis=True)
+    assert parts[0].shape == (2, 4)
+
+
+def test_slice_ops():
+    x = onp.arange(24).reshape(2, 3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(nd.slice_axis(a, 2, 1, 3), x[:, :, 1:3])
+    assert_almost_equal(nd.slice_like(a, nd.zeros((1, 2, 2))), x[:1, :2, :2])
+
+
+def test_take_pick_onehot():
+    x = onp.random.rand(5, 4).astype("float32")
+    a = nd.array(x)
+    idx = nd.array([0, 2, 4])
+    assert_almost_equal(nd.take(a, idx, axis=0), x[[0, 2, 4]])
+    p = nd.pick(a, nd.array([0, 1, 2, 3, 0]), axis=1)
+    assert_almost_equal(p, x[onp.arange(5), [0, 1, 2, 3, 0]])
+    oh = nd.one_hot(nd.array([1, 0, 2]), 3)
+    assert_almost_equal(oh, onp.eye(3)[[1, 0, 2]])
+
+
+def test_ordering():
+    x = onp.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype="float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a, axis=1), onp.sort(x, axis=1))
+    assert_almost_equal(nd.sort(a, axis=1, is_ascend=False), -onp.sort(-x, axis=1))
+    assert_almost_equal(nd.argsort(a, axis=1), onp.argsort(x, axis=1))
+    v = nd.topk(a, k=2, axis=1, ret_typ="value")
+    assert_almost_equal(v, -onp.sort(-x, axis=1)[:, :2])
+
+
+def test_where_clip_cast():
+    x = onp.array([-2.0, -0.5, 0.5, 2.0], dtype="float32")
+    a = nd.array(x)
+    assert_almost_equal(a.clip(-1, 1), onp.clip(x, -1, 1))
+    assert_almost_equal(nd.where(a > 0, a, -a), onp.abs(x))
+    assert a.astype("int32").dtype == onp.int32
+    assert nd.cast(a, "float16").dtype == onp.float16
+
+
+def test_unary_math():
+    x = onp.random.rand(3, 4).astype("float32") + 0.5
+    a = nd.array(x)
+    for name, ref in [("exp", onp.exp), ("log", onp.log), ("sqrt", onp.sqrt),
+                      ("square", onp.square), ("abs", onp.abs), ("sin", onp.sin),
+                      ("cos", onp.cos), ("tanh", onp.tanh), ("floor", onp.floor),
+                      ("ceil", onp.ceil), ("log1p", onp.log1p), ("expm1", onp.expm1)]:
+        assert_almost_equal(getattr(nd, name)(a), ref(x), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + onp.exp(-x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.relu(nd.array(x - 1)), onp.maximum(x - 1, 0))
+    assert_almost_equal(nd.rsqrt(a), 1 / onp.sqrt(x), rtol=1e-4, atol=1e-5)
+
+
+def test_broadcast_ops():
+    a = nd.array(onp.random.rand(2, 1, 3).astype("float32"))
+    b = nd.array(onp.random.rand(1, 4, 3).astype("float32"))
+    assert (a + b).shape == (2, 4, 3)
+    assert nd.broadcast_add(a, b).shape == (2, 4, 3)
+    assert nd.broadcast_maximum(a, b).shape == (2, 4, 3)
+    assert nd.broadcast_to(nd.ones((1, 3)), (5, 3)).shape == (5, 3)
+    assert nd.broadcast_axis(nd.ones((1, 3)), axis=0, size=4).shape == (4, 3)
+
+
+def test_copy_context():
+    a = nd.ones((2, 2), ctx=mx.cpu(0))
+    b = a.copyto(mx.cpu(0))
+    assert (b.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context.device_type in ("cpu",)
+    a2 = a.copy()
+    a2[0, 0] = 5
+    assert a.asnumpy()[0, 0] == 1
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    d = {"w": nd.random.normal(shape=(3, 3)), "b": nd.ones((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"].asnumpy())
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_wait_sync():
+    a = nd.ones((10, 10))
+    b = (a * 2).sum()
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asscalar() == 200
+
+
+def test_dtype_promotion_scalar():
+    a = nd.ones((2,), dtype="float16")
+    assert (a + 1.0).dtype == onp.float16
+    b = nd.ones((2,), dtype="int32")
+    assert (b + 1).dtype == onp.int32
+
+
+def test_sequence_ops():
+    x = onp.random.rand(4, 2, 3).astype("float32")  # TNC
+    a = nd.array(x)
+    slen = nd.array([2, 4])
+    m = nd.SequenceMask(a, slen, True, value=0.0)
+    out = m.asnumpy()
+    assert (out[2:, 0] == 0).all() and (out[:, 1] != 0).any()
+    last = nd.SequenceLast(a, slen, True)
+    assert_almost_equal(last, x[[1, 3], [0, 1]])
+    rev = nd.SequenceReverse(a, slen, True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x[1, 0])
+    assert_almost_equal(rev.asnumpy()[1, 0], x[0, 0])
+    assert_almost_equal(rev.asnumpy()[2, 0], x[2, 0])

@@ -1,0 +1,294 @@
+"""Operator numeric tests incl. gradient checks
+(ref tests/python/unittest/test_operator.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_numeric_gradient)
+
+
+def test_fully_connected():
+    x = onp.random.rand(4, 8).astype("float32")
+    w = onp.random.rand(3, 8).astype("float32")
+    b = onp.random.rand(3).astype("float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x.dot(w.T) + b, rtol=1e-4, atol=1e-5)
+    # flatten semantics
+    x4 = onp.random.rand(4, 2, 2, 2).astype("float32")
+    out = nd.FullyConnected(nd.array(x4), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x4.reshape(4, 8).dot(w.T) + b, rtol=1e-4, atol=1e-5)
+
+
+def test_fully_connected_grad():
+    check_numeric_gradient(
+        lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=3),
+        [onp.random.rand(2, 4), onp.random.rand(3, 4), onp.random.rand(3)])
+
+
+def test_convolution_shapes():
+    x = nd.random.normal(shape=(2, 3, 10, 10))
+    w = nd.random.normal(shape=(8, 3, 3, 3))
+    b = nd.zeros((8,))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=8)
+    assert out.shape == (2, 8, 8, 8)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=8, stride=(2, 2),
+                         pad=(1, 1))
+    assert out.shape == (2, 8, 5, 5)
+    # grouped
+    wg = nd.random.normal(shape=(6, 1, 3, 3))
+    out = nd.Convolution(x, wg, None, kernel=(3, 3), num_filter=6, num_group=3,
+                         no_bias=True)
+    assert out.shape == (2, 6, 8, 8)
+    # 1D
+    x1 = nd.random.normal(shape=(2, 3, 20))
+    w1 = nd.random.normal(shape=(4, 3, 5))
+    out = nd.Convolution(x1, w1, None, kernel=(5,), num_filter=4, no_bias=True)
+    assert out.shape == (2, 4, 16)
+
+
+def test_convolution_vs_numpy():
+    # direct conv check against explicit loops on a small case
+    x = onp.random.rand(1, 1, 5, 5).astype("float32")
+    w = onp.random.rand(1, 1, 3, 3).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=1, no_bias=True).asnumpy()
+    ref = onp.zeros((1, 1, 3, 3), "float32")
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_grad():
+    check_numeric_gradient(
+        lambda x, w: nd.Convolution(x, w, None, kernel=(3, 3), num_filter=2,
+                                    no_bias=True),
+        [onp.random.rand(1, 2, 5, 5), onp.random.rand(2, 2, 3, 3)])
+
+
+def test_deconvolution():
+    x = nd.random.normal(shape=(1, 4, 5, 5))
+    w = nd.random.normal(shape=(4, 8, 3, 3))
+    out = nd.Deconvolution(x, w, None, kernel=(3, 3), num_filter=8, no_bias=True)
+    assert out.shape == (1, 8, 7, 7)
+    out = nd.Deconvolution(x, w, None, kernel=(3, 3), num_filter=8, stride=(2, 2),
+                           no_bias=True)
+    assert out.shape == (1, 8, 11, 11)
+
+
+def test_pooling():
+    x = onp.random.rand(1, 2, 4, 4).astype("float32")
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="max")
+    assert out.shape == (1, 2, 1, 1)
+    # ceil mode ('full' convention)
+    x5 = nd.random.normal(shape=(1, 1, 5, 5))
+    out = nd.Pooling(x5, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                     pooling_convention="full")
+    assert out.shape == (1, 1, 3, 3)
+
+
+def test_softmax_ops():
+    x = onp.random.rand(3, 5).astype("float32")
+    s = nd.softmax(nd.array(x)).asnumpy()
+    assert_almost_equal(s.sum(axis=1), onp.ones(3), rtol=1e-5, atol=1e-6)
+    ref = onp.exp(x) / onp.exp(x).sum(axis=1, keepdims=True)
+    assert_almost_equal(s, ref, rtol=1e-4, atol=1e-5)
+    ls = nd.log_softmax(nd.array(x)).asnumpy()
+    assert_almost_equal(ls, onp.log(ref), rtol=1e-4, atol=1e-5)
+    sm = nd.softmin(nd.array(x)).asnumpy()
+    refm = onp.exp(-x) / onp.exp(-x).sum(axis=1, keepdims=True)
+    assert_almost_equal(sm, refm, rtol=1e-4, atol=1e-5)
+    # axis + temperature
+    st = nd.softmax(nd.array(x), axis=0, temperature=2.0).asnumpy()
+    reft = onp.exp(x / 2) / onp.exp(x / 2).sum(axis=0, keepdims=True)
+    assert_almost_equal(st, reft, rtol=1e-4, atol=1e-5)
+    # masked by length
+    sl = nd.softmax(nd.array(x), axis=-1, length=nd.array([2, 5, 3])).asnumpy()
+    assert_almost_equal(sl[0, 2:], onp.zeros(3))
+    assert_almost_equal(sl.sum(axis=1), onp.ones(3), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_grad():
+    check_numeric_gradient(lambda x: nd.softmax(x),
+                           [onp.random.rand(3, 4)], rtol=1e-2, atol=1e-3)
+
+
+def test_batchnorm_train_and_eval():
+    x = onp.random.rand(4, 3, 5, 5).astype("float32") * 2
+    g = onp.random.rand(3).astype("float32")
+    b = onp.random.rand(3).astype("float32")
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    with autograd.record(train_mode=True):
+        out = nd.BatchNorm(nd.array(x), nd.array(g), nd.array(b), mm, mv)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / onp.sqrt(var[None, :, None, None] + 1e-5)
+    ref = ref * g[None, :, None, None] + b[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+    # moving stats got updated toward batch stats
+    assert_almost_equal(mm, 0.1 * mean, rtol=1e-3, atol=1e-4)
+    # eval mode uses moving stats
+    out_eval = nd.BatchNorm(nd.array(x), nd.array(g), nd.array(b),
+                            nd.zeros((3,)), nd.ones((3,)))
+    ref_eval = x * g[None, :, None, None] / onp.sqrt(1 + 1e-5) + b[None, :, None, None]
+    assert_almost_equal(out_eval, ref_eval, rtol=1e-3, atol=1e-4)
+
+
+def test_layernorm():
+    x = onp.random.rand(4, 6).astype("float32")
+    g = onp.random.rand(6).astype("float32")
+    b = onp.random.rand(6).astype("float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    ref = (x - mean) / onp.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(lambda x, g, b: nd.LayerNorm(x, g, b),
+                           [onp.random.rand(2, 5), onp.random.rand(5),
+                            onp.random.rand(5)])
+
+
+def test_activation_family():
+    x = onp.linspace(-3, 3, 13).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.Activation(a, "relu"), onp.maximum(x, 0))
+    assert_almost_equal(nd.Activation(a, "softrelu"), onp.log1p(onp.exp(x)),
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        onp.where(x >= 0, x, 0.1 * x))
+    assert_almost_equal(nd.LeakyReLU(a, act_type="elu", slope=1.0),
+                        onp.where(x >= 0, x, onp.expm1(x)), rtol=1e-4, atol=1e-5)
+    gamma = nd.array([0.25])
+    prelu = nd.LeakyReLU(a.reshape(1, 13), gamma=gamma, act_type="prelu")
+    assert_almost_equal(prelu, onp.where(x >= 0, x, 0.25 * x).reshape(1, 13))
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    keep_frac = float((y.asnumpy() != 0).mean())
+    assert 0.35 < keep_frac < 0.65
+    assert_almost_equal(y.asnumpy()[y.asnumpy() != 0], 2.0)
+    # eval: identity
+    y2 = nd.Dropout(x, p=0.5)
+    assert_almost_equal(y2, x.asnumpy())
+
+
+def test_embedding():
+    w = onp.random.rand(10, 4).astype("float32")
+    idx = nd.array([[0, 5], [9, 1]])
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[[[0, 5], [9, 1]]])
+
+
+def test_norm_ops():
+    x = onp.random.rand(2, 3, 4).astype("float32")
+    assert_almost_equal(nd.L2Normalization(nd.array(x)),
+                        x / onp.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True) + 1e-10),
+                        rtol=1e-4, atol=1e-5)
+    gn = nd.GroupNorm(nd.array(x.reshape(2, 3, 4, 1)), nd.ones((3,)), nd.zeros((3,)),
+                      num_groups=3)
+    assert gn.shape == (2, 3, 4, 1)
+
+
+def test_elemwise_grad():
+    check_numeric_gradient(lambda a, b: a * b + a / (b + 2.0),
+                           [onp.random.rand(3, 4), onp.random.rand(3, 4)])
+    check_numeric_gradient(lambda a: nd.exp(a) + nd.log(a + 2),
+                           [onp.random.rand(5)])
+    check_numeric_gradient(lambda a: nd.tanh(a).sum(axis=0),
+                           [onp.random.rand(3, 3)])
+
+
+def test_broadcast_grad():
+    check_numeric_gradient(lambda a, b: nd.broadcast_mul(a, b),
+                           [onp.random.rand(3, 1), onp.random.rand(1, 4)])
+
+
+def test_sequence_grad():
+    check_numeric_gradient(
+        lambda x: nd.SequenceMask(x, nd.array([1, 2]), True),
+        [onp.random.rand(3, 2, 2)])
+
+
+def test_linalg():
+    from incubator_mxnet_tpu.ndarray import linalg
+    a = onp.random.rand(3, 3).astype("float32")
+    spd = a.dot(a.T) + 3 * onp.eye(3, dtype="float32")
+    l = linalg.potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(l.dot(l.T), spd, rtol=1e-3, atol=1e-4)
+    g2 = linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True).asnumpy()
+    assert_almost_equal(g2, a.dot(a.T), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(linalg.sumlogdiag(nd.array(spd)),
+                        onp.log(onp.diag(spd)).sum(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(linalg.inverse(nd.array(spd)), onp.linalg.inv(spd),
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_random_ops():
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    n = nd.random.normal(0, 1, shape=(5000,))
+    assert abs(float(n.mean().asscalar())) < 0.1
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.dtype == onp.int32 and r.asnumpy().max() < 10
+    # seeding reproducibility
+    mx.random.seed(7)
+    a = nd.random.normal(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.normal(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)
+    m = nd.random.multinomial(nd.array([0.0, 0.0, 1.0]), shape=(8,))
+    assert (m.asnumpy() == 2).all()
+
+
+def test_gather_scatter():
+    x = onp.random.rand(3, 4).astype("float32")
+    idx = nd.array([[0, 2], [1, 3]])  # 2 points: (0,1),(2,3)
+    out = nd.gather_nd(nd.array(x), idx)
+    assert_almost_equal(out, x[[0, 2], [1, 3]])
+    s = nd.scatter_nd(out, idx, (3, 4)).asnumpy()
+    assert s[0, 1] == x[0, 1] and s[2, 3] == x[2, 3]
+    assert s.sum() == pytest.approx(x[0, 1] + x[2, 3], rel=1e-5)
+
+
+def test_control_flow_where():
+    cond = nd.array([1, 0, 1])
+    a, b = nd.array([1, 2, 3]), nd.array([10, 20, 30])
+    assert_almost_equal(nd.where(cond, a, b), [1, 20, 3])
+
+
+def test_pad_op():
+    x = onp.random.rand(1, 1, 3, 3).astype("float32")
+    out = nd.pad(nd.array(x), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                 constant_value=0)
+    assert out.shape == (1, 1, 5, 5)
+    assert out.asnumpy()[0, 0, 0, 0] == 0
+    out = nd.pad(nd.array(x), mode="edge", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    assert out.asnumpy()[0, 0, 0, 0] == x[0, 0, 0, 0]
+
+
+def test_upsampling_resize():
+    x = nd.random.normal(shape=(1, 2, 4, 4))
+    up = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 8, 8)
+    rs = nd.BilinearResize2D(x, height=6, width=6)
+    assert rs.shape == (1, 2, 6, 6)
+
+
+def test_moments_diag():
+    x = onp.random.rand(3, 4).astype("float32")
+    m, v = nd.moments(nd.array(x), axes=(0,))
+    assert_almost_equal(m, x.mean(axis=0), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(v, x.var(axis=0), rtol=1e-4, atol=1e-5)
+    d = nd.diag(nd.array(x[:3, :3]))
+    assert_almost_equal(d, onp.diag(x[:3, :3]))

@@ -1,0 +1,114 @@
+"""RNN tests (ref tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu.gluon import rnn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cells_shapes():
+    for cell_cls, n_states in [(rnn.RNNCell, 1), (rnn.LSTMCell, 2), (rnn.GRUCell, 1)]:
+        cell = cell_cls(16, input_size=8)
+        cell.initialize()
+        x = nd.random.normal(shape=(4, 8))
+        states = cell.begin_state(4)
+        out, new_states = cell(x, states)
+        assert out.shape == (4, 16)
+        assert len(new_states) == n_states
+
+
+def test_cell_unroll():
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_sequential_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.LSTMCell(6, input_size=8))
+    stack.initialize()
+    x = nd.random.normal(shape=(2, 4))
+    states = stack.begin_state(2)
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 6)
+    assert len(new_states) == 4
+
+
+def test_fused_lstm_layer():
+    layer = rnn.LSTM(16, num_layers=2, input_size=8)
+    layer.initialize()
+    x = nd.random.normal(shape=(5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+
+def test_fused_lstm_matches_cell():
+    """Fused scan path must agree with the explicit cell unroll."""
+    layer = rnn.LSTM(8, input_size=4)
+    layer.initialize()
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    # copy weights
+    cell.i2h_weight.set_data(layer._i2h[0].data())
+    cell.h2h_weight.set_data(layer._h2h[0].data())
+    cell.i2h_bias.set_data(layer._i2hb[0].data())
+    cell.h2h_bias.set_data(layer._h2hb[0].data())
+    x = nd.random.normal(shape=(6, 2, 4))
+    fused_out = layer(x)
+    cell_out, _ = cell.unroll(6, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(fused_out, cell_out.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_gru_rnn_layers():
+    for layer_cls in (rnn.GRU, rnn.RNN):
+        layer = layer_cls(8, num_layers=1, input_size=4)
+        layer.initialize()
+        out = layer(nd.random.normal(shape=(3, 2, 4)))
+        assert out.shape == (3, 2, 8)
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(8, bidirectional=True, input_size=4)
+    layer.initialize()
+    out = layer(nd.random.normal(shape=(5, 2, 4)))
+    assert out.shape == (5, 2, 16)
+
+
+def test_ntc_layout():
+    layer = rnn.LSTM(8, layout="NTC", input_size=4)
+    layer.initialize()
+    out = layer(nd.random.normal(shape=(2, 5, 4)))
+    assert out.shape == (2, 5, 8)
+
+
+def test_lstm_backward():
+    layer = rnn.LSTM(8, input_size=4)
+    layer.initialize()
+    x = nd.random.normal(shape=(5, 2, 4))
+    with autograd.record():
+        loss = (layer(x) ** 2).sum()
+    loss.backward()
+    g = layer._i2h[0].grad().asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_residual_dropout_cells():
+    cell = rnn.ResidualCell(rnn.GRUCell(4, input_size=4))
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 4))
+    out, _ = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 4)
+    dcell = rnn.DropoutCell(0.5)
+    out2, _ = dcell(x, [])
+    assert out2.shape == (2, 4)
