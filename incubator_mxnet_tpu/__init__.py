@@ -32,3 +32,5 @@ from . import callback
 from . import kvstore
 from . import kvstore as kv
 from . import gluon
+from . import jit
+from . import parallel

@@ -50,13 +50,17 @@ def _tree_wrap(data):
 class TrainStep:
     """Compile net forward + loss + backward + optimizer update into one program."""
 
-    def __init__(self, net, loss_fn, trainer, batch_axis=0, grad_postprocess=None):
+    def __init__(self, net, loss_fn, trainer, batch_axis=0, grad_postprocess=None,
+                 mesh=None, data_axis="dp"):
         self.net = net
         self.loss_fn = loss_fn
         self.trainer = trainer
         self._grad_postprocess = grad_postprocess
         self._cache = {}
         self._step_count = 0
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.batch_axis = batch_axis
 
     # ------------------------------------------------------------------
     def _split_params(self):
@@ -121,8 +125,50 @@ class TrainStep:
                 new_opt.append(_tree_to_data(new_state_nd))
             return loss_full, new_t, new_opt, aux_vals
 
-        jitted = jax.jit(step_fn, donate_argnums=(0, 2))
+        if self.mesh is not None:
+            jitted = self._jit_sharded(step_fn, trainable, frozen)
+        else:
+            jitted = jax.jit(step_fn, donate_argnums=(0, 2))
         return jitted, trainable, frozen, t_arrs, f_arrs, aux_box
+
+    def _param_sharding(self, p):
+        """Per-parameter sharding: p.sharding (a PartitionSpec) if set by a
+        tensor/expert-parallel layer, else fully replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        if getattr(p, "sharding", None) is not None:
+            spec = p.sharding
+            if isinstance(spec, NamedSharding):
+                return spec
+            return NamedSharding(self.mesh, spec)
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _jit_sharded(self, step_fn, trainable, frozen):
+        """SPMD data(+tensor)-parallel: inputs sharded on the batch axis over
+        ``data_axis``; params/optimizer state follow their own shardings. XLA
+        inserts the gradient all-reduce (psum over dp) automatically — this IS
+        the kvstore dist_device_sync path on ICI (SURVEY §2.5 north star)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        t_sh = [self._param_sharding(p) for p in trainable]
+        f_sh = [self._param_sharding(p) for p in frozen]
+        data_sh = NamedSharding(self.mesh, PartitionSpec(self.data_axis))
+        jitted = jax.jit(step_fn, donate_argnums=(0, 2))
+
+        def wrapper(t_datas, f_datas, opt_states, input_datas, *rest):
+            # lay out operands on the mesh; no-op once steady-state shardings
+            # are established (outputs inherit them), so the reshard cost is
+            # first-step-only
+            t_datas = [jax.device_put(d, s) for d, s in zip(t_datas, t_sh)]
+            f_datas = [jax.device_put(d, s) for d, s in zip(f_datas, f_sh)]
+            opt_states = [jax.tree_util.tree_map(
+                lambda x, _s=s: jax.device_put(x, _s), st)
+                for st, s in zip(opt_states, t_sh)]
+            input_datas = [jax.device_put(d, data_sh) for d in input_datas]
+            rest = [jax.device_put(r, repl) for r in rest]
+            return jitted(t_datas, f_datas, opt_states, input_datas, *rest)
+
+        return wrapper
 
     # ------------------------------------------------------------------
     def __call__(self, *inputs, batch_size=None, n_net_inputs=1):

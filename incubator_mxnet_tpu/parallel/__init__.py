@@ -13,6 +13,6 @@ from .mesh import make_mesh, current_mesh, set_current_mesh, replicated, shard_s
 from .data_parallel import DataParallelTrainStep  # noqa
 from .tensor_parallel import ColParallelDense, RowParallelDense, shard_params  # noqa
 from .ring_attention import ring_attention, local_attention  # noqa
-from .pipeline import PipelineParallel  # noqa
+from .pipeline import PipelineParallel, pipeline_spmd  # noqa
 from .moe import MoELayer  # noqa
 from .compression import GradientCompression  # noqa

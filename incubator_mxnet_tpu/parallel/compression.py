@@ -1,0 +1,37 @@
+"""Gradient compression (ref src/kvstore/gradient_compression.h:37-127).
+
+2-bit stochastic-threshold quantization with error-feedback residual, as a
+pure JAX transform usable either through the kvstore facade or as a
+``grad_postprocess`` hook on the fused train step (where it models the
+bandwidth/precision trade-off of the reference's dist push path).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise ValueError("only 2bit compression is supported (ref parity)")
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def compress_decompress(self, grad, key=None):
+        """Quantize to {-t, 0, +t} with error feedback (ref Quantize/Dequantize)."""
+        data = grad._data if isinstance(grad, NDArray) else grad
+        k = key if key is not None else id(grad)
+        res = self._residuals.get(k)
+        if res is None:
+            res = jnp.zeros_like(data)
+        acc = data + res
+        t = self.threshold
+        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0)).astype(data.dtype)
+        self._residuals[k] = acc - q
+        if isinstance(grad, NDArray):
+            return NDArray(q)
+        return q

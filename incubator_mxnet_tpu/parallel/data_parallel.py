@@ -1,0 +1,29 @@
+"""Data-parallel fused train step over a mesh.
+
+TPU-native replacement for the reference's data-parallel stack
+(DataParallelExecutorGroup executor_group.py:144 + kvstore comm.h/NCCL/dist):
+the batch is sharded over the ``dp`` mesh axis inside ONE compiled program;
+XLA emits the gradient all-reduce on ICI. Multi-host (DCN) runs the same
+program under jax.distributed with a process-spanning mesh.
+"""
+from __future__ import annotations
+
+from ..jit import TrainStep
+from .mesh import current_mesh
+
+__all__ = ["DataParallelTrainStep"]
+
+
+class DataParallelTrainStep(TrainStep):
+    """TrainStep with the batch sharded over a mesh axis.
+
+    Parameters follow their per-parameter ``sharding`` (so tensor/expert
+    parallel compose with dp on a 2D+ mesh); unannotated params replicate.
+    """
+
+    def __init__(self, net, loss_fn, trainer, mesh=None, data_axis="dp", **kw):
+        mesh = mesh or current_mesh()
+        if mesh is None:
+            raise ValueError("DataParallelTrainStep needs a mesh "
+                             "(parallel.make_mesh({'dp': N}))")
+        super().__init__(net, loss_fn, trainer, mesh=mesh, data_axis=data_axis, **kw)

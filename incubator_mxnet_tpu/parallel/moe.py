@@ -1,0 +1,65 @@
+"""Mixture-of-Experts with expert parallelism — NEW capability
+(SURVEY §2.5: no MoE ops in the reference).
+
+Experts are sharded over the ``ep`` mesh axis (expert dim of the stacked
+weights carries PartitionSpec('ep', ...)); token routing is dense top-k with
+capacity-free einsum dispatch — the all-to-all falls out of GSPMD resharding
+between the token-sharded and expert-sharded einsum operands.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import ndarray as nd
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray, _apply
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(HybridBlock):
+    """Top-k gated MoE FFN: y = sum_k g_k * FFN_{e_k}(x).
+
+    Weights: w1 (E, D, H), w2 (E, H, D) with E sharded over ``ep``.
+    """
+
+    def __init__(self, num_experts, hidden_size, ffn_hidden, top_k=2,
+                 ep_axis="ep", activation="relu", **kwargs):
+        super().__init__(**kwargs)
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self._act = activation
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(num_experts, hidden_size), init="xavier")
+            self.w1 = self.params.get("w1", shape=(num_experts, hidden_size, ffn_hidden),
+                                      init="xavier")
+            self.w2 = self.params.get("w2", shape=(num_experts, ffn_hidden, hidden_size),
+                                      init="xavier")
+        self.w1.sharding = P(ep_axis, None, None)
+        self.w2.sharding = P(ep_axis, None, None)
+
+    def forward(self, x):
+        """x: (..., D) → (..., D); dense dispatch (no token dropping)."""
+        top_k, num_experts, act = self.top_k, self.num_experts, self._act
+
+        def fn(xd, gw, w1, w2):
+            shape = xd.shape
+            tokens = xd.reshape(-1, shape[-1])                       # (T, D)
+            logits = tokens @ gw.T                                    # (T, E)
+            import jax
+            gates = jax.nn.softmax(logits, axis=-1)
+            top_vals, top_idx = jax.lax.top_k(gates, top_k)           # (T, k)
+            top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+            # dense one-hot combine weights (T, E)
+            oh = jax.nn.one_hot(top_idx, num_experts, dtype=gates.dtype)  # (T,k,E)
+            combine = jnp.einsum("tk,tke->te", top_vals, oh)
+            # expert compute: (E, T, H) — GSPMD reshards tokens→experts (a2a)
+            h = jnp.einsum("td,edh->eth", tokens, w1)
+            h = jax.nn.relu(h) if act == "relu" else jax.nn.gelu(h)
+            y = jnp.einsum("eth,ehd->etd", h, w2)
+            out = jnp.einsum("etd,te->td", y, combine)
+            return out.reshape(shape)
+
+        return _apply(fn, x, self.gate_weight.data(), self.w1.data(), self.w2.data())

@@ -1,0 +1,100 @@
+"""Pipeline parallelism — NEW capability (SURVEY §2.5: absent in reference).
+
+GPipe-style microbatching over homogeneous stages expressed with shard_map +
+ppermute over the ``pp`` mesh axis: stage weights are stacked on a leading
+stage dim sharded over ``pp``; activations circulate the ring once per
+microbatch tick. XLA overlaps the permute with stage compute on ICI.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["PipelineParallel", "pipeline_spmd"]
+
+
+def _pipeline_sharded(x_mb, stacked_params, stage_fn, axis_name, n_microbatches):
+    """Inside shard_map: each device holds ONE stage's params (leading stage
+    dim of size 1 locally) and processes the stream of microbatches.
+
+    x_mb: (n_micro, mb, ...) — full microbatch stream, replicated.
+    Returns (n_micro, mb, ...) outputs (valid on the last stage; all-gathered).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+    mb_shape = x_mb.shape[1:]
+    total_ticks = n_microbatches + n_stages - 1
+
+    def tick(t, carry):
+        state, outputs = carry  # state: activation currently held (mb, ...)
+        # stage 0 injects microbatch t (if any); others use what arrived
+        inject = jnp.where(t < n_microbatches, t, n_microbatches - 1)
+        fresh = x_mb[inject]
+        cur = jnp.where(stage == 0, fresh, state)
+        out = stage_fn(params, cur)
+        # last stage records its result for microbatch (t - n_stages + 1)
+        done_idx = t - (n_stages - 1)
+        record = jnp.logical_and(stage == n_stages - 1, done_idx >= 0)
+        write_idx = jnp.clip(done_idx, 0, n_microbatches - 1)
+        outputs = jnp.where(record, outputs.at[write_idx].set(out), outputs)
+        # shift activations to the next stage on the ring
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        state = lax.ppermute(out, axis_name, perm)
+        return state, outputs
+
+    out0 = lax.pvary(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype), (axis_name,))
+    state0 = lax.pvary(jnp.zeros(mb_shape, x_mb.dtype), (axis_name,))
+    _, outputs = lax.fori_loop(0, total_ticks, tick, (state0, out0))
+    # only the last stage holds real outputs; broadcast them to all stages
+    return _bcast_from_last(outputs, axis_name, n_stages)
+
+
+def _bcast_from_last(x, axis_name, n_stages):
+    # psum with a mask selects the last stage's copy on every device
+    stage = lax.axis_index(axis_name)
+    mask = (stage == n_stages - 1).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)
+
+
+def pipeline_spmd(stage_fn, stacked_params, x, mesh, n_microbatches, axis="pp"):
+    """Run a homogeneous-stage pipeline.
+
+    stage_fn(params, x)->y with identical in/out shapes; stacked_params has a
+    leading dim = n_stages sharded over ``axis``; x: (batch, ...) split into
+    n_microbatches along dim 0.
+    """
+    mb = x.shape[0] // n_microbatches
+    x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
+    fn = functools.partial(_pipeline_sharded, stage_fn=stage_fn, axis_name=axis,
+                           n_microbatches=n_microbatches)
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    out = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(), param_specs),
+        out_specs=P())(x_mb, stacked_params)
+    return out.reshape((x.shape[0],) + out.shape[2:])
+
+
+class PipelineParallel:
+    """Convenience wrapper: pipeline a stack of identical HybridBlocks.
+
+    Used for transformer-layer stacks: all stages share one structure; their
+    parameters are stacked on a leading dim and sharded over ``pp``.
+    """
+
+    def __init__(self, stage_fn, n_stages, mesh, axis="pp", n_microbatches=None):
+        self.stage_fn = stage_fn
+        self.n_stages = n_stages
+        self.mesh = mesh
+        self.axis = axis
+        self.n_microbatches = n_microbatches or n_stages
+
+    def __call__(self, stacked_params, x):
+        return pipeline_spmd(self.stage_fn, stacked_params, x, self.mesh,
+                             self.n_microbatches, self.axis)

@@ -1,0 +1,56 @@
+"""Tensor parallelism — NEW capability (absent in reference, SURVEY §2.5).
+
+Megatron-style column/row sharded linear layers expressed as GSPMD sharding
+annotations: the weight carries a PartitionSpec over the ``tp`` mesh axis and
+XLA partitions the matmul and inserts the all-reduce/all-gather on ICI.
+No explicit collective calls are needed in the layer code.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..gluon import nn
+
+__all__ = ["ColParallelDense", "RowParallelDense", "shard_params"]
+
+
+class ColParallelDense(nn.Dense):
+    """Dense with output features sharded over ``tp`` (weight rows split).
+
+    y = x W^T : W is (units, in) → shard dim 0. Output is sharded on features;
+    follow with RowParallelDense to contract back (Megatron MLP pattern).
+    """
+
+    def __init__(self, units, tp_axis="tp", **kwargs):
+        super().__init__(units, **kwargs)
+        self.weight.sharding = P(tp_axis, None)
+        if self.bias is not None:
+            self.bias.sharding = P(tp_axis)
+
+
+class RowParallelDense(nn.Dense):
+    """Dense with input features sharded over ``tp`` (weight cols split).
+
+    The partial products are psum'd by XLA automatically (GSPMD)."""
+
+    def __init__(self, units, tp_axis="tp", **kwargs):
+        super().__init__(units, **kwargs)
+        self.weight.sharding = P(None, tp_axis)
+        # bias replicated
+
+
+def shard_params(block, rules, mesh=None):
+    """Annotate parameters by name-pattern → PartitionSpec.
+
+    rules: list of (regex, PartitionSpec). First match wins. This is the
+    declarative analog of the reference's manual group2ctx model-parallel
+    placement (symbol.py:1554) — placement by annotation, not device copies.
+    """
+    import re
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    for name, p in block.collect_params().items():
+        for pat, spec in compiled:
+            if pat.search(name):
+                p.sharding = spec
+                break
+    return block
