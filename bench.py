@@ -1,0 +1,63 @@
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+Baseline anchor (BASELINE.md): MXNet 1.2 ResNet-50 training, batch 128,
+1x V100 = 363.69 img/s (perf.md:245-254). We run the same workload —
+ResNet-50 forward+backward+SGD-momentum update, synthetic ImageNet batch —
+as ONE fused XLA program in bf16 compute / fp32 master weights.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+BASELINE_IMG_S = 363.69  # V100 b128, docs/.../perf.md:245-254
+
+
+def main():
+    import numpy as onp
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, jit
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = 20
+    warmup = 3
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+
+    x = nd.random.normal(shape=(batch, 3, 224, 224)).astype("bfloat16")
+    y = nd.array(onp.random.randint(0, 1000, batch).astype("float32"))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "multi_precision": True})
+    step = jit.TrainStep(net, loss_fn, trainer)
+
+    # NOTE: sync via scalar readback — device-side work is async and
+    # block_until_ready alone does not drain the remote execution stream
+    for _ in range(warmup):
+        float(step(x, y).mean().asscalar())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss.mean().asscalar())  # one sync at the end: steps chain via donation
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,3 +34,14 @@ from . import kvstore as kv
 from . import gluon
 from . import jit
 from . import parallel
+from . import recordio
+from . import io
+from . import model
+from .model import save_checkpoint, load_checkpoint
+from . import symbol
+from . import symbol as sym
+from .executor import Executor
+from . import module
+from . import module as mod
+from . import models
+from . import ops

@@ -1,0 +1,31 @@
+"""Engine controls (ref python/mxnet/engine.py, src/engine/).
+
+TPU-native: there is no software dependency engine — XLA/PJRT owns device
+ordering; bulking is automatic whole-step compilation. These controls are
+kept for API parity: bulk() is a no-op scope (everything is already bulked),
+set_bulk_size returns the previous value.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = [15]
+
+
+def set_bulk_size(size):
+    """ref engine.py set_bulk_size (MXNET_ENGINE_BULK_SIZE analog)."""
+    prev = _BULK_SIZE[0]
+    _BULK_SIZE[0] = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """ref engine.py bulk scope — no-op: XLA fuses the whole step already."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

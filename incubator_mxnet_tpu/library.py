@@ -1,0 +1,73 @@
+"""Custom-op library loading (ref python/mxnet/library.py MXLoadLib).
+
+TPU-native: an "op library" is a python module registering ops into the nd /
+sym namespaces (pure-JAX or Pallas implementations) — the dlopen'd C++ .so of
+the reference maps to importable plugin modules (native C extensions welcome).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from . import ndarray as nd
+
+__all__ = ["load", "register_op"]
+
+
+def register_op(name, fn, gradient=None):
+    """Register a custom operator into nd (and sym mirrors).
+
+    fn operates on NDArrays; gradient (optional) supplies a custom VJP.
+    """
+    if gradient is not None:
+        import jax
+
+        @jax.custom_vjp
+        def raw(*datas):
+            from .ndarray import NDArray
+            outs = fn(*[nd.NDArray(d) for d in datas])
+            return outs._data if isinstance(outs, nd.NDArray) else tuple(o._data for o in outs)
+
+        def fwd(*datas):
+            out = raw(*datas)
+            return out, datas
+
+        def bwd(datas, g):
+            from .ndarray import NDArray
+            grads = gradient([nd.NDArray(d) for d in datas],
+                             nd.NDArray(g) if not isinstance(g, tuple) else
+                             [nd.NDArray(x) for x in g])
+            return tuple(x._data for x in grads)
+
+        raw.defvjp(fwd, bwd)
+
+        def op(*args, **kwargs):
+            from .ndarray import _apply
+            return _apply(raw, *args)
+    else:
+        def op(*args, **kwargs):
+            return fn(*args, **kwargs)
+
+    setattr(nd, name, op)
+    try:
+        from . import symbol as sym_mod
+        from .symbol import _symbolize
+        setattr(sym_mod, name, _symbolize(op, name))
+    except Exception:
+        pass
+    return op
+
+
+def load(path, verbose=True):
+    """Load a plugin: a .py module calling register_op at import
+    (ref library.py load / MXLoadLib)."""
+    if not os.path.exists(path):
+        raise ValueError("library %s not found" % path)
+    if path.endswith(".py"):
+        spec = importlib.util.spec_from_file_location(
+            os.path.basename(path)[:-3], path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    raise ValueError("unsupported library type %s (use a .py plugin module; "
+                     "C extensions load via normal python import)" % path)

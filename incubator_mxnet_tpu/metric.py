@@ -360,6 +360,15 @@ class Caffe(Loss):
         EvalMetric.__init__(self, name, **kw)
 
 
+# MXNet-style aliases
+_REG.register(Accuracy, "acc")
+_REG.register(TopKAccuracy, "top_k_acc")
+_REG.register(TopKAccuracy, "top_k_accuracy")
+_REG.register(CrossEntropy, "ce")
+_REG.register(NegativeLogLikelihood, "nll_loss")
+_REG.register(PearsonCorrelation, "pearsonr")
+
+
 class CustomMetric(EvalMetric):
     def __init__(self, feval, name=None, allow_extra_outputs=False, **kw):
         name = name if name is not None else getattr(feval, "__name__", "custom")

@@ -1,0 +1,54 @@
+"""Checkpoint save/load (ref python/mxnet/model.py:403-452)."""
+from __future__ import annotations
+
+import json
+import os
+
+from . import ndarray as nd
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params", "BatchEndParam"]
+
+
+class BatchEndParam:
+    """Callback payload (ref model.py BatchEndParam namedtuple)."""
+
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """model-symbol.json + model-%04d.params (ref model.py:403)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_params(prefix, epoch):
+    """ref model.py load_params."""
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """ref model.py:428 load_checkpoint → (symbol, arg_params, aux_params)."""
+    symbol = None
+    sym_file = "%s-symbol.json" % prefix
+    if os.path.exists(sym_file):
+        from .symbol import load as sym_load
+        symbol = sym_load(sym_file)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,13 @@
+"""Model families covering the BASELINE.json configs:
+
+- lenet:      LeNet MNIST (config 1)
+- resnet etc: via gluon.model_zoo.vision (config 2)
+- bert:       BERT-base pretraining w/ TP + ring-attention SP (config 3)
+- ssd:        SSD object detection w/ MultiBox ops (config 4)
+- lstm_lm:    LSTM language model (config 5)
+"""
+from .lenet import LeNet  # noqa
+from .bert import BERTEncoder, BERTModel, TransformerEncoderLayer, MultiHeadAttention  # noqa
+from .lstm_lm import LSTMLanguageModel  # noqa
+from .ssd import SSD  # noqa
+from ..gluon.model_zoo.vision import get_model  # noqa

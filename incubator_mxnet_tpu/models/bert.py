@@ -1,0 +1,160 @@
+"""BERT / Transformer encoder (BASELINE config 3 — GluonNLP BERT-base analog).
+
+TPU-native design points:
+- MXU-friendly: all projections are batched matmuls; bf16-ready (cast()).
+- Tensor parallelism: ``tp_axis`` shards attention heads and FFN hidden over
+  the mesh (Megatron pattern via GSPMD sharding annotations on the params).
+- Sequence parallelism: ``attention='ring'`` computes attention with the
+  ring-attention kernel over the ``sp`` mesh axis (parallel/ring_attention.py)
+  — the long-context capability absent in the reference (SURVEY §5).
+"""
+from __future__ import annotations
+
+import math
+
+from jax.sharding import PartitionSpec as P
+
+from .. import ndarray as nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray, _apply
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, attention="dense",
+                 sp_axis="sp", tp_axis=None, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self._attention = attention
+        self._sp_axis = sp_axis
+        with self.name_scope():
+            self.query = nn.Dense(units, flatten=False, in_units=units)
+            self.key = nn.Dense(units, flatten=False, in_units=units)
+            self.value = nn.Dense(units, flatten=False, in_units=units)
+            self.proj = nn.Dense(units, flatten=False, in_units=units)
+        if tp_axis:
+            # shard heads over tp: qkv col-parallel, out proj row-parallel
+            for lyr in (self.query, self.key, self.value):
+                lyr.weight.sharding = P(tp_axis, None)
+                lyr.bias.sharding = P(tp_axis)
+            self.proj.weight.sharding = P(None, tp_axis)
+
+    def forward(self, x, mask=None):
+        B, S, U = x.shape
+        H = self._num_heads
+        D = U // H
+        q = self.query(x).reshape((B, S, H, D)).transpose((0, 2, 1, 3))
+        k = self.key(x).reshape((B, S, H, D)).transpose((0, 2, 1, 3))
+        v = self.value(x).reshape((B, S, H, D)).transpose((0, 2, 1, 3))
+
+        if self._attention == "ring":
+            from ..parallel.ring_attention import ring_attention
+            from ..parallel.mesh import current_mesh
+            mesh = current_mesh()
+            out = _apply(lambda qd, kd, vd: ring_attention(
+                qd, kd, vd, mesh=mesh, axis=self._sp_axis), q, k, v)
+        else:
+            scale = 1.0 / math.sqrt(D)
+            scores = nd.batch_dot(q.reshape((B * H, S, D)),
+                                  k.reshape((B * H, S, D)), transpose_b=True) * scale
+            if mask is not None:
+                scores = scores.reshape((B, H, S, S)) + (1.0 - mask) * -1e9
+                scores = scores.reshape((B * H, S, S))
+            attn = nd.softmax(scores, axis=-1)
+            if self._dropout:
+                attn = nd.Dropout(attn, p=self._dropout)
+            out = nd.batch_dot(attn, v.reshape((B * H, S, D))).reshape((B, H, S, D))
+        out = out.transpose((0, 2, 1, 3)).reshape((B, S, U))
+        return self.proj(out)
+
+
+class TransformerEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 attention="dense", tp_axis=None, sp_axis="sp", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention_cell = MultiHeadAttention(units, num_heads, dropout,
+                                                     attention, sp_axis, tp_axis)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ffn1 = nn.Dense(hidden_size, flatten=False, in_units=units)
+            self.ffn2 = nn.Dense(units, flatten=False, in_units=hidden_size)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.dropout_layer = nn.Dropout(dropout) if dropout else None
+        if tp_axis:
+            self.ffn1.weight.sharding = P(tp_axis, None)
+            self.ffn1.bias.sharding = P(tp_axis)
+            self.ffn2.weight.sharding = P(None, tp_axis)
+
+    def forward(self, x, mask=None):
+        h = self.attention_cell(x, mask)
+        if self.dropout_layer:
+            h = self.dropout_layer(h)
+        x = self.ln1(x + h)
+        h = self.ffn2(nd.LeakyReLU(self.ffn1(x), act_type="gelu"))
+        if self.dropout_layer:
+            h = self.dropout_layer(h)
+        return self.ln2(x + h)
+
+
+class BERTEncoder(HybridBlock):
+    """ref GluonNLP bert.BERTEncoder (structure parity)."""
+
+    def __init__(self, units=768, hidden_size=3072, num_layers=12, num_heads=12,
+                 max_length=512, dropout=0.1, attention="dense", tp_axis=None,
+                 sp_axis="sp", **kwargs):
+        super().__init__(**kwargs)
+        self._max_length = max_length
+        self._units = units
+        with self.name_scope():
+            self.position_weight = self.params.get("position_weight",
+                                                   shape=(max_length, units),
+                                                   init="normal")
+            self.layers = []
+            for i in range(num_layers):
+                layer = TransformerEncoderLayer(units, hidden_size, num_heads,
+                                                dropout, attention, tp_axis, sp_axis)
+                self.register_child(layer, "layer%d" % i)
+                self.layers.append(layer)
+
+    def forward(self, x, mask=None):
+        S = x.shape[1]
+        pos = nd.slice_axis(self.position_weight.data(), 0, 0, S)
+        x = x + pos.expand_dims(0)
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT with embeddings + MLM head (pretraining objective)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
+                 num_heads=12, max_length=512, dropout=0.1, attention="dense",
+                 tp_axis=None, sp_axis="sp", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units)
+            self.token_type_embed = nn.Embedding(2, units)
+            self.embed_ln = nn.LayerNorm(in_channels=units)
+            self.embed_dropout = nn.Dropout(dropout) if dropout else None
+            self.encoder = BERTEncoder(units, hidden_size, num_layers, num_heads,
+                                       max_length, dropout, attention, tp_axis,
+                                       sp_axis)
+            self.mlm_dense = nn.Dense(units, flatten=False, activation="relu",
+                                      in_units=units)
+            self.mlm_ln = nn.LayerNorm(in_channels=units)
+            self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
+
+    def forward(self, token_ids, token_types=None, mask=None):
+        x = self.word_embed(token_ids)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_ln(x)
+        if self.embed_dropout:
+            x = self.embed_dropout(x)
+        h = self.encoder(x, mask)
+        mlm = self.mlm_decoder(self.mlm_ln(self.mlm_dense(h)))
+        return mlm

@@ -1,0 +1,24 @@
+"""LeNet-5 (ref example/gluon/mnist/mnist.py — BASELINE config 1)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+
+class LeNet(HybridBlock):
+    def __init__(self, classes=10, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(
+                nn.Conv2D(20, kernel_size=5, activation="relu"),
+                nn.MaxPool2D(pool_size=2, strides=2),
+                nn.Conv2D(50, kernel_size=5, activation="relu"),
+                nn.MaxPool2D(pool_size=2, strides=2),
+                nn.Flatten(),
+                nn.Dense(500, activation="relu"),
+            )
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))

@@ -1,0 +1,33 @@
+"""LSTM language model (ref example/rnn/word_lm — BASELINE config 5).
+
+The fused gluon.rnn.LSTM lowers to lax.scan (the cuDNN RNN analog)."""
+from __future__ import annotations
+
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock
+
+
+class LSTMLanguageModel(HybridBlock):
+    def __init__(self, vocab_size=10000, embed_size=650, hidden_size=650,
+                 num_layers=2, dropout=0.5, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.embedding = nn.Embedding(vocab_size, embed_size)
+            self.lstm = rnn.LSTM(hidden_size, num_layers=num_layers,
+                                 dropout=dropout, input_size=embed_size)
+            self.decoder = nn.Dense(vocab_size, flatten=False, in_units=hidden_size)
+
+    def begin_state(self, batch_size):
+        return self.lstm.begin_state(batch_size)
+
+    def forward(self, inputs, states=None):
+        """inputs: (T, N) int token ids → logits (T, N, V)."""
+        emb = self.drop(self.embedding(inputs))
+        if states is None:
+            out = self.lstm(emb)
+            out = self.drop(out)
+            return self.decoder(out)
+        out, states = self.lstm(emb, states)
+        out = self.drop(out)
+        return self.decoder(out), states

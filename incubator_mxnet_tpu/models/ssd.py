@@ -1,0 +1,72 @@
+"""SSD object detector (ref example/ssd — BASELINE config 4).
+
+Multi-scale conv heads over a downsampling backbone; anchors/targets/NMS via
+ops.multibox (contrib MultiBox* op parity)."""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ops.multibox import MultiBoxPrior
+
+
+def _down_sample(channels):
+    blk = nn.HybridSequential()
+    for _ in range(2):
+        blk.add(nn.Conv2D(channels, 3, padding=1, use_bias=False))
+        blk.add(nn.BatchNorm())
+        blk.add(nn.Activation("relu"))
+    blk.add(nn.MaxPool2D(2, 2))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Compact SSD: backbone + 4 detection scales.
+
+    sizes/ratios follow the example/ssd defaults (per-scale anchors)."""
+
+    def __init__(self, num_classes=20, base_channels=64,
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619), (0.71, 0.79)),
+                 ratios=((1, 2, 0.5),) * 4, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.sizes = sizes
+        self.ratios = ratios
+        self.num_anchors = [len(s) + len(r) - 1 for s, r in zip(sizes, ratios)]
+        with self.name_scope():
+            self.backbone = nn.HybridSequential(prefix="backbone_")
+            for ch in (base_channels, base_channels * 2):
+                self.backbone.add(_down_sample(ch))
+            self.stages, self.cls_heads, self.loc_heads = [], [], []
+            for i in range(4):
+                if i > 0:
+                    stage = _down_sample(base_channels * 2)
+                    self.register_child(stage, "stage%d" % i)
+                    self.stages.append(stage)
+                cls_head = nn.Conv2D(self.num_anchors[i] * (num_classes + 1), 3,
+                                     padding=1)
+                loc_head = nn.Conv2D(self.num_anchors[i] * 4, 3, padding=1)
+                self.register_child(cls_head, "cls%d" % i)
+                self.register_child(loc_head, "loc%d" % i)
+                self.cls_heads.append(cls_head)
+                self.loc_heads.append(loc_head)
+
+    def forward(self, x):
+        """Returns (anchors (1,A,4), cls_preds (N, num_cls+1, A), loc_preds (N, A*4))."""
+        feat = self.backbone(x)
+        anchors, cls_outs, loc_outs = [], [], []
+        for i in range(4):
+            if i > 0:
+                feat = self.stages[i - 1](feat)
+            anchors.append(MultiBoxPrior(feat, sizes=self.sizes[i],
+                                         ratios=self.ratios[i]))
+            c = self.cls_heads[i](feat)          # (N, A_i*(C+1), H, W)
+            l = self.loc_heads[i](feat)
+            N = c.shape[0]
+            cls_outs.append(c.transpose((0, 2, 3, 1)).reshape(
+                (N, -1, self.num_classes + 1)))
+            loc_outs.append(l.transpose((0, 2, 3, 1)).reshape((N, -1)))
+        anchors = nd.concat(*anchors, dim=1)
+        cls_preds = nd.concat(*cls_outs, dim=1).transpose((0, 2, 1))
+        loc_preds = nd.concat(*loc_outs, dim=1)
+        return anchors, cls_preds, loc_preds

@@ -1,0 +1,4 @@
+"""mx.mod namespace (ref python/mxnet/module/__init__.py)."""
+from .base_module import BaseModule  # noqa
+from .module import Module  # noqa
+from .bucketing_module import BucketingModule  # noqa

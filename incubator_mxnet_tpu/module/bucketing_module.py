@@ -1,0 +1,105 @@
+"""BucketingModule — variable-length sequences via per-bucket executables
+(ref python/mxnet/module/bucketing_module.py).
+
+TPU-native: each bucket key is a distinct static shape → a distinct XLA
+executable, shared parameters. This is the bucketed-executable-cache answer
+to dynamic shapes (SURVEY §7 hard part b)."""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._opt_config = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    @symbol.setter
+    def symbol(self, v):
+        pass
+
+    def _gen_module(self, bucket_key):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(sym, data_names, label_names, self.logger,
+                         self._context, **self._kwargs)
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False, **kw):
+        self._curr_module = self._gen_module(self._default_bucket_key)
+        self._curr_bucket_key = self._default_bucket_key
+        self._curr_module.bind(data_shapes, label_shapes, for_training,
+                               force_rebind=force_rebind)
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """ref bucketing_module.py switch_bucket — share params across buckets."""
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training)
+            if self._curr_module is not None and self._curr_module.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.init_params(arg_params=arg, aux_params=aux)
+            if self._opt_config is not None:
+                mod.init_optimizer(*self._opt_config)
+        else:
+            # re-sync shared params into this bucket's executor
+            if self._curr_module is not None and self._curr_module is not mod \
+                    and self._curr_module.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.set_params(arg, aux)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, *args, **kwargs):
+        self._curr_module.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init)
+        self._opt_config = (kvstore, self._curr_module._optimizer, None)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._curr_bucket_key)
+        if key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)

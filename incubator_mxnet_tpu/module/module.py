@@ -1,0 +1,204 @@
+"""Module — symbolic trainer (ref python/mxnet/module/module.py:40).
+
+bind (:364) → Executor; init_optimizer (:474) → optimizer + kvstore;
+forward/backward/update (:575,629,646). TPU-native: one logical executor
+(data parallelism is an SPMD sharding on the compiled step, not per-ctx
+executor copies — DataParallelExecutorGroup collapses away).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from .. import kvstore as kvs_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..context import cpu, current_context
+from ..model import load_params as _load_params, save_checkpoint
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self.symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context if context is not None else current_context()
+        self._fixed_param_names = set(fixed_param_names or [])
+        self._exec = None
+        self._optimizer = None
+        self._kvstore = None
+        self._updater_states = {}
+        self._arg_names = symbol.list_arguments()
+        self._param_names = [n for n in self._arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..symbol import load as sym_load
+        sym = sym_load("%s-symbol.json" % prefix)
+        mod = Module(sym, **kwargs)
+        arg_params, aux_params = _load_params(prefix, epoch)
+        mod._preloaded_params = (arg_params, aux_params)
+        return mod
+
+    # -----------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """ref module.py:364."""
+        if self.binded and not force_rebind:
+            return
+        from ..executor import Executor
+
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = desc[0], desc[1]
+            shapes[name] = tuple(shape)
+        if label_shapes:
+            for desc in label_shapes:
+                shapes[desc[0]] = tuple(desc[1])
+        args = {k: nd.zeros(v) for k, v in shapes.items()}
+        self._exec = Executor(self.symbol, self._context, args,
+                              grad_req=grad_req if for_training else "null")
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self.binded = True
+        self.for_training = for_training
+        if hasattr(self, "_preloaded_params"):
+            arg_params, aux_params = self._preloaded_params
+            self.init_params(arg_params=arg_params, aux_params=aux_params)
+
+    @property
+    def param_names(self):
+        return [n for n in self._exec.arg_dict
+                if n not in self._data_names and n not in self._label_names
+                and not n.endswith("_label")
+                and n not in self._exec._aux_names] if self._exec else \
+            self._param_names
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """ref module.py init_params."""
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        for name in self.param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._data = arg_params[name].astype(arr.dtype)._data
+            else:
+                initializer(name, arr)
+        for name in self._exec._aux_names:
+            arr = self._exec.arg_dict[name]
+            if aux_params and name in aux_params:
+                arr._data = aux_params[name].astype(arr.dtype)._data
+            else:
+                initializer(name, arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self.param_names}
+        aux = {n: self._exec.arg_dict[n].copy() for n in self._exec._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(None, arg_params, aux_params, allow_missing, force_init,
+                         allow_extra)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        """ref module.py:474."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        optimizer_params = dict(optimizer_params or {"learning_rate": 0.01})
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self.param_names)}
+            optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
+                                       **optimizer_params)
+        self._optimizer = optimizer
+        if kvstore:
+            kv = kvs_mod.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+        self._updater_states = {}
+        self.optimizer_initialized = True
+
+    # -----------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """ref module.py:575."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        """ref module.py:629."""
+        self._exec.backward(out_grads)
+
+    def update(self):
+        """ref module.py:646 — optimizer step on accumulated grads."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self.param_names):
+            w = self._exec.arg_dict[name]
+            g = self._exec.grad_dict.get(name)
+            if g is None or name in self._fixed_param_names:
+                continue
+            if i not in self._updater_states:
+                self._updater_states[i] = self._optimizer.create_state_multi_precision(i, w)
+            new_state = self._optimizer.update_multi_precision(
+                i, w, g, self._updater_states[i])
+            if new_state is not None:
+                self._updater_states[i] = new_state
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._exec.outputs)
+
+    # -----------------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        """ref module.py:165."""
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    def save_optimizer_states(self, fname):
+        """ref module.py:793."""
+        import pickle
+        from ..optimizer.optimizer import _state_to_np
+        with open(fname, "wb") as f:
+            pickle.dump({k: _state_to_np(v) for k, v in self._updater_states.items()}, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+        from ..optimizer.optimizer import _state_from_np
+        with open(fname, "rb") as f:
+            st = pickle.load(f)
+        self._updater_states = {k: _state_from_np(v) for k, v in st.items()}

@@ -17,5 +17,6 @@ from .ndarray import (  # noqa
     SequenceReverse, make_loss, BlockGrad, stop_gradient, Embedding, CTCLoss,
     ctc_loss, save, load, Cast, Concat, SliceChannel, SwapAxis,
     elemwise_add, elemwise_sub, elemwise_mul, elemwise_div,
+    LinearRegressionOutput, LogisticRegressionOutput, MAERegressionOutput,
 )
 from .ndarray import slice_op as slice  # noqa  (MXNet nd.slice)

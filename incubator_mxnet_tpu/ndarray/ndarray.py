@@ -986,11 +986,11 @@ def Convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
 
     def conv(x, w):
         dn = lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
+        # bf16 operands accumulate in fp32 on the MXU natively; keeping the
+        # output dtype == input dtype keeps the VJP dtype-consistent
         return lax.conv_general_dilated(
             x, w, window_strides=stride, padding=[(p, p) for p in pad_],
-            rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-        ).astype(x.dtype)
+            rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group)
 
     if no_bias or bias is None:
         return _apply(conv, data, weight)
@@ -1105,18 +1105,83 @@ def SoftmaxActivation(data, mode="instance"):
     return softmax(data, axis=axis)
 
 
+@functools.lru_cache(maxsize=64)
+def _softmax_output_fn(grad_scale, ignore_label, use_ignore, normalization):
+    """Custom-VJP op matching src/operator/softmax_output.cc: forward =
+    softmax(data); backward = (softmax - one_hot(label)) * grad_scale,
+    independent of the incoming head gradient (loss-layer semantics)."""
+
+    @jax.custom_vjp
+    def op(x, lbl):
+        return jax.nn.softmax(x, axis=-1)
+
+    def fwd(x, lbl):
+        probs = jax.nn.softmax(x, axis=-1)
+        return probs, (probs, lbl)
+
+    def bwd(res, g):
+        probs, lbl = res
+        oh = jax.nn.one_hot(lbl.astype(jnp.int32), probs.shape[-1],
+                            dtype=probs.dtype)
+        grad = (probs - oh) * grad_scale
+        if use_ignore:
+            mask = (lbl != ignore_label).astype(probs.dtype)
+            grad = grad * jnp.expand_dims(mask, -1)
+        if normalization == "valid" and use_ignore:
+            n = jnp.maximum(jnp.sum(lbl != ignore_label), 1).astype(probs.dtype)
+            grad = grad / n
+        elif normalization == "batch":
+            grad = grad / probs.shape[0]
+        return grad, None
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1, use_ignore=False,
                   multi_output=False, preserve_shape=False, normalization="null",
                   out_grad=False, smooth_alpha=0.0, **kw):
-    """ref src/operator/softmax_output.cc — forward is softmax; the backward
-    (softmax - one_hot(label)) falls out of the XLA VJP of this construction."""
-    def fn(x, lbl):
-        probs = jax.nn.softmax(x, axis=-1)
-        # construct so that d(out)/dx under sum-loss == (softmax - onehot) * scale
-        oh = jax.nn.one_hot(lbl.astype(jnp.int32), x.shape[-1], dtype=x.dtype)
-        ce = -jnp.sum(oh * jax.nn.log_softmax(x, axis=-1), axis=-1)
-        return probs + 0.0 * jnp.expand_dims(ce, -1)  # value==softmax
-    return _apply(fn, data, label)
+    """ref src/operator/softmax_output.cc (loss-layer backward semantics)."""
+    op = _softmax_output_fn(float(grad_scale), int(ignore_label), bool(use_ignore),
+                            str(normalization))
+    return _apply(op, data, label)
+
+
+@functools.lru_cache(maxsize=16)
+def _regression_output_fn(kind, grad_scale):
+    """ref src/operator/regression_output.cc Linear/Logistic/MAE."""
+
+    @jax.custom_vjp
+    def op(x, lbl):
+        return jax.nn.sigmoid(x) if kind == "logistic" else x
+
+    def fwd(x, lbl):
+        out = jax.nn.sigmoid(x) if kind == "logistic" else x
+        return out, (out, lbl)
+
+    def bwd(res, g):
+        out, lbl = res
+        lblr = lbl.reshape(out.shape)
+        if kind == "mae":
+            grad = jnp.sign(out - lblr) * grad_scale
+        else:
+            grad = (out - lblr) * grad_scale
+        return grad, None
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def LinearRegressionOutput(data, label, grad_scale=1.0, **kw):
+    return _apply(_regression_output_fn("linear", float(grad_scale)), data, label)
+
+
+def LogisticRegressionOutput(data, label, grad_scale=1.0, **kw):
+    return _apply(_regression_output_fn("logistic", float(grad_scale)), data, label)
+
+
+def MAERegressionOutput(data, label, grad_scale=1.0, **kw):
+    return _apply(_regression_output_fn("mae", float(grad_scale)), data, label)
 
 
 def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
@@ -1227,7 +1292,8 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9
     def fn(x, g, b, mm, mv):
         gg = jnp.ones_like(g) if fix_gamma else g
         scale = gg.reshape(bshape) * lax.rsqrt(mv.reshape(bshape) + eps)
-        return (x - mm.reshape(bshape)) * scale + b.reshape(bshape)
+        out = (x - mm.reshape(bshape)) * scale + b.reshape(bshape)
+        return out.astype(x.dtype)
     return _apply(fn, data, gamma, beta, moving_mean, moving_var)
 
 
@@ -1266,7 +1332,8 @@ def InstanceNorm(data, gamma, beta, eps=1e-3, **kw):
         m = jnp.mean(x, axis=axes, keepdims=True)
         v = jnp.var(x, axis=axes, keepdims=True)
         shp = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-        return (x - m) * lax.rsqrt(v + eps) * g.reshape(shp) + b.reshape(shp)
+        out = (x - m) * lax.rsqrt(v + eps) * g.reshape(shp) + b.reshape(shp)
+        return out.astype(x.dtype)
     return _apply(fn, data, gamma, beta)
 
 

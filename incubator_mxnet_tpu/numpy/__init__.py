@@ -1,0 +1,471 @@
+"""mx.np — NumPy-compatible array namespace (ref python/mxnet/numpy/,
+"deepnumpy"). Backed by the same NDArray/jax machinery as nd; ops here follow
+NumPy semantics (true scalars, 0-d arrays, numpy broadcasting/naming).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..context import current_context
+from ..ndarray import NDArray, _apply, _ctx_put, _np_dtype
+from ..ndarray import ndarray as _nd_mod
+
+__all__ = ["ndarray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "logspace", "eye", "identity", "meshgrid", "concatenate",
+           "stack", "vstack", "hstack", "dstack", "split", "expand_dims",
+           "squeeze", "transpose", "swapaxes", "moveaxis", "reshape", "where",
+           "einsum", "dot", "matmul", "tensordot", "inner", "outer", "kron",
+           "trace", "diag", "tril", "triu", "cross", "clip", "unique", "sort",
+           "argsort", "argmax", "argmin", "take", "repeat", "tile", "flip",
+           "roll", "pad", "nonzero", "count_nonzero", "copysign", "isnan",
+           "isinf", "isfinite", "random", "linalg"]
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (ref numpy/multiarray.py ndarray)."""
+
+    def __getitem__(self, key):
+        key = _nd_mod._index_fixup(key)
+        return _apply_np(lambda x: x[key], self)
+
+    def _reduce(self, fn, axis=None, keepdims=False):
+        ax = _nd_mod._norm_axis(axis)
+        return _apply_np(lambda x: fn(x, axis=ax, keepdims=keepdims), self)
+
+    def mean(self, axis=None, dtype=None, keepdims=False, **kw):
+        return self._reduce(jnp.mean, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.std, axis, keepdims)
+
+    def var(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.var, axis, keepdims)
+
+    def reshape(self, *shape, **kw):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _apply_np(lambda x: x.reshape(shape), self)
+
+    def flatten(self, order="C"):
+        return _apply_np(lambda x: x.reshape(-1), self)
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        return _apply_np(lambda x: x.astype(_np_dtype(dtype)), self)
+
+    def copy(self):
+        return ndarray(jnp.array(self._data))
+
+    def as_nd_ndarray(self):
+        return NDArray(self._data)
+
+    @property
+    def T(self):
+        return _apply_np(jnp.transpose, self)
+
+
+def _apply_np(fn, *inputs):
+    """_apply but producing mx.np.ndarray outputs (keeps autograd taping)."""
+    out = _nd_mod._apply(fn, *inputs)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_vieww(o) for o in out)
+    return _vieww(out)
+
+
+def _vieww(x):
+    v = ndarray(x._data)
+    v._in_graph = x._in_graph
+    return v
+
+
+def _to(x):
+    if isinstance(x, NDArray):
+        return x
+    return array(x)
+
+
+# ------------------------------------------------------------ creation
+def array(object, dtype=None, ctx=None):
+    if isinstance(object, NDArray):
+        data = object._data
+        if dtype is not None:
+            data = data.astype(_np_dtype(dtype))
+        return ndarray(data)
+    data = onp.asarray(object, dtype=_np_dtype(dtype) if dtype else None)
+    if data.dtype == onp.float64 and dtype is None:
+        data = data.astype(onp.float32)
+    return ndarray(_ctx_put(data, ctx))
+
+
+def zeros(shape, dtype="float32", ctx=None, **kw):
+    return ndarray(_ctx_put(jnp.zeros(shape, _np_dtype(dtype)), ctx))
+
+
+def ones(shape, dtype="float32", ctx=None, **kw):
+    return ndarray(_ctx_put(jnp.ones(shape, _np_dtype(dtype)), ctx))
+
+
+def full(shape, fill_value, dtype="float32", ctx=None, **kw):
+    return ndarray(_ctx_put(jnp.full(shape, fill_value, _np_dtype(dtype)), ctx))
+
+
+def empty(shape, dtype="float32", ctx=None):
+    return zeros(shape, dtype, ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return ndarray(_ctx_put(jnp.arange(start, stop, step,
+                                       _np_dtype(dtype) if dtype else None), ctx))
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None, **kw):
+    return ndarray(_ctx_put(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                         dtype=_np_dtype(dtype) if dtype else None), ctx))
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, ctx=None):
+    return ndarray(_ctx_put(jnp.logspace(start, stop, num, endpoint, base), ctx))
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    return ndarray(_ctx_put(jnp.eye(N, M, k, dtype=_np_dtype(dtype)), ctx))
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def meshgrid(*xi, indexing="xy"):
+    outs = jnp.meshgrid(*[_to(x)._data for x in xi], indexing=indexing)
+    return [ndarray(o) for o in outs]
+
+
+# ------------------------------------------------------------ generated ops
+_UNARY_NP = ["abs", "absolute", "sign", "rint", "ceil", "floor", "trunc", "sqrt",
+             "cbrt", "square", "exp", "expm1", "log", "log2", "log10", "log1p",
+             "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+             "tanh", "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+             "reciprocal", "negative", "isnan", "isinf", "isfinite", "sort",
+             "nonzero"]
+_BINARY_NP = ["add", "subtract", "multiply", "divide", "true_divide", "mod",
+              "remainder", "power", "maximum", "minimum", "hypot", "arctan2",
+              "copysign", "equal", "not_equal", "less", "less_equal", "greater",
+              "greater_equal", "logical_and", "logical_or", "logical_xor",
+              "float_power", "fmod", "gcd", "lcm"]
+_REDUCE_NP = ["sum", "prod", "mean", "std", "var", "max", "min", "amax", "amin",
+              "nansum", "nanprod", "nanmax", "nanmin", "median", "all", "any"]
+
+_g = globals()
+for _name in _UNARY_NP:
+    def _mk(fn):
+        def op(x, out=None, **kw):
+            return _apply_np(fn, _to(x))
+        return op
+    _g[_name] = _mk(getattr(jnp, _name))
+    if _name not in __all__:
+        __all__.append(_name)
+
+for _name in _BINARY_NP:
+    def _mkb(fn):
+        def op(x1, x2, out=None, **kw):
+            if isinstance(x1, NDArray) and isinstance(x2, NDArray):
+                return _apply_np(fn, x1, x2)
+            if isinstance(x1, NDArray):
+                return _apply_np(lambda a: fn(a, x2), x1)
+            if isinstance(x2, NDArray):
+                return _apply_np(lambda b: fn(x1, b), x2)
+            return _apply_np(fn, _to(x1), _to(x2))
+        return op
+    _g[_name] = _mkb(getattr(jnp, _name))
+    if _name not in __all__:
+        __all__.append(_name)
+
+for _name in _REDUCE_NP:
+    def _mkr(fn):
+        def op(a, axis=None, keepdims=False, out=None, **kw):
+            ax = _nd_mod._norm_axis(axis)
+            return _apply_np(lambda x: fn(x, axis=ax, keepdims=keepdims), _to(a))
+        return op
+    _g[_name] = _mkr(getattr(jnp, _name))
+    if _name not in __all__:
+        __all__.append(_name)
+
+
+# ------------------------------------------------------------ shape/linalg ops
+def concatenate(seq, axis=0, out=None):
+    return _apply_np(lambda *xs: jnp.concatenate(xs, axis=axis), *[_to(s) for s in seq])
+
+
+def stack(arrays, axis=0, out=None):
+    return _apply_np(lambda *xs: jnp.stack(xs, axis=axis), *[_to(a) for a in arrays])
+
+
+def vstack(tup):
+    return _apply_np(lambda *xs: jnp.vstack(xs), *[_to(a) for a in tup])
+
+
+def hstack(tup):
+    return _apply_np(lambda *xs: jnp.hstack(xs), *[_to(a) for a in tup])
+
+
+def dstack(tup):
+    return _apply_np(lambda *xs: jnp.dstack(xs), *[_to(a) for a in tup])
+
+
+def split(ary, indices_or_sections, axis=0):
+    out = _apply_np(lambda x: jnp.split(x, indices_or_sections, axis=axis), _to(ary))
+    return list(out)
+
+
+def expand_dims(a, axis):
+    return _apply_np(lambda x: jnp.expand_dims(x, axis), _to(a))
+
+
+def squeeze(a, axis=None):
+    return _apply_np(lambda x: jnp.squeeze(x, axis), _to(a))
+
+
+def transpose(a, axes=None):
+    return _apply_np(lambda x: jnp.transpose(x, axes), _to(a))
+
+
+def swapaxes(a, axis1, axis2):
+    return _apply_np(lambda x: jnp.swapaxes(x, axis1, axis2), _to(a))
+
+
+def moveaxis(a, source, destination):
+    return _apply_np(lambda x: jnp.moveaxis(x, source, destination), _to(a))
+
+
+def reshape(a, newshape, order="C"):
+    return _apply_np(lambda x: jnp.reshape(x, newshape), _to(a))
+
+
+def where(condition, x=None, y=None):
+    if x is None:
+        return tuple(ndarray(o) for o in jnp.where(_to(condition)._data))
+    return _apply_np(lambda c, a, b: jnp.where(c, a, b), _to(condition), _to(x), _to(y))
+
+
+def einsum(subscripts, *operands, **kw):
+    """ref numpy/np_einsum_op — jnp.einsum hits the MXU directly."""
+    return _apply_np(lambda *xs: jnp.einsum(subscripts, *xs),
+                     *[_to(o) for o in operands])
+
+
+def dot(a, b, out=None):
+    return _apply_np(jnp.dot, _to(a), _to(b))
+
+
+def matmul(a, b, out=None):
+    return _apply_np(jnp.matmul, _to(a), _to(b))
+
+
+def tensordot(a, b, axes=2):
+    return _apply_np(lambda x, y: jnp.tensordot(x, y, axes=axes), _to(a), _to(b))
+
+
+def inner(a, b):
+    return _apply_np(jnp.inner, _to(a), _to(b))
+
+
+def outer(a, b):
+    return _apply_np(jnp.outer, _to(a), _to(b))
+
+
+def kron(a, b):
+    return _apply_np(jnp.kron, _to(a), _to(b))
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _apply_np(lambda x: jnp.trace(x, offset, axis1, axis2), _to(a))
+
+
+def diag(v, k=0):
+    return _apply_np(lambda x: jnp.diag(x, k), _to(v))
+
+
+def tril(m, k=0):
+    return _apply_np(lambda x: jnp.tril(x, k), _to(m))
+
+
+def triu(m, k=0):
+    return _apply_np(lambda x: jnp.triu(x, k), _to(m))
+
+
+def cross(a, b, axis=-1):
+    return _apply_np(lambda x, y: jnp.cross(x, y, axis=axis), _to(a), _to(b))
+
+
+def clip(a, a_min, a_max, out=None):
+    return _apply_np(lambda x: jnp.clip(x, a_min, a_max), _to(a))
+
+
+def unique(ar, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    res = onp.unique(_to(ar).asnumpy(), return_index=return_index,
+                     return_inverse=return_inverse, return_counts=return_counts,
+                     axis=axis)
+    if isinstance(res, tuple):
+        return tuple(array(r) for r in res)
+    return array(res)
+
+
+def argsort(a, axis=-1, kind=None, order=None):
+    return _apply_np(lambda x: jnp.argsort(x, axis=axis), _to(a))
+
+
+def argmax(a, axis=None, out=None, keepdims=False):
+    return _apply_np(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def argmin(a, axis=None, out=None, keepdims=False):
+    return _apply_np(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def take(a, indices, axis=None, mode=None, out=None):
+    return _apply_np(lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis),
+                     _to(a), _to(indices))
+
+
+def repeat(a, repeats, axis=None):
+    return _apply_np(lambda x: jnp.repeat(x, repeats, axis=axis), _to(a))
+
+
+def tile(A, reps):
+    return _apply_np(lambda x: jnp.tile(x, reps), _to(A))
+
+
+def flip(m, axis=None):
+    return _apply_np(lambda x: jnp.flip(x, axis), _to(m))
+
+
+def roll(a, shift, axis=None):
+    return _apply_np(lambda x: jnp.roll(x, shift, axis), _to(a))
+
+
+def pad(array_, pad_width, mode="constant", **kw):
+    return _apply_np(lambda x: jnp.pad(x, pad_width, mode=mode, **kw), _to(array_))
+
+
+def count_nonzero(a, axis=None):
+    return _apply_np(lambda x: jnp.count_nonzero(x, axis=axis), _to(a))
+
+
+# ------------------------------------------------------------ submodules
+class _NPRandom:
+    """mx.np.random (ref python/mxnet/numpy/random.py)."""
+
+    @staticmethod
+    def _key():
+        from ..ndarray.random import _next_key
+        return _next_key()
+
+    def seed(self, s):
+        from ..ndarray import random as _r
+        _r.seed(s)
+
+    def uniform(self, low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+        size = size if size is not None else ()
+        return ndarray(jax.random.uniform(self._key(), size if isinstance(size, tuple)
+                                          else (size,), _np_dtype(dtype or "float32"),
+                                          low, high))
+
+    def normal(self, loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+        size = size if size is not None else ()
+        shp = size if isinstance(size, tuple) else (size,)
+        return ndarray(loc + scale * jax.random.normal(
+            self._key(), shp, _np_dtype(dtype or "float32")))
+
+    def randint(self, low, high=None, size=None, dtype="int64", ctx=None):
+        if high is None:
+            low, high = 0, low
+        shp = size if isinstance(size, tuple) else ((size,) if size else ())
+        return ndarray(jax.random.randint(self._key(), shp, low, high,
+                                          onp.dtype("int32")))
+
+    def rand(self, *size):
+        return self.uniform(size=size or ())
+
+    def randn(self, *size):
+        return self.normal(size=size or ())
+
+    def choice(self, a, size=None, replace=True, p=None):
+        arr = _to(a)._data if isinstance(a, (NDArray, onp.ndarray, list)) else jnp.arange(a)
+        shp = size if isinstance(size, tuple) else ((size,) if size else ())
+        return ndarray(jax.random.choice(self._key(), arr, shp, replace,
+                                         None if p is None else _to(p)._data))
+
+    def shuffle(self, x):
+        x._data = jax.random.permutation(self._key(), x._data, axis=0)
+
+
+random = _NPRandom()
+
+
+class _NPLinalg:
+    """mx.np.linalg (ref python/mxnet/numpy/linalg.py)."""
+
+    def norm(self, x, ord=None, axis=None, keepdims=False):
+        return _apply_np(lambda a: jnp.linalg.norm(a, ord, axis, keepdims), _to(x))
+
+    def inv(self, a):
+        return _apply_np(jnp.linalg.inv, _to(a))
+
+    def det(self, a):
+        return _apply_np(jnp.linalg.det, _to(a))
+
+    def slogdet(self, a):
+        s, l = jnp.linalg.slogdet(_to(a)._data)
+        return ndarray(s), ndarray(l)
+
+    def cholesky(self, a):
+        return _apply_np(jnp.linalg.cholesky, _to(a))
+
+    def qr(self, a):
+        q, r = jnp.linalg.qr(_to(a)._data)
+        return ndarray(q), ndarray(r)
+
+    def svd(self, a):
+        u, s, vt = jnp.linalg.svd(_to(a)._data, full_matrices=False)
+        return ndarray(u), ndarray(s), ndarray(vt)
+
+    def eigh(self, a):
+        w, v = jnp.linalg.eigh(_to(a)._data)
+        return ndarray(w), ndarray(v)
+
+    def solve(self, a, b):
+        return _apply_np(jnp.linalg.solve, _to(a), _to(b))
+
+    def lstsq(self, a, b, rcond="warn"):
+        res = jnp.linalg.lstsq(_to(a)._data, _to(b)._data)
+        return tuple(ndarray(r) for r in res)
+
+    def pinv(self, a):
+        return _apply_np(jnp.linalg.pinv, _to(a))
+
+    def matrix_rank(self, a):
+        return _apply_np(jnp.linalg.matrix_rank, _to(a))
+
+
+linalg = _NPLinalg()
+
+pi = onp.pi
+e = onp.e
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+float32 = onp.float32
+float64 = onp.float64
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+bool_ = onp.bool_

@@ -1,0 +1,49 @@
+"""npx — mx.numpy_extension (ref python/mxnet/numpy_extension/):
+neural-net ops usable on mx.np arrays + np-mode switches."""
+from __future__ import annotations
+
+from .. import ndarray as _nd
+from ..numpy import ndarray as np_ndarray, _apply_np, _to
+from ..util import set_np, reset_np, is_np_array, use_np
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
+
+__all__ = ["set_np", "reset_np", "is_np_array", "use_np", "cpu", "gpu", "tpu",
+           "num_gpus", "num_tpus", "current_context", "relu", "sigmoid",
+           "softmax", "log_softmax", "activation", "batch_norm", "layer_norm",
+           "fully_connected", "convolution", "pooling", "dropout", "one_hot",
+           "pick", "topk", "embedding", "gamma", "reshape_like", "waitall",
+           "seed"]
+
+
+def _wrap(nd_fn):
+    def op(*args, **kwargs):
+        out = nd_fn(*args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return type(out)(np_ndarray(o._data) for o in out)
+        return np_ndarray(out._data)
+    return op
+
+
+relu = _wrap(_nd.relu)
+sigmoid = _wrap(_nd.sigmoid)
+softmax = _wrap(_nd.softmax)
+log_softmax = _wrap(_nd.log_softmax)
+activation = _wrap(_nd.Activation)
+batch_norm = _wrap(_nd.BatchNorm)
+layer_norm = _wrap(_nd.LayerNorm)
+fully_connected = _wrap(_nd.FullyConnected)
+convolution = _wrap(_nd.Convolution)
+pooling = _wrap(_nd.Pooling)
+dropout = _wrap(_nd.Dropout)
+one_hot = _wrap(_nd.one_hot)
+pick = _wrap(_nd.pick)
+topk = _wrap(_nd.topk)
+embedding = _wrap(_nd.Embedding)
+gamma = _wrap(_nd.gamma)
+reshape_like = _wrap(_nd.reshape_like)
+waitall = _nd.waitall
+
+
+def seed(s):
+    from ..ndarray import random as _r
+    _r.seed(s)

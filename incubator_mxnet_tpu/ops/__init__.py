@@ -1,0 +1,3 @@
+"""Custom TPU ops: Pallas kernels + composite HLO ops (multibox, ctc)."""
+from . import multibox  # noqa
+from .multibox import MultiBoxPrior, MultiBoxTarget, MultiBoxDetection  # noqa

@@ -1,0 +1,182 @@
+"""MultiBox SSD ops (ref src/operator/contrib/multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc — required by BASELINE config 4).
+
+TPU-native: everything is dense, statically-shaped XLA — IoU matrices as
+batched einsums, NMS as a fixed-trip-count lax.fori_loop with masking (no
+dynamic shapes, so the whole detection head compiles onto the chip).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray import NDArray, _apply, _to_nd
+
+__all__ = ["MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection", "box_iou"]
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                  offsets=(0.5, 0.5)):
+    """Generate anchor boxes per feature-map pixel (ref multibox_prior.cc).
+
+    data: (N, C, H, W). Returns (1, H*W*(len(sizes)+len(ratios)-1), 4) corners
+    normalised to [0,1] — matches MXNet's anchor layout.
+    """
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+
+    def fn(_):
+        cy = (jnp.arange(h) + offsets[0]) * step_y
+        cx = (jnp.arange(w) + offsets[1]) * step_x
+        cy, cx = jnp.meshgrid(cy, cx, indexing="ij")         # (H, W)
+        boxes = []
+        # MXNet order: (s_i, r_0) for all sizes, then (s_0, r_j) for ratios[1:]
+        for s in sizes:
+            r = ratios[0]
+            bw, bh = s * jnp.sqrt(r) / 2, s / jnp.sqrt(r) / 2
+            boxes.append((bw, bh))
+        for r in ratios[1:]:
+            s = sizes[0]
+            bw, bh = s * jnp.sqrt(r) / 2, s / jnp.sqrt(r) / 2
+            boxes.append((bw, bh))
+        anchors = []
+        for bw, bh in boxes:
+            a = jnp.stack([cx - bw, cy - bh, cx + bw, cy + bh], axis=-1)  # (H,W,4)
+            anchors.append(a)
+        out = jnp.stack(anchors, axis=2).reshape(-1, 4)      # (H*W*A, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out[None]
+    return _apply(fn, _to_nd(data))
+
+
+def box_iou(a, b):
+    """IoU matrix between (Na,4) and (Nb,4) corner boxes."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1, negative_mining_ratio=-1,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign training targets (ref multibox_target.cc).
+
+    anchor: (1, A, 4); label: (N, M, 5) [cls, xmin, ymin, xmax, ymax] with
+    cls == -1 padding; cls_pred: (N, num_cls+1, A) (used for hard mining).
+    Returns [loc_target (N, A*4), loc_mask (N, A*4), cls_target (N, A)].
+    """
+    v = jnp.asarray(variances)
+
+    def one_sample(lbl, cp):
+        valid = lbl[:, 0] >= 0                                  # (M,)
+        gt = lbl[:, 1:5]
+        anc = anchor._data[0] if isinstance(anchor, NDArray) else anchor[0]
+        iou = box_iou(anc, gt)                                  # (A, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                       # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        # each gt's best anchor is forced positive
+        best_anchor_for_gt = jnp.argmax(iou, axis=0)            # (M,)
+        forced = jnp.zeros(anc.shape[0], bool).at[best_anchor_for_gt].set(valid)
+        pos = (best_iou >= overlap_threshold) | forced
+        matched_gt = gt[best_gt]                                # (A, 4)
+        matched_cls = lbl[best_gt, 0]
+        # encode loc targets (center/size, variance-normalised)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(matched_gt[:, 2] - matched_gt[:, 0], 1e-8)
+        gh = jnp.maximum(matched_gt[:, 3] - matched_gt[:, 1], 1e-8)
+        gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+        gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+        loc = jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-8) / v[0],
+                         (gcy - acy) / jnp.maximum(ah, 1e-8) / v[1],
+                         jnp.log(gw / jnp.maximum(aw, 1e-8)) / v[2],
+                         jnp.log(gh / jnp.maximum(ah, 1e-8)) / v[3]], axis=-1)
+        loc = jnp.where(pos[:, None], loc, 0.0)
+        mask = jnp.where(pos[:, None], jnp.ones_like(loc), 0.0)
+        cls_t = jnp.where(pos, matched_cls + 1.0, 0.0)          # 0 = background
+        if negative_mining_ratio > 0:
+            # hard negative mining by background confidence
+            bg_prob = jax.nn.softmax(cp, axis=0)[0]             # (A,)
+            neg_score = jnp.where(pos, jnp.inf, bg_prob)
+            n_pos = jnp.sum(pos)
+            n_neg = jnp.minimum(
+                (negative_mining_ratio * n_pos).astype(jnp.int32),
+                anc.shape[0] - n_pos.astype(jnp.int32))
+            order = jnp.argsort(neg_score)                      # hardest first
+            rank = jnp.zeros(anc.shape[0], jnp.int32).at[order].set(
+                jnp.arange(anc.shape[0], dtype=jnp.int32))
+            keep_neg = rank < n_neg
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, float(ignore_label)))
+        return loc.reshape(-1), mask.reshape(-1), cls_t
+
+    def fn(anc, lbl, cp):
+        loc, mask, cls_t = jax.vmap(one_sample)(lbl, cp)
+        return loc, mask, cls_t
+
+    return _apply(fn, _to_nd(anchor), _to_nd(label), _to_nd(cls_pred))
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5, force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (ref multibox_detection.cc).
+
+    cls_prob: (N, num_cls+1, A); loc_pred: (N, A*4); anchor: (1, A, 4).
+    Returns (N, A, 6): [cls_id, score, xmin, ymin, xmax, ymax], cls_id=-1 ⇒
+    suppressed. Fixed shapes: NMS is a masked fori_loop.
+    """
+    v = jnp.asarray(variances)
+
+    def one(cp, lp, anc):
+        A = anc.shape[0]
+        scores = cp[1:]                                         # (C, A) drop bg
+        cls_id = jnp.argmax(scores, axis=0)                     # (A,)
+        score = jnp.max(scores, axis=0)
+        # decode
+        loc = lp.reshape(A, 4)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        cx = loc[:, 0] * v[0] * aw + acx
+        cy = loc[:, 1] * v[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * v[2]) * aw / 2
+        h = jnp.exp(loc[:, 3] * v[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        keep = score > threshold
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
+        boxes_o = boxes[order]
+        score_o = jnp.where(keep[order], score[order], 0.0)
+        cls_o = jnp.where(keep[order], cls_id[order].astype(jnp.float32), -1.0)
+        iou = box_iou(boxes_o, boxes_o)
+
+        def body(i, alive):
+            sup = (iou[i] > nms_threshold) & (jnp.arange(A) > i) & alive[i]
+            if not force_suppress:
+                sup = sup & (cls_o == cls_o[i])
+            return alive & ~sup
+
+        alive = lax.fori_loop(0, A, body, cls_o >= 0)
+        cls_final = jnp.where(alive, cls_o, -1.0)
+        return jnp.concatenate([cls_final[:, None], score_o[:, None], boxes_o],
+                               axis=-1)
+
+    def fn(cp, lp, anc):
+        return jax.vmap(lambda c, l: one(c, l, anc[0]))(cp, lp)
+
+    return _apply(fn, _to_nd(cls_prob), _to_nd(loc_pred), _to_nd(anchor))

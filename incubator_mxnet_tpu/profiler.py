@@ -1,0 +1,130 @@
+"""Profiler (ref src/profiler/profiler.h:251, python/mxnet/profiler.py).
+
+Reference parity: set_config / set_state('run'/'stop') / dumps, scoped
+``profiler.scope``, chrome://tracing JSON output, in-memory aggregate table.
+TPU-native: wraps jax.profiler (XLA xplane traces for device time) and a
+host-side event recorder emitting the same chrome-trace JSON format.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
+           "scope", "Marker", "record_event"]
+
+_CONFIG = {"filename": "profile.json", "aggregate_stats": True}
+_STATE = {"running": False, "jax_trace_dir": None}
+_EVENTS = []
+_LOCK = threading.Lock()
+_AGG = {}
+
+
+def set_config(**kwargs):
+    """ref profiler.py set_config (filename, profile_all, aggregate_stats...)."""
+    _CONFIG.update(kwargs)
+
+
+def set_state(state_="stop", profile_process="worker"):
+    """ref profiler.py set_state('run'|'stop')."""
+    if state_ == "run" and not _STATE["running"]:
+        _STATE["running"] = True
+        try:
+            import jax
+            trace_dir = _CONFIG.get("jax_trace_dir")
+            if trace_dir:
+                jax.profiler.start_trace(trace_dir)
+                _STATE["jax_trace_dir"] = trace_dir
+        except Exception:
+            pass
+    elif state_ == "stop" and _STATE["running"]:
+        _STATE["running"] = False
+        if _STATE["jax_trace_dir"]:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _STATE["jax_trace_dir"] = None
+
+
+def state():
+    return "run" if _STATE["running"] else "stop"
+
+
+def record_event(name, categories="host", start_us=None, dur_us=None):
+    """Record one host-side event (complete-event 'X' phase)."""
+    if not _STATE["running"]:
+        return
+    with _LOCK:
+        _EVENTS.append({"name": name, "cat": categories, "ph": "X",
+                        "ts": start_us if start_us is not None else time.time() * 1e6,
+                        "dur": dur_us or 0, "pid": 0, "tid": threading.get_ident()})
+        agg = _AGG.setdefault(name, {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        agg["count"] += 1
+        agg["total_us"] += dur_us or 0
+        agg["max_us"] = max(agg["max_us"], dur_us or 0)
+
+
+class Marker:
+    """Scoped host event (≙ ProfileTask/ProfileEvent)."""
+
+    def __init__(self, name, categories="host"):
+        self.name = name
+        self.categories = categories
+
+    def __enter__(self):
+        self._t0 = time.time() * 1e6
+        return self
+
+    def __exit__(self, *a):
+        record_event(self.name, self.categories, self._t0,
+                     time.time() * 1e6 - self._t0)
+
+
+class scope:
+    """ref profiler.py profiler.scope — names nested under a prefix."""
+
+    _current = threading.local()
+
+    def __init__(self, name="<unk>:"):
+        self.name = name
+
+    def __enter__(self):
+        self._old = getattr(scope._current, "value", "")
+        scope._current.value = self._old + self.name
+        return self
+
+    def __exit__(self, *a):
+        scope._current.value = self._old
+
+
+def pause(profile_process="worker"):
+    _STATE["running"] = False
+
+
+def resume(profile_process="worker"):
+    _STATE["running"] = True
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate stats table (ref aggregate_stats.cc)."""
+    lines = ["%-40s %8s %12s %12s" % ("Name", "Calls", "Total(us)", "Max(us)")]
+    with _LOCK:
+        for name, agg in sorted(_AGG.items()):
+            lines.append("%-40s %8d %12.1f %12.1f"
+                         % (name[:40], agg["count"], agg["total_us"], agg["max_us"]))
+        if reset:
+            _AGG.clear()
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (ref profiler.h EmitEvents)."""
+    with _LOCK:
+        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        with open(_CONFIG["filename"], "w") as f:
+            json.dump(payload, f)
+        if finished:
+            _EVENTS.clear()

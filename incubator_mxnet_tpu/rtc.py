@@ -1,0 +1,53 @@
+"""Runtime kernel compilation (ref python/mxnet/rtc.py CudaModule/NVRTC,
+src/common/rtc.cc).
+
+TPU-native: user runtime kernels are Pallas kernels, not CUDA source. A
+PallasModule compiles a user-supplied Pallas kernel function at runtime with
+the same module/get_kernel/launch UX the reference offered for NVRTC.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray, _apply
+
+__all__ = ["PallasModule", "CudaModule"]
+
+
+class PallasModule:
+    """Runtime-compiled device kernels from a Pallas function."""
+
+    def __init__(self, kernel_fn, out_shape_fn=None):
+        """kernel_fn(*refs) in pallas style; out_shape_fn(*arrs)->ShapeDtypeStruct."""
+        self._kernel_fn = kernel_fn
+        self._out_shape_fn = out_shape_fn
+
+    def get_kernel(self, name=None, signature=None):
+        return PallasKernel(self._kernel_fn, self._out_shape_fn)
+
+
+class PallasKernel:
+    def __init__(self, kernel_fn, out_shape_fn):
+        self._kernel_fn = kernel_fn
+        self._out_shape_fn = out_shape_fn
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        from jax.experimental import pallas as pl
+
+        arrs = [a._data if isinstance(a, NDArray) else jnp.asarray(a) for a in args]
+        out_shape = (self._out_shape_fn(*arrs) if self._out_shape_fn
+                     else jax.ShapeDtypeStruct(arrs[0].shape, arrs[0].dtype))
+        fn = pl.pallas_call(self._kernel_fn, out_shape=out_shape,
+                            grid=grid_dims if grid_dims else None)
+        return NDArray(fn(*arrs))
+
+
+class CudaModule:
+    """Compatibility shim: CUDA source modules cannot run on TPU."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise RuntimeError(
+            "CudaModule (NVRTC) is CUDA-only; on TPU use rtc.PallasModule with "
+            "a Pallas kernel function instead.")

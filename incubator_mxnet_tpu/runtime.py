@@ -1,0 +1,78 @@
+"""Runtime feature detection (ref python/mxnet/runtime.py, include/mxnet/libinfo.h)."""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "[%s %s]" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    import jax
+
+    feats = {
+        "TPU": any(d.platform in ("tpu", "axon") for d in _safe_devices(jax)),
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "XLA": True,
+        "PALLAS": True,
+        "BF16": True,
+        "INT64_TENSOR_SIZE": True,
+        "DIST_KVSTORE": True,
+        "SPMD_SHARDING": True,
+        "RING_ATTENTION": True,
+        "OPENMP": True,
+        "NATIVE_RECORDIO": _has_native(),
+        "SSE": True,
+        "F16C": True,
+        "MKLDNN": False,
+        "OPENCV": _has_pil(),
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+def _safe_devices(jax):
+    try:
+        return jax.devices()
+    except RuntimeError:
+        return []
+
+
+def _has_native():
+    try:
+        from .native import lib as _lib
+        return _lib.available()
+    except Exception:
+        return False
+
+
+def _has_pil():
+    try:
+        import PIL  # noqa
+        return True
+    except ImportError:
+        return False
+
+
+class Features(dict):
+    """ref runtime.py Features."""
+
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown" % feature_name)
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,247 @@
+"""mx.sym namespace — symbolic mirrors of the nd ops
+(ref python/mxnet/symbol/__init__.py and register.py generation).
+
+Simple ops are generated from the nd namespace; layer ops (FullyConnected,
+Convolution, ...) auto-create parameter Variables with deferred shape rules,
+so ``simple_bind`` can allocate them from the data shape alone — the analog of
+NNVM shape inference (SURVEY §2.1 GraphExecutor InferShape)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from .symbol import (Symbol, Group, Variable, var, load, load_json, zeros, ones,
+                     _auto_name)
+
+__all__ = ["Symbol", "Group", "Variable", "var", "load", "load_json", "zeros",
+           "ones"]
+
+_OP_TABLE = {}
+
+
+def _deferred_rules(op_name, kwargs):
+    """Deferred param-shape rules by op + attrs, for graph-JSON reload
+    (input index → shape_fn(data_shape))."""
+    if op_name == "FullyConnected":
+        nh = kwargs.get("num_hidden")
+        flatten_ = kwargs.get("flatten", True)
+
+        def w_shape(s):
+            inu = int(onp.prod(s[1:])) if flatten_ else s[-1]
+            return (nh, inu)
+        return {1: w_shape, 2: lambda s: (nh,)}
+    if op_name == "Convolution":
+        nf = kwargs.get("num_filter")
+        kernel = tuple(kwargs.get("kernel"))
+        ng = kwargs.get("num_group", 1)
+        return {1: lambda s: (nf, s[1] // ng) + kernel, 2: lambda s: (nf,)}
+    if op_name in ("BatchNorm",):
+        ax = kwargs.get("axis", 1)
+        c = lambda s: (s[ax],)
+        return {1: c, 2: c, 3: c, 4: c}
+    if op_name == "LayerNorm":
+        ax = kwargs.get("axis", -1)
+        c = lambda s: (s[ax],)
+        return {1: c, 2: c}
+    if op_name == "Embedding":
+        return {1: lambda s: (kwargs.get("input_dim"), kwargs.get("output_dim"))}
+    return None
+
+
+def _op_lookup(name):
+    if name in _OP_TABLE:
+        return _OP_TABLE[name]
+    return getattr(nd, name)
+
+
+def _symbolize(fn, op_name):
+    """Wrap an nd function into a Symbol builder."""
+
+    def sym_fn(*args, name=None, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            else:
+                raise TypeError("%s: positional args must be Symbols" % op_name)
+        return Symbol(op=fn, op_name=op_name, inputs=inputs, kwargs=kwargs,
+                      name=name)
+
+    sym_fn.__name__ = op_name
+    _OP_TABLE[op_name] = fn
+    return sym_fn
+
+
+# generate the simple-op surface from nd
+_SIMPLE_OPS = [
+    "abs", "sign", "round", "ceil", "floor", "trunc", "square", "sqrt", "rsqrt",
+    "exp", "log", "log10", "log2", "log1p", "expm1", "sin", "cos", "tan",
+    "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "sigmoid", "relu",
+    "softsign", "reciprocal", "negative", "erf", "gamma", "gammaln",
+    "add", "subtract", "multiply", "divide", "power", "maximum", "minimum",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_power", "broadcast_to",
+    "sum", "mean", "prod", "max", "min", "norm", "argmax", "argmin", "clip",
+    "reshape", "flatten", "transpose", "swapaxes", "expand_dims", "squeeze",
+    "tile", "repeat", "pad", "flip", "concat", "stack", "split", "slice_axis",
+    "take", "pick", "one_hot", "gather_nd", "where", "cast", "zeros_like",
+    "ones_like", "dot", "batch_dot", "softmax", "log_softmax", "softmin",
+    "sequence_mask", "SequenceMask", "SequenceLast", "SequenceReverse",
+    "make_loss", "BlockGrad", "identity", "L2Normalization", "LRN",
+    "UpSampling", "BilinearResize2D", "slice_like", "amp_cast",
+]
+_g = globals()
+for _name in _SIMPLE_OPS:
+    _g[_name] = _symbolize(getattr(nd, _name), _name)
+    __all__.append(_name)
+slice = _symbolize(nd.slice, "slice")
+Concat = _g["concat"]
+SliceChannel = _g["split"]
+Flatten = _g["flatten"]
+Cast = _g["cast"]
+
+
+# -------------------------------------------------------------- layer ops
+def _param_var(base_name, suffix, shape_fn):
+    v = var("%s_%s" % (base_name, suffix))
+    v._deferred_shape_fn = shape_fn
+    v._is_param = True
+    return v
+
+
+def FullyConnected(data=None, weight=None, bias=None, num_hidden=None,
+                   no_bias=False, flatten=True, name=None, **kw):
+    """ref nn/fully_connected.cc symbol interface (auto weight/bias vars)."""
+    name = name or _auto_name("fullyconnected")
+
+    def w_shape(in_shape):
+        in_units = int(onp.prod(in_shape[1:])) if flatten else in_shape[-1]
+        return (num_hidden, in_units)
+
+    weight = weight if weight is not None else _param_var(name, "weight", w_shape)
+    inputs = [data, weight]
+    if not no_bias:
+        bias = bias if bias is not None else _param_var(
+            name, "bias", lambda s: (num_hidden,))
+        inputs.append(bias)
+    kwargs = dict(num_hidden=num_hidden, no_bias=no_bias, flatten=flatten)
+    return Symbol(op=nd.FullyConnected, op_name="FullyConnected", inputs=inputs,
+                  kwargs=kwargs, name=name)
+
+
+def Convolution(data=None, weight=None, bias=None, kernel=None, stride=(1, 1),
+                dilate=(1, 1), pad=(0, 0), num_filter=None, num_group=1,
+                no_bias=False, layout="NCHW", name=None, **kw):
+    name = name or _auto_name("convolution")
+
+    def w_shape(in_shape):
+        return (num_filter, in_shape[1] // num_group) + tuple(kernel)
+
+    weight = weight if weight is not None else _param_var(name, "weight", w_shape)
+    inputs = [data, weight]
+    if not no_bias:
+        bias = bias if bias is not None else _param_var(
+            name, "bias", lambda s: (num_filter,))
+        inputs.append(bias)
+    kwargs = dict(kernel=kernel, stride=stride, dilate=dilate, pad=pad,
+                  num_filter=num_filter, num_group=num_group, no_bias=no_bias)
+    return Symbol(op=nd.Convolution, op_name="Convolution", inputs=inputs,
+                  kwargs=kwargs, name=name)
+
+
+def BatchNorm(data=None, gamma=None, beta=None, moving_mean=None, moving_var=None,
+              eps=1e-5, momentum=0.9, fix_gamma=True, use_global_stats=False,
+              axis=1, name=None, **kw):
+    name = name or _auto_name("batchnorm")
+    c_shape = lambda s: (s[axis],)
+    gamma = gamma if gamma is not None else _param_var(name, "gamma", c_shape)
+    beta = beta if beta is not None else _param_var(name, "beta", c_shape)
+    moving_mean = moving_mean if moving_mean is not None else _param_var(
+        name, "moving_mean", c_shape)
+    moving_var = moving_var if moving_var is not None else _param_var(
+        name, "moving_var", c_shape)
+    moving_mean._is_aux = True
+    moving_var._is_aux = True
+    kwargs = dict(eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                  use_global_stats=use_global_stats, axis=axis)
+    return Symbol(op=nd.BatchNorm, op_name="BatchNorm",
+                  inputs=[data, gamma, beta, moving_mean, moving_var],
+                  kwargs=kwargs, name=name)
+
+
+def Activation(data=None, act_type="relu", name=None, **kw):
+    return Symbol(op=nd.Activation, op_name="Activation", inputs=[data],
+                  kwargs=dict(act_type=act_type), name=name)
+
+
+def LeakyReLU(data=None, act_type="leaky", slope=0.25, name=None, **kw):
+    return Symbol(op=nd.LeakyReLU, op_name="LeakyReLU", inputs=[data],
+                  kwargs=dict(act_type=act_type, slope=slope), name=name)
+
+
+def Pooling(data=None, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid", name=None, **kw):
+    kwargs = dict(kernel=kernel, pool_type=pool_type, global_pool=global_pool,
+                  stride=stride, pad=pad, pooling_convention=pooling_convention)
+    return Symbol(op=nd.Pooling, op_name="Pooling", inputs=[data], kwargs=kwargs,
+                  name=name)
+
+
+def Dropout(data=None, p=0.5, name=None, **kw):
+    return Symbol(op=nd.Dropout, op_name="Dropout", inputs=[data],
+                  kwargs=dict(p=p), name=name)
+
+
+def SoftmaxOutput(data=None, label=None, grad_scale=1.0, name=None, **kw):
+    name = name or "softmax"
+    label = label if label is not None else var(name + "_label")
+    label._is_label = True
+    return Symbol(op=nd.SoftmaxOutput, op_name="SoftmaxOutput",
+                  inputs=[data, label], kwargs=dict(grad_scale=grad_scale),
+                  name=name)
+
+
+def Embedding(data=None, weight=None, input_dim=None, output_dim=None,
+              name=None, **kw):
+    name = name or _auto_name("embedding")
+    weight = weight if weight is not None else _param_var(
+        name, "weight", lambda s: (input_dim, output_dim))
+    return Symbol(op=nd.Embedding, op_name="Embedding", inputs=[data, weight],
+                  kwargs=dict(input_dim=input_dim, output_dim=output_dim),
+                  name=name)
+
+
+def LayerNorm(data=None, gamma=None, beta=None, axis=-1, eps=1e-5, name=None, **kw):
+    name = name or _auto_name("layernorm")
+    c_shape = lambda s: (s[axis],)
+    gamma = gamma if gamma is not None else _param_var(name, "gamma", c_shape)
+    beta = beta if beta is not None else _param_var(name, "beta", c_shape)
+    return Symbol(op=nd.LayerNorm, op_name="LayerNorm",
+                  inputs=[data, gamma, beta], kwargs=dict(axis=axis, eps=eps),
+                  name=name)
+
+
+def _make_regression_output(op_name, nd_fn):
+    def builder(data=None, label=None, grad_scale=1.0, name=None, **kw):
+        name = name or _auto_name(op_name.lower())
+        label = label if label is not None else var(name + "_label")
+        label._is_label = True
+        return Symbol(op=nd_fn, op_name=op_name, inputs=[data, label],
+                      kwargs=dict(grad_scale=grad_scale), name=name)
+    builder.__name__ = op_name
+    return builder
+
+
+LinearRegressionOutput = _make_regression_output(
+    "LinearRegressionOutput", nd.LinearRegressionOutput)
+LogisticRegressionOutput = _make_regression_output(
+    "LogisticRegressionOutput", nd.LogisticRegressionOutput)
+MAERegressionOutput = _make_regression_output(
+    "MAERegressionOutput", nd.MAERegressionOutput)
+
+
+for _n in ["FullyConnected", "Convolution", "BatchNorm", "Activation", "LeakyReLU",
+           "Pooling", "Dropout", "SoftmaxOutput", "Embedding", "LayerNorm",
+           "LinearRegressionOutput"]:
+    __all__.append(_n)
+    _OP_TABLE[_n] = getattr(nd, _n, None)

@@ -1,0 +1,360 @@
+"""Symbol — lazy graph API (ref python/mxnet/symbol/symbol.py:53).
+
+TPU-native design: a Symbol is a lightweight expression DAG over the SAME pure
+JAX op implementations the eager nd namespace uses (no separate kernel
+registry). ``simple_bind`` traces the DAG once and jit-compiles it — NNVM
+graph passes (fusion, memory planning) are delegated to XLA (SURVEY §7 table:
+GraphExecutor+CachedOp collapse into compile-and-cache).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
+           "ones"]
+
+
+class Symbol:
+    def __init__(self, op=None, op_name="", inputs=None, kwargs=None, name=None,
+                 num_outputs=1, output_index=None):
+        self._op = op                     # callable on NDArrays (nd namespace fn)
+        self._op_name = op_name
+        self._inputs = inputs or []       # list[Symbol]
+        self._kwargs = kwargs or {}
+        self._attr = {}
+        self.name = name or _auto_name(op_name or "sym")
+        self._num_outputs = num_outputs
+        self._output_index = output_index  # not None → view of multi-output node
+
+    # ---------------------------------------------------------------- graph
+    @property
+    def is_var(self):
+        return self._op is None and not self._inputs
+
+    def list_inputs(self):
+        return self.list_arguments()
+
+    def list_arguments(self):
+        """Free variables in DFS order (ref symbol.py list_arguments)."""
+        seen, order = set(), []
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            if s.is_var and s.name not in [o for o in order]:
+                order.append(s.name)
+
+        visit(self)
+        return order
+
+    def list_outputs(self):
+        if self._num_outputs == 1 or self._output_index is not None:
+            return [self.name + "_output"]
+        return ["%s_output%d" % (self.name, i) for i in range(self._num_outputs)]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def get_internals(self):
+        """All nodes as a Group (ref symbol.py get_internals)."""
+        seen, order = set(), []
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            order.append(s)
+
+        visit(self)
+        return Group(order)
+
+    def attr(self, key):
+        return self._attr.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._attr.update(kwargs)
+
+    def __getitem__(self, index):
+        if isinstance(index, int):
+            if self._num_outputs == 1:
+                assert index == 0
+                return self
+            return Symbol(op=self._op, op_name=self._op_name, inputs=self._inputs,
+                          kwargs=self._kwargs, name=self.name,
+                          num_outputs=self._num_outputs, output_index=index)
+        raise TypeError("symbol index must be int")
+
+    def __iter__(self):
+        return iter([self[i] for i in range(self._num_outputs)])
+
+    # ---------------------------------------------------------------- eval
+    def eval_imperative(self, bindings, _cache=None):
+        """Evaluate the DAG with NDArray bindings {name: NDArray}."""
+        cache = _cache if _cache is not None else {}
+
+        def ev(s):
+            key = (id(s), s._output_index)
+            base_key = (id(s), None)
+            if key in cache:
+                return cache[key]
+            if s.is_var:
+                if s.name not in bindings:
+                    raise ValueError("unbound variable %r" % s.name)
+                out = bindings[s.name]
+            else:
+                if base_key in cache:
+                    full = cache[base_key]
+                else:
+                    args = [ev(i) for i in s._inputs]
+                    full = s._op(*args, **s._kwargs)
+                    cache[base_key] = full
+                out = full[s._output_index] if s._output_index is not None else full
+            cache[key] = out
+            return out
+
+        return ev(self)
+
+    def eval(self, ctx=None, **kwargs):
+        """ref symbol.py eval — returns list of NDArrays."""
+        out = self.eval_imperative(kwargs)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    # ---------------------------------------------------------------- shapes
+    def infer_shape(self, **kwargs):
+        """ref symbol.py infer_shape — via jax.eval_shape on the traced DAG."""
+        import jax
+
+        names = self.list_arguments()
+        unknown = [n for n in names if n not in kwargs]
+
+        def fn(binding_datas):
+            b = {k: NDArray(v) for k, v in binding_datas.items()}
+            out = self.eval_imperative(b)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._data for o in outs]
+
+        if unknown:
+            return None, None, None
+        shapes = {k: jax.ShapeDtypeStruct(tuple(v), onp.float32)
+                  for k, v in kwargs.items()}
+        out_shapes = jax.eval_shape(fn, shapes)
+        arg_shapes = [tuple(kwargs[n]) for n in names]
+        return arg_shapes, [tuple(o.shape) for o in out_shapes], []
+
+    def infer_type(self, **kwargs):
+        names = self.list_arguments()
+        return [onp.float32] * len(names), [onp.float32], []
+
+    # ---------------------------------------------------------------- bind
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate args + compile (ref symbol.py:1507 → c_api_executor.cc:860)."""
+        from ..executor import Executor
+
+        args = {}
+        by_name = {}
+        seen = set()
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            if s.is_var:
+                by_name[s.name] = s
+
+        visit(self)
+        for name in self.list_arguments():
+            v = by_name.get(name)
+            if name in shapes:
+                args[name] = nd.zeros(shapes[name], ctx=ctx)
+            elif v is not None and getattr(v, "_deferred_shape_fn", None):
+                continue  # materialised by the Executor from data shapes
+            else:
+                raise ValueError("simple_bind needs shape for %r" % name)
+        return Executor(self, ctx, args, grad_req=grad_req)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        """ref symbol.py bind."""
+        from ..executor import Executor
+
+        names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(names, args_grad))
+        return Executor(self, ctx, args, args_grad=args_grad, grad_req=grad_req)
+
+    # ---------------------------------------------------------------- misc ops
+    def _binop(self, other, fn, op_name, reverse=False):
+        if isinstance(other, Symbol):
+            ins = [other, self] if reverse else [self, other]
+            return Symbol(op=fn, op_name=op_name, inputs=ins)
+        if reverse:
+            return Symbol(op=lambda a: fn(_const(other, a), a), op_name=op_name,
+                          inputs=[self])
+        return Symbol(op=lambda a: fn(a, other), op_name=op_name, inputs=[self])
+
+    def __add__(self, o): return self._binop(o, nd.add, "_plus")
+    def __radd__(self, o): return self._binop(o, nd.add, "_plus", True)
+    def __sub__(self, o): return self._binop(o, nd.subtract, "_minus")
+    def __rsub__(self, o): return self._binop(o, nd.subtract, "_minus", True)
+    def __mul__(self, o): return self._binop(o, nd.multiply, "_mul")
+    def __rmul__(self, o): return self._binop(o, nd.multiply, "_mul", True)
+    def __truediv__(self, o): return self._binop(o, nd.divide, "_div")
+    def __rtruediv__(self, o): return self._binop(o, nd.divide, "_div", True)
+    def __pow__(self, o): return self._binop(o, nd.power, "_pow")
+    def __neg__(self):
+        return Symbol(op=lambda a: -a, op_name="negative", inputs=[self])
+
+    def __repr__(self):
+        return "<Symbol %s>" % self.name
+
+    # ---------------------------------------------------------------- io
+    def tojson(self):
+        """Graph JSON (structural; op impls are named, not serialized)."""
+        nodes, index = [], {}
+
+        def visit(s):
+            if id(s) in index:
+                return index[id(s)]
+            inputs = [visit(i) for i in s._inputs]
+            idx = len(nodes)
+            nodes.append({
+                "op": "null" if s.is_var else s._op_name,
+                "name": s.name,
+                "inputs": [[i, 0, 0] for i in inputs],
+                "attrs": {k: str(v) for k, v in s._kwargs.items()},
+            })
+            index[id(s)] = idx
+            return idx
+
+        visit(self)
+        return json.dumps({"nodes": nodes, "format": "incubator_mxnet_tpu.symbol",
+                           "heads": [[len(nodes) - 1, 0, 0]]}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+class Group(Symbol):
+    """Multiple outputs grouped (ref symbol.py Group)."""
+
+    def __init__(self, symbols):
+        super().__init__(op_name="_group", name=_auto_name("group"))
+        self._symbols = list(symbols)
+        self._num_outputs = len(self._symbols)
+
+    def eval_imperative(self, bindings, _cache=None):
+        cache = _cache if _cache is not None else {}
+        return [s.eval_imperative(bindings, cache) for s in self._symbols]
+
+    def list_arguments(self):
+        seen, order = [], []
+        for s in self._symbols:
+            for n in s.list_arguments():
+                if n not in order:
+                    order.append(n)
+        return order
+
+    def list_outputs(self):
+        return sum((s.list_outputs() for s in self._symbols), [])
+
+    def __getitem__(self, i):
+        return self._symbols[i]
+
+
+_NAME_COUNT = {}
+
+
+def _auto_name(hint):
+    c = _NAME_COUNT.get(hint, 0)
+    _NAME_COUNT[hint] = c + 1
+    return "%s%d" % (hint, c)
+
+
+def _const(v, like):
+    return v
+
+
+def var(name, shape=None, dtype=None, **kwargs):
+    s = Symbol(name=name)
+    s._shape = shape
+    s._dtype = dtype
+    return s
+
+
+Variable = var
+
+
+def zeros(shape, dtype="float32", **kw):
+    return Symbol(op=lambda: nd.zeros(shape, dtype=dtype), op_name="zeros",
+                  inputs=[])
+
+
+def ones(shape, dtype="float32", **kw):
+    return Symbol(op=lambda: nd.ones(shape, dtype=dtype), op_name="ones",
+                  inputs=[])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol DAG from graph JSON (op impls resolved from nd)."""
+    from . import _op_lookup, _deferred_rules
+
+    graph = json.loads(json_str)
+    nodes = graph["nodes"]
+    built = []
+    for node in nodes:
+        if node["op"] == "null":
+            built.append(var(node["name"]))
+        else:
+            fn = _op_lookup(node["op"])
+            inputs = [built[i[0]] for i in node["inputs"]]
+            kwargs = {k: _parse_attr(v) for k, v in node.get("attrs", {}).items()}
+            # restore deferred-shape rules on auto-created parameter vars
+            rules = _deferred_rules(node["op"], kwargs)
+            for idx, shape_fn in (rules or {}).items():
+                if idx < len(inputs) and inputs[idx].is_var:
+                    v = inputs[idx]
+                    if not hasattr(v, "_deferred_shape_fn"):
+                        v._deferred_shape_fn = shape_fn
+                        v._is_param = True
+                        if node["op"] == "BatchNorm" and idx >= 3:
+                            v._is_aux = True
+            s = Symbol(op=fn, op_name=node["op"],
+                       inputs=inputs, kwargs=kwargs, name=node["name"])
+            built.append(s)
+    return built[graph["heads"][0][0]]
+
+
+def _parse_attr(v):
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _bind_kwargs(fn, kwargs):
+    def wrapped(*args, **kw):
+        merged = dict(kwargs)
+        merged.update(kw)
+        return fn(*args, **merged)
+    return wrapped

@@ -1,0 +1,89 @@
+"""Utility scopes & flags (ref python/mxnet/util.py: np_shape/np_array scopes)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+__all__ = ["is_np_shape", "is_np_array", "set_np_shape", "set_np", "reset_np",
+           "np_shape", "np_array", "use_np", "getenv", "setenv"]
+
+
+class _Flags(threading.local):
+    def __init__(self):
+        self.np_shape = False
+        self.np_array = False
+
+
+_F = _Flags()
+
+
+def is_np_shape():
+    return _F.np_shape
+
+
+def is_np_array():
+    return _F.np_array
+
+
+def set_np_shape(active):
+    prev = _F.np_shape
+    _F.np_shape = bool(active)
+    return prev
+
+
+def set_np(shape=True, array=True):
+    """ref util.py set_np — enable NumPy semantics globally."""
+    _F.np_shape = shape
+    _F.np_array = array
+
+
+def reset_np():
+    set_np(False, False)
+
+
+class _Scope:
+    def __init__(self, attr, value):
+        self.attr = attr
+        self.value = value
+
+    def __enter__(self):
+        self.prev = getattr(_F, self.attr)
+        setattr(_F, self.attr, self.value)
+        return self
+
+    def __exit__(self, *a):
+        setattr(_F, self.attr, self.prev)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with type(self)(self.attr, self.value):
+                return fn(*args, **kwargs)
+        return wrapped
+
+
+def np_shape(active=True):
+    return _Scope("np_shape", active)
+
+
+def np_array(active=True):
+    return _Scope("np_array", active)
+
+
+def use_np(fn):
+    """ref util.py use_np decorator."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with _Scope("np_shape", True), _Scope("np_array", True):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+def getenv(name):
+    import os
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = value

@@ -1,0 +1,54 @@
+"""Network visualization (ref python/mxnet/visualization.py print_summary)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """ref visualization.py print_summary — layer table of a Symbol graph."""
+    nodes = json.loads(symbol.tojson())["nodes"]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(cells):
+        line = ""
+        for i, c in enumerate(cells):
+            line += str(c)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        prev = ", ".join(nodes[i[0]]["name"] for i in node["inputs"])
+        print_row(["%s (%s)" % (node["name"], node["op"]), "", "", prev])
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None, dtype=None,
+                 node_attrs=None, hide_weights=True):
+    """DOT-source graph (graphviz rendering optional; returns the source)."""
+    nodes = json.loads(symbol.tojson())["nodes"]
+    lines = ["digraph %s {" % title, "  rankdir=BT;"]
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" and hide_weights and node["name"] != "data":
+            continue
+        label = node["name"] if node["op"] == "null" else \
+            "%s\\n%s" % (node["op"], node["name"])
+        lines.append('  n%d [label="%s"];' % (i, label))
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for inp in node["inputs"]:
+            src = nodes[inp[0]]
+            if src["op"] == "null" and hide_weights and src["name"] != "data":
+                continue
+            lines.append("  n%d -> n%d;" % (inp[0], i))
+    lines.append("}")
+    return "\n".join(lines)

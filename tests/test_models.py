@@ -1,0 +1,111 @@
+"""Model family tests (BASELINE configs 1-5)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon, jit, models
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_lenet():
+    net = models.LeNet()
+    net.initialize()
+    out = net(nd.random.normal(shape=(2, 1, 28, 28)))
+    assert out.shape == (2, 10)
+
+
+def test_bert_forward_and_train():
+    net = models.BERTModel(vocab_size=100, units=32, hidden_size=64, num_layers=2,
+                           num_heads=4, max_length=16, dropout=0.1)
+    net.initialize()
+    tokens = nd.array(onp.random.randint(0, 100, (2, 16)).astype("int32"))
+    out = net(tokens)
+    assert out.shape == (2, 16, 100)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = jit.TrainStep(net, loss_fn, trainer)
+    l0 = float(step(tokens, tokens).mean().asscalar())
+    for _ in range(5):
+        l = float(step(tokens, tokens).mean().asscalar())
+    assert l < l0
+
+
+def test_lstm_lm():
+    net = models.LSTMLanguageModel(vocab_size=50, embed_size=16, hidden_size=32,
+                                   num_layers=2)
+    net.initialize()
+    x = nd.array(onp.random.randint(0, 50, (7, 3)).astype("int32"))
+    logits = net(x)
+    assert logits.shape == (7, 3, 50)
+    states = net.begin_state(3)
+    logits, states = net(x, states)
+    assert states[0].shape == (2, 3, 32)
+
+
+def test_multibox_prior():
+    from incubator_mxnet_tpu.ops import MultiBoxPrior
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # centers in (0,1), first anchor centered at (.125,.125) with half-size .25
+    assert_almost_equal(a[0], [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_target_and_detection():
+    from incubator_mxnet_tpu.ops import MultiBoxTarget, MultiBoxDetection
+    anchors = nd.array([[[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.4, 1.0]]])
+    # one gt box matching anchor 0 well
+    label = nd.array([[[0, 0.05, 0.05, 0.35, 0.35]]])
+    cls_pred = nd.zeros((1, 3, 3))  # 2 classes + bg
+    loc_t, mask, cls_t = MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0 and ct[1] == 0.0  # anchor0 positive (cls 0 → 1), anchor1 bg
+    assert mask.asnumpy()[0][:4].sum() == 4.0
+
+    # detection: probs favor class 1 on anchor 0
+    cls_prob = nd.array([[[0.1, 0.8, 0.8], [0.8, 0.1, 0.1], [0.1, 0.1, 0.1]]])
+    loc_pred = nd.zeros((1, 12))
+    out = MultiBoxDetection(cls_prob, loc_pred, anchors, nms_threshold=0.5)
+    o = out.asnumpy()[0]
+    assert o.shape == (3, 6)
+    assert o[0, 0] >= 0  # best-scoring kept
+
+
+def test_ssd_forward():
+    net = models.SSD(num_classes=4, base_channels=16)
+    net.initialize()
+    x = nd.random.normal(shape=(2, 3, 64, 64))
+    anchors, cls_preds, loc_preds = net(x)
+    A = anchors.shape[1]
+    assert cls_preds.shape == (2, 5, A)
+    assert loc_preds.shape == (2, A * 4)
+
+
+def test_ssd_train_step():
+    from incubator_mxnet_tpu.ops import MultiBoxTarget
+    net = models.SSD(num_classes=2, base_channels=8)
+    net.initialize()
+    x = nd.random.normal(shape=(2, 3, 32, 32))
+    label = nd.array([[[0, 0.1, 0.1, 0.4, 0.4]], [[1, 0.5, 0.5, 0.9, 0.9]]])
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01})
+    cls_loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        anchors, cls_preds, loc_preds = net(x)
+        with autograd.pause():
+            loc_t, loc_mask, cls_t = MultiBoxTarget(anchors, label, cls_preds)
+        cls_l = cls_loss_fn(cls_preds.transpose((0, 2, 1)), cls_t)
+        loc_l = (nd.abs(loc_preds - loc_t) * loc_mask).sum() / 2
+        total = cls_l.sum() + loc_l
+    total.backward()
+    trainer.step(2)
+    assert onp.isfinite(float(total.asscalar()))
+
+
+def test_resnet50_forward():
+    net = models.get_model("resnet50_v1", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 10)

@@ -1,0 +1,225 @@
+"""Symbol/Executor/Module tests (ref tests/python/unittest/test_module.py,
+test_symbol.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    net = _mlp_symbol()
+    args = net.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "fc2_bias" in args
+    assert "softmax_label" in args
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = 2 * a + b / a - 1
+    out = c.eval(a=nd.array([2.0]), b=nd.array([4.0]))[0]
+    assert_almost_equal(out, [5.0])
+
+
+def test_symbol_infer_shape():
+    net = _mlp_symbol()
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=(8, 10), softmax_label=(8,), fc1_weight=(16, 10), fc1_bias=(16,),
+        fc2_weight=(4, 16), fc2_bias=(4,))
+    assert out_shapes[0] == (8, 4)
+
+
+def test_symbol_save_load(tmp_path):
+    net = _mlp_symbol()
+    f = str(tmp_path / "sym.json")
+    net.save(f)
+    loaded = mx.sym.load(f)
+    assert set(loaded.list_arguments()) == set(net.list_arguments())
+    # loaded graph evaluates
+    ex = loaded.simple_bind(data=(2, 10), softmax_label=(2,))
+    out = ex.forward(data=onp.random.rand(2, 10).astype("float32"),
+                     softmax_label=onp.zeros(2, "float32"))
+    assert out[0].shape == (2, 4)
+
+
+def test_simple_bind_and_grads():
+    net = _mlp_symbol()
+    ex = net.simple_bind(data=(8, 10), softmax_label=(8,))
+    assert ex.arg_dict["fc1_weight"].shape == (16, 10)
+    X = onp.random.rand(8, 10).astype("float32")
+    y = onp.random.randint(0, 4, 8).astype("float32")
+    mx.init.Xavier()("fc1_weight", ex.arg_dict["fc1_weight"])
+    mx.init.Xavier()("fc2_weight", ex.arg_dict["fc2_weight"])
+    ex.forward(is_train=True, data=X, softmax_label=y)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_softmax_output_grad_semantics():
+    """backward == (softmax - onehot) regardless of head grads."""
+    data = mx.sym.var("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=4, name="fc"),
+                               name="softmax")
+    ex = net.simple_bind(data=(4, 6), softmax_label=(4,))
+    X = onp.random.rand(4, 6).astype("float32")
+    y = onp.array([0, 1, 2, 3], "float32")
+    ex.arg_dict["fc_weight"]._data = nd.random.normal(shape=(4, 6))._data
+    ex.forward(is_train=True, data=X, softmax_label=y)
+    ex.backward()
+    w = ex.arg_dict["fc_weight"].asnumpy()
+    b = ex.arg_dict["fc_bias"].asnumpy()
+    logits = X.dot(w.T) + b
+    p = onp.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    ref = (p - onp.eye(4)[y.astype(int)]).T.dot(X)
+    assert_almost_equal(ex.grad_dict["fc_weight"], ref, rtol=1e-3, atol=1e-4)
+
+
+def test_regression_outputs():
+    data = mx.sym.var("data")
+    net = mx.sym.Symbol  # noqa
+    lin = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    from incubator_mxnet_tpu.symbol import LinearRegressionOutput
+    out = LinearRegressionOutput(lin, name="lro")
+    ex = out.simple_bind(data=(4, 3), lro_label=(4, 1))
+    X = onp.random.rand(4, 3).astype("float32")
+    y = onp.random.rand(4, 1).astype("float32")
+    ex.forward(is_train=True, data=X, lro_label=y)
+    ex.backward()
+    pred = ex.outputs[0].asnumpy()
+    ref_grad = (pred - y).T.dot(X)
+    assert_almost_equal(ex.grad_dict["fc_weight"], ref_grad, rtol=1e-3, atol=1e-4)
+
+
+def test_module_fit_convergence():
+    net = _mlp_symbol()
+    rng = onp.random.RandomState(0)
+    w = rng.randn(10, 4).astype("float32")
+    X = rng.randn(64, 10).astype("float32")
+    y = X.dot(w).argmax(axis=1).astype("float32")
+    train_iter = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=12, optimizer_params={"learning_rate": 0.1})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    net = _mlp_symbol()
+    X = onp.random.rand(32, 10).astype("float32")
+    y = onp.zeros(32, "float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(net)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (32, 4)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    sym, arg, aux = mx.load_checkpoint(prefix, 1)
+    assert "fc1_weight" in arg
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(it.provide_data, it.provide_label)
+    preds2 = mod2.predict(it)
+    assert_almost_equal(preds2, preds.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    bm = mx.module.BucketingModule(sym_gen, default_bucket_key=10)
+    from incubator_mxnet_tpu.io import DataDesc
+    bm.bind([DataDesc("data", (4, 10))], [DataDesc("softmax_label", (4,))])
+    bm.init_params()
+    bm.init_optimizer()
+    bm.switch_bucket(20, [DataDesc("data", (4, 20))],
+                     [DataDesc("softmax_label", (4,))])
+    assert bm._curr_bucket_key == 20
+
+
+def test_mnist_iter():
+    it = mx.io.MNISTIter(batch_size=32)
+    batch = next(iter([b for b, _ in zip(it, range(1))]))
+    assert batch.data[0].shape == (32, 1, 28, 28)
+
+
+def test_ndarray_iter_padding():
+    X = onp.arange(10).reshape(10, 1).astype("float32")
+    it = mx.io.NDArrayIter(X, onp.zeros(10, "float32"), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_recordio_roundtrip(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    uri = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(uri, "w")
+    for i in range(5):
+        w.write(b"payload-%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(uri, "r")
+    for i in range(5):
+        assert r.read() == b"payload-%d" % i
+    assert r.read() is None
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    uri = str(tmp_path / "idx.rec")
+    idx = str(tmp_path / "idx.idx")
+    w = recordio.MXIndexedRecordIO(idx, uri, "w")
+    for i in range(5):
+        payload = recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                b"data%d" % i)
+        w.write_idx(i, payload)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, uri, "r")
+    hdr, content = recordio.unpack(r.read_idx(3))
+    assert hdr.label == 3.0
+    assert content == b"data3"
+    # multi-label pack
+    p = recordio.pack(recordio.IRHeader(2, onp.array([1.0, 2.0]), 7, 0), b"x")
+    hdr2, rest = recordio.unpack(p)
+    assert_almost_equal(hdr2.label, [1.0, 2.0])
+
+
+def test_image_record_pipeline(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    uri = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, uri, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(12, 12, 3) * 255).astype("uint8")
+        w.write_idx(i, recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0),
+                                         img, img_fmt=".png"))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=uri, path_imgidx=idx,
+                               data_shape=(3, 12, 12), batch_size=4)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 12, 12)
+    assert batch.label[0].shape == (4,)
+    # distributed sharding args
+    it2 = mx.io.ImageRecordIter(path_imgrec=uri, path_imgidx=idx,
+                                data_shape=(3, 12, 12), batch_size=2,
+                                num_parts=2, part_index=1)
+    b2 = it2.next()
+    assert b2.data[0].shape == (2, 3, 12, 12)
