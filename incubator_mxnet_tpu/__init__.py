@@ -45,3 +45,23 @@ from . import module
 from . import module as mod
 from . import models
 from . import ops
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import engine
+from . import runtime
+from . import util
+from .util import is_np_array, set_np, reset_np, np_shape, np_array
+from . import image
+from . import rtc
+from . import library
+from . import attribute, name
+from .attribute import AttrScope
+from .name import NameManager
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import numpy
+from . import numpy as np
+from . import numpy_extension
+from . import numpy_extension as npx

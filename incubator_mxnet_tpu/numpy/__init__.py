@@ -10,8 +10,9 @@ import jax
 import jax.numpy as jnp
 
 from ..context import current_context
-from ..ndarray import NDArray, _apply, _ctx_put, _np_dtype
+from ..ndarray import NDArray
 from ..ndarray import ndarray as _nd_mod
+from ..ndarray.ndarray import _apply, _ctx_put, _np_dtype
 
 __all__ = ["ndarray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "logspace", "eye", "identity", "meshgrid", "concatenate",
