@@ -269,6 +269,19 @@ class ImageRecordIter(DataIter):
         from .. import recordio
         from concurrent.futures import ThreadPoolExecutor
 
+        # native C++ prefetching reader (src/recordio.cc) is the fast path:
+        # threaded readahead + sharding happen off the GIL
+        self._native = None
+        try:
+            from ..native import lib as _native_lib
+            if _native_lib.available():
+                self._native = _native_lib.NativeBatchReader(
+                    path_imgrec, batch_size, shuffle=shuffle, seed=seed,
+                    num_threads=max(1, preprocess_threads // 2),
+                    part_index=part_index, num_parts=num_parts)
+        except Exception:
+            self._native = None
+
         if path_imgidx is None and path_imgrec is not None:
             guess = path_imgrec[: path_imgrec.rfind(".")] + ".idx"
             import os
@@ -317,6 +330,8 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
+        if self._native is not None:
+            self._native.reset(reshuffle=self._shuffle)
         if self._shuffle:
             self._rng.shuffle(self._order)
         self._cursor = 0
@@ -333,6 +348,14 @@ class ImageRecordIter(DataIter):
 
     def _process(self, i):
         header, img = self._read_record(i)
+        return self._augment(header, img)
+
+    def _decode_payload(self, raw):
+        from .. import recordio
+        header, img = recordio.unpack_img(raw, iscolor=1)
+        return self._augment(header, img)
+
+    def _augment(self, header, img):
         c, h, w = self._data_shape
         ih, iw = img.shape[:2]
         if self._rand_crop and ih > h and iw > w:
@@ -352,6 +375,16 @@ class ImageRecordIter(DataIter):
         return chw, label
 
     def next(self):
+        if self._native is not None:
+            payloads = self._native.next()
+            if payloads is None:
+                raise StopIteration
+            results = list(self._pool.map(self._decode_payload, payloads))
+            data = onp.stack([r[0] for r in results])
+            labels = onp.asarray(
+                [onp.ravel(r[1])[: self._label_width] if onp.ndim(r[1])
+                 else r[1] for r in results], dtype="float32")
+            return DataBatch([nd.array(data)], [nd.array(labels)], pad=0)
         n = self._hi - self._lo
         if self._cursor >= n:
             raise StopIteration

@@ -1,0 +1,3 @@
+"""Native (C++) runtime components: RecordIO scan/batch-prefetch reader and
+pooled host allocator. See src/recordio.cc; python bindings in lib.py."""
+from . import lib  # noqa
