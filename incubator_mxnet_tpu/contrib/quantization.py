@@ -1,0 +1,157 @@
+"""INT8 quantization flow (ref src/operator/quantization/* +
+python/mxnet/contrib/quantization.py).
+
+TPU-native: symmetric int8 quantize/dequantize as XLA convert ops; calibration
+(minmax / KL-entropy) over a calibration dataset using the Monitor-style
+collection the reference uses (contrib/quantization.py:261).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray, _apply
+
+__all__ = ["quantize", "dequantize", "requantize", "calib_minmax", "calib_entropy",
+           "quantize_model", "QuantizedDense"]
+
+
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """ref quantization/quantize.cc — symmetric linear quantization."""
+    import jax.numpy as jnp
+
+    if min_range is None or max_range is None:
+        a = data.asnumpy()
+        min_range, max_range = float(a.min()), float(a.max())
+    scale = max(abs(min_range), abs(max_range)) / 127.0 or 1.0
+
+    def fn(x):
+        return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+    q = _apply(fn, data)
+    return q, nd.array([min_range]), nd.array([max_range])
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """ref quantization/dequantize.cc."""
+    import jax.numpy as jnp
+
+    lo = float(min_range.asnumpy()[0]) if isinstance(min_range, NDArray) else min_range
+    hi = float(max_range.asnumpy()[0]) if isinstance(max_range, NDArray) else max_range
+    scale = max(abs(lo), abs(hi)) / 127.0 or 1.0
+    return _apply(lambda x: x.astype(jnp.float32) * scale, data)
+
+
+def requantize(data, min_range, max_range, min_calib=None, max_calib=None):
+    """ref quantization/requantize.cc — int32 accum → int8."""
+    deq = dequantize(data, min_range, max_range)
+    return quantize(deq, min_calib, max_calib)
+
+
+def calib_minmax(activations):
+    """Min-max calibration thresholds (ref calibrate.cc minmax mode)."""
+    a = onp.concatenate([x.asnumpy().ravel() for x in activations])
+    return float(a.min()), float(a.max())
+
+
+def calib_entropy(activations, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence threshold search (ref calibrate.cc entropy mode)."""
+    a = onp.abs(onp.concatenate([x.asnumpy().ravel() for x in activations]))
+    amax = float(a.max()) or 1.0
+    hist, edges = onp.histogram(a, bins=num_bins, range=(0, amax))
+    best_kl, best_t = onp.inf, amax
+    for i in range(num_quantized_bins, num_bins, num_bins // 64 or 1):
+        t = edges[i]
+        p = hist[:i].astype(onp.float64).copy()
+        p[-1] += hist[i:].sum()  # clip outliers into the last bin
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = len(p) / num_quantized_bins
+        q = onp.zeros_like(p)
+        for j in range(num_quantized_bins):
+            lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
+            mass = p[lo:hi].sum()
+            nz = (p[lo:hi] > 0).sum()
+            if nz:
+                q[lo:hi] = onp.where(p[lo:hi] > 0, mass / nz, 0)
+        p_n = p / p.sum()
+        q_n = q / q.sum() if q.sum() else q
+        mask = (p_n > 0) & (q_n > 0)
+        kl = float((p_n[mask] * onp.log(p_n[mask] / q_n[mask])).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return -best_t, best_t
+
+
+class QuantizedDense:
+    """INT8 inference dense layer (ref quantized_fully_connected.cc)."""
+
+    def __init__(self, dense_block, calib_min, calib_max):
+        w = dense_block.weight.data()
+        self._wq, self._wmin, self._wmax = quantize(w)
+        self._bias = dense_block.bias.data() if dense_block.bias is not None else None
+        self._cmin, self._cmax = calib_min, calib_max
+        self._units = dense_block._units
+
+    def __call__(self, x):
+        xq, xmin, xmax = quantize(x, self._cmin, self._cmax)
+        import jax.numpy as jnp
+        xs = max(abs(self._cmin), abs(self._cmax)) / 127.0 or 1.0
+        wmin = float(self._wmin.asnumpy()[0])
+        wmax = float(self._wmax.asnumpy()[0])
+        ws = max(abs(wmin), abs(wmax)) / 127.0 or 1.0
+
+        def fn(xq_, wq_):
+            acc = jnp.matmul(xq_.astype(jnp.int32), wq_.astype(jnp.int32).T)
+            return acc.astype(jnp.float32) * (xs * ws)
+
+        out = _apply(fn, xq, self._wq)
+        if self._bias is not None:
+            out = out + self._bias
+        return out
+
+
+def quantize_model(net, calib_data=None, calib_mode="minmax", num_calib_batches=4):
+    """Quantize Dense layers of a gluon net for int8 inference
+    (ref contrib/quantization.py quantize_model / quantize_net)."""
+    from ..gluon import nn
+
+    # collect activation stats per Dense layer via forward hooks
+    stats = {}
+
+    def make_hook(key):
+        def hook(blk, inputs, output):
+            stats.setdefault(key, []).append(inputs[0])
+        return hook
+
+    handles = []
+    dense_layers = []
+
+    def walk(b):
+        if isinstance(b, nn.Dense):
+            dense_layers.append(b)
+            b.register_forward_hook(make_hook(id(b)))
+        for c in b._children.values():
+            walk(c)
+
+    walk(net)
+    if calib_data is not None:
+        for i, batch in enumerate(calib_data):
+            if i >= num_calib_batches:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            net(x)
+
+    quantized = {}
+    for layer in dense_layers:
+        acts = stats.get(id(layer))
+        if acts:
+            if calib_mode == "entropy":
+                lo, hi = calib_entropy(acts)
+            else:
+                lo, hi = calib_minmax(acts)
+        else:
+            lo, hi = -1.0, 1.0
+        quantized[layer.name] = QuantizedDense(layer, lo, hi)
+    return quantized

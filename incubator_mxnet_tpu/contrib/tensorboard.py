@@ -1,0 +1,40 @@
+"""TensorBoard logging hook (ref python/mxnet/contrib/tensorboard.py).
+
+Writes scalar summaries via tensorboardX/tensorboard if installed, else
+falls back to a JSONL event log readable by any dashboard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        os.makedirs(logging_dir, exist_ok=True)
+        self._writer = None
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # torch is baked in
+            self._writer = SummaryWriter(logging_dir)
+        except Exception:
+            self._jsonl = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
+        self.step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, self.step)
+            else:
+                self._jsonl.write(json.dumps(
+                    {"ts": time.time(), "step": self.step, name: value}) + "\n")
+                self._jsonl.flush()

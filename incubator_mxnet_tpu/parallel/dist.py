@@ -1,0 +1,46 @@
+"""Multi-host initialisation over DCN (ref ps-lite scheduler/worker roles).
+
+TPU-native: jax.distributed — every host runs the same SPMD program; the
+coordinator address replaces the parameter-server scheduler. Reads the env
+set by tools/launch.py (MXTPU_COORD_ADDR / MXTPU_NUM_PROC / MXTPU_PROC_ID).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_distributed", "rank", "num_workers", "is_initialized"]
+
+_STATE = {"initialized": False}
+
+
+def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialise jax.distributed from args or launcher env."""
+    if _STATE["initialized"]:
+        return
+    coordinator_address = coordinator_address or os.environ.get("MXTPU_COORD_ADDR")
+    num_processes = num_processes or int(os.environ.get("MXTPU_NUM_PROC", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("MXTPU_PROC_ID", "0"))
+    if num_processes > 1 and coordinator_address:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _STATE["initialized"] = True
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def rank():
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def num_workers():
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1

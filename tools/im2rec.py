@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""im2rec — build RecordIO image datasets (ref tools/im2rec.py / im2rec.cc).
+
+Usage: python tools/im2rec.py <prefix> <root> [--list] [--recursive]
+       python tools/im2rec.py <prefix> <root>          # pack from prefix.lst
+List file format (tab-separated): index \t label \t relative/path.jpg
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_list(prefix, root, recursive=True, exts=(".jpg", ".jpeg", ".png")):
+    entries = []
+    if recursive:
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        label_map = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            for dirpath, _, files in os.walk(os.path.join(root, c)):
+                for f in sorted(files):
+                    if f.lower().endswith(exts):
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        entries.append((label_map[c], rel))
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(exts):
+                entries.append((0, f))
+    with open(prefix + ".lst", "w") as out:
+        for i, (label, rel) in enumerate(entries):
+            out.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    print("wrote %s.lst (%d entries)" % (prefix, len(entries)))
+
+
+def pack(prefix, root, quality=95, resize=0):
+    from incubator_mxnet_tpu import recordio, image
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            img = image.imread(os.path.join(root, rel))
+            if resize:
+                img = image.resize_short(img, resize)
+            header = recordio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, recordio.pack_img(header, img.asnumpy(),
+                                                 quality=quality))
+            n += 1
+    rec.close()
+    print("packed %d records into %s.rec" % (n, prefix))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--recursive", action="store_true", default=True)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, args.recursive)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, args.recursive)
+        pack(args.prefix, args.root, args.quality, args.resize)

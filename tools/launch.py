@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Distributed launcher (ref tools/launch.py + dmlc-tracker).
+
+TPU-native: multi-host SPMD uses jax.distributed — one process per host over
+DCN. This launcher starts N local worker processes with the coordinator env
+(COORD_ADDR/NUM_PROC/PROC_ID), the analog of DMLC_ROLE/DMLC_PS_ROOT_URI for
+the parameter-server design. Remote hosts: run the same command per host with
+PROC_ID set (ssh orchestration mirrors dmlc-tracker's ssh mode).
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--coord-addr", default="127.0.0.1:12321")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+
+    procs = []
+    if args.launcher == "local":
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "MXTPU_COORD_ADDR": args.coord_addr,
+                "MXTPU_NUM_PROC": str(args.num_workers),
+                "MXTPU_PROC_ID": str(rank),
+                # DMLC-compat aliases so reference-era scripts keep working
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_RANK": str(rank),
+            })
+            procs.append(subprocess.Popen(args.command, env=env))
+        code = 0
+        for p in procs:
+            code |= p.wait()
+        sys.exit(code)
+    else:
+        hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+        for rank, host in enumerate(hosts[: args.num_workers]):
+            cmd = ["ssh", host,
+                   "MXTPU_COORD_ADDR=%s" % args.coord_addr,
+                   "MXTPU_NUM_PROC=%d" % args.num_workers,
+                   "MXTPU_PROC_ID=%d" % rank] + args.command
+            procs.append(subprocess.Popen(cmd))
+        for p in procs:
+            p.wait()
+
+
+if __name__ == "__main__":
+    main()
