@@ -108,8 +108,14 @@ class Parameter:
         if initializer is None:
             default_init(self.name, data)
         else:
-            init_mod.create(initializer)(self.name, data) if isinstance(initializer, str) \
-                else initializer(self.name, data)
+            # explicit per-parameter init bypasses name-suffix dispatch
+            # (ref initializer.py __call__: attrs['__init__'] → _init_weight)
+            if isinstance(initializer, str):
+                initializer = init_mod.create(initializer)
+            if isinstance(initializer, init_mod.Initializer):
+                initializer._init_weight(self.name, data)
+            else:
+                initializer(self.name, data)
         if data.dtype != nd._np_dtype(self.dtype):
             data = data.astype(self.dtype)
         self._data = data

@@ -28,6 +28,8 @@ __all__ = ["ndarray", "array", "zeros", "ones", "full", "empty", "arange",
 class ndarray(NDArray):
     """NumPy-semantics array (ref numpy/multiarray.py ndarray)."""
 
+    __slots__ = ()  # layout-compatible with NDArray for in-place re-classing
+
     def __getitem__(self, key):
         key = _nd_mod._index_fixup(key)
         return _apply_np(lambda x: x[key], self)
@@ -74,17 +76,17 @@ class ndarray(NDArray):
 
 
 def _apply_np(fn, *inputs):
-    """_apply but producing mx.np.ndarray outputs (keeps autograd taping)."""
+    """_apply but producing mx.np.ndarray outputs (keeps autograd taping).
+
+    Re-classes the returned NDArray in place so the tape's object identity is
+    preserved (backward is keyed by id(output))."""
     out = _nd_mod._apply(fn, *inputs)
     if isinstance(out, (list, tuple)):
-        return type(out)(_vieww(o) for o in out)
-    return _vieww(out)
-
-
-def _vieww(x):
-    v = ndarray(x._data)
-    v._in_graph = x._in_graph
-    return v
+        for o in out:
+            o.__class__ = ndarray
+        return out
+    out.__class__ = ndarray
+    return out
 
 
 def _to(x):
@@ -100,9 +102,13 @@ def array(object, dtype=None, ctx=None):
         if dtype is not None:
             data = data.astype(_np_dtype(dtype))
         return ndarray(data)
-    data = onp.asarray(object, dtype=_np_dtype(dtype) if dtype else None)
-    if data.dtype == onp.float64 and dtype is None:
-        data = data.astype(onp.float32)
+    if dtype is None and isinstance(object, (list, tuple, int, float)):
+        # MXNet deepnumpy semantics: python containers default to float32
+        data = onp.asarray(object, dtype=onp.float32)
+    else:
+        data = onp.asarray(object, dtype=_np_dtype(dtype) if dtype else None)
+        if data.dtype == onp.float64 and dtype is None:
+            data = data.astype(onp.float32)
     return ndarray(_ctx_put(data, ctx))
 
 
@@ -359,6 +365,23 @@ def pad(array_, pad_width, mode="constant", **kw):
 
 def count_nonzero(a, axis=None):
     return _apply_np(lambda x: jnp.count_nonzero(x, axis=axis), _to(a))
+
+
+def zeros_like(a, dtype=None):
+    return _apply_np(lambda x: jnp.zeros_like(x, dtype=_np_dtype(dtype) if dtype
+                                              else None), _to(a))
+
+
+def ones_like(a, dtype=None):
+    return _apply_np(lambda x: jnp.ones_like(x, dtype=_np_dtype(dtype) if dtype
+                                             else None), _to(a))
+
+
+def full_like(a, fill_value, dtype=None):
+    return _apply_np(lambda x: jnp.full_like(x, fill_value), _to(a))
+
+
+__all__ += ["zeros_like", "ones_like", "full_like"]
 
 
 # ------------------------------------------------------------ submodules

@@ -1,0 +1,176 @@
+"""Metric + aux subsystem tests (ref tests/python/unittest/test_metric.py)."""
+import math
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, metric
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    m.update([nd.array([0, 1, 1])], [nd.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])])
+    assert m.get()[1] == pytest.approx(2.0 / 3)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]])
+    m.update([nd.array([2, 1])], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mse_mae_rmse():
+    a = onp.array([[1.0], [2.0]], dtype="float32")
+    b = onp.array([[3.0], [2.0]], dtype="float32")
+    m = metric.MSE()
+    m.update([nd.array(a)], [nd.array(b)])
+    assert m.get()[1] == pytest.approx(2.0)
+    m = metric.MAE()
+    m.update([nd.array(a)], [nd.array(b)])
+    assert m.get()[1] == pytest.approx(1.0)
+    m = metric.RMSE()
+    m.update([nd.array(a)], [nd.array(b)])
+    assert m.get()[1] == pytest.approx(math.sqrt(2.0))
+
+
+def test_perplexity_crossentropy():
+    probs = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    labels = nd.array([0, 0])
+    ce = metric.CrossEntropy()
+    ce.update([labels], [probs])
+    expected = -(math.log(0.5) + math.log(0.9)) / 2
+    assert ce.get()[1] == pytest.approx(expected, rel=1e-4)
+    p = metric.Perplexity(ignore_label=None)
+    p.update([labels], [probs])
+    assert p.get()[1] == pytest.approx(math.exp(expected), rel=1e-4)
+
+
+def test_f1_mcc():
+    f1 = metric.F1()
+    f1.update([nd.array([1, 0, 1])], [nd.array([[0.1, 0.9], [0.8, 0.2], [0.2, 0.8]])])
+    assert f1.get()[1] == pytest.approx(1.0)
+    mcc = metric.MCC()
+    mcc.update([nd.array([1, 0])], [nd.array([[0.2, 0.8], [0.7, 0.3]])])
+    assert mcc.get()[1] == pytest.approx(1.0)
+
+
+def test_composite_and_custom():
+    comp = metric.CompositeEvalMetric()
+    comp.add(metric.Accuracy())
+    comp.add(metric.MSE())
+    names, values = comp.get()
+    assert len(names) == 2
+    cm = metric.np(lambda l, p: float(onp.abs(l - p).sum()))
+    cm.update([nd.array([1.0])], [nd.array([0.5])])
+    assert cm.get()[1] == pytest.approx(0.5)
+
+
+def test_create_by_name():
+    assert isinstance(metric.create("acc"), metric.Accuracy)
+    assert isinstance(metric.create(["acc", "mse"]), metric.CompositeEvalMetric)
+
+
+def test_initializers():
+    for name, check in [
+        ("zeros", lambda a: (a == 0).all()),
+        ("ones", lambda a: (a == 1).all()),
+        ("xavier", lambda a: a.std() > 0),
+        ("normal", lambda a: a.std() > 0),
+        ("uniform", lambda a: abs(a).max() <= 0.07 + 1e-6),
+    ]:
+        arr = nd.zeros((8, 8))
+        mx.init.create(name)("weight", arr)
+        assert check(arr.asnumpy()), name
+    # name-based dispatch
+    arr = nd.ones((4,))
+    mx.init.Xavier()("fc_bias", arr)
+    assert (arr.asnumpy() == 0).all()
+    arr = nd.zeros((3, 3))
+    mx.init.Orthogonal()("weight", arr)
+    q = arr.asnumpy()
+    assert_almost_equal(q @ q.T, 2.0 * onp.eye(3), rtol=1e-3, atol=1e-4)
+    # LSTMBias forget gate — explicit param init bypasses suffix dispatch
+    from incubator_mxnet_tpu.gluon import Parameter
+    p = Parameter("lstm_bias", shape=(8,), init=mx.init.LSTMBias(1.0))
+    p.initialize()
+    assert_almost_equal(p.data().asnumpy(), [0, 0, 1, 1, 0, 0, 0, 0])
+
+
+def test_profiler_and_monitor():
+    from incubator_mxnet_tpu import profiler
+    profiler.set_state("run")
+    with profiler.Marker("unit_test_event"):
+        pass
+    table = profiler.dumps()
+    assert "unit_test_event" in table
+    import tempfile, os, json
+    f = os.path.join(tempfile.mkdtemp(), "trace.json")
+    profiler.set_config(filename=f)
+    profiler.dump()
+    data = json.load(open(f))
+    assert "traceEvents" in data
+    profiler.set_state("stop")
+
+    from incubator_mxnet_tpu import Monitor, gluon
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    mon = Monitor(interval=1, pattern=".*")
+    mon.install(net)
+    mon.tic()
+    net(nd.ones((2, 3)))
+    res = mon.toc()
+    assert len(res) >= 1
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+
+
+def test_engine_bulk():
+    from incubator_mxnet_tpu import engine
+    prev = engine.set_bulk_size(4)
+    with engine.bulk(32):
+        pass
+    engine.set_bulk_size(prev)
+
+
+def test_visualization():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    src = mx.visualization.plot_network(net)
+    assert "digraph" in src
+
+
+def test_custom_op_library():
+    from incubator_mxnet_tpu import library
+    called = {}
+
+    def myop(x):
+        called["yes"] = True
+        return x * 3
+
+    library.register_op("triple_op", myop)
+    out = nd.triple_op(nd.array([1.0]))
+    assert out.asnumpy()[0] == 3.0 and called["yes"]
+
+
+def test_estimator_fit():
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est
+    rng = onp.random.RandomState(0)
+    X = rng.rand(32, 8).astype("float32")
+    y = rng.randint(0, 2, 32).astype("float32")
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=8)
+    net = gluon.nn.Dense(2, in_units=8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam")
+    e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer=trainer)
+    e.fit(loader, epochs=2)
+    metrics = e.evaluate(loader)
+    assert metrics[0].get()[1] >= 0.0

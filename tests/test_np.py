@@ -1,0 +1,94 @@
+"""mx.np / npx tests (ref tests/python/unittest/test_numpy_op.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np, npx, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation_and_dtypes():
+    a = np.array([[1, 2], [3, 4]])
+    assert a.dtype == onp.float32
+    assert np.zeros((2, 3)).shape == (2, 3)
+    assert np.linspace(0, 1, 5).shape == (5,)
+    assert np.eye(3).asnumpy()[0, 0] == 1
+    xs, ys = np.meshgrid(np.arange(3), np.arange(2))
+    assert xs.shape == (2, 3)
+
+
+def test_math_matches_numpy():
+    x = onp.random.rand(3, 4).astype("float32")
+    a = np.array(x)
+    assert_almost_equal(np.exp(a), onp.exp(x), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(np.sum(a, axis=1), x.sum(1), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(np.mean(a), x.mean(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(np.maximum(a, 0.5), onp.maximum(x, 0.5))
+    assert_almost_equal(a.std(), x.std(), rtol=1e-3, atol=1e-4)
+
+
+def test_matmul_einsum():
+    x = onp.random.rand(3, 4).astype("float32")
+    y = onp.random.rand(4, 5).astype("float32")
+    assert_almost_equal(np.matmul(np.array(x), np.array(y)), x @ y,
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(np.einsum("ij,jk->ik", np.array(x), np.array(y)),
+                        x @ y, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(np.tensordot(np.array(x), np.array(y), axes=1),
+                        x @ y, rtol=1e-4, atol=1e-5)
+
+
+def test_shape_manip():
+    x = onp.arange(24).reshape(2, 3, 4).astype("float32")
+    a = np.array(x)
+    assert np.transpose(a).shape == (4, 3, 2)
+    assert np.expand_dims(a, 0).shape == (1, 2, 3, 4)
+    assert np.concatenate([a, a], axis=1).shape == (2, 6, 4)
+    assert np.stack([a, a]).shape == (2, 2, 3, 4)
+    parts = np.split(a, 3, axis=1)
+    assert len(parts) == 3
+    assert_almost_equal(np.flip(a, 0), x[::-1])
+    assert np.where(a > 5, a, np.zeros_like(a)).shape == x.shape
+
+
+def test_linalg_random():
+    x = onp.random.rand(3, 3).astype("float32")
+    spd = x @ x.T + 3 * onp.eye(3, dtype="float32")
+    assert_almost_equal(np.linalg.inv(np.array(spd)), onp.linalg.inv(spd),
+                        rtol=1e-3, atol=1e-4)
+    u, s, vt = np.linalg.svd(np.array(x))
+    assert u.shape == (3, 3)
+    np.random.seed(0)
+    r = np.random.normal(size=(100,))
+    assert abs(float(r.mean().item())) < 0.5
+    assert np.random.randint(0, 5, size=(10,)).asnumpy().max() < 5
+
+
+def test_np_autograd():
+    a = np.array([1.0, 2.0, 3.0])
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(a * a)
+    y.backward()
+    assert_almost_equal(a.grad, 2 * a.asnumpy())
+
+
+def test_npx_ops():
+    a = np.array([[-1.0, 2.0]])
+    assert_almost_equal(npx.relu(a), [[0.0, 2.0]])
+    s = npx.softmax(a)
+    assert_almost_equal(s.asnumpy().sum(axis=-1), [1.0], rtol=1e-5, atol=1e-6)
+    w = np.random.normal(size=(3, 2))
+    out = npx.fully_connected(a, w, no_bias=True, num_hidden=3)
+    assert out.shape == (1, 3)
+
+
+def test_np_array_mode_scopes():
+    from incubator_mxnet_tpu import util
+    assert not util.is_np_array()
+    with util.np_array(True):
+        assert util.is_np_array()
+    util.set_np()
+    assert util.is_np_array()
+    util.reset_np()
+    assert not util.is_np_array()
