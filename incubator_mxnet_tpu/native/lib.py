@@ -32,8 +32,8 @@ def _load():
     if _LIB is not None:
         return _LIB
     try:
-        if not os.path.exists(_SO):
-            build()
+        build()  # mtime-gated: rebuilds when src/*.cc is newer than the .so,
+        #          so a stale binary can't skew the Python<->C++ contract
         lib = ctypes.CDLL(_SO)
     except (OSError, subprocess.CalledProcessError):
         _LIB = False
@@ -114,19 +114,23 @@ class NativeBatchReader:
                                           self._sizes)
         if total < 0:
             return None
-        if total > self._cap:
+        while total > self._cap:
+            # Oversized batch: the C++ side kept it queued (did not consume),
+            # so growing the buffer and retrying fetches the SAME batch.
             self._cap = 1 << max(total.bit_length(), 22)
             self._buf = ctypes.create_string_buffer(self._cap)
-            # batch was consumed but not copied: it is lost; simplest recovery
-            # is a reset-less retry of the NEXT batch with a bigger buffer.
             total = self._lib.rio_reader_next(self._h, self._buf, self._cap,
                                               self._sizes)
             if total < 0:
                 return None
+        raw = self._buf.raw  # ONE copy of the buffer, not one per record
         out, off = [], 0
         for i in range(self.batch_size):
             n = self._sizes[i]
-            out.append(self._buf.raw[off:off + n])
+            if n < 0:
+                raise IOError("truncated record in batch (record %d): file "
+                              "shorter than its index claims" % i)
+            out.append(raw[off:off + n])
             off += n
         return out
 

@@ -203,7 +203,11 @@ static void reader_worker(BatchReader* r) {
       b->data.resize(off + len);
       fseek(f, r->offsets[idx] + 8, SEEK_SET);  // skip magic+lrec
       if (fread(b->data.data() + off, 1, len, f) != (size_t)len) {
-        b->sizes.push_back(0);
+        // Truncated/failed record: shrink the data region back so offsets
+        // stay aligned with sizes, and surface the error as size -1 so the
+        // consumer raises instead of training on an empty payload.
+        b->data.resize(off);
+        b->sizes.push_back(-1);
         continue;
       }
       b->sizes.push_back(len);
@@ -255,7 +259,9 @@ long rio_reader_num_records(void* h) {
 
 // Blocks for the next in-order batch. Returns total bytes (payloads are
 // copied into out_buf up to cap); sizes into out_sizes (batch_size entries).
-// Returns -1 at end of epoch.
+// Returns -1 at end of epoch. If total > cap the batch is NOT consumed:
+// it stays queued so the caller can grow its buffer and retry the SAME
+// batch (no silent data loss on oversized batches).
 long rio_reader_next(void* h, char* out_buf, long cap, int64_t* out_sizes) {
   auto* r = static_cast<BatchReader*>(h);
   Batch* b = nullptr;
@@ -266,12 +272,14 @@ long rio_reader_next(void* h, char* out_buf, long cap, int64_t* out_sizes) {
     r->cv_ready.wait(lk, [&] { return r->stop || r->ready.count(want); });
     if (r->stop) return -1;
     b = r->ready[want];
+    long total = (long)b->data.size();
+    if (total > cap) return total;  // keep queued; caller retries with bigger buf
     r->ready.erase(want);
     r->next_consume++;
   }
   r->cv_space.notify_all();
   long total = (long)b->data.size();
-  if (total <= cap) memcpy(out_buf, b->data.data(), total);
+  memcpy(out_buf, b->data.data(), total);
   for (size_t i = 0; i < b->sizes.size(); ++i) out_sizes[i] = b->sizes[i];
   delete b;
   return total;

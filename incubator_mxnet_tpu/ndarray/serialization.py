@@ -1,0 +1,211 @@
+"""Binary NDArray list serialization, byte-compatible with the reference.
+
+Implements the exact on-disk format of the reference's NDArray::Save/Load
+(ref src/ndarray/ndarray.cc:1596-1868):
+
+    uint64  kMXAPINDArrayListMagic (0x112)
+    uint64  reserved (0)
+    uint64  number of arrays
+    per array (dense, V2):
+        uint32  NDARRAY_V2_MAGIC (0xF993fac9)
+        int32   storage type (0 = kDefaultStorage; ndarray.h:61-65)
+        int32   ndim; int64 x ndim        (TShape, tuple.h:731-740)
+        int32   dev_type; int32 dev_id    (Context::Save, base.h:157-160)
+        int32   type_flag                 (mshadow base.h:334-346)
+        raw little-endian buffer
+    uint64  number of names
+    per name: uint64 length; bytes
+
+so ``.params`` files written here load in upstream MXNet and vice versa.
+Also reads V1/legacy (magic = ndim, uint32 dims) and V3 (np-shape) records,
+and row_sparse/CSR records (aux types/shapes/data per ndarray.cc:1654-1678).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+
+kMXAPINDArrayListMagic = 0x112
+NDARRAY_V1_MAGIC = 0xF993FAC8
+NDARRAY_V2_MAGIC = 0xF993FAC9
+NDARRAY_V3_MAGIC = 0xF993FACA
+
+# mshadow type flags (ref 3rdparty/mshadow/mshadow/base.h:334-346)
+_FLAG2DTYPE = {
+    0: onp.float32, 1: onp.float64, 2: onp.float16, 3: onp.uint8,
+    4: onp.int32, 5: onp.int8, 6: onp.int64, 7: onp.bool_,
+}
+_DTYPE2FLAG = {onp.dtype(v): k for k, v in _FLAG2DTYPE.items()}
+_BFLOAT16_FLAG = 12
+
+kDefaultStorage = 0
+kRowSparseStorage = 1
+kCSRStorage = 2
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<i", len(shape)))
+    out.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _save_dense(out, arr):
+    """One dense ndarray in V2 framing."""
+    a = onp.ascontiguousarray(arr)
+    if str(a.dtype) == "bfloat16":
+        flag = _BFLOAT16_FLAG
+    elif a.dtype in _DTYPE2FLAG:
+        flag = _DTYPE2FLAG[a.dtype]
+    else:
+        a = a.astype(onp.float32)
+        flag = 0
+    out.append(struct.pack("<I", NDARRAY_V2_MAGIC))
+    out.append(struct.pack("<i", kDefaultStorage))
+    _write_shape(out, a.shape)
+    out.append(struct.pack("<ii", 1, 0))  # Context: kCPU, dev_id 0
+    out.append(struct.pack("<i", flag))
+    out.append(a.tobytes())
+
+
+def save_ndarray_list(fname, arrays, names):
+    """Write arrays (list of numpy) + names in the reference list format."""
+    out = [struct.pack("<QQ", kMXAPINDArrayListMagic, 0),
+           struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _save_dense(out, a)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, fmt):
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_tuple(self, fmt):
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += struct.calcsize("<" + fmt)
+        return vals
+
+    def read_bytes(self, n):
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def read_shape_i64(self):
+        ndim = self.read("i")
+        return self.read_tuple("%dq" % ndim) if ndim else ()
+
+    def read_shape_u32(self, ndim):
+        return self.read_tuple("%dI" % ndim) if ndim else ()
+
+
+def _load_one(r):
+    magic = r.read("I")
+    if magic in (NDARRAY_V2_MAGIC, NDARRAY_V3_MAGIC):
+        stype = r.read("i")
+        nad = {kDefaultStorage: 0, kRowSparseStorage: 1, kCSRStorage: 2}[stype]
+        storage_shape = r.read_shape_i64() if nad > 0 else None
+        shape = r.read_shape_i64()
+        if len(shape) == 0 and magic == NDARRAY_V2_MAGIC:
+            return onp.zeros((), onp.float32)  # is_none() placeholder
+        r.read("ii")  # context
+        flag = r.read("i")
+        aux = []
+        if nad > 0:
+            aux_meta = []
+            for _ in range(nad):
+                aflag = r.read("i")
+                ashape = r.read_shape_i64()
+                aux_meta.append((aflag, ashape))
+        dtype, isize = _decode_flag(flag)
+        data_shape = storage_shape if nad > 0 else shape
+        n = int(onp.prod(data_shape)) if data_shape else 1
+        data = onp.frombuffer(r.read_bytes(n * isize), dtype=dtype).reshape(
+            data_shape).copy()
+        if nad > 0:
+            for aflag, ashape in aux_meta:
+                adt, asz = _decode_flag(aflag)
+                cnt = int(onp.prod(ashape)) if ashape else 1
+                aux.append(onp.frombuffer(r.read_bytes(cnt * asz),
+                                          dtype=adt).reshape(ashape).copy())
+            return _densify(stype, shape, data, aux)
+        return data
+    # legacy V1 / raw-ndim framing (ndarray.cc LegacyLoad)
+    if magic == NDARRAY_V1_MAGIC:
+        shape = r.read_shape_i64()
+    else:
+        shape = r.read_shape_u32(magic)  # magic IS ndim in the oldest format
+    if len(shape) == 0:
+        return onp.zeros((), onp.float32)
+    r.read("ii")
+    flag = r.read("i")
+    dtype, isize = _decode_flag(flag)
+    n = int(onp.prod(shape))
+    return onp.frombuffer(r.read_bytes(n * isize), dtype=dtype).reshape(
+        shape).copy()
+
+
+def _decode_flag(flag):
+    if flag == _BFLOAT16_FLAG:
+        try:
+            import ml_dtypes
+            return onp.dtype(ml_dtypes.bfloat16), 2
+        except ImportError:
+            return onp.dtype(onp.uint16), 2
+    dt = onp.dtype(_FLAG2DTYPE[flag])
+    return dt, dt.itemsize
+
+
+def _densify(stype, shape, data, aux):
+    """Materialize a sparse record densely (we load sparse files; our runtime
+    representation converts via sparse.py when asked)."""
+    out = onp.zeros(shape, dtype=data.dtype)
+    if stype == kRowSparseStorage:
+        idx = aux[0]
+        if idx.size:
+            out[idx] = data
+    elif stype == kCSRStorage:
+        indptr, indices = aux[0], aux[1]
+        for i in range(shape[0]):
+            lo, hi = int(indptr[i]), int(indptr[i + 1])
+            out[i, indices[lo:hi]] = data[lo:hi]
+    return out
+
+
+def load_ndarray_list(fname):
+    """Returns (list_of_numpy, list_of_names) from a reference .params file."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    r = _Reader(buf)
+    header, _reserved = r.read("QQ")
+    if header != kMXAPINDArrayListMagic:
+        raise ValueError("not an NDArray list file (bad magic 0x%x)" % header)
+    n = r.read("Q")
+    arrays = [_load_one(r) for _ in range(n)]
+    n_names = r.read("Q")
+    names = []
+    for _ in range(n_names):
+        ln = r.read("Q")
+        names.append(r.read_bytes(ln).decode("utf-8"))
+    return arrays, names
+
+
+def is_ndarray_list_file(fname):
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+        return len(head) == 8 and struct.unpack("<Q", head)[0] == \
+            kMXAPINDArrayListMagic
+    except OSError:
+        return False

@@ -54,7 +54,13 @@ class Optimizer:
         self.multi_precision = multi_precision
         self.idx2name = dict(param_idx2name or {})
         self.param_dict = param_dict or {}
+        self.sym_info = ((sym.attr_dict(), sym.list_arguments())
+                         if sym is not None else ())
         self._states = {}
+        # the reference __init__ applies __lr_mult__/__wd_mult__ attributes
+        # immediately (ref optimizer.py:139-140)
+        self.set_lr_mult({})
+        self.set_wd_mult({})
 
     # -- registry ------------------------------------------------------
     @staticmethod
@@ -99,14 +105,29 @@ class Optimizer:
         return wd
 
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = dict(args_lr_mult)
+        """Per-parameter lr multipliers; honors __lr_mult__ symbol attributes
+        (ref optimizer.py:372-402)."""
+        self.lr_mult = {}
+        if getattr(self, "sym_info", None):
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
+        """Per-parameter weight-decay multipliers (ref optimizer.py:404-431).
+
+        Matches the reference exactly: only ``__wd_mult__`` symbol attributes
+        (when sym_info is available) plus the user-supplied dict are applied;
+        biases/gamma/beta are NOT auto-excluded from weight decay (the
+        reference decays them too)."""
         self.wd_mult = {}
-        for n in self.idx2name.values():
-            is_gamma_beta = n.endswith(("_gamma", "_beta", "gamma", "beta"))
-            if n.endswith("_bias") or n.endswith("bias") or is_gamma_beta:
-                self.wd_mult[n] = 0.0
+        if getattr(self, "sym_info", None):
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
         self.wd_mult.update(args_wd_mult)
 
     def set_learning_rate(self, lr):

@@ -84,6 +84,16 @@ class Symbol:
     def _set_attr(self, **kwargs):
         self._attr.update(kwargs)
 
+    def attr_dict(self):
+        """{node_name: {attr: str}} over the whole DAG (ref symbol.py attr_dict);
+        consumed by Optimizer.set_lr_mult/set_wd_mult via __lr_mult__/__wd_mult__."""
+        out = {}
+        for s in self.get_internals():
+            if s._attr:
+                out.setdefault(s.name, {}).update(
+                    {k: str(v) for k, v in s._attr.items()})
+        return out
+
     def __getitem__(self, index):
         if isinstance(index, int):
             if self._num_outputs == 1:
@@ -255,6 +265,10 @@ class Group(Symbol):
     def __init__(self, symbols):
         super().__init__(op_name="_group", name=_auto_name("group"))
         self._symbols = list(symbols)
+        # children double as graph inputs so DAG walks (get_internals,
+        # attr_dict) reach them; Group overrides eval_imperative so the
+        # no-op _op is never applied
+        self._inputs = list(symbols)
         self._num_outputs = len(self._symbols)
 
     def eval_imperative(self, bindings, _cache=None):
@@ -289,10 +303,21 @@ def _const(v, like):
     return v
 
 
-def var(name, shape=None, dtype=None, **kwargs):
+def var(name, shape=None, dtype=None, lr_mult=None, wd_mult=None, init=None,
+        **kwargs):
+    """Free variable (ref symbol.py var): lr_mult/wd_mult/attr kwargs become
+    __lr_mult__/__wd_mult__/... node attributes consumed via attr_dict()."""
     s = Symbol(name=name)
     s._shape = shape
     s._dtype = dtype
+    if lr_mult is not None:
+        kwargs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        kwargs["__wd_mult__"] = wd_mult
+    if init is not None:
+        kwargs["__init__"] = init
+    if kwargs:
+        s._set_attr(**kwargs)
     return s
 
 

@@ -1,0 +1,128 @@
+"""Native library (libmxtpu.so) end-to-end coverage.
+
+Exercises the C++ RecordIO writer/scanner, the threaded prefetching batch
+reader (incl. the oversized-batch no-data-loss path and truncated-record
+error path — ADVICE r1 medium/low), and the pooled host allocator.
+Ref parity targets: src/io/iter_image_recordio_2.cc, iter_prefetcher.h,
+src/storage/pooled_storage_manager.h.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu.native import lib as nlib
+
+pytestmark = pytest.mark.skipif(not nlib.available(),
+                                reason="native library unavailable")
+
+
+def _write_records(path, payloads):
+    lib = nlib.get()
+    h = lib.rio_writer_open(path.encode())
+    assert h
+    for p in payloads:
+        assert lib.rio_write(h, p, len(p)) == 0
+    lib.rio_writer_close(h)
+
+
+def test_writer_scan_roundtrip(tmp_path):
+    path = str(tmp_path / "a.rec")
+    payloads = [b"x" * n for n in (1, 3, 4, 7, 1024)]
+    _write_records(path, payloads)
+    offs, lens = nlib.scan_offsets(path)
+    assert list(lens) == [len(p) for p in payloads]
+    assert offs[0] == 0
+    # python-side RecordIO reader agrees with the native framing
+    from incubator_mxnet_tpu import recordio
+    r = recordio.MXRecordIO(path, "r")
+    got = [r.read() for _ in payloads]
+    assert got == payloads
+
+
+def test_batch_reader_order_and_content(tmp_path):
+    path = str(tmp_path / "b.rec")
+    payloads = [bytes([i]) * (i + 1) for i in range(10)]
+    _write_records(path, payloads)
+    rd = nlib.NativeBatchReader(path, batch_size=3, shuffle=False,
+                                num_threads=3)
+    assert rd.num_records == 10
+    assert rd.num_batches == 4
+    seen = []
+    while True:
+        b = rd.next()
+        if b is None:
+            break
+        seen.extend(b)
+    # last batch wraps around (drop-last=False semantics: pad from start)
+    assert seen[:10] == payloads
+    assert len(seen) == 12
+
+
+def test_batch_reader_epoch_reset(tmp_path):
+    path = str(tmp_path / "c.rec")
+    _write_records(path, [bytes([i]) for i in range(8)])
+    rd = nlib.NativeBatchReader(path, batch_size=4, shuffle=True, seed=7)
+    e1 = []
+    while True:
+        b = rd.next()
+        if b is None:
+            break
+        e1.extend(b)
+    rd.reset(reshuffle=True)
+    e2 = []
+    while True:
+        b = rd.next()
+        if b is None:
+            break
+        e2.extend(b)
+    assert sorted(e1) == sorted(e2) == [bytes([i]) for i in range(8)]
+
+
+def test_oversized_batch_not_dropped(tmp_path):
+    """A batch bigger than the initial staging buffer must still be
+    delivered (the C++ side keeps it queued while Python grows its buffer)."""
+    path = str(tmp_path / "d.rec")
+    big = os.urandom(6 << 20)  # 6 MiB > 4 MiB initial cap
+    small = b"s"
+    _write_records(path, [small, big, b"t", b"u"])
+    rd = nlib.NativeBatchReader(path, batch_size=2, shuffle=False)
+    b1 = rd.next()
+    assert b1 == [small, big]  # nothing lost
+    b2 = rd.next()
+    assert b2 == [b"t", b"u"]
+    assert rd.next() is None
+
+
+def test_truncated_record_raises(tmp_path):
+    path = str(tmp_path / "e.rec")
+    _write_records(path, [b"aaaa", b"bbbb"])
+    # truncate the file mid-payload of the second record
+    sz = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(sz - 2)
+    rd = nlib.NativeBatchReader(path, batch_size=2, shuffle=False)
+    with pytest.raises(IOError):
+        rd.next()
+
+
+def test_sharded_parts(tmp_path):
+    path = str(tmp_path / "f.rec")
+    _write_records(path, [bytes([i]) for i in range(10)])
+    r0 = nlib.NativeBatchReader(path, batch_size=5, part_index=0, num_parts=2)
+    r1 = nlib.NativeBatchReader(path, batch_size=5, part_index=1, num_parts=2)
+    assert r0.num_records == r1.num_records == 5
+    assert sorted(r0.next() + r1.next()) == [bytes([i]) for i in range(10)]
+
+
+def test_host_pool_reuse():
+    pool = nlib.HostBufferPool()
+    p1 = pool.alloc(1000)
+    pool.free(p1, 1000)
+    p2 = pool.alloc(900)  # same 4096 bucket → reused
+    assert p1 == p2
+    used = pool.used_bytes()
+    pool.free(p2, 900)
+    p3 = pool.alloc(100000)
+    assert pool.used_bytes() > used
+    pool.free(p3, 100000)

@@ -1,0 +1,115 @@
+"""Reference-binary-format .params serialization (ref ndarray.cc:1596-1868)."""
+import struct
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import serialization as ser
+
+
+def test_dict_roundtrip(tmp_path):
+    f = str(tmp_path / "m.params")
+    data = {"arg:w": nd.array(onp.random.randn(3, 4).astype("float32")),
+            "aux:mean": nd.array(onp.arange(5, dtype="int32"))}
+    nd.save(f, data)
+    back = nd.load(f)
+    assert set(back) == set(data)
+    for k in data:
+        onp.testing.assert_array_equal(back[k].asnumpy(), data[k].asnumpy())
+        assert back[k].dtype == data[k].dtype
+
+
+def test_list_roundtrip(tmp_path):
+    f = str(tmp_path / "l.params")
+    data = [nd.array(onp.ones((2, 2), "float64")), nd.array(onp.zeros(3))]
+    nd.save(f, data)
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 2
+    onp.testing.assert_array_equal(back[0].asnumpy(), onp.ones((2, 2)))
+
+
+def test_exact_binary_layout(tmp_path):
+    """Byte-level check of the header framing the reference expects."""
+    f = str(tmp_path / "x.params")
+    a = onp.arange(6, dtype="float32").reshape(2, 3)
+    ser.save_ndarray_list(f, [a], ["w"])
+    buf = open(f, "rb").read()
+    header, reserved, n = struct.unpack_from("<QQQ", buf, 0)
+    assert header == 0x112 and reserved == 0 and n == 1
+    magic, stype = struct.unpack_from("<Ii", buf, 24)
+    assert magic == 0xF993FAC9 and stype == 0
+    ndim, = struct.unpack_from("<i", buf, 32)
+    assert ndim == 2
+    dims = struct.unpack_from("<2q", buf, 36)
+    assert dims == (2, 3)
+    dev_type, dev_id, type_flag = struct.unpack_from("<iii", buf, 52)
+    assert (dev_type, dev_id, type_flag) == (1, 0, 0)
+    payload = onp.frombuffer(buf, "float32", count=6, offset=64)
+    onp.testing.assert_array_equal(payload.reshape(2, 3), a)
+    # trailing names
+    n_names, = struct.unpack_from("<Q", buf, 64 + 24)
+    ln, = struct.unpack_from("<Q", buf, 96)
+    assert (n_names, ln) == (1, 1) and buf[104:105] == b"w"
+
+
+def test_legacy_v1_and_raw_load(tmp_path):
+    """Old writers framed shape as uint32 dims with magic==ndim."""
+    f = str(tmp_path / "old.params")
+    a = onp.arange(4, dtype="float32")
+    out = [struct.pack("<QQQ", 0x112, 0, 1),
+           struct.pack("<I", 1),                 # magic == ndim (oldest)
+           struct.pack("<I", 4),                 # uint32 dim
+           struct.pack("<ii", 1, 0),
+           struct.pack("<i", 0), a.tobytes(),
+           struct.pack("<Q", 0)]
+    open(f, "wb").write(b"".join(out))
+    arrays, names = ser.load_ndarray_list(f)
+    onp.testing.assert_array_equal(arrays[0], a)
+    assert names == []
+
+
+def test_sparse_record_densifies(tmp_path):
+    """row_sparse records load as dense arrays."""
+    f = str(tmp_path / "rs.params")
+    data = onp.array([[1., 2.], [3., 4.]], "float32")
+    idx = onp.array([0, 3], "int64")
+    out = [struct.pack("<QQQ", 0x112, 0, 1),
+           struct.pack("<Ii", 0xF993FAC9, 1)]      # V2, row_sparse
+    out.append(struct.pack("<i2q", 2, 2, 2))        # storage shape
+    out.append(struct.pack("<i2q", 2, 4, 2))        # logical shape
+    out.append(struct.pack("<ii", 1, 0))            # ctx
+    out.append(struct.pack("<i", 0))                # float32
+    out.append(struct.pack("<i", 6))                # aux type int64
+    out.append(struct.pack("<i1q", 1, 2))           # aux shape (2,)
+    out.append(data.tobytes())
+    out.append(idx.tobytes())
+    out.append(struct.pack("<Q", 0))
+    open(f, "wb").write(b"".join(out))
+    arrays, _ = ser.load_ndarray_list(f)
+    expect = onp.zeros((4, 2), "float32")
+    expect[[0, 3]] = data
+    onp.testing.assert_array_equal(arrays[0], expect)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    args = {k: v.data() for k, v in net.collect_params().items()}
+    mx.model.save_checkpoint(prefix, 3, None, args, {})
+    _, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    assert set(arg_params) == set(args)
+    for k in args:
+        onp.testing.assert_array_equal(arg_params[k].asnumpy(),
+                                       args[k].asnumpy())
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    f = str(tmp_path / "bf.params")
+    x = nd.array(onp.random.randn(4, 4)).astype("bfloat16")
+    nd.save(f, {"w": x})
+    back = nd.load(f)
+    assert str(back["w"].dtype) == "bfloat16"
+    onp.testing.assert_array_equal(back["w"].asnumpy(), x.asnumpy())
