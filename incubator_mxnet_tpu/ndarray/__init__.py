@@ -20,3 +20,4 @@ from .ndarray import (  # noqa
     LinearRegressionOutput, LogisticRegressionOutput, MAERegressionOutput,
 )
 from .ndarray import slice_op as slice  # noqa  (MXNet nd.slice)
+from . import contrib  # noqa  (control flow: foreach/while_loop/cond)

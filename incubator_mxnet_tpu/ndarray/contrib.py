@@ -1,0 +1,210 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+TPU-native analog of the reference's control-flow ops
+(ref src/operator/control_flow.cc:1089 _foreach, :1150 _while_loop,
+:1211 _cond; frontend python/mxnet/ndarray/contrib.py:139,235,403).
+
+Design (tpu-first, not a translation):
+- Eager mode runs real Python loops — exactly the reference's own eager
+  semantics — so the autograd tape records through loop bodies and
+  gradients flow to closed-over parameters naturally.
+- Traced mode (inside hybridize / TrainStep / jit) lowers to XLA-native
+  structured control flow: foreach -> lax.scan, cond -> lax.cond, and
+  while_loop -> a MASKED lax.scan over max_iterations steps. The masked
+  scan (rather than lax.while_loop) keeps the op reverse-mode
+  differentiable — XLA cannot differentiate a dynamic while — at the cost
+  of always executing max_iterations steps; rows past the dynamic stop
+  are zero-filled (the return signature matches the reference:
+  (outputs, final_loop_vars)).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import tree_util as jtu
+
+from .ndarray import NDArray, _apply, _to_nd
+from .. import autograd
+
+__all__ = ["foreach", "while_loop", "cond", "isinf", "isnan", "isfinite"]
+
+
+def _is_nd(v):
+    return isinstance(v, NDArray)
+
+
+def _flatten(tree):
+    return jtu.tree_flatten(tree, is_leaf=_is_nd)
+
+
+def _traced(leaves):
+    from ..gluon import _functional
+    if _functional.in_functional_mode():
+        return True
+    return any(isinstance(x._data, jax.core.Tracer) for x in leaves)
+
+
+def _stack_trees(trees):
+    """Stack a list of identically-structured NDArray trees along axis 0,
+    through _apply so the autograd tape sees it."""
+    leaves0, treedef = _flatten(trees[0])
+    cols = [[_flatten(t)[0][i] for t in trees] for i in range(len(leaves0))]
+    stacked = [_apply(lambda *ds: jnp.stack(ds, 0), *c) for c in cols]
+    return jtu.tree_unflatten(treedef, stacked)
+
+
+def foreach(body, data, init_states):
+    """Loop body over dim 0 of data (ref ndarray/contrib.py:139).
+
+    body(data_i, states) -> (out, new_states). Returns (outs, final_states)
+    with outs stacked along a new axis 0. Lowers to lax.scan when traced.
+    """
+    data_leaves, data_def = _flatten(data)
+    state_leaves, state_def = _flatten(init_states)
+    if not data_leaves:
+        raise ValueError("foreach needs at least one input array")
+    n = data_leaves[0].shape[0]
+
+    if not _traced(data_leaves + state_leaves):
+        if n == 0:
+            raise ValueError("foreach over zero-length data: outputs are "
+                             "undefined in eager mode (shape unknown)")
+        states = init_states
+        outs = []
+        for i in range(n):
+            sl = jtu.tree_unflatten(data_def, [d[i] for d in data_leaves])
+            out, states = body(sl, states)
+            outs.append(out)
+        return _stack_trees(outs), states
+
+    out_def_box = []
+
+    def scan_body(carry, xs):
+        states = jtu.tree_unflatten(state_def, [NDArray(c) for c in carry])
+        sl = jtu.tree_unflatten(data_def, [NDArray(x) for x in xs])
+        out, new_states = body(sl, states)
+        o_leaves, o_def = _flatten(out)
+        s_leaves, _ = _flatten(new_states)
+        out_def_box.clear()
+        out_def_box.append(o_def)
+        return [s._data for s in s_leaves], [o._data for o in o_leaves]
+
+    carry0 = [s._data for s in state_leaves]
+    xs = [d._data for d in data_leaves]
+    carry_t, ys = lax.scan(scan_body, carry0, xs)
+    outs = jtu.tree_unflatten(out_def_box[0], [NDArray(y) for y in ys])
+    states = jtu.tree_unflatten(state_def, [NDArray(c) for c in carry_t])
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """While loop (ref ndarray/contrib.py:235).
+
+    cond(*loop_vars) -> scalar; func(*loop_vars) -> (step_output,
+    new_loop_vars). Returns (outputs, final_loop_vars); outputs stacked
+    along axis 0. Eager: exact number of executed steps. Traced:
+    max_iterations is REQUIRED, outputs have shape[0] == max_iterations
+    with rows past the dynamic stop zero-filled (masked-scan lowering,
+    reverse-differentiable).
+    """
+    loop_vars = list(loop_vars)
+    var_leaves, var_def = _flatten(loop_vars)
+
+    if not _traced(var_leaves):
+        outs = []
+        steps = 0
+        while (max_iterations is None or steps < max_iterations) and \
+                bool(_to_nd(cond(*loop_vars)).asscalar()):
+            step_out, new_vars = func(*loop_vars)
+            loop_vars = list(new_vars)
+            outs.append(step_out)
+            steps += 1
+        if not outs:
+            raise ValueError("while_loop executed zero steps — outputs "
+                             "undefined (reference raises here too)")
+        return _stack_trees(outs), loop_vars
+
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations when traced "
+                         "(static shapes; see module docstring)")
+
+    # shape-infer the step output so the masked branch can emit zeros
+    def _step_datas(datas):
+        vs = jtu.tree_unflatten(var_def, [NDArray(d) for d in datas])
+        out, new_vars = func(*vs)
+        o_leaves, o_def = _flatten(out)
+        v_leaves, _ = _flatten(list(new_vars))
+        return [o._data for o in o_leaves], [v._data for v in v_leaves], o_def
+
+    datas0 = [v._data for v in var_leaves]
+    out_def_box = []
+
+    def _probe(ds):
+        vs = jtu.tree_unflatten(var_def, [NDArray(d) for d in ds])
+        out, _ = func(*vs)
+        leaves, o_def = _flatten(out)
+        out_def_box.append(o_def)
+        return [o._data for o in leaves]
+
+    o_shapes = jax.eval_shape(_probe, datas0)
+    out_def = out_def_box[0]
+
+    def scan_body(carry, _):
+        datas, active = carry
+        vs = jtu.tree_unflatten(var_def, [NDArray(d) for d in datas])
+        pred = _to_nd(cond(*vs))._data.reshape(()).astype(bool)
+        run = jnp.logical_and(active, pred)
+
+        def do(ds):
+            o, v, _ = _step_datas(ds)
+            return v, o
+
+        def skip(ds):
+            return list(ds), [jnp.zeros(s.shape, s.dtype) for s in o_shapes]
+
+        new_datas, out_datas = lax.cond(run, do, skip, datas)
+        return (new_datas, run), (out_datas, run)
+
+    (final_datas, _), (ys, _valid) = lax.scan(
+        scan_body, (datas0, jnp.bool_(True)), None, length=max_iterations)
+    outs = jtu.tree_unflatten(out_def, [NDArray(y) for y in ys])
+    final_vars = jtu.tree_unflatten(var_def, [NDArray(d) for d in final_datas])
+    return outs, final_vars
+
+
+def cond(pred, then_func, else_func):
+    """If-then-else (ref ndarray/contrib.py:403). Branch outputs must have
+    identical structure/shape/dtype. Lowers to lax.cond when traced."""
+    pred = _to_nd(pred)
+    if not _traced([pred]):
+        return then_func() if bool(pred.asscalar()) else else_func()
+
+    defs = []
+
+    def _branch(f):
+        def run(_):
+            out = f()
+            leaves, tdef = _flatten(out)
+            defs.append(tdef)
+            return [o._data for o in leaves]
+        return run
+
+    p = pred._data.reshape(()).astype(bool)
+    ys = lax.cond(p, _branch(then_func), _branch(else_func), 0)
+    if defs[0] != defs[-1]:
+        raise ValueError("cond branches returned different structures")
+    return jtu.tree_unflatten(defs[0], [NDArray(y) for y in ys])
+
+
+# ---- misc contrib ops the reference exposes alongside control flow ------
+def isinf(data):
+    return _apply(lambda x: jnp.isinf(x).astype(jnp.float32), _to_nd(data))
+
+
+def isnan(data):
+    return _apply(lambda x: jnp.isnan(x).astype(jnp.float32), _to_nd(data))
+
+
+def isfinite(data):
+    return _apply(lambda x: jnp.isfinite(x).astype(jnp.float32), _to_nd(data))

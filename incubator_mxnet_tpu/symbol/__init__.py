@@ -245,3 +245,5 @@ for _n in ["FullyConnected", "Convolution", "BatchNorm", "Activation", "LeakyReL
            "LinearRegressionOutput"]:
     __all__.append(_n)
     _OP_TABLE[_n] = getattr(nd, _n, None)
+
+from . import contrib  # noqa  (symbolic control flow)

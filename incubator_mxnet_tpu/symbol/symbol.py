@@ -99,9 +99,16 @@ class Symbol:
             if self._num_outputs == 1:
                 assert index == 0
                 return self
-            return Symbol(op=self._op, op_name=self._op_name, inputs=self._inputs,
-                          kwargs=self._kwargs, name=self.name,
-                          num_outputs=self._num_outputs, output_index=index)
+            # memoized views sharing _base so eval_imperative caches the
+            # producing op ONCE across all consumed outputs
+            views = self.__dict__.setdefault("_views", {})
+            if index not in views:
+                v = Symbol(op=self._op, op_name=self._op_name, inputs=self._inputs,
+                           kwargs=self._kwargs, name=self.name,
+                           num_outputs=self._num_outputs, output_index=index)
+                v._base = self._base if self._output_index is not None else self
+                views[index] = v
+            return views[index]
         raise TypeError("symbol index must be int")
 
     def __iter__(self):
@@ -113,8 +120,9 @@ class Symbol:
         cache = _cache if _cache is not None else {}
 
         def ev(s):
-            key = (id(s), s._output_index)
-            base_key = (id(s), None)
+            base = getattr(s, "_base", None) or s
+            key = (id(base), s._output_index)
+            base_key = (id(base), None)
             if key in cache:
                 return cache[key]
             if s.is_var:
@@ -225,6 +233,13 @@ class Symbol:
     def __truediv__(self, o): return self._binop(o, nd.divide, "_div")
     def __rtruediv__(self, o): return self._binop(o, nd.divide, "_div", True)
     def __pow__(self, o): return self._binop(o, nd.power, "_pow")
+    # comparisons (ref symbol.py __gt__/__ge__/__lt__/__le__ → broadcast_*);
+    # __eq__/__hash__ stay identity-based so symbols remain dict keys
+    def __gt__(self, o): return self._binop(o, nd.greater, "_greater")
+    def __ge__(self, o): return self._binop(o, nd.greater_equal, "_greater_equal")
+    def __lt__(self, o): return self._binop(o, nd.lesser, "_lesser")
+    def __le__(self, o): return self._binop(o, nd.lesser_equal, "_lesser_equal")
+    def __mod__(self, o): return self._binop(o, nd.modulo, "_mod")
     def __neg__(self):
         return Symbol(op=lambda a: -a, op_name="negative", inputs=[self])
 

@@ -1,0 +1,194 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Modeled on the reference's tests/python/unittest/test_contrib_control_flow.py
+(while_loop forward :31, cond :1085, foreach throughout). Covers eager,
+autograd-through-loop, hybridized (lax.scan lowering), and symbolic modes.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.ndarray import contrib as ndc
+
+
+# ------------------------------------------------------------------ foreach
+def test_foreach_cumsum():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, states):
+        s = states[0] + x
+        return s, [s]
+
+    outs, states = ndc.foreach(body, data, [init])
+    expect = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    onp.testing.assert_allclose(states[0].asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_list_data_and_outputs():
+    a = nd.array(onp.ones((3, 2), "float32"))
+    b = nd.array(onp.full((3, 2), 2.0, "float32"))
+
+    def body(xs, states):
+        x, y = xs
+        return [x + y, x * y], states
+
+    outs, _ = ndc.foreach(body, [a, b], [])
+    onp.testing.assert_allclose(outs[0].asnumpy(), onp.full((3, 2), 3.0))
+    onp.testing.assert_allclose(outs[1].asnumpy(), onp.full((3, 2), 2.0))
+
+
+def test_foreach_autograd_closure_params():
+    """Gradients flow through the python-loop path to closed-over params."""
+    w = nd.array(onp.array([2.0], "float32"))
+    w.attach_grad()
+    data = nd.array(onp.arange(1, 5, dtype="float32").reshape(4, 1))
+
+    def body(x, states):
+        y = x * w
+        return y, states
+
+    with autograd.record():
+        outs, _ = ndc.foreach(body, data, [])
+        loss = outs.sum()
+    loss.backward()
+    # d/dw sum(w * x_i) = sum(x_i) = 10
+    onp.testing.assert_allclose(w.grad.asnumpy(), [10.0], rtol=1e-6)
+
+
+def test_foreach_rnn_style_hybridized():
+    """foreach inside a HybridBlock lowers to lax.scan and matches eager."""
+
+    class Cum(mx.gluon.HybridBlock):
+        def forward(self, x):
+            def body(x_t, states):
+                s = states[0] + x_t * 2.0
+                return s, [s]
+            outs, st = ndc.foreach(body, x, [nd.zeros_like(x[0])])
+            return outs
+
+    x = nd.array(onp.random.RandomState(0).randn(5, 4).astype("float32"))
+    net = Cum()
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(eager, hybrid, rtol=1e-5)
+
+
+# --------------------------------------------------------------- while_loop
+def test_while_loop_simple_forward():
+    """ref test_contrib_control_flow.py:31 — accumulate to a limit."""
+
+    def cond_fn(i, s):
+        return i <= 5
+
+    def func(i, s):
+        return i, (i + 1, s + i)
+
+    outs, (i_f, s_f) = ndc.while_loop(
+        cond_fn, func,
+        loop_vars=(nd.array([1.0]), nd.array([0.0])),
+        max_iterations=10)
+    assert float(s_f.asnumpy()[0]) == 15.0   # 1+2+3+4+5
+    assert float(i_f.asnumpy()[0]) == 6.0
+    assert outs.shape[0] == 5                # eager: exact step count
+    onp.testing.assert_allclose(outs.asnumpy().ravel(), [1, 2, 3, 4, 5])
+
+
+def test_while_loop_traced_masked():
+    """Under tracing, outputs have max_iterations rows, zero past stop."""
+
+    class W(mx.gluon.HybridBlock):
+        def forward(self, x):
+            def cond_fn(i, s):
+                return i <= 3
+            def func(i, s):
+                return s + i, (i + 1, s + i)
+            outs, _ = ndc.while_loop(cond_fn, func, (x, nd.zeros_like(x)),
+                                     max_iterations=6)
+            return outs
+
+    net = W()
+    net.hybridize()
+    out = net(nd.array([1.0])).asnumpy()
+    assert out.shape[0] == 6
+    onp.testing.assert_allclose(out.ravel(), [1, 3, 6, 0, 0, 0])
+
+
+def test_while_loop_zero_steps_raises():
+    with pytest.raises(ValueError):
+        ndc.while_loop(lambda i: i < 0, lambda i: (i, (i + 1,)),
+                       (nd.array([1.0]),), max_iterations=4)
+
+
+# --------------------------------------------------------------------- cond
+def test_cond_eager_and_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = ndc.cond(x < 5, lambda: x * 2, lambda: x * 3)
+    out.backward()
+    onp.testing.assert_allclose(out.asnumpy(), [6.0])
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_cond_hybridized_both_branches():
+    class C(mx.gluon.HybridBlock):
+        def forward(self, x):
+            return ndc.cond(x.sum() > 0, lambda: x * 2, lambda: -x)
+
+    net = C()
+    net.hybridize()
+    onp.testing.assert_allclose(net(nd.array([2.0])).asnumpy(), [4.0])
+    onp.testing.assert_allclose(net(nd.array([-2.0])).asnumpy(), [2.0])
+
+
+# ----------------------------------------------------------------- symbolic
+def test_symbol_foreach_bind():
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+
+    def body(x, states):
+        return x * w, [states[0] + x]
+
+    outs, states = mx.sym.contrib.foreach(body, data, [mx.sym.var("init")])
+    g = mx.sym.Group([outs, states[0]])
+    ex = g.bind(args={"data": nd.array(onp.ones((3, 2), "float32")),
+                      "w": nd.array(onp.full((2,), 4.0, "float32")),
+                      "init": nd.zeros((2,))})
+    o, s = ex.forward()
+    onp.testing.assert_allclose(o.asnumpy(), onp.full((3, 2), 4.0))
+    onp.testing.assert_allclose(s.asnumpy(), onp.full((2,), 3.0))
+
+
+def test_symbol_while_loop():
+    i0 = mx.sym.var("i")
+    s0 = mx.sym.var("s")
+    outs, finals = mx.sym.contrib.while_loop(
+        lambda i, s: i <= 4,
+        lambda i, s: (i * 10, (i + 1, s + i)),
+        [i0, s0], max_iterations=8)
+    g = mx.sym.Group([outs, finals[1]])
+    ex = g.bind(args={"i": nd.array([1.0]), "s": nd.array([0.0])})
+    o, sf = ex.forward()
+    onp.testing.assert_allclose(o.asnumpy().ravel(), [10, 20, 30, 40])
+    assert float(sf.asnumpy()[0]) == 10.0
+
+
+def test_symbol_cond():
+    p = mx.sym.var("p")
+    a = mx.sym.var("a")
+    out = mx.sym.contrib.cond(p, lambda: a + 1, lambda: a - 1)
+    ex = out.bind(args={"p": nd.array([1.0]), "a": nd.array([5.0])})
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(), [6.0])
+    ex2 = out.bind(args={"p": nd.array([0.0]), "a": nd.array([5.0])})
+    onp.testing.assert_allclose(ex2.forward()[0].asnumpy(), [4.0])
+
+
+def test_contrib_isops():
+    x = nd.array([1.0, onp.inf, onp.nan])
+    onp.testing.assert_allclose(ndc.isinf(x).asnumpy(), [0, 1, 0])
+    onp.testing.assert_allclose(ndc.isnan(x).asnumpy(), [0, 0, 1])
+    onp.testing.assert_allclose(ndc.isfinite(x).asnumpy(), [1, 0, 0])
