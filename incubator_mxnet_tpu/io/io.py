@@ -252,6 +252,24 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
 
+def _payload_is_jpeg(path):
+    """Probe the first record: IRHeader (flag u32, label f32, id u64x2 = 24
+    bytes, + flag extra label floats) followed by JPEG SOI bytes?"""
+    import struct
+    from .. import recordio
+    try:
+        r = recordio.MXRecordIO(path, "r")
+        raw = r.read()
+        r.close()
+        if raw is None or len(raw) < 26:
+            return False
+        flag = struct.unpack("<I", raw[:4])[0]
+        off = 24 + 4 * flag
+        return raw[off:off + 2] == b"\xff\xd8"
+    except Exception:
+        return False
+
+
 class ImageRecordIter(DataIter):
     """RecordIO image pipeline (ref src/io/iter_image_recordio_2.cc:880).
 
@@ -264,23 +282,43 @@ class ImageRecordIter(DataIter):
                  label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
                  num_parts=1, part_index=0, preprocess_threads=4, round_batch=True,
-                 seed=0, path_imgidx=None, prefetch_buffer=2, **kwargs):
+                 seed=0, path_imgidx=None, prefetch_buffer=2, resize=0, **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         from concurrent.futures import ThreadPoolExecutor
 
-        # native C++ prefetching reader (src/recordio.cc) is the fast path:
-        # threaded readahead + sharding happen off the GIL
+        # Fast path tier 1: FULL native pipeline — JPEG decode + augment +
+        # NCHW batch assembly in C++ worker threads, zero Python in the
+        # decode loop (src/image.cc; ref iter_image_recordio_2.cc:51).
+        # Requires 3-channel output and JPEG payloads (probed below).
+        # Tier 2: native record READER (C++ readahead) + PIL decode threads.
+        # Tier 3: pure Python.
+        self._native_pipe = None
         self._native = None
         try:
             from ..native import lib as _native_lib
-            if _native_lib.available():
-                self._native = _native_lib.NativeBatchReader(
-                    path_imgrec, batch_size, shuffle=shuffle, seed=seed,
-                    num_threads=max(1, preprocess_threads // 2),
+            if _native_lib.available() and data_shape[0] == 3 and \
+                    _payload_is_jpeg(path_imgrec):
+                self._native_pipe = _native_lib.NativeImagePipeline(
+                    path_imgrec, batch_size, data_shape,
+                    label_width=label_width, resize_short=resize,
+                    rand_crop=rand_crop, rand_mirror=rand_mirror,
+                    mean_rgb=(mean_r, mean_g, mean_b),
+                    std_rgb=(std_r, std_g, std_b), shuffle=shuffle,
+                    seed=seed, num_threads=preprocess_threads,
                     part_index=part_index, num_parts=num_parts)
         except Exception:
-            self._native = None
+            self._native_pipe = None
+        if self._native_pipe is None:
+            try:
+                from ..native import lib as _native_lib
+                if _native_lib.available():
+                    self._native = _native_lib.NativeBatchReader(
+                        path_imgrec, batch_size, shuffle=shuffle, seed=seed,
+                        num_threads=max(1, preprocess_threads // 2),
+                        part_index=part_index, num_parts=num_parts)
+            except Exception:
+                self._native = None
 
         if path_imgidx is None and path_imgrec is not None:
             guess = path_imgrec[: path_imgrec.rfind(".")] + ".idx"
@@ -330,6 +368,8 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
+        if self._native_pipe is not None:
+            self._native_pipe.reset(reshuffle=self._shuffle)
         if self._native is not None:
             self._native.reset(reshuffle=self._shuffle)
         if self._shuffle:
@@ -375,6 +415,15 @@ class ImageRecordIter(DataIter):
         return chw, label
 
     def next(self):
+        if self._native_pipe is not None:
+            res = self._native_pipe.next()
+            if res is None:
+                raise StopIteration
+            data, labels, _bad = res
+            if self._label_width == 1:
+                labels = labels[:, 0]
+            # buffers are reused by the pipeline; nd.array copies to device
+            return DataBatch([nd.array(data)], [nd.array(labels)], pad=0)
         if self._native is not None:
             payloads = self._native.next()
             if payloads is None:

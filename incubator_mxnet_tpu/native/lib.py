@@ -16,13 +16,15 @@ _LIB = None
 
 
 def build(force=False):
-    """Compile src/*.cc into libmxtpu.so with g++ -O3 -pthread."""
-    src = os.path.join(_DIR, "src", "recordio.cc")
+    """Compile src/*.cc into libmxtpu.so with g++ -O3 -pthread -ljpeg."""
+    srcs = sorted(
+        os.path.join(_DIR, "src", f) for f in os.listdir(os.path.join(_DIR, "src"))
+        if f.endswith(".cc"))
     if os.path.exists(_SO) and not force and \
-            os.path.getmtime(_SO) >= os.path.getmtime(src):
+            os.path.getmtime(_SO) >= max(os.path.getmtime(s) for s in srcs):
         return _SO
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", _SO]
+           *srcs, "-o", _SO, "-ljpeg"]
     subprocess.run(cmd, check=True, capture_output=True)
     return _SO
 
@@ -68,6 +70,21 @@ def _load():
                                     c.POINTER(c.c_int64)]
     lib.rio_reader_reset.argtypes = [c.c_void_p, c.c_int]
     lib.rio_reader_destroy.argtypes = [c.c_void_p]
+    # image pipeline (src/image.cc)
+    lib.img_pipe_create.restype = c.c_void_p
+    lib.img_pipe_create.argtypes = [
+        c.c_char_p, c.c_long, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int, c.POINTER(c.c_float), c.POINTER(c.c_float), c.c_float,
+        c.c_int, c.c_int, c.c_int, c.c_long, c.c_long, c.c_long]
+    lib.img_pipe_num_batches.restype = c.c_long
+    lib.img_pipe_num_batches.argtypes = [c.c_void_p]
+    lib.img_pipe_num_records.restype = c.c_long
+    lib.img_pipe_num_records.argtypes = [c.c_void_p]
+    lib.img_pipe_next.restype = c.c_long
+    lib.img_pipe_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                  c.POINTER(c.c_float)]
+    lib.img_pipe_reset.argtypes = [c.c_void_p, c.c_int]
+    lib.img_pipe_destroy.argtypes = [c.c_void_p]
     _LIB = lib
     return lib
 
@@ -141,6 +158,63 @@ class NativeBatchReader:
         try:
             if getattr(self, "_h", None):
                 self._lib.rio_reader_destroy(self._h)
+        except Exception:
+            pass
+
+
+class NativeImagePipeline:
+    """C++ JPEG decode + augment + NCHW batch assembly (src/image.cc) — no
+    Python in the decode loop (ref src/io/iter_image_recordio_2.cc:51)."""
+
+    def __init__(self, path, batch_size, data_shape, label_width=1,
+                 resize_short=0, rand_crop=False, rand_mirror=False,
+                 mean_rgb=None, std_rgb=None, scale=1.0, shuffle=False,
+                 seed=0, num_threads=4, part_index=0, num_parts=1):
+        import numpy as onp
+        self._lib = get()
+        c, h, w = data_shape
+        if c != 3:
+            raise ValueError("native pipeline produces 3-channel RGB")
+        mean = (ctypes.c_float * 3)(*(mean_rgb or (0., 0., 0.)))
+        std = (ctypes.c_float * 3)(*(std_rgb or (1., 1., 1.)))
+        self._h = self._lib.img_pipe_create(
+            path.encode(), batch_size, h, w, label_width, resize_short,
+            int(rand_crop), int(rand_mirror), mean, std, float(scale),
+            int(shuffle), seed, num_threads, 4, part_index, num_parts)
+        if not self._h:
+            raise IOError("cannot open record file %s" % path)
+        self.batch_size = batch_size
+        self.data_shape = (batch_size, 3, h, w)
+        self.label_shape = (batch_size, label_width)
+        self._data = onp.empty(self.data_shape, onp.float32)
+        self._labels = onp.empty(self.label_shape, onp.float32)
+
+    @property
+    def num_batches(self):
+        return self._lib.img_pipe_num_batches(self._h)
+
+    @property
+    def num_records(self):
+        return self._lib.img_pipe_num_records(self._h)
+
+    def next(self):
+        """Returns (data NCHW float32, labels, n_bad) or None at epoch end.
+        The returned arrays are reused across calls — copy if you keep them."""
+        bad = self._lib.img_pipe_next(
+            self._h,
+            self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if bad < 0:
+            return None
+        return self._data, self._labels, int(bad)
+
+    def reset(self, reshuffle=True):
+        self._lib.img_pipe_reset(self._h, int(reshuffle))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.img_pipe_destroy(self._h)
         except Exception:
             pass
 
