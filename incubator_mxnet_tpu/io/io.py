@@ -295,6 +295,8 @@ class ImageRecordIter(DataIter):
         # Tier 3: pure Python.
         self._native_pipe = None
         self._native = None
+        self._path = path_imgrec
+        self._pipe_batch = 0
         try:
             from ..native import lib as _native_lib
             if _native_lib.available() and data_shape[0] == 3 and \
@@ -368,6 +370,7 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
+        self._pipe_batch = 0
         if self._native_pipe is not None:
             self._native_pipe.reset(reshuffle=self._shuffle)
         if self._native is not None:
@@ -419,21 +422,38 @@ class ImageRecordIter(DataIter):
             res = self._native_pipe.next()
             if res is None:
                 raise StopIteration
-            data, labels, _bad = res
+            data, labels, bad = res
+            self._pipe_batch += 1  # before any raise: pipe consumed the batch
+            if bad:
+                raise IOError(
+                    "%d undecodable record(s) in %s (corrupt JPEG data); the "
+                    "native pipeline fails loudly to match the Python path"
+                    % (bad, self._path))
             if self._label_width == 1:
                 labels = labels[:, 0]
+            # last batch wraps with duplicated head records on the C++ side;
+            # report them as pad so consumers (metrics/eval) can exclude them
+            pad = 0
+            if self._pipe_batch == self._native_pipe.num_batches:
+                rem = self._native_pipe.num_records % self.batch_size
+                pad = (self.batch_size - rem) % self.batch_size
             # buffers are reused by the pipeline; nd.array copies to device
-            return DataBatch([nd.array(data)], [nd.array(labels)], pad=0)
+            return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
         if self._native is not None:
             payloads = self._native.next()
             if payloads is None:
                 raise StopIteration
+            self._pipe_batch += 1
+            pad = 0
+            if self._pipe_batch == self._native.num_batches:
+                rem = self._native.num_records % self.batch_size
+                pad = (self.batch_size - rem) % self.batch_size
             results = list(self._pool.map(self._decode_payload, payloads))
             data = onp.stack([r[0] for r in results])
             labels = onp.asarray(
                 [onp.ravel(r[1])[: self._label_width] if onp.ndim(r[1])
                  else r[1] for r in results], dtype="float32")
-            return DataBatch([nd.array(data)], [nd.array(labels)], pad=0)
+            return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
         n = self._hi - self._lo
         if self._cursor >= n:
             raise StopIteration

@@ -48,13 +48,17 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            agg = self._aggregate(v)
+            agg = self._aggregate(v, k)
             if self._updater is not None:
                 self._updater(_key_int(k), agg, self._data[k])
             else:
                 self._data[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        # Sharing the jax.Array is snapshot-correct: jax.Arrays are immutable,
+        # and every NDArray "in-place" op rebinds ._data rather than mutating
+        # the buffer, so neither side can observe the other's later updates
+        # (regression-tested in tests/test_parallel.py::test_kvstore_pull_isolation).
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
             for oo in (o if isinstance(o, (list, tuple)) else [o]):
@@ -116,19 +120,20 @@ class KVStore(KVStoreBase):
             return [key], [value]
         return list(key), list(value)
 
-    def _aggregate(self, v):
+    def _aggregate(self, v, key):
         """Sum gradients from a list of per-device values (ref comm.h Reduce)."""
         if isinstance(v, (list, tuple)):
+            if self._compression is not None:
+                v = [self._compression.compress_decompress(x, (key, i))
+                     for i, x in enumerate(v)]
             if len(v) == 1:
                 return v[0]
-            if self._compression is not None:
-                v = [self._compression.compress_decompress(x) for x in v]
             acc = v[0]
             for x in v[1:]:
                 acc = acc + x
             return acc
         if self._compression is not None:
-            return self._compression.compress_decompress(v)
+            return self._compression.compress_decompress(v, key)
         return v
 
 

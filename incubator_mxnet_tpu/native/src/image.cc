@@ -141,6 +141,7 @@ struct ImgPipe {
   std::condition_variable cv_ready, cv_space;
   std::map<long, ImgBatch*> ready;
   long next_consume = 0, next_produce = 0;
+  long epoch = 0;  // bumped by reset(); stale in-flight batches are discarded
   long max_ready;
   bool stop = false;
   std::vector<std::thread> workers;
@@ -162,8 +163,9 @@ void pipe_worker(ImgPipe* p, unsigned tseed) {
   if (!f) return;
   std::mt19937 rng(tseed);
   std::vector<uint8_t> raw, rgb, resized;
+  std::vector<long> idxs;
   while (true) {
-    long seq;
+    long seq, epoch;
     {
       std::unique_lock<std::mutex> lk(p->mu);
       p->cv_space.wait(lk, [&] {
@@ -172,6 +174,13 @@ void pipe_worker(ImgPipe* p, unsigned tseed) {
       });
       if (p->stop) break;
       seq = p->next_produce++;
+      epoch = p->epoch;
+      // snapshot record indices under the lock: reset() may reshuffle
+      // p->order concurrently with decode
+      idxs.resize(p->batch_size);
+      long n = (long)p->order.size();
+      for (long j = 0; j < p->batch_size; ++j)
+        idxs[j] = p->order[(seq * p->batch_size + j) % n];
     }
     auto* b = new ImgBatch();
     b->seq = seq;
@@ -179,9 +188,8 @@ void pipe_worker(ImgPipe* p, unsigned tseed) {
     const long plane = (long)p->H * p->W;
     b->data.assign((size_t)p->batch_size * 3 * plane, 0.f);
     b->labels.assign((size_t)p->batch_size * p->label_width, 0.f);
-    long n = (long)p->order.size();
     for (long j = 0; j < p->batch_size; ++j) {
-      long idx = p->order[(seq * p->batch_size + j) % n];
+      long idx = idxs[j];
       int64_t len = p->lengths[idx];
       raw.resize(len);
       fseek(f, p->offsets[idx] + 8, SEEK_SET);
@@ -198,13 +206,19 @@ void pipe_worker(ImgPipe* p, unsigned tseed) {
       if (flag == 0) {
         lab_dst[0] = label;
       } else {
+        // extra-label section must fit inside the record
+        if ((int64_t)(24 + (uint64_t)4 * flag) >= len) {
+          b->bad++;
+          continue;
+        }
         for (uint32_t k = 0; k < flag && k < (uint32_t)p->label_width; ++k)
           memcpy(lab_dst + k, raw.data() + off + 4 * k, 4);
-        off += 4 * flag;
+        off += (size_t)4 * flag;
       }
       int w = 0, h = 0;
       int hint = p->resize_short > 0 ? p->resize_short : std::min(p->H, p->W);
-      if (!decode_jpeg(raw.data() + off, len - off, &rgb, &w, &h, hint)) {
+      if (!decode_jpeg(raw.data() + off, (size_t)(len - (int64_t)off), &rgb, &w,
+                       &h, hint)) {
         b->bad++;
         continue;
       }
@@ -259,7 +273,12 @@ void pipe_worker(ImgPipe* p, unsigned tseed) {
     }
     {
       std::lock_guard<std::mutex> lk(p->mu);
-      p->ready[seq] = b;
+      if (epoch == p->epoch) {
+        p->ready[seq] = b;
+      } else {
+        delete b;  // produced for a pre-reset epoch; discard
+        b = nullptr;
+      }
     }
     p->cv_ready.notify_all();
   }
@@ -353,6 +372,7 @@ void img_pipe_reset(void* h, int reshuffle) {
     p->ready.clear();
     p->next_consume = 0;
     p->next_produce = 0;
+    p->epoch++;  // in-flight worker batches from the old epoch get discarded
     if (reshuffle && p->shuffle)
       std::shuffle(p->order.begin(), p->order.end(), p->rng);
   }

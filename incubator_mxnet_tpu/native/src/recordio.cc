@@ -159,6 +159,7 @@ struct BatchReader {
   std::map<long, Batch*> ready;   // seq -> batch
   long next_consume = 0;
   long next_produce = 0;
+  long epoch = 0;  // bumped by reset(); stale in-flight batches are discarded
   long max_ready;
   bool stop = false;
   std::vector<std::thread> workers;
@@ -178,8 +179,9 @@ struct BatchReader {
 static void reader_worker(BatchReader* r) {
   FILE* f = fopen(r->path.c_str(), "rb");
   if (!f) return;
+  std::vector<long> idxs;
   while (true) {
-    long seq;
+    long seq, epoch;
     {
       std::unique_lock<std::mutex> lk(r->mu);
       r->cv_space.wait(lk, [&] {
@@ -191,13 +193,18 @@ static void reader_worker(BatchReader* r) {
       // predicate guarantees next_produce < n_batches here; workers persist
       // across epochs (reset() rewinds next_produce and re-notifies)
       seq = r->next_produce++;
+      epoch = r->epoch;
+      // snapshot record indices under the lock: reset() may reshuffle
+      // r->order concurrently with the reads below
+      idxs.resize(r->batch_size);
+      long n = (long)r->order.size();
+      for (long j = 0; j < r->batch_size; ++j)
+        idxs[j] = r->order[(seq * r->batch_size + j) % n];
     }
     auto* b = new Batch();
     b->seq = seq;
-    long n = (long)r->order.size();
     for (long j = 0; j < r->batch_size; ++j) {
-      long k = (seq * r->batch_size + j) % n;
-      long idx = r->order[k];
+      long idx = idxs[j];
       int64_t len = r->lengths[idx];
       size_t off = b->data.size();
       b->data.resize(off + len);
@@ -214,7 +221,12 @@ static void reader_worker(BatchReader* r) {
     }
     {
       std::lock_guard<std::mutex> lk(r->mu);
-      r->ready[seq] = b;
+      if (epoch == r->epoch) {
+        r->ready[seq] = b;
+      } else {
+        delete b;  // produced for a pre-reset epoch; discard
+        b = nullptr;
+      }
     }
     r->cv_ready.notify_all();
   }
@@ -293,6 +305,7 @@ void rio_reader_reset(void* h, int reshuffle) {
     r->ready.clear();
     r->next_consume = 0;
     r->next_produce = 0;
+    r->epoch++;  // in-flight worker batches from the old epoch get discarded
     if (reshuffle && r->shuffle)
       std::shuffle(r->order.begin(), r->order.end(), r->rng);
   }

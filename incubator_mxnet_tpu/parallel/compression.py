@@ -21,10 +21,15 @@ class GradientCompression:
         self.threshold = float(threshold)
         self._residuals = {}
 
-    def compress_decompress(self, grad, key=None):
-        """Quantize to {-t, 0, +t} with error feedback (ref Quantize/Dequantize)."""
+    def compress_decompress(self, grad, key):
+        """Quantize to {-t, 0, +t} with error feedback (ref Quantize/Dequantize).
+
+        ``key`` is mandatory: residuals are error-feedback state that must be
+        keyed by the stable parameter key (kvstore key / param name), never by
+        object identity — Python id() reuse would silently corrupt feedback.
+        """
         data = grad._data if isinstance(grad, NDArray) else grad
-        k = key if key is not None else id(grad)
+        k = key
         res = self._residuals.get(k)
         if res is None:
             res = jnp.zeros_like(data)

@@ -2,33 +2,94 @@
 (SURVEY §2.5: no MoE ops in the reference).
 
 Experts are sharded over the ``ep`` mesh axis (expert dim of the stacked
-weights carries PartitionSpec('ep', ...)); token routing is dense top-k with
-capacity-free einsum dispatch — the all-to-all falls out of GSPMD resharding
-between the token-sharded and expert-sharded einsum operands.
+weights carries PartitionSpec('ep', ...)); token routing follows the GShard
+recipe: top-k gating, per-expert capacity ``C = ceil(k*T/E * capacity_factor)``
+with position-in-expert computed by cumulative sum, tokens over capacity
+dropped, and a dispatch/combine einsum whose token→expert resharding GSPMD
+lowers to an all-to-all over ``ep``. An auxiliary load-balancing loss
+(Switch-Transformer form, ``E * sum_e fraction_routed_e * mean_gate_e``)
+is returned alongside the output so the trainer can add it to the task loss.
+
+``capacity_factor=None`` selects dense (capacity-free) dispatch: every token
+reaches its top-k experts with no dropping — exact but O(T*E) compute, used
+for small expert counts and in tests as the reference for the dropped path.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .. import ndarray as nd
 from ..gluon.block import HybridBlock
-from ..ndarray import NDArray, _apply
+from ..ndarray import _apply
 
-__all__ = ["MoELayer"]
+__all__ = ["MoELayer", "load_balancing_loss"]
+
+
+def load_balancing_loss(gates, top_idx, num_experts):
+    """Switch-Transformer aux loss: E * sum_e f_e * p_e.
+
+    gates: (T, E) softmax router probabilities; top_idx: (T, k) chosen experts.
+    f_e = fraction of tokens whose FIRST choice is e; p_e = mean gate prob.
+    """
+    p = jnp.mean(gates, axis=0)                                   # (E,)
+    f = jnp.mean(jax.nn.one_hot(top_idx[:, 0], num_experts,
+                                dtype=gates.dtype), axis=0)       # (E,)
+    return num_experts * jnp.sum(f * p)
+
+
+def _route_dense(tokens, gates, top_vals, top_idx, num_experts, w1, w2, act):
+    """Capacity-free dispatch: every token to its top-k experts (no drops)."""
+    oh = jax.nn.one_hot(top_idx, num_experts, dtype=gates.dtype)  # (T,k,E)
+    combine = jnp.einsum("tk,tke->te", top_vals, oh)              # (T,E)
+    h = jnp.einsum("td,edh->eth", tokens, w1)
+    h = act(h)
+    y = jnp.einsum("eth,ehd->etd", h, w2)
+    return jnp.einsum("etd,te->td", y, combine)
+
+
+def _route_capacity(tokens, top_vals, top_idx, num_experts, capacity, w1, w2,
+                    act):
+    """GShard capacity dispatch with token dropping.
+
+    Position-in-expert: all 1st choices fill expert queues before any 2nd
+    choice (priority by k, then token order), matching GShard's semantics.
+    """
+    T, k = top_idx.shape
+    oh = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)  # (T,k,E)
+    # (k,T,E) so cumsum order = all k=0 assignments first, then k=1, ...
+    flat = oh.transpose(1, 0, 2).reshape(k * T, num_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat                         # (k*T,E)
+    pos = (pos * flat).sum(-1).reshape(k, T).transpose(1, 0)      # (T,k)
+    pos = pos.astype(jnp.int32)  # exact slot ids for one_hot / comparison
+    keep = (pos < capacity)                                       # (T,k)
+    gate_w = jnp.where(keep, top_vals, 0.0)
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)     # (T,k,C)
+    # combine (T,E,C): gate weight at each token's slot; dispatch = combine>0
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_w, oh, pos_oh)
+    dispatch = (combine > 0.0).astype(tokens.dtype)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)       # (E,C,D)
+    h = jnp.einsum("ecd,edh->ech", expert_in, w1)
+    h = act(h)
+    y = jnp.einsum("ech,ehd->ecd", h, w2)
+    return jnp.einsum("tec,ecd->td", combine.astype(y.dtype), y)
 
 
 class MoELayer(HybridBlock):
     """Top-k gated MoE FFN: y = sum_k g_k * FFN_{e_k}(x).
 
     Weights: w1 (E, D, H), w2 (E, H, D) with E sharded over ``ep``.
+    ``forward`` returns the output only; ``forward_with_aux`` additionally
+    returns the load-balancing loss for the trainer to add to the task loss.
     """
 
     def __init__(self, num_experts, hidden_size, ffn_hidden, top_k=2,
-                 ep_axis="ep", activation="relu", **kwargs):
+                 ep_axis="ep", activation="relu", capacity_factor=None,
+                 **kwargs):
         super().__init__(**kwargs)
         self.num_experts = num_experts
         self.top_k = top_k
+        self.capacity_factor = capacity_factor
         self._act = activation
         with self.name_scope():
             self.gate_weight = self.params.get(
@@ -40,26 +101,35 @@ class MoELayer(HybridBlock):
         self.w1.sharding = P(ep_axis, None, None)
         self.w2.sharding = P(ep_axis, None, None)
 
+    def _fn(self, xd, gw, w1, w2, compute_aux):
+        top_k, num_experts = self.top_k, self.num_experts
+        act = jax.nn.relu if self._act == "relu" else jax.nn.gelu
+        shape = xd.shape
+        tokens = xd.reshape(-1, shape[-1])                        # (T, D)
+        logits = tokens @ gw.T                                    # (T, E)
+        gates = jax.nn.softmax(logits, axis=-1)
+        top_vals, top_idx = jax.lax.top_k(gates, top_k)           # (T, k)
+        top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+        if self.capacity_factor is None:
+            out = _route_dense(tokens, gates, top_vals, top_idx, num_experts,
+                               w1, w2, act)
+        else:
+            T = tokens.shape[0]
+            capacity = max(1, int(-(-top_k * T * self.capacity_factor
+                                    // num_experts)))
+            out = _route_capacity(tokens, top_vals, top_idx, num_experts,
+                                  capacity, w1, w2, act)
+        out = out.reshape(shape)
+        if compute_aux:
+            return out, load_balancing_loss(gates, top_idx, num_experts)
+        return out
+
     def forward(self, x):
-        """x: (..., D) → (..., D); dense dispatch (no token dropping)."""
-        top_k, num_experts, act = self.top_k, self.num_experts, self._act
+        """x: (..., D) → (..., D)."""
+        return _apply(lambda *a: self._fn(*a, compute_aux=False), x,
+                      self.gate_weight.data(), self.w1.data(), self.w2.data())
 
-        def fn(xd, gw, w1, w2):
-            shape = xd.shape
-            tokens = xd.reshape(-1, shape[-1])                       # (T, D)
-            logits = tokens @ gw.T                                    # (T, E)
-            import jax
-            gates = jax.nn.softmax(logits, axis=-1)
-            top_vals, top_idx = jax.lax.top_k(gates, top_k)           # (T, k)
-            top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
-            # dense one-hot combine weights (T, E)
-            oh = jax.nn.one_hot(top_idx, num_experts, dtype=gates.dtype)  # (T,k,E)
-            combine = jnp.einsum("tk,tke->te", top_vals, oh)
-            # expert compute: (E, T, H) — GSPMD reshards tokens→experts (a2a)
-            h = jnp.einsum("td,edh->eth", tokens, w1)
-            h = jax.nn.relu(h) if act == "relu" else jax.nn.gelu(h)
-            y = jnp.einsum("eth,ehd->etd", h, w2)
-            out = jnp.einsum("etd,te->td", y, combine)
-            return out.reshape(shape)
-
-        return _apply(fn, x, self.gate_weight.data(), self.w1.data(), self.w2.data())
+    def forward_with_aux(self, x):
+        """Returns (y, aux_load_balancing_loss)."""
+        return _apply(lambda *a: self._fn(*a, compute_aux=True), x,
+                      self.gate_weight.data(), self.w1.data(), self.w2.data())
