@@ -1,21 +1,32 @@
-"""Fused (flash) attention Pallas kernel for TPU.
+"""Fused (flash) attention Pallas kernels for TPU — forward AND backward.
 
 The hot exception to "let XLA fuse" (SURVEY §7 table): attention's softmax
-forces an HBM round-trip of the (S, S) score matrix under plain XLA. This
-kernel tiles Q against K/V blocks in VMEM with an online-softmax accumulator,
-so scores never leave VMEM. Used by models.bert MultiHeadAttention
-(attention='flash'); falls back to the XLA composite off-TPU or for odd
-shapes. Custom VJP recomputes blockwise (flash-style backward).
+forces an HBM round-trip of the (S, S) score matrix under plain XLA. The
+forward kernel tiles Q against K/V blocks in VMEM with an online-softmax
+accumulator and saves the per-row log-sum-exp (LSE); the backward kernels
+recompute probabilities blockwise from the LSE (FlashAttention-2
+formulation) and accumulate dQ/dK/dV across sequential grid steps, so the
+(S, S) score matrix NEVER materializes in HBM in either direction and VMEM
+use is O(block^2 + block*D) — long sequences fit.
+
+Used by models.bert MultiHeadAttention (attention='flash'); falls back to
+the XLA composite off-TPU or for odd shapes. Set MXTPU_FLASH_INTERPRET=1 to
+run the kernels in Pallas interpret mode on CPU (tests).
 """
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["flash_attention", "flash_attention_supported"]
+
+
+def _interpret():
+    return os.environ.get("MXTPU_FLASH_INTERPRET", "0") == "1"
 
 
 def _blocked_reference(q, k, v, causal, scale):
@@ -35,14 +46,20 @@ def flash_attention_supported(q_shape, block_q=128, block_k=128):
         import jax.experimental.pallas  # noqa
     except ImportError:
         return False
-    plat = jax.devices()[0].platform
-    if plat not in ("tpu", "axon"):
-        return False
+    if not _interpret():
+        plat = jax.devices()[0].platform
+        if plat not in ("tpu", "axon"):
+            return False
     return S % block_q == 0 and S % block_k == 0 and D % 128 == 0
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+# --------------------------------------------------------------- forward
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
+               causal, scale):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+
+    Also writes the per-row LSE (m + log l) consumed by the backward kernels.
+    """
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale            # (block_q, D)
@@ -75,58 +92,192 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
         acc = acc * alpha + p @ v_blk
         return m_new, l, acc
 
+    if causal:
+        # K/V blocks fully above the diagonal contribute nothing — skip them
+        hi = (pl.program_id(1) + 1) * block_q + block_k - 1
+        num_kb = jnp.minimum(num_kb, hi // block_k)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
     o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-37)).astype(o_ref.dtype)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128):
-    """q,k,v: (B, H, S, D) → (B, H, S, D)."""
-    return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+    # rows with l=0 cannot occur (causal keeps the diagonal; dense keeps all)
+    lse_ref[0, 0, :] = (m + jnp.log(jnp.maximum(l, 1e-37)))[:, 0]
 
 
 def _fa_call(q, k, v, causal, scale, block_q, block_k):
+    """Returns (out (B,H,S,D), lse (B*H,S) fp32)."""
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
-    if scale is None:
-        scale = 1.0 / math.sqrt(D)
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     grid = (B * H, S // block_q)
     kernel = functools.partial(_fa_kernel, block_k=block_k, seq_len=S,
                                causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))),
+        interpret=_interpret(),
     )(qf, kf, vf)
-    return out.reshape(B, H, S, D)
+    return out.reshape(B, H, S, D), lse
+
+
+# --------------------------------------------------------------- backward
+def _recompute_p_ds(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qb, kb,
+                    causal, scale, block_q, block_k):
+    """Shared FA2 recompute: returns (q, do, k_blk, p, ds) for one block pair."""
+    q = q_ref[0].astype(jnp.float32)                     # (block_q, D)
+    do = do_ref[0].astype(jnp.float32)                   # (block_q, D)
+    lse = lse_ref[0, 0][:, None]                         # (block_q, 1)
+    delta = delta_ref[0, 0][:, None]                     # (block_q, 1)
+    k_blk = k_ref[0].astype(jnp.float32)                 # (block_k, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+
+    s = (q @ k_blk.T) * scale                            # (block_q, block_k)
+    if causal:
+        qi = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        ki = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+    p = jnp.exp(s - lse)                                 # (block_q, block_k)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    dp = do @ v_blk.T                                    # (block_q, block_k)
+    ds = p * (dp - delta) * scale
+    return q, do, k_blk, p, ds
+
+
+def _fa_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                       dk_ref, dv_ref, *, causal, scale, block_q, block_k):
+    """Grid (bh, kv-block, q-block): accumulate dK/dV over sequential q steps."""
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_ref[0, :, :] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0, :, :] = jnp.zeros_like(dv_ref[0])
+
+    kb = pl.program_id(1)
+    # q-blocks fully above the diagonal contribute nothing in causal mode
+    live = (qb + 1) * block_q - 1 >= kb * block_k if causal else qb >= 0
+
+    @pl.when(live)
+    def _compute():
+        q, do, _k, p, ds = _recompute_p_ds(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qb, kb,
+            causal, scale, block_q, block_k)
+        dv_ref[0, :, :] += (p.T @ do).astype(dv_ref.dtype)
+        dk_ref[0, :, :] += (ds.T @ q).astype(dk_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, causal, scale, block_q, block_k):
+    """Grid (bh, q-block, kv-block): accumulate dQ over sequential kv steps."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_ref[0, :, :] = jnp.zeros_like(dq_ref[0])
+
+    qb = pl.program_id(1)
+    # K/V blocks fully above the diagonal contribute nothing in causal mode
+    live = (qb + 1) * block_q - 1 >= kb * block_k if causal else kb >= 0
+
+    @pl.when(live)
+    def _compute():
+        _q, _do, k_blk, _p, ds = _recompute_p_ds(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qb, kb,
+            causal, scale, block_q, block_k)
+        dq_ref[0, :, :] += (ds @ k_blk).astype(dq_ref.dtype)
+
+
+def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    dof = do.reshape(B * H, S, D)
+    # delta_i = sum_d dO_i * O_i — O(S*D), computed by XLA
+    delta = jnp.sum(dof.astype(jnp.float32) *
+                    o.reshape(B * H, S, D).astype(jnp.float32),
+                    axis=-1)[:, None, :]                 # (B*H, 1, S)
+
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j))
+    kvspec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
+    dkv_kernel = functools.partial(_fa_bwd_dkv_kernel, causal=causal,
+                                   scale=scale, block_q=block_q,
+                                   block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B * H, S, D), jnp.float32)),
+        grid=(B * H, S // block_k, S // block_q),
+        in_specs=[qspec, qspec, rowspec, rowspec, kvspec, kvspec],
+        out_specs=(kvspec, kvspec),
+        interpret=_interpret(),
+    )(qf, dof, lse, delta, kf, vf)
+
+    qspec2 = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    kvspec2 = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    dq_kernel = functools.partial(_fa_bwd_dq_kernel, causal=causal,
+                                  scale=scale, block_q=block_q,
+                                  block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+        grid=(B * H, S // block_q, S // block_k),
+        in_specs=[kvspec2, kvspec2, qspec2, qspec2, rowspec2, rowspec2],
+        out_specs=qspec2,
+        interpret=_interpret(),
+    )(kf, vf, qf, dof, lse, delta)
+
+    shape = (B, H, S, D)
+    return (dq.reshape(shape).astype(q.dtype),
+            dk.reshape(shape).astype(k.dtype),
+            dv.reshape(shape).astype(v.dtype))
+
+
+# --------------------------------------------------------------- custom VJP
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128):
+    """q,k,v: (B, H, S, D) → (B, H, S, D)."""
+    return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if flash_attention_supported(q.shape, block_q, block_k):
-        out = _fa_call(q, k, v, causal, scale, block_q, block_k)
+        out, lse = _fa_call(q, k, v, causal, scale, block_q, block_k)
     else:
-        out = _blocked_reference(q, k, v, causal, scale)
-    return out, (q, k, v, out)
+        out, lse = _blocked_reference(q, k, v, causal, scale), None
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, do):
-    """Flash backward via recomputation (standard FA2 formulation in XLA —
-    the score matrix is rematerialised blockwise by XLA fusion here)."""
-    q, k, v, o = res
+    q, k, v, o, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if lse is not None:
+        return _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q,
+                            block_k)
+    # XLA composite fallback (materializes (S,S); only off-TPU small shapes)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
     if causal:
