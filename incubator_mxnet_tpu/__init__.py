@@ -15,6 +15,19 @@ Usage mirrors MXNet::
 """
 __version__ = "0.1.0"
 
+# Multi-process (DCN) workers: jax.distributed must come up BEFORE anything
+# touches the XLA backend, and importing this package initialises it (device
+# queries in context/ndarray). tools/launch.py sets this env per worker.
+import os as _os
+
+if int(_os.environ.get("MXTPU_NUM_PROC", "1")) > 1 and \
+        _os.environ.get("MXTPU_COORD_ADDR"):
+    import jax as _jax
+    if not _jax.distributed.is_initialized():  # user may have done it already
+        _jax.distributed.initialize(_os.environ["MXTPU_COORD_ADDR"],
+                                    int(_os.environ["MXTPU_NUM_PROC"]),
+                                    int(_os.environ.get("MXTPU_PROC_ID", "0")))
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus

@@ -24,13 +24,23 @@ _ID2DEVTYPE = {v: k for k, v in _DEVTYPE2ID.items()}
 def _accelerator_platform():
     """Best non-CPU platform available to JAX, else 'cpu'."""
     try:
-        platforms = {d.platform for d in jax.devices()}
+        # local: under jax.distributed a context must resolve to a device
+        # THIS process can address, never a peer's
+        platforms = {d.platform for d in jax.local_devices()}
     except RuntimeError:
         return "cpu"
     for p in ("tpu", "axon", "gpu", "cuda", "rocm"):
         if p in platforms:
             return p
     return next(iter(platforms), "cpu")
+
+
+def _local_devices(platform=None):
+    """This process's addressable devices for a platform (multi-host safe)."""
+    if platform is None:
+        return jax.local_devices()
+    return [d for d in jax.local_devices() if d.platform == platform] or \
+        jax.devices(platform)
 
 
 class Context:
@@ -65,16 +75,17 @@ class Context:
         """The jax.Device this context denotes."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                return jax.devices("cpu")[self.device_id]
+                devs = _local_devices("cpu")
+                return devs[self.device_id % len(devs)]
             except RuntimeError:
                 # single-platform TPU-only runtime: fall back to default device
-                return jax.devices()[0]
+                return jax.local_devices()[0]
         plat = _accelerator_platform()
         if plat == "cpu":
             # no accelerator present (unit tests on CPU): map onto cpu devices
-            devs = jax.devices("cpu")
+            devs = _local_devices("cpu")
             return devs[self.device_id % len(devs)]
-        devs = jax.devices(plat)
+        devs = _local_devices(plat)
         return devs[self.device_id % len(devs)]
 
     # -- scope ---------------------------------------------------------

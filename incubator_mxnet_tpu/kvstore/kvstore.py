@@ -144,11 +144,21 @@ class LocalKVStore(KVStore):
 
 @KVStoreBase.register
 class DistKVStore(KVStore):
-    """'dist_sync'/'dist_device_sync'/'dist_async' over jax.distributed.
+    """'dist_sync'/'dist_device_sync' over jax.distributed
+    (ref src/kvstore/kvstore_dist.h:44).
 
-    Multi-host: every host pushes its local gradient; aggregation is an ICI/DCN
-    all-reduce executed in-program by the sharded trainer. This class carries
-    rank/num_workers plumbing (ref src/kvstore/kvstore_dist.h:44).
+    The parameter-server is replaced by symmetric SPMD: ``init`` broadcasts
+    rank-0's values to every worker, ``push`` all-reduces the gradient across
+    processes (DCN collective via the jax.distributed runtime), and the
+    optimizer — when set via ``set_optimizer`` — runs identically on every
+    worker against the identical aggregated gradient, which is semantically
+    the reference's server-side optimizer (kvstore_dist_server.h:179) without
+    a server role. ``dist_async`` (Hogwild, kvstore_dist_server.h:349) has no
+    analog in a collective design and is intentionally mapped to sync — see
+    README "sparse & async" compatibility notes.
+
+    Exercised as real multi-process in tests/test_dist.py (the reference's own
+    strategy, tests/nightly/dist_sync_kvstore.py:36-81).
     """
 
     def __init__(self, name="dist_sync"):
@@ -156,6 +166,36 @@ class DistKVStore(KVStore):
         import jax
         self._rank = jax.process_index() if jax.process_count() > 1 else 0
         self._num_workers = jax.process_count()
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            data = v._data if isinstance(v, NDArray) else v
+            if self._num_workers > 1:
+                from jax.experimental import multihost_utils
+                import jax.numpy as jnp
+                data = jnp.asarray(
+                    multihost_utils.broadcast_one_to_all(data))
+            self._data[k] = NDArray(data) if not isinstance(data, NDArray) \
+                else data.copy()
+
+    def _aggregate(self, v, key):
+        agg = super()._aggregate(v, key)
+        if self._num_workers > 1:
+            from jax.experimental import multihost_utils
+            import jax.numpy as jnp
+            arr = agg._data if isinstance(agg, NDArray) else agg
+            # allgather lands on host; reduce there, upload the sum once
+            summed = jnp.asarray(
+                multihost_utils.process_allgather(arr).sum(axis=0))
+            agg = NDArray(summed) if isinstance(agg, NDArray) else summed
+        return agg
+
+    def barrier(self):
+        if self._num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxtpu_kv_barrier")
+        nd.waitall()
 
     @property
     def rank(self):

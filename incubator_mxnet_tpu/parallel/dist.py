@@ -16,8 +16,16 @@ _STATE = {"initialized": False}
 
 
 def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
-    """Initialise jax.distributed from args or launcher env."""
+    """Initialise jax.distributed from args or launcher env.
+
+    Idempotent: importing incubator_mxnet_tpu under tools/launch.py already
+    initialises the runtime (package __init__), because it must happen before
+    anything touches the XLA backend.
+    """
     if _STATE["initialized"]:
+        return
+    if jax.distributed.is_initialized():  # already up (package import)
+        _STATE["initialized"] = True
         return
     coordinator_address = coordinator_address or os.environ.get("MXTPU_COORD_ADDR")
     num_processes = num_processes or int(os.environ.get("MXTPU_NUM_PROC", "1"))

@@ -1,0 +1,83 @@
+"""Multi-process dist worker — launched by tests/test_dist.py via
+tools/launch.py (ref tests/nightly/dist_sync_kvstore.py worker side).
+
+Runs on the CPU backend (1 device per process); asserts dist_sync semantics
+and prints a RESULT line per check that the parent test collects.
+"""
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+
+def main():
+    from incubator_mxnet_tpu.parallel import dist
+    dist.init_distributed()
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+
+    kv = mx.kv.create("dist_sync")
+    rank, nworkers = kv.rank, kv.num_workers
+    assert nworkers == int(os.environ["MXTPU_NUM_PROC"]), nworkers
+
+    # -- 1. push/pull aggregation across processes ----------------------
+    kv.init("a", nd.full((4,), 100.0) if rank == 0 else nd.zeros((4,)))
+    out = nd.zeros((4,))
+    kv.pull("a", out=out)  # init must broadcast rank-0's value
+    assert onp.allclose(out.asnumpy(), 100.0), out.asnumpy()
+    kv.push("a", nd.full((4,), float(rank + 1)))
+    kv.pull("a", out=out)
+    expected = float(sum(r + 1 for r in range(nworkers)))
+    assert onp.allclose(out.asnumpy(), expected), (out.asnumpy(), expected)
+    print("RESULT pushpull %d ok" % rank, flush=True)
+
+    # -- 2. dist_sync training step: params bitwise-equal everywhere ----
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    rng = onp.random.RandomState(7)  # same init on every worker via bcast
+    w0 = rng.randn(8, 4).astype("float32")
+    kv2.init(0, nd.array(w0))
+    for step in range(3):
+        # worker-dependent gradient: only the all-reduce makes these agree
+        grad = onp.full((8, 4), 0.01 * (rank + 1) * (step + 1), "float32")
+        kv2.push(0, nd.array(grad))
+    w = nd.zeros((8, 4))
+    kv2.pull(0, out=w)
+    digest = hashlib.sha1(onp.ascontiguousarray(w.asnumpy())).hexdigest()
+    print("RESULT params %d %s" % (rank, digest), flush=True)
+
+    # -- 3. global-mesh SPMD collective across processes ----------------
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = onp.asarray(jax.devices())  # nworkers global CPU devices
+    mesh = Mesh(devs, ("dp",))
+    local = jnp.full((1, 4), float(rank + 1))
+    from jax.experimental import multihost_utils
+    garr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, P("dp", None))
+
+    @jax.jit
+    def global_sum(x):
+        return jnp.sum(x, axis=0)
+
+    with mesh:
+        total = global_sum(garr)
+    # result is replicated: this process's shard holds the full value
+    got = onp.asarray(total.addressable_data(0))
+    want = float(sum(r + 1 for r in range(nworkers)))
+    assert onp.allclose(got, want), (got, want)
+    print("RESULT spmd %d ok" % rank, flush=True)
+
+    kv.barrier()
+    print("RESULT done %d" % rank, flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
