@@ -14,6 +14,7 @@ from .data_parallel import DataParallelTrainStep  # noqa
 from .tensor_parallel import ColParallelDense, RowParallelDense, shard_params  # noqa
 from .ring_attention import ring_attention, local_attention  # noqa
 from .pipeline import PipelineParallel, pipeline_spmd  # noqa
-from .moe import MoELayer  # noqa
+from .gluon_pipeline import PipelineStack  # noqa
+from .moe import MoELayer, load_balancing_loss  # noqa
 from .compression import GradientCompression  # noqa
 from .dist import init_distributed, rank, num_workers  # noqa

@@ -1,0 +1,123 @@
+"""Gluon pipeline parallelism: embed → pipelined trunk → head.
+
+NEW capability (SURVEY §2.5 — the reference has no pipeline parallelism).
+TPU-native design: the repeated trunk (N structurally-identical stage blocks,
+e.g. transformer layers) rides the GPipe ppermute ring over the ``pp`` mesh
+axis (parallel.pipeline), while the heterogeneous ends — embedding and head —
+run OUTSIDE the ring, sharded over tp/dp like any other layer. On TPU this is
+strictly better than putting embed/head inside the ring: they are single
+matmuls that shard perfectly over the MXU, and excluding them keeps every
+ring stage shape-identical, which is what lets XLA overlap ppermute with
+stage compute on ICI. Loss and gradients flow through the whole composite
+(the ring is differentiable), so one TrainStep trains embed + trunk + head
+together — the "embed→layers→head with loss/grad through the pipeline" shape.
+
+Usage::
+
+    trunk  = PipelineStack([make_layer() for _ in range(4)], mesh, n_microbatches=8)
+    net    = nn.HybridSequential()
+    net.add(embed, trunk, head)
+    step   = TrainStep(net, loss_fn, trainer)   # grads reach all three parts
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon.block import HybridBlock
+from ..gluon import _functional
+from ..ndarray import _apply
+from .pipeline import pipeline_spmd
+
+__all__ = ["PipelineStack"]
+
+
+class PipelineStack(HybridBlock):
+    """Pipeline N structurally-identical blocks over the ``pp`` mesh axis.
+
+    Each stage keeps its own Parameters (so ``collect_params``/Trainer see
+    them all); at call time the per-stage tensors are stacked on a leading
+    stage dim inside the traced program (gradient of stack = per-stage
+    unstack) and the stack rides the GPipe ring. Stages must map
+    (batch, ...) -> (batch, ...) with identical shapes — transformer layers.
+
+    Stages with BatchNorm-style aux-state updates are rejected: aux writes
+    cannot cross the shard_map boundary. Use LayerNorm inside ring stages.
+    """
+
+    def __init__(self, stages, mesh, axis="pp", n_microbatches=None,
+                 data_axis=None, **kwargs):
+        super().__init__(**kwargs)
+        self.mesh = mesh
+        self.axis = axis
+        self.data_axis = data_axis
+        self.n_stages = len(stages)
+        self.n_microbatches = n_microbatches or self.n_stages
+        self.stages = list(stages)
+        for s in self.stages:
+            self.register_child(s)
+        self._stage_pure = None
+
+    def _build(self):
+        # pure fns traced from stage 0 (per train/eval mode); every stage
+        # shares its structure
+        self._stage_pure = {
+            mode: _functional.make_pure_fn(self.stages[0], train_mode=mode)[2]
+            for mode in (False, True)}
+        self._per_stage = [list(s.collect_params().values())
+                           for s in self.stages]
+        def sig(stage, ps):
+            # drop the stage's own name prefix; compare structure + shapes
+            pre = len(getattr(stage, "prefix", "") or "")
+            return [(p.name[pre:], p.shape) for p in ps]
+
+        n0 = sig(self.stages[0], self._per_stage[0])
+        for st, ps in zip(self.stages[1:], self._per_stage[1:]):
+            if sig(st, ps) != n0:
+                raise ValueError("pipeline stages must be structurally "
+                                 "identical (same parameter structure)")
+
+    def forward(self, x):
+        from .. import autograd
+        if self._stage_pure is None:
+            self._build()
+        train_mode = autograd.is_training()
+        n_stages, nper = self.n_stages, len(self._per_stage[0])
+        pure_fn = self._stage_pure[train_mode]
+        mesh, axis, n_micro = self.mesh, self.axis, self.n_microbatches
+        data_axis = self.data_axis
+        flat = [p.data() for ps in self._per_stage for p in ps]
+
+        if _functional.in_functional_mode():
+            key = _functional.next_functional_key()
+        elif train_mode:
+            from ..gluon.block import _split_global_key
+            key = _split_global_key()
+        else:
+            key = jax.random.PRNGKey(0)
+
+        def fn(xd, *param_datas):
+            eager = not isinstance(xd, jax.core.Tracer)
+            stacked = [jnp.stack([param_datas[i * nper + j]
+                                  for i in range(n_stages)])
+                       for j in range(nper)]
+
+            def stage_fn(stage_params, h, k):
+                outs, aux = pure_fn(stage_params, [h], k)
+                if aux:
+                    raise ValueError(
+                        "PipelineStack stages cannot carry aux-state updates "
+                        "(BatchNorm running stats); use LayerNorm in ring "
+                        "stages")
+                return outs[0]
+
+            out = pipeline_spmd(stage_fn, stacked, xd, mesh, n_micro,
+                                axis=axis, data_axis=data_axis, key=key)
+            if eager:
+                # back to the caller's device so downstream eager ops (head,
+                # loss) see a consistent placement; under jit the mesh-sharded
+                # result flows on unchanged
+                out = jax.device_put(out, next(iter(xd.devices())))
+            return out
+
+        return _apply(fn, x, *flat)

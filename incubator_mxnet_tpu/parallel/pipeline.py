@@ -1,9 +1,18 @@
 """Pipeline parallelism — NEW capability (SURVEY §2.5: absent in reference).
 
-GPipe-style microbatching over homogeneous stages expressed with shard_map +
-ppermute over the ``pp`` mesh axis: stage weights are stacked on a leading
-stage dim sharded over ``pp``; activations circulate the ring once per
-microbatch tick. XLA overlaps the permute with stage compute on ICI.
+GPipe-style microbatching over structurally-identical stages expressed with
+shard_map + ppermute over the ``pp`` mesh axis: stage weights are stacked on
+a leading stage dim sharded over ``pp``; activations circulate the ring once
+per microbatch tick. XLA overlaps the permute with stage compute on ICI.
+
+The whole transform is differentiable (ppermute/scan have transposes), so
+loss and gradients flow through the pipeline — see parallel.gluon_pipeline
+for the Gluon block that pipelines a trunk between an embedding and a head
+with TrainStep/Trainer integration.
+
+``data_axis`` composes pp with data parallelism: the microbatch dim stays
+sharded over ``dp`` while activations ring over ``pp``. ``key`` threads PRNG
+randomness into stages (folded per-stage and per-tick) for dropout.
 """
 from __future__ import annotations
 
@@ -17,7 +26,8 @@ from jax.sharding import PartitionSpec as P
 __all__ = ["PipelineParallel", "pipeline_spmd"]
 
 
-def _pipeline_sharded(x_mb, stacked_params, stage_fn, axis_name, n_microbatches):
+def _pipeline_sharded(x_mb, stacked_params, key, stage_fn, axis_name,
+                      n_microbatches, vary_axes=None):
     """Inside shard_map: each device holds ONE stage's params (leading stage
     dim of size 1 locally) and processes the stream of microbatches.
 
@@ -29,6 +39,7 @@ def _pipeline_sharded(x_mb, stacked_params, stage_fn, axis_name, n_microbatches)
     params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
     mb_shape = x_mb.shape[1:]
     total_ticks = n_microbatches + n_stages - 1
+    stage_key = None if key is None else jax.random.fold_in(key, stage)
 
     def tick(t, carry):
         state, outputs = carry  # state: activation currently held (mb, ...)
@@ -36,7 +47,10 @@ def _pipeline_sharded(x_mb, stacked_params, stage_fn, axis_name, n_microbatches)
         inject = jnp.where(t < n_microbatches, t, n_microbatches - 1)
         fresh = x_mb[inject]
         cur = jnp.where(stage == 0, fresh, state)
-        out = stage_fn(params, cur)
+        if stage_key is None:
+            out = stage_fn(params, cur)
+        else:
+            out = stage_fn(params, cur, jax.random.fold_in(stage_key, t))
         # last stage records its result for microbatch (t - n_stages + 1)
         done_idx = t - (n_stages - 1)
         record = jnp.logical_and(stage == n_stages - 1, done_idx >= 0)
@@ -47,8 +61,10 @@ def _pipeline_sharded(x_mb, stacked_params, stage_fn, axis_name, n_microbatches)
         state = lax.ppermute(out, axis_name, perm)
         return state, outputs
 
-    out0 = lax.pvary(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype), (axis_name,))
-    state0 = lax.pvary(jnp.zeros(mb_shape, x_mb.dtype), (axis_name,))
+    axes = vary_axes or (axis_name,)
+    out0 = lax.pcast(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype),
+                     axes, to="varying")
+    state0 = lax.pcast(jnp.zeros(mb_shape, x_mb.dtype), axes, to="varying")
     _, outputs = lax.fori_loop(0, total_ticks, tick, (state0, out0))
     # only the last stage holds real outputs; broadcast them to all stages
     return _bcast_from_last(outputs, axis_name, n_stages)
@@ -61,23 +77,56 @@ def _bcast_from_last(x, axis_name, n_stages):
     return lax.psum(x * mask, axis_name)
 
 
-def pipeline_spmd(stage_fn, stacked_params, x, mesh, n_microbatches, axis="pp"):
-    """Run a homogeneous-stage pipeline.
+def pipeline_spmd(stage_fn, stacked_params, x, mesh, n_microbatches, axis="pp",
+                  data_axis=None, key=None):
+    """Run a structurally-identical-stage pipeline.
 
-    stage_fn(params, x)->y with identical in/out shapes; stacked_params has a
-    leading dim = n_stages sharded over ``axis``; x: (batch, ...) split into
-    n_microbatches along dim 0.
+    stage_fn(params, x[, key])->y with identical in/out shapes; stacked_params
+    has a leading dim = n_stages sharded over ``axis``; x: (batch, ...) split
+    into n_microbatches along dim 0. With ``data_axis``, the microbatch dim
+    stays sharded over that mesh axis (pp x dp composition). ``key`` (optional
+    PRNG key) is folded per-stage/per-tick and passed as stage_fn's 3rd arg.
     """
+    from jax.sharding import NamedSharding
+
+    n_stages = int(mesh.shape[axis])
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != n_stages:
+        raise ValueError(
+            "stacked_params leading dim (%d stages) must equal the %r mesh "
+            "axis size (%d) — a divisible mismatch would silently drop "
+            "stages" % (leaves[0].shape[0], axis, n_stages))
+    if x.shape[0] % n_microbatches:
+        raise ValueError("batch %d not divisible by n_microbatches %d"
+                         % (x.shape[0], n_microbatches))
     mb = x.shape[0] // n_microbatches
     x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
-    fn = functools.partial(_pipeline_sharded, stage_fn=stage_fn, axis_name=axis,
-                           n_microbatches=n_microbatches)
+    fn = functools.partial(
+        _pipeline_sharded, stage_fn=stage_fn, axis_name=axis,
+        n_microbatches=n_microbatches,
+        vary_axes=(axis, data_axis) if data_axis else (axis,))
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
-    out = jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(P(), param_specs),
-        out_specs=P())(x_mb, stacked_params)
+    io_spec = P(None, data_axis) if data_axis else P()
+    # operands may arrive committed to a single device (eager NDArray data);
+    # lay them out on the mesh so shard_map accepts them (no-op under jit
+    # steady state — becomes a sharding constraint)
+    x_mb = jax.device_put(x_mb, NamedSharding(mesh, io_spec))
+    stacked_params = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        stacked_params, param_specs)
+    if key is not None:
+        key = jax.device_put(key, NamedSharding(mesh, P()))
+    if key is None:
+        out = jax.shard_map(
+            lambda xm, sp: fn(xm, sp, None), mesh=mesh,
+            in_specs=(io_spec, param_specs),
+            out_specs=io_spec)(x_mb, stacked_params)
+    else:
+        out = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(io_spec, param_specs, P()),
+            out_specs=io_spec)(x_mb, stacked_params, key)
     return out.reshape((x.shape[0],) + out.shape[2:])
 
 

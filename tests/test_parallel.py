@@ -130,6 +130,105 @@ def test_pipeline_spmd_matches_sequential():
     assert_almost_equal(onp.asarray(out), ref, rtol=1e-3, atol=1e-4)
 
 
+class _FFNStage(gluon.HybridBlock):
+    """LayerNorm + FFN + residual — a transformer-trunk ring stage."""
+
+    def __init__(self, dim, hidden, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.norm = nn.LayerNorm(in_channels=dim)
+            self.fc1 = nn.Dense(hidden, activation="relu", in_units=dim,
+                                flatten=False)
+            self.fc2 = nn.Dense(dim, in_units=hidden, flatten=False)
+
+    def forward(self, x):
+        return x + self.fc2(self.fc1(self.norm(x)))
+
+
+def _build_pipelined_lm(mesh, n_stages=4, vocab=32, dim=16, seed=5):
+    mx.random.seed(seed)
+    embed = nn.Embedding(vocab, dim)
+    stages = [_FFNStage(dim, 2 * dim) for _ in range(n_stages)]
+    # microbatch dim stays sharded over dp while activations ring over pp
+    trunk = parallel.PipelineStack(stages, mesh, n_microbatches=4,
+                                   data_axis="dp")
+    head = nn.Dense(vocab, in_units=dim, flatten=False)
+    net = nn.HybridSequential()
+    net.add(embed, trunk, head)
+    net.initialize(mx.init.Xavier())
+    return net, (embed, stages, head)
+
+
+def test_gluon_pipeline_forward_matches_sequential():
+    _need_devices(8)
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    net, (embed, stages, head) = _build_pipelined_lm(mesh)
+    tokens = nd.array(onp.random.RandomState(0).randint(0, 32, (8, 6)),
+                      dtype="int32")
+    out = net(tokens)
+    # sequential reference through the SAME blocks, no pipeline
+    h = embed(tokens)
+    for s in stages:
+        h = s(h)
+    ref = head(h)
+    assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_pipeline_train_step_matches_sequential():
+    _need_devices(8)
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    rng = onp.random.RandomState(1)
+    tokens = nd.array(rng.randint(0, 32, (8, 6)), dtype="int32")
+    labels = nd.array(rng.randint(0, 32, (8, 6)), dtype="int32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(pipelined):
+        net, (embed, stages, head) = _build_pipelined_lm(mesh)
+        if not pipelined:
+            seq = nn.HybridSequential()
+            seq.add(embed, *stages, head)
+            net = seq
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        # pipelined: one jitted program over the dp x pp mesh (batch over dp,
+        # ring over pp); sequential reference: plain single-device TrainStep
+        step = jit.TrainStep(net, loss_fn, trainer,
+                             mesh=mesh if pipelined else None)
+        losses = [float(step(tokens, labels).mean().asnumpy())
+                  for _ in range(3)]
+        return losses
+
+    lp = run(True)
+    ls = run(False)
+    assert_almost_equal(onp.asarray(lp), onp.asarray(ls), rtol=1e-4, atol=1e-5)
+    assert lp[-1] < lp[0]  # it actually trains
+
+
+def test_pipeline_grad_through_ring():
+    _need_devices(8)
+    import jax.numpy as jnp
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    n_stages, D = 4, 8
+    rng = onp.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(n_stages, D, D).astype("float32") * 0.3)
+    X = jnp.asarray(rng.randn(8, D).astype("float32"))
+
+    def loss_pipe(Ws):
+        y = parallel.pipeline_spmd(lambda W, x: jnp.tanh(x @ W), Ws, X, mesh,
+                                   n_microbatches=4)
+        return jnp.sum(y ** 2)
+
+    def loss_seq(Ws):
+        h = X
+        for i in range(n_stages):
+            h = jnp.tanh(h @ Ws[i])
+        return jnp.sum(h ** 2)
+
+    gp = jax.grad(loss_pipe)(Ws)
+    gs = jax.grad(loss_seq)(Ws)
+    assert_almost_equal(onp.asarray(gp), onp.asarray(gs), rtol=1e-3, atol=1e-5)
+
+
 def test_moe_layer():
     _need_devices(8)
     mesh = parallel.make_mesh({"ep": 8})
