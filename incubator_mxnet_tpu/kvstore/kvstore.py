@@ -46,12 +46,17 @@ class KVStore(KVStoreBase):
             self._data[k] = v.copy()
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import BaseSparseNDArray
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             agg = self._aggregate(v, k)
             if self._updater is not None:
                 self._updater(_key_int(k), agg, self._data[k])
             else:
+                # the store holds dense values (pull invariants); a pushed
+                # sparse aggregate is densified at store time
+                if isinstance(agg, BaseSparseNDArray):
+                    agg = agg.tostype("default")
                 self._data[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -74,8 +79,39 @@ class KVStore(KVStoreBase):
         self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense-only TPU build: full pull (sparse stypes deferred, SURVEY §7f)
-        self.pull(key, out, priority)
+        """Pull only the requested rows (ref kvstore.h:262 PullRowSparse).
+
+        With a RowSparseNDArray ``out``, fills (indices, values) for
+        ``row_ids``; with a dense out or no row_ids, falls back to full pull."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        keys, outs = self._normalize(key, out)
+        for ki, (k, o) in enumerate(zip(keys, outs)):
+            w = self._data[k]
+            oo_list = o if isinstance(o, (list, tuple)) else [o]
+            # row_ids pairs 1:1 with the outs of each key (multi-device
+            # pattern), or a single spec is shared by all of them
+            if isinstance(row_ids, (list, tuple)):
+                if len(row_ids) == len(oo_list):
+                    rid_list = list(row_ids)
+                elif len(row_ids) == len(keys):
+                    rid_list = [row_ids[ki]] * len(oo_list)
+                else:
+                    raise ValueError(
+                        "row_ids (len %d) must pair with out (len %d) or "
+                        "keys (len %d)" % (len(row_ids), len(oo_list),
+                                           len(keys)))
+            else:
+                rid_list = [row_ids] * len(oo_list)
+            for oo, rid in zip(oo_list, rid_list):
+                rid_arr = rid._data if isinstance(rid, NDArray) else rid
+                if isinstance(oo, RowSparseNDArray):
+                    oo.indices = NDArray(rid_arr)
+                    oo.data = NDArray(w._data[rid_arr])
+                    oo._shape = tuple(w.shape)
+                else:
+                    oo._data = w._data
 
     # ---- optimizer ----------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -121,20 +157,26 @@ class KVStore(KVStoreBase):
         return list(key), list(value)
 
     def _aggregate(self, v, key):
-        """Sum gradients from a list of per-device values (ref comm.h Reduce)."""
+        """Sum gradients from a list of per-device values (ref comm.h Reduce).
+
+        Sparse values skip compression (the reference's 2-bit compression is
+        dense-only: gradient_compression.cc rejects non-default stype)."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        def compress(x, k):
+            if self._compression is None or isinstance(x, BaseSparseNDArray):
+                return x
+            return self._compression.compress_decompress(x, k)
+
         if isinstance(v, (list, tuple)):
-            if self._compression is not None:
-                v = [self._compression.compress_decompress(x, (key, i))
-                     for i, x in enumerate(v)]
+            v = [compress(x, (key, i)) for i, x in enumerate(v)]
             if len(v) == 1:
                 return v[0]
             acc = v[0]
             for x in v[1:]:
                 acc = acc + x
             return acc
-        if self._compression is not None:
-            return self._compression.compress_decompress(v, key)
-        return v
+        return compress(v, key)
 
 
 @KVStoreBase.register

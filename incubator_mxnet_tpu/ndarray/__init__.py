@@ -21,3 +21,4 @@ from .ndarray import (  # noqa
 )
 from .ndarray import slice_op as slice  # noqa  (MXNet nd.slice)
 from . import contrib  # noqa  (control flow: foreach/while_loop/cond)
+from . import sparse  # noqa  (row_sparse/csr storage types)

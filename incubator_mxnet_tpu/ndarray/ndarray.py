@@ -172,10 +172,24 @@ class NDArray:
     def as_nd_ndarray(self):
         return self
 
+    @property
+    def stype(self):
+        return "default"
+
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError("TPU build is dense-only (row_sparse/csr deferred)")
-        return self
+        """Convert to a storage type (ref ndarray.py cast_storage).
+
+        Compiled programs never need this: row_sparse grads are an XLA
+        scatter in the fused step (see ndarray/sparse.py). Conversion is
+        eager (data-dependent nnz can't live under jit)."""
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        if stype == "row_sparse":
+            return _sp.row_sparse_array(self)
+        if stype == "csr":
+            return _sp.csr_matrix(self)
+        raise ValueError("unknown stype %r" % stype)
 
     # ------------------------------------------------------------- indexing
     def __getitem__(self, key):

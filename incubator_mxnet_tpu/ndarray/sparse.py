@@ -1,0 +1,261 @@
+"""Sparse storage types — row_sparse and csr (ref include/mxnet/ndarray.h:61-65,
+python/mxnet/ndarray/sparse.py).
+
+TPU compatibility decision (SURVEY §7f): inside compiled programs, "row_sparse
+gradients" are an XLA scatter — the VJP of the embedding gather IS the
+reference's row_sparse grad, fused by the compiler with static shapes, so the
+hot path needs no sparse storage class. These classes exist for the parts of
+the API where sparse STORAGE (not compute) is the contract: kvstore
+row_sparse_pull, optimizer lazy/sparse updates, IO interchange, and
+`tostype`. They live on host+device as (indices, values) / (data, indices,
+indptr) arrays; conversion to/from dense happens eagerly (data-dependent
+shapes cannot live under jit).
+
+dist_async-style delayed sparse aggregation is intentionally out of scope —
+see DistKVStore's docstring.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "array", "zeros", "dot",
+           "retain", "embedding_backward"]
+
+
+class BaseSparseNDArray:
+    stype = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__, "x".join(map(str, self._shape)),
+                                self.stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values): values[i] is row indices[i] of the dense array
+    (ref ndarray.h kRowSparseStorage). Indices are unique and sorted."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else _dense_array(data)
+        self.indices = indices if isinstance(indices, NDArray) else \
+            _dense_array(indices, dtype="int32")
+        self._shape = tuple(shape)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, self.data._data.dtype)
+            dense = dense.at[self.indices._data].set(self.data._data)
+            return NDArray(dense)
+        raise ValueError("cannot convert row_sparse to %r" % stype)
+
+    def retain(self, row_ids):
+        """Rows of self present in row_ids; absent rows drop (ref sparse_retain)."""
+        row_ids = row_ids if isinstance(row_ids, NDArray) else \
+            _dense_array(row_ids, dtype="int32")
+        keep = jnp.isin(self.indices._data, row_ids._data)
+        idx = onp.asarray(self.indices._data)[onp.asarray(keep)]
+        vals = onp.asarray(self.data._data)[onp.asarray(keep)]
+        return RowSparseNDArray(vals, idx, self._shape)
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(), self._shape)
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return RowSparseNDArray(self.data * other, self.indices, self._shape)
+        return self.tostype("default") * other
+
+    __rmul__ = __mul__
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            # O(nnz) merge: concatenate and reduce by unique row id — never
+            # materializes the dense array (vocab x dim grads stay small)
+            idx = onp.concatenate([onp.asarray(self.indices._data),
+                                   onp.asarray(other.indices._data)])
+            vals = onp.concatenate([onp.asarray(self.data._data),
+                                    onp.asarray(other.data._data)])
+            uniq, inv = onp.unique(idx, return_inverse=True)
+            merged = onp.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+            onp.add.at(merged, inv, vals)
+            return RowSparseNDArray(merged, uniq.astype("int32"), self._shape)
+        return self.tostype("default") + other
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row (ref ndarray.h kCSRStorage): 2-D only."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data if isinstance(data, NDArray) else _dense_array(data)
+        self.indices = indices if isinstance(indices, NDArray) else \
+            _dense_array(indices, dtype="int32")
+        self.indptr = indptr if isinstance(indptr, NDArray) else \
+            _dense_array(indptr, dtype="int32")
+        self._shape = tuple(shape)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            n_rows, _ = self._shape
+            ptr = self.indptr._data
+            # row id per nnz: count of indptr entries <= k (static nnz shape)
+            nnz = self.data.shape[0]
+            k = jnp.arange(nnz)
+            rows = jnp.searchsorted(ptr[1:], k, side="right")
+            dense = jnp.zeros(self._shape, self.data._data.dtype)
+            dense = dense.at[rows, self.indices._data].set(self.data._data)
+            return NDArray(dense)
+        if stype == "row_sparse":
+            return self.tostype("default").tostype("row_sparse")
+        raise ValueError("cannot convert csr to %r" % stype)
+
+    def __getitem__(self, i):
+        # row slice (ref sparse.py CSRNDArray.__getitem__ for int keys)
+        lo = int(self.indptr._data[i])
+        hi = int(self.indptr._data[i + 1])
+        row = onp.zeros((self._shape[1],), dtype=str(self.data.dtype))
+        cols = onp.asarray(self.indices._data[lo:hi])
+        row[cols] = onp.asarray(self.data._data[lo:hi])
+        return _dense_array(row)
+
+
+def _dense_to_row_sparse(arr):
+    a = onp.asarray(arr._data if isinstance(arr, NDArray) else arr)
+    nz = onp.where(a.reshape(a.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(a[nz], nz.astype("int32"), a.shape)
+
+
+def _dense_to_csr(arr):
+    a = onp.asarray(arr._data if isinstance(arr, NDArray) else arr)
+    assert a.ndim == 2, "csr is 2-D only"
+    rows, cols = onp.nonzero(a)
+    data = a[rows, cols]
+    indptr = onp.zeros(a.shape[0] + 1, "int32")
+    onp.add.at(indptr, rows + 1, 1)
+    indptr = onp.cumsum(indptr)
+    return CSRNDArray(data, cols.astype("int32"), indptr, a.shape)
+
+
+# ------------------------------------------------------------ constructors
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    """row_sparse_array((data, indices), shape=...) or from dense/another."""
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        if shape is None:
+            raise ValueError("shape required with (data, indices)")
+        return RowSparseNDArray(data, indices, shape)
+    return _dense_to_row_sparse(arg if isinstance(arg, NDArray)
+                                else _dense_array(arg, dtype=dtype))
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    """csr_matrix((data, indices, indptr), shape=...) or from dense."""
+    if isinstance(arg, CSRNDArray):
+        return arg
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        if shape is None:
+            raise ValueError("shape required with (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape)
+    return _dense_to_csr(arg if isinstance(arg, NDArray)
+                         else _dense_array(arg, dtype=dtype))
+
+
+def array(source_array, stype="default", **kwargs):
+    if stype == "default":
+        return _dense_array(source_array, **kwargs)
+    if stype == "row_sparse":
+        return row_sparse_array(source_array, **kwargs)
+    if stype == "csr":
+        return csr_matrix(source_array, **kwargs)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "default":
+        return _dense_zeros(shape, dtype=dtype)
+    if stype == "row_sparse":
+        d = dtype or "float32"
+        return RowSparseNDArray(onp.zeros((0,) + tuple(shape[1:]), d),
+                                onp.zeros((0,), "int32"), shape)
+    if stype == "csr":
+        d = dtype or "float32"
+        return CSRNDArray(onp.zeros((0,), d), onp.zeros((0,), "int32"),
+                          onp.zeros((shape[0] + 1,), "int32"), shape)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def retain(data, indices):
+    """ref mx.nd.sparse.retain."""
+    return data.retain(indices)
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """csr @ dense (ref dot(csr, default) — the LibSVM linear-model path).
+
+    O(nnz * k): gathers rhs rows per nonzero and scatter-adds into the
+    output; the CSR matrix is never densified."""
+    if isinstance(lhs, CSRNDArray):
+        r = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        data = lhs.data._data
+        cols = lhs.indices._data
+        nnz = data.shape[0]
+        rows = jnp.searchsorted(lhs.indptr._data[1:], jnp.arange(nnz),
+                                side="right")
+        contrib = data[:, None] * r[cols]                    # (nnz, k)
+        if transpose_a:
+            out = jnp.zeros((lhs.shape[1],) + r.shape[1:], contrib.dtype)
+            # csr.T @ rhs needs rhs indexed by ROW of the csr entry
+            contrib = data[:, None] * r[rows]
+            return NDArray(out.at[cols].add(contrib))
+        out = jnp.zeros((lhs.shape[0],) + r.shape[1:], contrib.dtype)
+        return NDArray(out.at[rows].add(contrib))
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return NDArray(lhs._data @ rhs._data)
+    raise TypeError("sparse.dot supports (csr, dense)")
+
+
+def embedding_backward(tokens, out_grad, vocab_size):
+    """The embedding-gradient-as-row_sparse helper (ref sparse_grad=True on
+    mx.nd.Embedding): rows = unique token ids, values = summed output grads.
+
+    Inside TrainStep this is an XLA scatter (gather VJP) — use this only for
+    eager/kvstore pipelines that want the sparse storage form.
+    """
+    tok = onp.asarray(tokens._data if isinstance(tokens, NDArray) else tokens
+                      ).reshape(-1)
+    og = onp.asarray(out_grad._data if isinstance(out_grad, NDArray)
+                     else out_grad)
+    og = og.reshape(-1, og.shape[-1])
+    uniq, inv = onp.unique(tok, return_inverse=True)
+    vals = onp.zeros((len(uniq), og.shape[-1]), og.dtype)
+    onp.add.at(vals, inv, og)
+    return RowSparseNDArray(vals, uniq.astype("int32"),
+                            (vocab_size, og.shape[-1]))

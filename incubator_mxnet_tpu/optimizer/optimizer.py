@@ -154,6 +154,15 @@ class Optimizer:
 
     # -- eager entry points (kvstore/Trainer call these) ---------------
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            # row-sparse (lazy) update: touch only the rows the grad carries
+            # (ref optimizer_op.cc sgd_update row_sparse kernels / the
+            # sparse-embedding training path). Rules with dense state
+            # semantics fall back to densifying the grad.
+            if self._sparse_lazy_supported(state):
+                return self._sparse_lazy_update(index, weight, grad, state)
+            grad = grad.tostype("default")
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
@@ -163,9 +172,18 @@ class Optimizer:
         weight._data = new_w.astype(w.dtype)
         return new_state
 
+    def _sparse_lazy_supported(self, state):
+        return False
+
+    def _sparse_lazy_update(self, index, weight, grad, state):
+        raise NotImplementedError
+
     def update_multi_precision(self, index, weight, grad, state):
         """fp32 master-weight update for bf16/fp16 params (ref optimizer.py:320)."""
+        from ..ndarray.sparse import RowSparseNDArray
         if self.multi_precision and weight.dtype in (jnp.bfloat16, onp.float16):
+            if isinstance(grad, RowSparseNDArray):
+                grad = grad.tostype("default")  # master-weight flow is dense
             master, inner = state
             self._update_count(index)
             lr, wd = self._get_lr(index), self._get_wd(index)
@@ -191,9 +209,12 @@ class Test(Optimizer):
 class SGD(Optimizer):
     """SGD w/ momentum (ref src/operator/optimizer_op.cc sgd_mom_update)."""
 
-    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        # lazy_update default True matches the reference (optimizer.py SGD):
+        # row_sparse grads touch only the rows they carry unless disabled
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -208,10 +229,35 @@ class SGD(Optimizer):
         state._data = mom
         return w + mom, state
 
+    def _sparse_lazy_supported(self, state):
+        return self.lazy_update
+
+    def _sparse_lazy_update(self, index, weight, grad, state):
+        """Row-sparse SGD: only rows in grad.indices are touched — weight AND
+        momentum (the reference's lazy_update semantics)."""
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        idx = grad.indices._data
+        g = self._preprocess_grad(grad.data._data).astype(jnp.float32)
+        w = weight._data
+        rows = w[idx].astype(jnp.float32)
+        g = g + wd * rows
+        if state is None:
+            new_rows = rows - lr * g
+        else:
+            mom_rows = self.momentum * state._data[idx] - lr * g
+            state._data = state._data.at[idx].set(mom_rows)
+            new_rows = rows + mom_rows
+        weight._data = w.at[idx].set(new_rows.astype(w.dtype))
+        return state
+
 
 @register
 class NAG(SGD):
     """Nesterov (ref optimizer.py NAG / nag_mom_update)."""
+
+    def _sparse_lazy_supported(self, state):
+        return False  # Nesterov lookahead has no lazy-row formulation here
 
     def update_rule(self, w, g, state, lr, wd, t):
         g = g + wd * w

@@ -1,0 +1,146 @@
+"""row_sparse / csr storage types (ref tests/python/unittest/test_sparse_ndarray.py
+subset + the SURVEY §7f compatibility decision in ndarray/sparse.py)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import sparse
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((6, 3), "float32")
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    rs = nd.array(dense).tostype("row_sparse")
+    assert rs.stype == "row_sparse"
+    assert list(onp.asarray(rs.indices.asnumpy())) == [1, 4]
+    assert rs.shape == (6, 3)
+    back = rs.tostype("default")
+    assert back.stype == "default"
+    assert_almost_equal(back.asnumpy(), dense)
+    assert_almost_equal(rs.asnumpy(), dense)
+
+
+def test_row_sparse_constructors_and_ops():
+    rs = sparse.row_sparse_array(([[1.0, 1.0], [2.0, 2.0]], [0, 3]),
+                                 shape=(5, 2))
+    assert_almost_equal((rs * 2.0).asnumpy()[3], [4.0, 4.0])
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.asnumpy().sum() == 0
+    # retain
+    kept = rs.retain([3])
+    assert list(onp.asarray(kept.indices.asnumpy())) == [3]
+    assert kept.asnumpy()[0].sum() == 0
+    # add merges rows
+    s2 = sparse.row_sparse_array(([[1.0, 0.0]], [3]), shape=(5, 2))
+    tot = rs + s2
+    assert_almost_equal(tot.asnumpy()[3], [3.0, 2.0])
+
+
+def test_csr_roundtrip_and_dot():
+    dense = onp.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], "float32")
+    csr = nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.asnumpy(), dense)
+    assert_almost_equal(csr[1].asnumpy(), dense[1])
+    rhs = nd.array(onp.arange(6).reshape(3, 2).astype("float32"))
+    out = sparse.dot(csr, rhs)
+    assert_almost_equal(out.asnumpy(), dense @ rhs.asnumpy())
+    cons = sparse.csr_matrix((csr.data.asnumpy(), csr.indices.asnumpy(),
+                              csr.indptr.asnumpy()), shape=(3, 3))
+    assert_almost_equal(cons.asnumpy(), dense)
+
+
+def test_sgd_row_sparse_lazy_update():
+    # dense vs sparse grads must agree on touched rows; untouched rows
+    # must NOT move under the lazy update even with weight decay
+    for momentum in (0.0, 0.9):
+        opt_d = mx.optimizer.SGD(learning_rate=0.5, momentum=momentum, wd=0.1)
+        opt_s = mx.optimizer.SGD(learning_rate=0.5, momentum=momentum, wd=0.1)
+        w0 = onp.arange(10, dtype="float32").reshape(5, 2) + 1.0
+        wd_, ws_ = nd.array(w0), nd.array(w0)
+        sd = opt_d.create_state(0, wd_)
+        ss = opt_s.create_state(0, ws_)
+        gdense = onp.zeros((5, 2), "float32")
+        gdense[1] = 0.5
+        gdense[3] = -1.0
+        for _ in range(2):
+            sd = opt_d.update(0, wd_, nd.array(gdense), sd)
+            ss = opt_s.update(0, ws_, nd.array(gdense).tostype("row_sparse"), ss)
+        # touched rows agree with the dense rule
+        assert_almost_equal(ws_.asnumpy()[[1, 3]], wd_.asnumpy()[[1, 3]],
+                            rtol=1e-5, atol=1e-6)
+        # untouched rows: sparse leaves them alone; dense applied wd
+        assert_almost_equal(ws_.asnumpy()[[0, 2, 4]], w0[[0, 2, 4]])
+        assert not onp.allclose(wd_.asnumpy()[[0, 2, 4]], w0[[0, 2, 4]])
+
+
+def test_csr_dot_transpose():
+    dense = onp.array([[0, 1, 0], [2, 0, 3]], "float32")
+    csr = nd.array(dense).tostype("csr")
+    rhs = nd.array(onp.arange(4).reshape(2, 2).astype("float32"))
+    out = sparse.dot(csr, rhs, transpose_a=True)
+    assert_almost_equal(out.asnumpy(), dense.T @ rhs.asnumpy())
+
+
+def test_sgd_lazy_update_false_is_dense():
+    opt = mx.optimizer.SGD(learning_rate=0.5, wd=0.1, lazy_update=False)
+    w0 = onp.ones((4, 2), "float32")
+    w = nd.array(w0)
+    st = opt.create_state(0, w)
+    g = sparse.row_sparse_array(([[1.0, 1.0]], [2]), shape=(4, 2))
+    opt.update(0, w, g, st)
+    # standard (non-lazy) update decays ALL rows, not just the touched one
+    assert not onp.allclose(w.asnumpy()[0], w0[0])
+
+
+def test_kvstore_sparse_push_densifies():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4, 2)))
+    g = sparse.row_sparse_array(([[1.0, 2.0]], [1]), shape=(4, 2))
+    kv.push("w", [g, g])  # multi-device sparse push, no updater
+    out = nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy()[1], [2.0, 4.0])
+
+
+def test_kvstore_row_sparse_pull_multi_out():
+    kv = mx.kv.create("local")
+    w = onp.arange(8, dtype="float32").reshape(4, 2)
+    kv.init("emb", nd.array(w))
+    o1 = sparse.zeros("row_sparse", (4, 2))
+    o2 = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull("emb", out=[o1, o2],
+                       row_ids=[nd.array([0], dtype="int32"),
+                                nd.array([3], dtype="int32")])
+    assert_almost_equal(o1.data.asnumpy(), w[[0]])
+    assert_almost_equal(o2.data.asnumpy(), w[[3]])
+
+
+def test_adam_row_sparse_densifies():
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    w = nd.array(onp.ones((4, 2), "float32"))
+    st = opt.create_state(0, w)
+    g = sparse.row_sparse_array(([[1.0, 1.0]], [2]), shape=(4, 2))
+    st = opt.update(0, w, g, st)  # falls back to dense — no crash
+    assert bool(onp.isfinite(w.asnumpy()).all())
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = onp.arange(12, dtype="float32").reshape(6, 2)
+    kv.init("emb", nd.array(w))
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 4], dtype="int32"))
+    assert list(onp.asarray(out.indices.asnumpy())) == [1, 4]
+    assert_almost_equal(out.data.asnumpy(), w[[1, 4]])
+
+
+def test_embedding_backward_helper():
+    tokens = nd.array(onp.array([[1, 2, 1]]), dtype="int32")
+    og = nd.array(onp.ones((1, 3, 4), "float32"))
+    rs = sparse.embedding_backward(tokens, og, vocab_size=10)
+    assert rs.shape == (10, 4)
+    assert list(onp.asarray(rs.indices.asnumpy())) == [1, 2]
+    assert_almost_equal(rs.data.asnumpy()[0], 2 * onp.ones(4))  # token 1 twice
