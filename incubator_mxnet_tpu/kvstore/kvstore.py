@@ -84,7 +84,17 @@ class KVStore(KVStoreBase):
         With a RowSparseNDArray ``out``, fills (indices, values) for
         ``row_ids``; with a dense out or no row_ids, falls back to full pull."""
         from ..ndarray.sparse import RowSparseNDArray
+
+        def _has_sparse(o):
+            if isinstance(o, (list, tuple)):
+                return any(_has_sparse(x) for x in o)
+            return isinstance(o, RowSparseNDArray)
+
         if row_ids is None:
+            if _has_sparse(out):
+                raise ValueError(
+                    "row_sparse_pull into a RowSparseNDArray requires "
+                    "row_ids (ref kvstore.h PullRowSparse)")
             return self.pull(key, out, priority)
         keys, outs = self._normalize(key, out)
         for ki, (k, o) in enumerate(zip(keys, outs)):
@@ -172,6 +182,9 @@ class KVStore(KVStoreBase):
             v = [compress(x, (key, i)) for i, x in enumerate(v)]
             if len(v) == 1:
                 return v[0]
+            # sparse values first: sparse+sparse merges O(nnz); sparse+dense
+            # densifies; dense+sparse would raise (NDArray.__add__ rejects it)
+            v = sorted(v, key=lambda x: not isinstance(x, BaseSparseNDArray))
             acc = v[0]
             for x in v[1:]:
                 acc = acc + x

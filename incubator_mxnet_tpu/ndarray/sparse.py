@@ -229,12 +229,12 @@ def dot(lhs, rhs, transpose_a=False):
         nnz = data.shape[0]
         rows = jnp.searchsorted(lhs.indptr._data[1:], jnp.arange(nnz),
                                 side="right")
-        contrib = data[:, None] * r[cols]                    # (nnz, k)
         if transpose_a:
+            # csr.T @ rhs: rhs indexed by ROW of the csr entry
+            contrib = data[:, None] * r[rows]                # (nnz, k)
             out = jnp.zeros((lhs.shape[1],) + r.shape[1:], contrib.dtype)
-            # csr.T @ rhs needs rhs indexed by ROW of the csr entry
-            contrib = data[:, None] * r[rows]
             return NDArray(out.at[cols].add(contrib))
+        contrib = data[:, None] * r[cols]                    # (nnz, k)
         out = jnp.zeros((lhs.shape[0],) + r.shape[1:], contrib.dtype)
         return NDArray(out.at[rows].add(contrib))
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):

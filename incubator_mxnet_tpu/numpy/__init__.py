@@ -74,6 +74,38 @@ class ndarray(NDArray):
     def T(self):
         return _apply_np(jnp.transpose, self)
 
+    # numpy semantics: comparisons yield BOOL masks (mx.nd yields float 0/1),
+    # so `a[a > 0]` boolean-indexes correctly
+    def _cmp(self, other, fn):
+        o = other._data if isinstance(other, NDArray) else other
+        return _apply_np(lambda x: fn(x, o), self)
+
+    def __gt__(self, other):
+        return self._cmp(other, jnp.greater)
+
+    def __ge__(self, other):
+        return self._cmp(other, jnp.greater_equal)
+
+    def __lt__(self, other):
+        return self._cmp(other, jnp.less)
+
+    def __le__(self, other):
+        return self._cmp(other, jnp.less_equal)
+
+    def __eq__(self, other):
+        if not isinstance(other, (NDArray, int, float, bool, complex,
+                                  onp.ndarray, onp.generic, list, tuple)):
+            return False  # numpy parity: `x == None` is falsy, not an error
+        return self._cmp(other, jnp.equal)
+
+    def __ne__(self, other):
+        if not isinstance(other, (NDArray, int, float, bool, complex,
+                                  onp.ndarray, onp.generic, list, tuple)):
+            return True
+        return self._cmp(other, jnp.not_equal)
+
+    __hash__ = NDArray.__hash__
+
 
 def _apply_np(fn, *inputs):
     """_apply but producing mx.np.ndarray outputs (keeps autograd taping).
@@ -431,6 +463,56 @@ class _NPRandom:
     def shuffle(self, x):
         x._data = jax.random.permutation(self._key(), x._data, axis=0)
 
+    # -- distribution parity (ref numpy/random.py; np_random ops) -------
+    @staticmethod
+    def _shp(size):
+        return size if isinstance(size, tuple) else (() if size is None else (size,))
+
+    def beta(self, a, b, size=None):
+        return ndarray(jax.random.beta(self._key(), a, b, self._shp(size)))
+
+    def gamma(self, shape, scale=1.0, size=None):
+        return ndarray(scale * jax.random.gamma(self._key(), shape, self._shp(size)))
+
+    def exponential(self, scale=1.0, size=None):
+        return ndarray(scale * jax.random.exponential(self._key(), self._shp(size)))
+
+    def laplace(self, loc=0.0, scale=1.0, size=None):
+        return ndarray(loc + scale * jax.random.laplace(self._key(), self._shp(size)))
+
+    def logistic(self, loc=0.0, scale=1.0, size=None):
+        return ndarray(loc + scale * jax.random.logistic(self._key(), self._shp(size)))
+
+    def gumbel(self, loc=0.0, scale=1.0, size=None):
+        return ndarray(loc + scale * jax.random.gumbel(self._key(), self._shp(size)))
+
+    def pareto(self, a, size=None):
+        return ndarray(jax.random.pareto(self._key(), a, self._shp(size)) - 1.0)
+
+    def weibull(self, a, size=None):
+        u = jax.random.uniform(self._key(), self._shp(size))
+        return ndarray((-jnp.log1p(-u)) ** (1.0 / a))
+
+    def chisquare(self, df, size=None):
+        return ndarray(jax.random.chisquare(self._key(), df, self._shp(size)))
+
+    def poisson(self, lam=1.0, size=None):
+        return ndarray(jax.random.poisson(self._key(), lam, self._shp(size)))
+
+    def multinomial(self, n, pvals, size=None):
+        draws = jax.random.categorical(
+            self._key(), jnp.log(jnp.asarray(pvals)), shape=self._shp(size) + (n,))
+        return ndarray(jax.nn.one_hot(draws, len(pvals), dtype="int32").sum(-2))
+
+    def dirichlet(self, alpha, size=None):
+        return ndarray(jax.random.dirichlet(self._key(), jnp.asarray(alpha),
+                                            self._shp(size)))
+
+    def permutation(self, x):
+        if isinstance(x, int):
+            return ndarray(jax.random.permutation(self._key(), x))
+        return ndarray(jax.random.permutation(self._key(), _to(x)._data, axis=0))
+
 
 random = _NPRandom()
 
@@ -493,3 +575,360 @@ int32 = onp.int32
 int64 = onp.int64
 uint8 = onp.uint8
 bool_ = onp.bool_
+
+
+# ------------------------------------------------------------ batch 2:
+# boolean masking, insert/delete, stats, bit ops, index helpers
+# (ref src/operator/numpy/np_insert_op*, np_delete_op*, np_percentile_op,
+#  np_cross, np_diff, np_ediff1d, np_interp, np_bincount, np_pad ...)
+def insert(arr, obj, values, axis=None):
+    return ndarray(jnp.insert(_to(arr)._data, obj,
+                              _to(values)._data if isinstance(values, (NDArray, list, onp.ndarray)) else values,
+                              axis=axis))
+
+
+def delete(arr, obj, axis=None):
+    o = _to(obj)._data if isinstance(obj, (NDArray, list, onp.ndarray)) else obj
+    return ndarray(jnp.delete(_to(arr)._data, onp.asarray(o), axis=axis))
+
+
+def append(arr, values, axis=None):
+    return _apply_np(lambda a, v: jnp.append(a, v, axis=axis), _to(arr), _to(values))
+
+
+def ravel(a, order="C"):
+    return _apply_np(lambda x: x.reshape(-1), _to(a))
+
+
+def atleast_1d(*arys):
+    out = [_apply_np(jnp.atleast_1d, _to(a)) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*arys):
+    out = [_apply_np(jnp.atleast_2d, _to(a)) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*arys):
+    out = [_apply_np(jnp.atleast_3d, _to(a)) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def broadcast_to(array_, shape):
+    return _apply_np(lambda x: jnp.broadcast_to(x, shape), _to(array_))
+
+
+def broadcast_arrays(*args):
+    outs = jnp.broadcast_arrays(*[_to(a)._data for a in args])
+    return [ndarray(o) for o in outs]
+
+
+def searchsorted(a, v, side="left", sorter=None):
+    return _apply_np(lambda x, q: jnp.searchsorted(x, q, side=side),
+                     _to(a), _to(v))
+
+
+def digitize(x, bins, right=False):
+    return _apply_np(lambda a, b: jnp.digitize(a, b, right=right),
+                     _to(x), _to(bins))
+
+
+def bincount(x, weights=None, minlength=0):
+    import builtins
+    xd = _to(x)._data
+    # NB: plain `max` here would resolve to this module's reduction op
+    length = builtins.max(int(minlength), int(xd.max()) + 1 if xd.size else 0)
+    w = None if weights is None else _to(weights)._data
+    return ndarray(jnp.bincount(xd, w, length=length))
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    h, edges = jnp.histogram(_to(a)._data, bins=bins, range=range,
+                             weights=None if weights is None else _to(weights)._data,
+                             density=density)
+    return ndarray(h), ndarray(edges)
+
+
+def cumsum(a, axis=None, dtype=None, out=None):
+    return _apply_np(lambda x: jnp.cumsum(x, axis=axis, dtype=_np_dtype(dtype) if dtype else None), _to(a))
+
+
+def cumprod(a, axis=None, dtype=None, out=None):
+    return _apply_np(lambda x: jnp.cumprod(x, axis=axis), _to(a))
+
+
+def diff(a, n=1, axis=-1, prepend=None, append=None):
+    return _apply_np(lambda x: jnp.diff(x, n=n, axis=axis), _to(a))
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    return _apply_np(lambda x: jnp.ediff1d(x, to_end, to_begin), _to(ary))
+
+
+def gradient(f, *varargs, axis=None, edge_order=1):
+    out = jnp.gradient(_to(f)._data, *varargs, axis=axis)
+    if isinstance(out, (list, tuple)):
+        return [ndarray(o) for o in out]
+    return ndarray(out)
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    return ndarray(jnp.trapezoid(_to(y)._data,
+                                 None if x is None else _to(x)._data,
+                                 dx=dx, axis=axis))
+
+
+def interp(x, xp, fp, left=None, right=None, period=None):
+    return _apply_np(lambda a, b, c: jnp.interp(a, b, c, left, right, period),
+                     _to(x), _to(xp), _to(fp))
+
+
+def percentile(a, q, axis=None, interpolation=None, keepdims=False, **kw):
+    method = interpolation or kw.get("method", "linear")
+    return _apply_np(lambda x: jnp.percentile(x, jnp.asarray(q), axis=axis,
+                                              method=method, keepdims=keepdims), _to(a))
+
+
+def quantile(a, q, axis=None, interpolation=None, keepdims=False, **kw):
+    method = interpolation or kw.get("method", "linear")
+    return _apply_np(lambda x: jnp.quantile(x, jnp.asarray(q), axis=axis,
+                                            method=method, keepdims=keepdims), _to(a))
+
+
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        out = jnp.mean(_to(a)._data, axis=axis)
+        scl = jnp.asarray(onp.prod([_to(a)._data.shape[ax] for ax in
+                                    (range(_to(a)._data.ndim) if axis is None
+                                     else [axis])]), "float32")
+    else:
+        out, scl = jnp.average(_to(a)._data, axis=axis,
+                               weights=_to(weights)._data, returned=True)
+    return (ndarray(out), ndarray(scl)) if returned else ndarray(out)
+
+
+def cov(m, y=None, rowvar=True, bias=False, ddof=None, fweights=None, aweights=None):
+    return ndarray(jnp.cov(_to(m)._data, None if y is None else _to(y)._data,
+                           rowvar=rowvar, bias=bias, ddof=ddof))
+
+
+def corrcoef(x, y=None, rowvar=True):
+    return ndarray(jnp.corrcoef(_to(x)._data,
+                                None if y is None else _to(y)._data, rowvar))
+
+
+def nanmean(a, axis=None, keepdims=False, **kw):
+    return _apply_np(lambda x: jnp.nanmean(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nanstd(a, axis=None, keepdims=False, **kw):
+    return _apply_np(lambda x: jnp.nanstd(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nanvar(a, axis=None, keepdims=False, **kw):
+    return _apply_np(lambda x: jnp.nanvar(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return _apply_np(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                              neginf=neginf), _to(x))
+
+
+def around(a, decimals=0, out=None):
+    return _apply_np(lambda x: jnp.round(x, decimals), _to(a))
+
+
+round = around
+round_ = around
+
+
+def fix(x, out=None):
+    return _apply_np(jnp.fix, _to(x))
+
+
+def signbit(x, out=None):
+    return _apply_np(jnp.signbit, _to(x))
+
+
+def heaviside(x1, x2, out=None):
+    return _apply_np(jnp.heaviside, _to(x1), _to(x2))
+
+
+def exp2(x, out=None):
+    return _apply_np(jnp.exp2, _to(x))
+
+
+def deg2rad(x, out=None):
+    return _apply_np(jnp.deg2rad, _to(x))
+
+
+def rad2deg(x, out=None):
+    return _apply_np(jnp.rad2deg, _to(x))
+
+
+def logical_not(x, out=None):
+    return _apply_np(jnp.logical_not, _to(x))
+
+
+def invert(x, out=None):
+    return _apply_np(jnp.invert, _to(x))
+
+
+bitwise_not = invert
+
+
+def bitwise_and(x1, x2, out=None):
+    return _apply_np(jnp.bitwise_and, _to(x1), _to(x2))
+
+
+def bitwise_or(x1, x2, out=None):
+    return _apply_np(jnp.bitwise_or, _to(x1), _to(x2))
+
+
+def bitwise_xor(x1, x2, out=None):
+    return _apply_np(jnp.bitwise_xor, _to(x1), _to(x2))
+
+
+def left_shift(x1, x2, out=None):
+    return _apply_np(jnp.left_shift, _to(x1), _to(x2))
+
+
+def right_shift(x1, x2, out=None):
+    return _apply_np(jnp.right_shift, _to(x1), _to(x2))
+
+
+def floor_divide(x1, x2, out=None):
+    return _apply_np(jnp.floor_divide, _to(x1), _to(x2))
+
+
+def flatnonzero(a):
+    return ndarray(jnp.flatnonzero(_to(a)._data))
+
+
+def argwhere(a):
+    return ndarray(jnp.argwhere(_to(a)._data))
+
+
+def extract(condition, arr):
+    return ndarray(jnp.extract(_to(condition)._data, _to(arr)._data))
+
+
+def compress(condition, a, axis=None):
+    return ndarray(jnp.compress(_to(condition)._data, _to(a)._data, axis=axis))
+
+
+def resize(a, new_shape):
+    return ndarray(jnp.resize(_to(a)._data, new_shape))
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    return _apply_np(lambda x: jnp.rot90(x, k, axes), _to(m))
+
+
+def fliplr(m):
+    return _apply_np(jnp.fliplr, _to(m))
+
+
+def flipud(m):
+    return _apply_np(jnp.flipud, _to(m))
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    outs = jnp.array_split(_to(ary)._data, indices_or_sections, axis=axis)
+    return [ndarray(o) for o in outs]
+
+
+def vsplit(ary, indices_or_sections):
+    return [ndarray(o) for o in jnp.vsplit(_to(ary)._data, indices_or_sections)]
+
+
+def hsplit(ary, indices_or_sections):
+    return [ndarray(o) for o in jnp.hsplit(_to(ary)._data, indices_or_sections)]
+
+
+def dsplit(ary, indices_or_sections):
+    return [ndarray(o) for o in jnp.dsplit(_to(ary)._data, indices_or_sections)]
+
+
+def column_stack(tup):
+    return _apply_np(lambda *xs: jnp.column_stack(xs), *[_to(a) for a in tup])
+
+
+row_stack = vstack
+
+
+def tri(N, M=None, k=0, dtype="float32"):
+    return ndarray(jnp.tri(N, M, k, _np_dtype(dtype)))
+
+
+def vander(x, N=None, increasing=False):
+    return _apply_np(lambda a: jnp.vander(a, N, increasing), _to(x))
+
+
+def unravel_index(indices, shape, order="C"):
+    outs = jnp.unravel_index(_to(indices)._data, shape)
+    return tuple(ndarray(o) for o in outs)
+
+
+def ravel_multi_index(multi_index, dims, mode="raise", order="C"):
+    mi = tuple(_to(m)._data for m in multi_index)
+    return ndarray(jnp.ravel_multi_index(mi, dims, mode="wrap" if mode == "wrap" else "clip"))
+
+
+def indices(dimensions, dtype="int32", sparse=False):
+    out = jnp.indices(dimensions, _np_dtype(dtype), sparse)
+    if sparse:
+        return tuple(ndarray(o) for o in out)
+    return ndarray(out)
+
+
+def diag_indices(n, ndim=2):
+    return tuple(ndarray(o) for o in jnp.diag_indices(n, ndim))
+
+
+def tril_indices(n, k=0, m=None):
+    return tuple(ndarray(o) for o in jnp.tril_indices(n, k, m))
+
+
+def triu_indices(n, k=0, m=None):
+    return tuple(ndarray(o) for o in jnp.triu_indices(n, k, m))
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return bool(jnp.allclose(_to(a)._data, _to(b)._data, rtol, atol, equal_nan))
+
+
+def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return _apply_np(lambda x, y: jnp.isclose(x, y, rtol, atol, equal_nan),
+                     _to(a), _to(b))
+
+
+def array_equal(a1, a2, equal_nan=False):
+    return bool(jnp.array_equal(_to(a1)._data, _to(a2)._data, equal_nan))
+
+
+def ptp(a, axis=None, keepdims=False):
+    return _apply_np(lambda x: jnp.ptp(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def may_share_memory(a, b, max_work=None):
+    return False  # jax arrays are immutable; views never alias writably
+
+
+__all__ += [
+    "insert", "delete", "append", "ravel", "atleast_1d", "atleast_2d",
+    "atleast_3d", "broadcast_to", "broadcast_arrays", "searchsorted",
+    "digitize", "bincount", "histogram", "cumsum", "cumprod", "diff",
+    "ediff1d", "gradient", "trapz", "interp", "percentile", "quantile",
+    "average", "cov", "corrcoef", "nanmean", "nanstd", "nanvar",
+    "nan_to_num", "around", "round", "round_", "fix", "signbit",
+    "heaviside", "exp2", "deg2rad", "rad2deg", "logical_not", "invert",
+    "bitwise_not", "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
+    "right_shift", "floor_divide", "flatnonzero", "argwhere", "extract",
+    "compress", "resize", "rot90", "fliplr", "flipud", "array_split",
+    "vsplit", "hsplit", "dsplit", "column_stack", "row_stack", "tri",
+    "vander", "unravel_index", "ravel_multi_index", "indices",
+    "diag_indices", "tril_indices", "triu_indices", "allclose", "isclose",
+    "array_equal", "ptp", "may_share_memory",
+]

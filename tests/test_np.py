@@ -92,3 +92,117 @@ def test_np_array_mode_scopes():
     assert util.is_np_array()
     util.reset_np()
     assert not util.is_np_array()
+
+
+# ------------------------------------------------------------ batch 2
+def test_np_insert_delete_append():
+    a = np.array([1.0, 2.0, 3.0])
+    assert np.insert(a, 1, 9.0).tolist() == [1.0, 9.0, 2.0, 3.0]
+    assert np.delete(a, 1).tolist() == [1.0, 3.0]
+    assert np.append(a, np.array([4.0])).tolist() == [1.0, 2.0, 3.0, 4.0]
+    m = np.arange(6).reshape(2, 3)
+    assert np.delete(m, 0, axis=1).shape == (2, 2)
+    assert np.insert(m, 1, np.zeros((2,)), axis=1).shape == (2, 4)
+
+
+def test_np_boolean_masking():
+    a = np.array([1.0, -2.0, 3.0, -4.0])
+    mask = a > 0
+    picked = a[mask]
+    assert picked.tolist() == [1.0, 3.0]
+    assert np.extract(mask, a).tolist() == [1.0, 3.0]
+    assert np.compress(np.array([True, False, True, False]), a).tolist() == [1.0, 3.0]
+    b = a.copy()
+    b[mask] = 0.0
+    assert b.tolist() == [0.0, -2.0, 0.0, -4.0]
+
+
+def test_np_stats_batch2():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert abs(float(np.percentile(a, 50)) - 2.5) < 1e-6
+    assert abs(float(np.quantile(a, 0.5)) - 2.5) < 1e-6
+    assert abs(float(np.average(a)) - 2.5) < 1e-6
+    w = np.array([1.0, 3.0])
+    assert abs(float(np.average(np.array([1.0, 2.0]), weights=w)) - 1.75) < 1e-6
+    c = np.cov(np.array([[1.0, 2.0, 3.0], [2.0, 4.0, 6.0]]))
+    assert c.shape == (2, 2)
+    n = np.array([1.0, float("nan"), 3.0])
+    assert abs(float(np.nanmean(n)) - 2.0) < 1e-6
+    assert abs(float(np.nan_to_num(n).sum()) - 4.0) < 1e-6
+
+
+def test_np_cumulative_and_diff():
+    a = np.array([1.0, 3.0, 6.0])
+    assert np.cumsum(a).tolist() == [1.0, 4.0, 10.0]
+    assert np.cumprod(np.array([1.0, 2.0, 3.0])).tolist() == [1.0, 2.0, 6.0]
+    assert np.diff(a).tolist() == [2.0, 3.0]
+    assert np.ediff1d(a).tolist() == [2.0, 3.0]
+    xi = np.interp(np.array([0.5]), np.array([0.0, 1.0]), np.array([10.0, 20.0]))
+    assert abs(float(xi[0]) - 15.0) < 1e-6
+
+
+def test_np_search_and_bins():
+    a = np.array([1.0, 2.0, 4.0, 8.0])
+    assert int(np.searchsorted(a, np.array(3.0))) == 2
+    assert np.digitize(np.array([2.5]), a).tolist() == [2]
+    bc = np.bincount(np.array([0, 1, 1, 3], dtype="int32"))
+    assert bc.tolist() == [1, 2, 0, 1]
+    h, edges = np.histogram(np.array([1.0, 2.0, 2.5]), bins=2, range=(1.0, 3.0))
+    assert int(h.sum()) == 3 and edges.shape == (3,)
+
+
+def test_np_shape_utils_batch2():
+    a = np.arange(6).reshape(2, 3)
+    assert np.ravel(a).shape == (6,)
+    assert np.broadcast_to(np.array([1.0, 2.0, 3.0]), (2, 3)).shape == (2, 3)
+    assert np.atleast_2d(np.array([1.0])).shape == (1, 1)
+    assert np.rot90(a).shape == (3, 2)
+    assert np.fliplr(a)[0, 0] == 2
+    assert np.flipud(a)[0, 0] == 3
+    parts = np.array_split(np.arange(7), 3)
+    assert [p.shape[0] for p in parts] == [3, 2, 2]
+    assert np.column_stack([np.array([1, 2]), np.array([3, 4])]).shape == (2, 2)
+    assert np.tri(3).shape == (3, 3)
+    assert np.vander(np.array([1.0, 2.0]), 3).shape == (2, 3)
+
+
+def test_np_index_helpers():
+    r, c = np.unravel_index(np.array([5], dtype="int32"), (2, 3))
+    assert (int(r[0]), int(c[0])) == (1, 2)
+    flat = np.ravel_multi_index((np.array([1], dtype="int32"),
+                                 np.array([2], dtype="int32")), (2, 3))
+    assert int(flat[0]) == 5
+    ii, jj = np.diag_indices(3)
+    assert ii.tolist() == [0, 1, 2]
+    assert np.argwhere(np.array([0.0, 1.0, 2.0])).shape == (2, 1)
+    assert np.flatnonzero(np.array([0.0, 3.0])).tolist() == [1]
+
+
+def test_np_bit_and_compare():
+    a = np.array([1, 2], dtype="int32")
+    assert np.bitwise_and(a, np.array([3, 3], dtype="int32")).tolist() == [1, 2]
+    assert np.left_shift(a, np.array([1, 1], dtype="int32")).tolist() == [2, 4]
+    assert np.allclose(np.array([1.0]), np.array([1.0 + 1e-9]))
+    assert np.array_equal(np.array([1.0]), np.array([1.0]))
+    assert bool(np.isclose(np.array([1.0]), np.array([1.0 + 1e-9])).asnumpy().all())
+    assert (np.array([1.0]) == None) is False  # noqa: E711 — numpy parity
+    assert (np.array([1.0]) != None) is True  # noqa: E711
+    assert float(np.ptp(np.array([1.0, 5.0]))) == 4.0
+    assert np.around(np.array([1.49]), 1).tolist() == [1.5]
+
+
+def test_np_random_distributions():
+    np.random.seed(0)
+    for name, args in [("beta", (2.0, 3.0)), ("gamma", (2.0,)),
+                       ("exponential", ()), ("laplace", ()), ("logistic", ()),
+                       ("gumbel", ()), ("pareto", (3.0,)), ("weibull", (2.0,)),
+                       ("chisquare", (3.0,)), ("poisson", ())]:
+        out = getattr(np.random, name)(*args, size=(100,))
+        arr = out.asnumpy()
+        assert arr.shape == (100,) and onp.isfinite(arr).all(), name
+    m = np.random.multinomial(10, [0.3, 0.7], size=(4,))
+    assert m.shape == (4, 2) and int(m.asnumpy().sum()) == 40
+    d = np.random.dirichlet([1.0, 1.0, 1.0], size=(5,))
+    assert onp.allclose(d.asnumpy().sum(-1), 1.0, atol=1e-5)
+    p = np.random.permutation(5)
+    assert sorted(p.tolist()) == [0, 1, 2, 3, 4]

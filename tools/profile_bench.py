@@ -1,0 +1,72 @@
+"""Capture a device profile of the bench train step and print the op-time
+breakdown (parses the chrome-trace json the jax profiler emits)."""
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as onp
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, jit
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    x = nd.random.normal(shape=(batch, 3, 224, 224)).astype("bfloat16")
+    y = nd.array(onp.random.randint(0, 1000, batch).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "multi_precision": True})
+    step = jit.TrainStep(net, loss_fn, trainer)
+    for _ in range(3):
+        float(step(x, y).mean().asscalar())
+
+    logdir = tempfile.mkdtemp(prefix="jaxprof_")
+    with jax.profiler.trace(logdir):
+        for _ in range(5):
+            loss = step(x, y)
+        float(loss.mean().asscalar())
+
+    traces = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                       recursive=True)
+    if not traces:
+        print("no trace found under", logdir)
+        return
+    with gzip.open(traces[0], "rt") as f:
+        trace = json.load(f)
+
+    # device-track complete events: aggregate wall time by op name
+    pid_names = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev["args"].get("name", "")
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "Device" in n or "/device" in n.lower()}
+    agg = defaultdict(float)
+    total = 0.0
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "X" and ev.get("pid") in dev_pids:
+            name = ev.get("name", "?")
+            if name.startswith("jit_") or name.isdigit():
+                continue  # umbrella/program events double-count leaf ops
+            agg[name] += ev.get("dur", 0.0)
+            total += ev.get("dur", 0.0)
+    print("pids:", {p: n for p, n in pid_names.items()})
+    print("total leaf-op device us per 5 steps: %.0f" % total)
+    for name, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:40]:
+        print("%10.0f us  %5.1f%%  %s" % (dur, 100 * dur / max(total, 1), name))
+
+
+if __name__ == "__main__":
+    main()
