@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""ResNet-50 on an ImageNet .rec — the reference's train_imagenet.py on TPU
+(ref example/image-classification/train_imagenet.py).
+
+Gluon path: model_zoo ResNet-50 + the fused bf16 TrainStep (forward +
+backward + SGD update as one XLA program); input pipeline is the native C++
+JPEG decode/augment pipeline (ImageRecordIter tier 1). --dp shards the batch
+over a data-parallel mesh (in-program gradient all-reduce on ICI).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, jit, parallel
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rec", required=True, help="path to train .rec")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel devices (1 = single chip)")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet50_v1(classes=args.classes)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.rec, data_shape=(3, 224, 224),
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, resize=256,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.12, std_b=57.38)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "multi_precision": True})
+    if args.dp > 1:
+        mesh = parallel.make_mesh({"dp": args.dp})
+        step = parallel.DataParallelTrainStep(net, loss_fn, trainer, mesh=mesh)
+    else:
+        step = jit.TrainStep(net, loss_fn, trainer)
+
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        train.reset()
+        tic, n = time.time(), 0
+        for i, batch in enumerate(train):
+            x = batch.data[0].astype("bfloat16")
+            y = batch.label[0]
+            loss = step(x, y)
+            n += args.batch_size
+            if i % 50 == 0:
+                nd.waitall()
+                print("epoch %d batch %d loss %.4f  %.0f img/s"
+                      % (epoch, i, float(loss.mean().asscalar()),
+                         n / (time.time() - tic)))
+        if args.model_prefix:
+            net.save_parameters("%s-%04d.params" % (args.model_prefix, epoch))
+
+
+if __name__ == "__main__":
+    main()

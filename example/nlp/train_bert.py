@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""BERT masked-LM pretraining step — long-context ready
+(ref the reference's example/bert workflows; SURVEY §5 long-context).
+
+- attention=flash: Pallas fused-attention kernels (fwd+bwd, O(S) memory)
+- --sp N: ring attention over a sequence-parallel mesh for contexts that
+  don't fit one chip; --tp N shards attention/FFN weights (Megatron-style)
+
+Synthetic token streams by default; point --corpus at token .npy files for
+real data.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, jit, models, parallel
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--units", type=int, default=768)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--corpus", default=None, help=".npy of int32 token ids")
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    attention = "ring" if args.sp > 1 else "flash"
+    net = models.BERTModel(vocab_size=args.vocab, units=args.units,
+                           hidden_size=4 * args.units, num_layers=args.layers,
+                           num_heads=args.heads, max_length=args.seq_len,
+                           dropout=0.0, attention=attention,
+                           tp_axis="tp" if args.tp > 1 else None,
+                           sp_axis="sp" if args.sp > 1 else None)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr,
+                             "multi_precision": True})
+    if args.tp > 1 or args.sp > 1:
+        mesh = parallel.make_mesh({"dp": 1, "tp": args.tp, "sp": args.sp})
+        step = parallel.DataParallelTrainStep(net, loss_fn, trainer, mesh=mesh)
+    else:
+        step = jit.TrainStep(net, loss_fn, trainer)
+
+    if args.corpus:
+        corpus = onp.load(args.corpus).astype("int32").reshape(-1)
+    else:
+        corpus = onp.random.RandomState(0).randint(
+            0, args.vocab, 4 * args.batch_size * args.seq_len).astype("int32")
+
+    per = args.batch_size * args.seq_len
+    tic = time.time()
+    for i in range(args.steps):
+        off = (i * per) % (len(corpus) - per)
+        tokens = nd.array(corpus[off:off + per].reshape(args.batch_size,
+                                                        args.seq_len))
+        loss = step(tokens, tokens)
+        if i % 10 == 0:
+            print("step %d loss %.4f  %.0f tok/s"
+                  % (i, float(loss.mean().asscalar()),
+                     (i + 1) * per / (time.time() - tic)))
+
+
+if __name__ == "__main__":
+    main()

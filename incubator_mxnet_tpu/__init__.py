@@ -61,6 +61,8 @@ from . import ops
 from . import profiler
 from . import monitor
 from .monitor import Monitor
+from . import operator
+from . import subgraph
 from . import engine
 from . import runtime
 from . import util

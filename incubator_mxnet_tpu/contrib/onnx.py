@@ -1,46 +1,346 @@
-"""ONNX interop (ref python/mxnet/contrib/onnx/).
+"""ONNX interop (ref python/mxnet/contrib/onnx/ mx2onnx + onnx2mx).
 
-Export: Symbol graph JSON → ONNX ModelProto when the ``onnx`` package is
-present (it is not baked into this image); otherwise a documented stub that
-emits the intermediate JSON so models remain portable. Import follows the
-same gate.
+REAL .onnx emission/parsing with no dependency on the `onnx` package (absent
+in this image): contrib.onnx_proto implements the protobuf wire format for
+the ONNX IR subset used here. Exported files are standard ModelProto
+(ir_version 8, opset 13) loadable by onnxruntime/netron; import maps ONNX
+nodes back onto mx.sym ops and round-trips numerically (tests/test_onnx.py).
+
+Supported ops (the model-zoo CNN surface): Conv, Gemm (FullyConnected),
+BatchNormalization, Relu/Sigmoid/Tanh/Softplus, MaxPool/AveragePool/
+GlobalAveragePool/GlobalMaxPool, Flatten, Softmax, Dropout, Concat, Add/Sub/
+Mul/Div, MatMul, Exp/Log/Sqrt/Neg/Abs, Reshape, Transpose, Clip.
 """
 from __future__ import annotations
 
-import json
+import numpy as onp
 
-__all__ = ["export_model", "import_model"]
+from . import onnx_proto as P
 
+__all__ = ["export_model", "import_model", "get_model_metadata"]
 
-def _require_onnx():
-    try:
-        import onnx  # noqa
-        return onnx
-    except ImportError:
-        return None
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus"}
+_ELEM = {"add": "Add", "elemwise_add": "Add", "broadcast_add": "Add",
+         "subtract": "Sub", "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+         "multiply": "Mul", "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+         "divide": "Div", "elemwise_div": "Div", "broadcast_div": "Div",
+         "_plus": "Add", "_minus": "Sub", "_mul": "Mul", "_div": "Div"}
+_UNARY = {"exp": "Exp", "log": "Log", "sqrt": "Sqrt", "negative": "Neg",
+          "abs": "Abs", "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "identity": "Identity", "flatten": "Flatten"}
 
 
 def export_model(sym, params, input_shape, input_type="float32",
                  onnx_file_path="model.onnx", verbose=False):
-    """ref contrib/onnx/mx2onnx — graph export (stub without onnx package)."""
-    onnx = _require_onnx()
-    graph_json = sym.tojson() if hasattr(sym, "tojson") else json.dumps(sym)
-    if onnx is None:
-        # portable fallback: structural JSON + params sidecar
-        with open(onnx_file_path + ".graph.json", "w") as f:
-            f.write(graph_json)
-        from .. import ndarray as nd
-        nd.save(onnx_file_path + ".params", params)
-        return onnx_file_path + ".graph.json"
-    raise NotImplementedError(
-        "full ONNX proto emission requires the onnx package at runtime; "
-        "graph JSON export path was written instead")
+    """Symbol + params → .onnx file (ref mx2onnx/export_model.py).
+
+    input_shape: one shape tuple (single data input) or list of tuples
+    matching the non-parameter arguments in order.
+    """
+    from ..ndarray import NDArray
+
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+
+    nodes, initializers, extra_inits = [], [], {}
+    fix_gamma_ones = []  # (ones_init_name, gamma_value_name) for BatchNorm
+    arg_names = sym.list_arguments()
+    data_names = [n for n in arg_names if n not in params]
+    if len(data_names) != len(input_shape):
+        raise ValueError("input_shape entries (%d) must match data inputs %s"
+                         % (len(input_shape), data_names))
+
+    name_of = {}
+    counter = [0]
+
+    def fresh(prefix):
+        counter[0] += 1
+        return "%s_%d" % (prefix, counter[0])
+
+    def emit(s):
+        """Returns the output value name for node s."""
+        base = getattr(s, "_base", None) or s
+        if id(base) in name_of:
+            return name_of[id(base)]
+        if base.is_var:
+            name_of[id(base)] = base.name
+            return base.name
+        ins = [emit(i) for i in base._inputs]
+        op, kw = base._op_name, base._kwargs
+        out = base.name
+        if op == "FullyConnected":
+            a = ins[0]
+            if kw.get("flatten", True):
+                f = fresh("flat")
+                nodes.append(P.node("Flatten", [a], [f], f,
+                                    [P.attr_int("axis", 1)]))
+                a = f
+            attrs = [P.attr_float("alpha", 1.0), P.attr_float("beta", 1.0),
+                     P.attr_int("transB", 1)]
+            gemm_in = [a, ins[1]] + (ins[2:3] if not kw.get("no_bias") else [])
+            nodes.append(P.node("Gemm", gemm_in, [out], out, attrs))
+        elif op == "Convolution":
+            attrs = [P.attr_ints("kernel_shape", kw["kernel"]),
+                     P.attr_ints("strides", kw.get("stride", (1, 1))),
+                     P.attr_ints("pads", tuple(kw.get("pad", (0, 0))) * 2),
+                     P.attr_ints("dilations", kw.get("dilate", (1, 1))),
+                     P.attr_int("group", kw.get("num_group", 1))]
+            cin = ins[:2] + (ins[2:3] if not kw.get("no_bias") else [])
+            nodes.append(P.node("Conv", cin, [out], out, attrs))
+        elif op == "BatchNorm":
+            attrs = [P.attr_float("epsilon", kw.get("eps", 1e-5)),
+                     P.attr_float("momentum", kw.get("momentum", 0.9))]
+            # mx order: data,gamma,beta,mean,var == onnx: X,scale,B,mean,var.
+            # fix_gamma=True (mx default) means gamma is IGNORED in compute —
+            # ONNX has no such flag, so emit a ones scale to match the math
+            if kw.get("fix_gamma", True):
+                ones_name = fresh("bn_scale_ones")
+                extra_inits[ones_name] = None  # filled after shapes known
+                fix_gamma_ones.append((ones_name, ins[1]))
+                ins = [ins[0], ones_name] + ins[2:]
+            nodes.append(P.node("BatchNormalization", ins[:5], [out], out,
+                                attrs))
+        elif op == "Activation":
+            nodes.append(P.node(_ACT[kw.get("act_type", "relu")], ins, [out],
+                                out))
+        elif op == "Pooling":
+            ptype = kw.get("pool_type", "max")
+            if kw.get("global_pool"):
+                o = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+                nodes.append(P.node(o, ins, [out], out))
+            else:
+                o = "MaxPool" if ptype == "max" else "AveragePool"
+                k = tuple(kw["kernel"])
+                attrs = [P.attr_ints("kernel_shape", k),
+                         P.attr_ints("strides", kw.get("stride") or (1,) * len(k)),
+                         P.attr_ints("pads",
+                                     tuple(kw.get("pad") or (0,) * len(k)) * 2)]
+                if o == "AveragePool":
+                    attrs.append(P.attr_int("count_include_pad", 1))
+                nodes.append(P.node(o, ins, [out], out, attrs))
+        elif op in ("softmax", "SoftmaxOutput", "log_softmax"):
+            nodes.append(P.node("Softmax", ins[:1], [out], out,
+                                [P.attr_int("axis", kw.get("axis", -1))]))
+            if op == "log_softmax":
+                lg = fresh("log")
+                nodes.append(P.node("Log", [out], [lg], lg))
+                name_of[id(base)] = lg
+                return lg
+        elif op == "concat":
+            nodes.append(P.node("Concat", ins, [out], out,
+                                [P.attr_int("axis", kw.get("dim",
+                                                           kw.get("axis", 1)))]))
+        elif op == "Dropout":
+            nodes.append(P.node("Dropout", ins[:1], [out], out))
+        elif op in ("dot", "batch_dot"):
+            nodes.append(P.node("MatMul", ins, [out], out))
+        elif op == "reshape":
+            shp = onp.asarray(kw.get("shape"), "int64")
+            sname = fresh("shape")
+            extra_inits[sname] = shp
+            nodes.append(P.node("Reshape", [ins[0], sname], [out], out))
+        elif op == "transpose":
+            axes = kw.get("axes")
+            attrs = [P.attr_ints("perm", axes)] if axes else []
+            nodes.append(P.node("Transpose", ins, [out], out, attrs))
+        elif op == "clip":
+            lo = onp.asarray(kw.get("a_min"), "float32")
+            hi = onp.asarray(kw.get("a_max"), "float32")
+            ln, hn = fresh("clip_min"), fresh("clip_max")
+            extra_inits[ln] = lo
+            extra_inits[hn] = hi
+            nodes.append(P.node("Clip", [ins[0], ln, hn], [out], out))
+        elif op in _ELEM:
+            nodes.append(P.node(_ELEM[op], ins, [out], out))
+        elif op in _UNARY:
+            attrs = [P.attr_int("axis", 1)] if _UNARY[op] == "Flatten" else []
+            nodes.append(P.node(_UNARY[op], ins, [out], out, attrs))
+        else:
+            raise NotImplementedError(
+                "ONNX export: unsupported op %r (supported: see module "
+                "docstring)" % op)
+        name_of[id(base)] = out
+        return out
+
+    out_name = emit(sym)
+
+    for ones_name, gamma_name in fix_gamma_ones:
+        shp = params[gamma_name].shape if gamma_name in params else (1,)
+        extra_inits[ones_name] = onp.ones(shp, "float32")
+    for k, v in params.items():
+        arr = v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v)
+        initializers.append(P.tensor(k, arr))
+    for k, v in extra_inits.items():
+        initializers.append(P.tensor(k, v))
+
+    inputs = [P.value_info(n, s, input_type)
+              for n, s in zip(data_names, input_shape)]
+    # ONNX requires initializers to also appear as graph inputs pre-IR4 —
+    # modern runtimes don't; we list only real data inputs (IR 8)
+    all_shapes = {n: s for n, s in zip(data_names, input_shape)}
+    all_shapes.update({k: tuple(v.shape) for k, v in params.items()})
+    try:
+        _, out_shapes, _ = sym.infer_shape(**all_shapes)
+    except Exception:
+        out_shapes = None
+    outputs = [P.value_info(out_name, out_shapes[0] if out_shapes else (),
+                            "float32")]
+    g = P.graph("mxtpu_graph", nodes, inputs, outputs, initializers)
+    buf = P.model(g)
+    with open(onnx_file_path, "wb") as f:
+        f.write(buf)
+    return onnx_file_path
+
+
+def get_model_metadata(model_file):
+    """ref onnx2mx get_model_metadata."""
+    with open(model_file, "rb") as f:
+        m = P.read_model(f.read())
+    g = m["graph"]
+    return {"input_tensor_data": P.read_value_infos(g, 11),
+            "output_tensor_data": P.read_value_infos(g, 12)}
 
 
 def import_model(model_file):
-    """ref contrib/onnx/onnx2mx — import (requires onnx package)."""
-    onnx = _require_onnx()
-    if onnx is None:
-        raise RuntimeError("onnx package not available in this environment; "
-                           "use Symbol JSON + params files instead")
-    raise NotImplementedError("ONNX import: map onnx nodes onto mx.sym ops")
+    """.onnx file → (sym, arg_params, aux_params) (ref onnx2mx/import_model)."""
+    from .. import symbol as mxsym
+    from .. import ndarray as nd
+
+    with open(model_file, "rb") as f:
+        m = P.read_model(f.read())
+    g = m["graph"]
+    inits = P.read_initializers(g)
+    value = {}  # onnx value name -> Symbol
+    for name, _shape, _dt in P.read_value_infos(g, 11):
+        value[name] = mxsym.var(name)
+
+    arg_params, aux_params = {}, {}
+    for k, v in inits.items():
+        arg_params[k] = nd.array(onp.asarray(v))
+
+    def sym_of(name):
+        if name in value:
+            return value[name]
+        if name in inits:
+            value[name] = mxsym.var(name)
+            return value[name]
+        raise ValueError("ONNX import: undefined input %r" % name)
+
+    last = None
+    for n in P.read_nodes(g):
+        ins = [sym_of(i) for i in n["inputs"]]
+        op, at = n["op_type"], n["attrs"]
+        if op == "Gemm":
+            if at.get("alpha", 1.0) != 1.0 or at.get("beta", 1.0) != 1.0 \
+                    or at.get("transA", 0):
+                raise NotImplementedError(
+                    "ONNX import: Gemm with alpha/beta != 1 or transA")
+            wname = n["inputs"][1]
+            if wname not in arg_params:
+                raise NotImplementedError(
+                    "ONNX import: Gemm weight must be an initializer")
+            if not at.get("transB", 0):
+                # (in, out) layout → FullyConnected's (out, in)
+                arg_params[wname] = nd.array(arg_params[wname].asnumpy().T)
+            out = mxsym.FullyConnected(
+                data=ins[0], weight=ins[1],
+                bias=ins[2] if len(ins) > 2 else None,
+                num_hidden=int(arg_params[wname].shape[0]),
+                no_bias=len(ins) < 3, flatten=False, name=n["outputs"][0])
+        elif op == "Conv":
+            w = arg_params[n["inputs"][1]]
+            out = mxsym.Convolution(
+                data=ins[0], weight=ins[1],
+                bias=ins[2] if len(ins) > 2 else None,
+                kernel=tuple(at["kernel_shape"]),
+                stride=tuple(at.get("strides", (1, 1))),
+                pad=_sym_pads(at, len(at["kernel_shape"])),
+                dilate=tuple(at.get("dilations", (1, 1))),
+                num_filter=int(w.shape[0]),
+                num_group=int(at.get("group", 1)),
+                no_bias=len(ins) < 3, name=n["outputs"][0])
+        elif op == "BatchNormalization":
+            # fix_gamma=False: ONNX scale is ALWAYS applied (our export emits
+            # explicit ones when the source had fix_gamma=True)
+            out = mxsym.BatchNorm(
+                data=ins[0], gamma=ins[1], beta=ins[2], moving_mean=ins[3],
+                moving_var=ins[4], eps=float(at.get("epsilon", 1e-5)),
+                momentum=float(at.get("momentum", 0.9)), fix_gamma=False,
+                use_global_stats=True, name=n["outputs"][0])
+            for mi, which in ((3, aux_params), (4, aux_params)):
+                nm = n["inputs"][mi]
+                if nm in arg_params:
+                    which[nm] = arg_params.pop(nm)
+        elif op == "Softplus":
+            out = mxsym.Activation(ins[0], act_type="softrelu")
+        elif op in ("Relu", "Sigmoid", "Tanh", "Exp", "Log",
+                    "Sqrt", "Neg", "Abs", "Identity"):
+            fn = {"Relu": mxsym.relu, "Sigmoid": mxsym.sigmoid,
+                  "Tanh": mxsym.tanh, "Exp": mxsym.exp, "Log": mxsym.log,
+                  "Sqrt": mxsym.sqrt, "Neg": mxsym.negative,
+                  "Abs": mxsym.abs, "Identity": mxsym.identity}[op]
+            out = fn(ins[0])
+        elif op in ("MaxPool", "AveragePool"):
+            out = mxsym.Pooling(
+                data=ins[0], kernel=tuple(at["kernel_shape"]),
+                stride=tuple(at.get("strides", (1, 1))),
+                pad=_sym_pads(at, len(at["kernel_shape"])),
+                pool_type="max" if op == "MaxPool" else "avg",
+                name=n["outputs"][0])
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = mxsym.Pooling(
+                data=ins[0], global_pool=True,
+                pool_type="max" if op == "GlobalMaxPool" else "avg",
+                kernel=(1, 1), name=n["outputs"][0])
+        elif op == "Flatten":
+            out = mxsym.flatten(ins[0])
+        elif op == "Softmax":
+            out = mxsym.softmax(ins[0], axis=int(at.get("axis", -1)))
+        elif op == "Dropout":
+            out = mxsym.identity(ins[0])
+        elif op == "Concat":
+            out = mxsym.concat(*ins, dim=int(at.get("axis", 1)))
+        elif op == "MatMul":
+            out = mxsym.dot(ins[0], ins[1])
+        elif op == "Reshape":
+            shp = tuple(int(x) for x in
+                        onp.asarray(inits[n["inputs"][1]]).tolist())
+            arg_params.pop(n["inputs"][1], None)
+            out = mxsym.reshape(ins[0], shape=shp)
+        elif op == "Transpose":
+            out = mxsym.transpose(ins[0], axes=tuple(at["perm"])
+                                  if "perm" in at else None)
+        elif op == "Clip":
+            lo = float(onp.asarray(inits[n["inputs"][1]]))
+            hi = float(onp.asarray(inits[n["inputs"][2]]))
+            arg_params.pop(n["inputs"][1], None)
+            arg_params.pop(n["inputs"][2], None)
+            out = mxsym.clip(ins[0], a_min=lo, a_max=hi)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": mxsym.broadcast_add, "Sub": mxsym.broadcast_sub,
+                  "Mul": mxsym.broadcast_mul, "Div": mxsym.broadcast_div}[op]
+            out = fn(ins[0], ins[1])
+        else:
+            raise NotImplementedError("ONNX import: unsupported op %r" % op)
+        for o in n["outputs"]:
+            value[o] = out
+        last = out
+    # the graph's DECLARED outputs win over file order (field 12)
+    declared = [name for name, _s, _d in P.read_value_infos(g, 12)]
+    if declared and declared[0] in value:
+        last = value[declared[0]]
+    return last, arg_params, aux_params
+
+
+def _sym_pads(at, ndim):
+    """ONNX pads are [begin..., end...]; mx supports symmetric only."""
+    pads = tuple(at.get("pads", (0,) * 2 * ndim))
+    begin, end = pads[:ndim], pads[ndim:2 * ndim]
+    if end and begin != end:
+        raise NotImplementedError(
+            "ONNX import: asymmetric padding %s unsupported" % (pads,))
+    if at.get("auto_pad", "") not in ("", "NOTSET"):
+        raise NotImplementedError("ONNX import: auto_pad unsupported")
+    return begin

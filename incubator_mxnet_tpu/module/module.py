@@ -128,6 +128,14 @@ class Module(BaseModule):
             return
         optimizer_params = dict(optimizer_params or {"learning_rate": 0.01})
         if isinstance(optimizer, str):
+            # the reference defaults rescale_grad to 1/batch when it builds
+            # the optimizer itself (module.py:497) — loss-layer grads are
+            # batch SUMS, so without this fit() takes batch_size-times-too-
+            # large steps and saturates
+            if "rescale_grad" not in optimizer_params and \
+                    getattr(self, "_data_shapes", None):
+                batch = self._data_shapes[0][1][0]
+                optimizer_params["rescale_grad"] = 1.0 / batch
             idx2name = {i: n for i, n in enumerate(self.param_names)}
             optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
                                        **optimizer_params)

@@ -22,3 +22,9 @@ from .ndarray import (  # noqa
 from .ndarray import slice_op as slice  # noqa  (MXNet nd.slice)
 from . import contrib  # noqa  (control flow: foreach/while_loop/cond)
 from . import sparse  # noqa  (row_sparse/csr storage types)
+
+
+def Custom(*inputs, op_type, **kwargs):
+    """Dispatch a registered custom op (ref mx.nd.Custom; operator.py)."""
+    from ..operator import Custom as _custom
+    return _custom(*inputs, op_type=op_type, **kwargs)

@@ -1,0 +1,204 @@
+"""Subgraph partitioning API — the custom-accelerator-backend hook
+(ref src/operator/subgraph/subgraph_property.h:252 SubgraphProperty,
+python/mxnet symbol.optimize_for).
+
+TPU-native role: XLA already owns fusion for the compiled path, so the
+default execution needs no partitioner. This API exists for what the
+reference used it for — plugging a BACKEND in: grouping matched operators
+into subgraph nodes a backend can claim (int8 paths, custom accelerators,
+vendor libraries). A partitioned Symbol stays a Symbol: subgraph nodes
+evaluate their captured sub-DAG through the same op implementations, so
+bind/eval/gradients keep working.
+
+Usage::
+
+    class MyBackend(SubgraphProperty):
+        def match(self, node):             # op whitelist
+            return node.op_name in ("dot", "add", "relu")
+    register_backend("my_backend", MyBackend)
+    part = sym.optimize_for("my_backend")  # or subgraph.partition(sym, ...)
+"""
+from __future__ import annotations
+
+__all__ = ["SubgraphProperty", "register_backend", "get_backend", "partition"]
+
+_BACKENDS = {}
+
+
+class SubgraphProperty:
+    """Backend description: which nodes it claims, and how to wrap them
+    (ref subgraph_property.h SubgraphProperty / SubgraphSelector)."""
+
+    name = "base"
+
+    def match(self, node):
+        """Whether this backend claims ``node`` (a non-variable Symbol)."""
+        raise NotImplementedError
+
+    def pre_partition(self, sym):
+        return sym
+
+    def post_partition(self, sym):
+        return sym
+
+    def create_subgraph_op(self, fn, nodes):
+        """Hook: wrap the fused callable (e.g. quantize/compile it)."""
+        return fn
+
+
+def register_backend(name, prop_cls):
+    """ref MXNET_REGISTER_SUBGRAPH_BACKEND / subgraph_property.h:429."""
+    _BACKENDS[name] = prop_cls
+    return prop_cls
+
+
+def get_backend(name):
+    if name not in _BACKENDS:
+        raise ValueError("subgraph backend %r not registered (have: %s)"
+                         % (name, sorted(_BACKENDS)))
+    return _BACKENDS[name]()
+
+
+def _topo(sym):
+    seen, order = set(), []
+
+    def visit(s):
+        base = getattr(s, "_base", None) or s
+        if id(base) in seen:
+            return
+        seen.add(id(base))
+        for i in base._inputs:
+            visit(i)
+        order.append(base)
+
+    visit(sym)
+    return order
+
+
+def partition(sym, backend):
+    """Group matched connected operators into subgraph nodes.
+
+    v1 contract (conservative, like the reference's default selector):
+    only single-output components are fused — a matched component whose
+    intermediate values are consumed outside stays unfused. Multi-output
+    heads are left to the backend's own selector subclassing.
+    """
+    from .symbol.symbol import Symbol
+
+    prop = backend if isinstance(backend, SubgraphProperty) else \
+        get_backend(backend)
+    sym = prop.pre_partition(sym)
+    nodes = _topo(sym)
+    matched = {id(n) for n in nodes
+               if not n.is_var and n._num_outputs == 1 and prop.match(n)}
+
+    # consumers map over the whole graph
+    consumers = {}
+    for n in nodes:
+        for i in n._inputs:
+            b = getattr(i, "_base", None) or i
+            consumers.setdefault(id(b), []).append(n)
+
+    # connected components among matched nodes (union-find over input edges)
+    parent = {i: i for i in matched}
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    byid = {id(n): n for n in nodes}
+    for n in nodes:
+        if id(n) not in matched:
+            continue
+        for i in n._inputs:
+            b = getattr(i, "_base", None) or i
+            if id(b) in matched:
+                union(id(n), id(b))
+
+    groups = {}
+    for i in matched:
+        groups.setdefault(find(i), []).append(byid[i])
+
+    # keep only components with exactly ONE node consumed outside (the root)
+    fuse = {}  # id(root) -> list of member nodes
+    for comp in groups.values():
+        if len(comp) < 2:
+            continue
+        ids = {id(n) for n in comp}
+        ext_out = [n for n in comp
+                   if any(id(c) not in ids for c in consumers.get(id(n), []))
+                   or not consumers.get(id(n))]
+        if len(ext_out) == 1:
+            fuse[id(ext_out[0])] = comp
+
+    if not fuse:
+        return prop.post_partition(sym)
+
+    # rebuild the DAG bottom-up, replacing each fused component's root
+    rebuilt = {}
+
+    def rebuild(s):
+        base = getattr(s, "_base", None) or s
+        if id(base) in rebuilt:
+            new = rebuilt[id(base)]
+        elif base.is_var:
+            new = base
+        elif id(base) in fuse:
+            comp_ids = {id(n) for n in fuse[id(base)]}
+            # external inputs of the component, in first-use order; keyed by
+            # (node, output_index) so two outputs of one multi-output node
+            # stay distinct
+            ext, seen_ext = [], set()
+            for n in fuse[id(base)]:
+                for i in n._inputs:
+                    ib = getattr(i, "_base", None) or i
+                    k = (id(ib), i._output_index)
+                    if id(ib) not in comp_ids and k not in seen_ext:
+                        seen_ext.add(k)
+                        ext.append(i)
+            root = base
+
+            def fused_fn(*ext_vals, _root=root, _ext=tuple(ext)):
+                cache = {}
+                for e, v in zip(_ext, ext_vals):
+                    eb = getattr(e, "_base", None) or e
+                    cache[(id(eb), e._output_index)] = v
+                    cache[(id(eb), None)] = v
+
+                def ev(s2):
+                    b2 = getattr(s2, "_base", None) or s2
+                    k = (id(b2), s2._output_index)
+                    if k in cache:
+                        return cache[k]
+                    args = [ev(i) for i in b2._inputs]
+                    out = b2._op(*args, **b2._kwargs)
+                    cache[k] = out
+                    return out
+
+                return ev(_root)
+
+            fused_fn = prop.create_subgraph_op(fused_fn, fuse[id(base)])
+            new = Symbol(op=fused_fn,
+                         op_name="_subgraph_%s" % prop.name,
+                         inputs=[rebuild(e) for e in ext],
+                         name="%s_subgraph%d" % (prop.name, len(rebuilt)))
+        else:
+            new = Symbol(op=base._op, op_name=base._op_name,
+                         inputs=[rebuild(i) for i in base._inputs],
+                         kwargs=base._kwargs, name=base.name,
+                         num_outputs=base._num_outputs)
+            new._attr = dict(base._attr)
+        rebuilt[id(base)] = new
+        if s._output_index is not None:
+            return new[s._output_index]
+        return new
+
+    out = rebuild(sym)
+    return prop.post_partition(out)

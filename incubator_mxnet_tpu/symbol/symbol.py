@@ -247,6 +247,16 @@ class Symbol:
         return "<Symbol %s>" % self.name
 
     # ---------------------------------------------------------------- io
+    def optimize_for(self, backend, args=None, ctx=None, **kwargs):
+        """Partition this graph for a registered subgraph backend
+        (ref python symbol.optimize_for / subgraph_property.h:252)."""
+        from ..subgraph import partition
+        return partition(self, backend)
+
+    def get_backend_symbol(self, backend):
+        """Legacy alias of optimize_for (ref symbol.py get_backend_symbol)."""
+        return self.optimize_for(backend)
+
     def tojson(self):
         """Graph JSON (structural; op impls are named, not serialized)."""
         nodes, index = [], {}

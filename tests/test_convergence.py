@@ -1,0 +1,62 @@
+"""Convergence anchors (BASELINE config 1: LeNet/MNIST parity; VERDICT
+weak #12 — training must actually learn, not just run).
+
+Uses the MNISTIter workflow end-to-end (synthetic learnable fallback set
+when real MNIST is absent — class-dependent means, gluon/data/vision.py).
+Regression value: Module.fit without the reference's 1/batch rescale_grad
+default sat at chance accuracy; this test pins the fixed behavior.
+"""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+
+
+def _lenet():
+    sym = mx.sym
+    data = sym.var("data")
+    c1 = sym.Convolution(data, kernel=(5, 5), num_filter=8)
+    p1 = sym.Pooling(sym.Activation(c1, act_type="tanh"), kernel=(2, 2),
+                     stride=(2, 2), pool_type="max")
+    f = sym.flatten(p1)
+    fc1 = sym.Activation(sym.FullyConnected(f, num_hidden=64, flatten=False),
+                         act_type="tanh")
+    fc2 = sym.FullyConnected(fc1, num_hidden=10, flatten=False)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_fit_converges_on_mnist():
+    train = mx.io.MNISTIter(batch_size=256, shuffle=True)
+    mod = mx.module.Module(_lenet())
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", initializer=mx.init.Xavier())
+    assert mod._optimizer.rescale_grad == 1.0 / 256  # ref module.py:497 default
+    score = dict(mod.score(train, mx.metric.Accuracy()))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_gluon_trainer_converges_on_mnist():
+    from incubator_mxnet_tpu import nd, gluon, jit
+    from incubator_mxnet_tpu.gluon.data.vision import MNIST
+
+    ds = MNIST(train=True)
+    data = ds._data.asnumpy().astype("float32")[:2048] / 255.0
+    label = onp.asarray(ds._label[:2048], dtype="float32")
+    x = nd.array(data.transpose(0, 3, 1, 2))
+    y = nd.array(label)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2), gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    step = jit.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
+    for ep in range(2):
+        perm = onp.random.RandomState(ep).permutation(len(label))
+        for i in range(0, len(label), 256):
+            step(x[perm[i:i + 256]], y[perm[i:i + 256]])
+    pred = net(x).asnumpy().argmax(-1)
+    assert (pred == label).mean() > 0.95

@@ -1,0 +1,105 @@
+"""Custom op protocol + subgraph partitioning
+(ref tests/python/unittest/test_operator.py CustomOp cases and
+tests/python/unittest/test_subgraph_op.py)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + nd.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array([[-1.0, 0.0, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    expect = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(y.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(x.grad.asnumpy(), expect * (1 - expect),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_custom_op_errors():
+    import pytest
+    with pytest.raises(ValueError):
+        nd.Custom(nd.ones((2,)), op_type="not_registered")
+    with pytest.raises(ValueError):
+        nd.Custom(nd.ones((2,)), nd.ones((2,)), op_type="test_sigmoid")
+
+
+class _MatmulChain(mx.subgraph.SubgraphProperty):
+    name = "test_fuse"
+
+    def __init__(self):
+        self.wrapped = 0
+
+    def match(self, node):
+        return node._op_name in ("dot", "relu", "add")
+
+    def create_subgraph_op(self, fn, nodes):
+        self.wrapped += 1
+        return fn
+
+
+mx.subgraph.register_backend("test_fuse", _MatmulChain)
+
+
+def test_subgraph_partition_preserves_outputs():
+    sym = mx.sym
+    x = sym.var("x")
+    w1 = sym.var("w1")
+    w2 = sym.var("w2")
+    h = sym.relu(sym.dot(x, w1))
+    y = sym.dot(h, w2)
+    out = sym.exp(y)  # exp not matched: stays outside the fused group
+
+    part = out.optimize_for("test_fuse")
+    names = [s._op_name for s in part.get_internals() if not s.is_var]
+    assert any(n.startswith("_subgraph_test_fuse") for n in names), names
+    assert "exp" in names
+    # matched ops are gone from the top-level graph
+    assert "dot" not in names and "relu" not in names
+
+    rng = onp.random.RandomState(0)
+    binds = {k: nd.array(rng.randn(4, 4).astype("float32"))
+             for k in ("x", "w1", "w2")}
+    a = out.eval(**binds)[0]
+    b = part.eval(**binds)[0]
+    assert_almost_equal(a.asnumpy(), b.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_subgraph_partition_respects_external_consumers():
+    sym = mx.sym
+    x = sym.var("x")
+    w = sym.var("w")
+    h = sym.dot(x, w)           # consumed by BOTH relu (matched) and exp
+    out = sym.exp(h) + sym.relu(h)
+    part = out.optimize_for("test_fuse")
+    rng = onp.random.RandomState(1)
+    binds = {k: nd.array(rng.randn(3, 3).astype("float32")) for k in ("x", "w")}
+    assert_almost_equal(out.eval(**binds)[0].asnumpy(),
+                        part.eval(**binds)[0].asnumpy(), rtol=1e-5, atol=1e-6)

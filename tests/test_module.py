@@ -110,7 +110,9 @@ def test_module_fit_convergence():
     y = X.dot(w).argmax(axis=1).astype("float32")
     train_iter = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
     mod = mx.mod.Module(net, context=mx.cpu())
-    mod.fit(train_iter, num_epoch=12, optimizer_params={"learning_rate": 0.1})
+    # grads are per-batch MEANS now (rescale_grad=1/batch default, ref
+    # module.py:497) — lr/epochs sized for the honest scale
+    mod.fit(train_iter, num_epoch=25, optimizer_params={"learning_rate": 0.5})
     score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
     assert score[0][1] > 0.9, score
 
