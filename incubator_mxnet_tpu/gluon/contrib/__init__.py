@@ -1,2 +1,3 @@
 """gluon.contrib (ref python/mxnet/gluon/contrib/) — estimator et al."""
 from . import estimator  # noqa
+from . import nn  # noqa

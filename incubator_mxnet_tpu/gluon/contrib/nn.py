@@ -1,0 +1,32 @@
+"""gluon.contrib.nn (ref python/mxnet/gluon/contrib/nn/basic_layers.py).
+
+SyncBatchNorm lives here for reference API parity; on TPU it is plain
+BatchNorm (SPMD batch stats are already global — see the class docstring).
+"""
+from ..nn import SyncBatchNorm, HybridSequential  # noqa
+
+__all__ = ["SyncBatchNorm", "Concurrent", "HybridConcurrent", "Identity"]
+
+
+class HybridConcurrent(HybridSequential):
+    """Run children on the same input and concat outputs
+    (ref contrib/nn/basic_layers.py HybridConcurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+Concurrent = HybridConcurrent
+
+
+class Identity(HybridSequential):
+    """ref contrib/nn/basic_layers.py Identity."""
+
+    def forward(self, x):
+        return x

@@ -9,7 +9,7 @@ from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-           "BatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "Flatten",
+           "BatchNorm", "SyncBatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "Flatten",
            "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU",
            "SELU", "Swish", "GELU", "Identity"]
 
@@ -382,3 +382,18 @@ class Swish(HybridBlock):
 
     def forward(self, x):
         return x * nd.sigmoid(self._beta * x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (ref gluon/contrib/nn/basic_layers.py
+    SyncBatchNorm, src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native: under SPMD data parallelism the fused train step computes
+    batch statistics over the GLOBAL batch — ``jnp.mean`` along a dp-sharded
+    axis lowers to a cross-device all-reduce — so BatchNorm is already
+    synchronized; this subclass exists for API parity. ``num_devices`` is
+    accepted and ignored (the mesh defines the sync group).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)

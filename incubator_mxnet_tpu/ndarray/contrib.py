@@ -208,3 +208,53 @@ def isnan(data):
 
 def isfinite(data):
     return _apply(lambda x: jnp.isfinite(x).astype(jnp.float32), _to_nd(data))
+
+
+# ---------------------------------------------------------------- detection
+# (ref src/operator/contrib/: ROIAlign, MultiProposal, fft; tensor/
+#  bounding_box.cc: box_nms/box_iou/bipartite_matching)
+def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=-1,
+             position_sensitive=False, aligned=True):
+    """ref contrib/roi_align.cc. sample_ratio=-1 (the reference's adaptive
+    per-bin count) is mapped to a fixed 2x2 grid — sample counts must be
+    static under XLA."""
+    if position_sensitive:
+        raise NotImplementedError(
+            "position_sensitive (PSRoIAlign) is not implemented")
+    from ..ops.detection import roi_align
+    return roi_align(data, rois, pooled_size, spatial_scale,
+                     sample_ratio if sample_ratio > 0 else 2)
+
+
+def MultiProposal(cls_prob, bbox_pred, im_info, **kw):
+    from ..ops.detection import multi_proposal
+    return multi_proposal(cls_prob, bbox_pred, im_info, **kw)
+
+
+def box_iou(lhs, rhs, format="corner"):
+    from ..ops import detection
+    return detection.box_iou(lhs, rhs, format)
+
+
+def box_nms(data, **kw):
+    from ..ops import detection
+    return detection.box_nms(data, **kw)
+
+
+def bipartite_matching(data, is_ascend=False, threshold=None, topk=-1):
+    """ref tensor/bounding_box.cc — NOTE the reference's positional order
+    is (data, is_ascend, threshold, topk)."""
+    if threshold is None:
+        raise ValueError("bipartite_matching requires threshold")
+    from ..ops import detection
+    return detection.bipartite_matching(data, threshold, is_ascend, topk)
+
+
+def fft(data, compute_size=None):
+    from ..ops import detection
+    return detection.fft(data, compute_size)
+
+
+def ifft(data, compute_size=None):
+    from ..ops import detection
+    return detection.ifft(data, compute_size)

@@ -4,10 +4,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
-from .ndarray import _apply
+from .ndarray import NDArray, _apply
 
 __all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk", "gelqf",
-           "sumlogdiag", "extractdiag", "makediag", "inverse", "det", "slogdet", "svd"]
+           "sumlogdiag", "extractdiag", "makediag", "inverse", "det", "slogdet",
+           "svd", "syevd", "extracttrian", "maketrian"]
 
 
 def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False, axis=-2):
@@ -109,3 +110,50 @@ def slogdet(A):
 
 def svd(A):
     return _apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=False)), A)
+
+
+def syevd(A):
+    """ref la_op.cc syevd: symmetric eigendecomposition (U, L)."""
+
+    def fn(a):
+        w, v = jnp.linalg.eigh(a)
+        return v.swapaxes(-1, -2), w
+
+    return _apply(fn, A)
+
+
+def _tri_side(offset, lower):
+    """ref la_op semantics: offset>0 selects the upper triangle, offset<0
+    the lower; ``lower`` only decides at offset 0."""
+    if offset > 0:
+        return False
+    if offset < 0:
+        return True
+    return lower
+
+
+def _tri_indices(n, offset, lower):
+    import numpy as onp
+    return onp.tril_indices(n, offset) if _tri_side(offset, lower) \
+        else onp.triu_indices(n, offset)
+
+
+def extracttrian(A, offset=0, lower=True):
+    """ref la_op.cc extracttrian: packed triangle of a square matrix."""
+    i0, i1 = _tri_indices(A.shape[-1], offset, lower)
+    return _apply(lambda a: a[..., i0, i1], A)
+
+
+def maketrian(A, offset=0, lower=True):
+    """ref la_op.cc maketrian: inverse of extracttrian."""
+    import math
+    k = A.shape[-1]
+    # packed length of an n x n triangle at |offset| o is (n-o)(n-o+1)/2
+    n = int((math.isqrt(8 * k + 1) - 1) // 2) + abs(offset)
+    i0, i1 = _tri_indices(n, offset, lower)
+
+    def fn(a):
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return out.at[..., i0, i1].set(a)
+
+    return _apply(fn, A)

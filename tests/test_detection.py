@@ -1,0 +1,157 @@
+"""Detection / spatial-sampling ops (ref tests/python/unittest
+test_contrib_operator.py + test_operator.py bounding-box & ROI cases)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ops import detection as det
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_roi_align_whole_image_mean():
+    # aligned (half-pixel) convention: box (0,0,4,4) with 4 samples lands
+    # exactly on pixel centers -> 1x1 output == exact image mean
+    data = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = nd.array(onp.array([[0, 0, 0, 4, 4]], "float32"))
+    out = det.roi_align(data, rois, (1, 1), spatial_scale=1.0, sample_ratio=4)
+    assert out.shape == (1, 1, 1, 1)
+    assert abs(float(out.asnumpy()) - 7.5) < 1e-4
+
+
+def test_roi_align_matches_shifted_rois():
+    rng = onp.random.RandomState(0)
+    data = nd.array(rng.rand(2, 3, 8, 8).astype("float32"))
+    rois = nd.array(onp.array([[0, 1, 1, 6, 6], [1, 0, 0, 7, 7]], "float32"))
+    out = det.roi_align(data, rois, (2, 2), spatial_scale=1.0)
+    assert out.shape == (2, 3, 2, 2)
+    assert bool(onp.isfinite(out.asnumpy()).all())
+    # ROI from batch image 1 must use image 1's data
+    out2 = det.roi_align(nd.array(data.asnumpy()[[0, 0]]), rois, (2, 2), 1.0)
+    assert not onp.allclose(out.asnumpy()[1], out2.asnumpy()[1])
+
+
+def test_roi_pooling_max_semantics():
+    data = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = nd.array(onp.array([[0, 0, 0, 3, 3]], "float32"))
+    out = det.roi_pooling(data, rois, (2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    # bottom-right bin's max should approach the image max (15)
+    assert float(out.asnumpy()[0, 0, 1, 1]) > 11.0
+
+
+def test_bilinear_sampler_identity():
+    rng = onp.random.RandomState(1)
+    x = nd.array(rng.rand(1, 2, 5, 5).astype("float32"))
+    H = W = 5
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, H), onp.linspace(-1, 1, W),
+                          indexing="ij")
+    grid = nd.array(onp.stack([xs, ys])[None].astype("float32"))
+    out = det.bilinear_sampler(x, grid)
+    assert_almost_equal(out.asnumpy(), x.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_identity_affine():
+    rng = onp.random.RandomState(2)
+    x = nd.array(rng.rand(2, 1, 6, 6).astype("float32"))
+    theta = nd.array(onp.tile(onp.array([1, 0, 0, 0, 1, 0], "float32"),
+                              (2, 1)))
+    out = det.spatial_transformer(x, theta, target_shape=(6, 6))
+    assert_almost_equal(out.asnumpy(), x.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_box_iou_known_values():
+    a = nd.array(onp.array([[0, 0, 2, 2]], "float32"))
+    b = nd.array(onp.array([[0, 0, 2, 2], [1, 1, 3, 3], [4, 4, 5, 5]],
+                           "float32"))
+    iou = mx.nd.contrib.box_iou(a, b).asnumpy()
+    assert_almost_equal(iou[0], [1.0, 1.0 / 7.0, 0.0], rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_suppression():
+    rows = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],  # overlaps the first -> suppressed
+        [0, 0.7, 5, 5, 7, 7],          # disjoint -> kept
+    ], "float32")
+    out = mx.nd.contrib.box_nms(nd.array(rows[None]),
+                                overlap_thresh=0.5).asnumpy()[0]
+    assert out[0, 1] == 0.9 and out[2, 1] == 0.7
+    assert (out[1] == -1).all()
+
+
+def test_bipartite_matching():
+    s = nd.array(onp.array([[0.9, 0.1], [0.2, 0.8]], "float32"))
+    row, col = mx.nd.contrib.bipartite_matching(s, threshold=0.5)
+    assert row.asnumpy().tolist() == [0.0, 1.0]
+    assert col.asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_multi_proposal_shapes():
+    rng = onp.random.RandomState(3)
+    B, A, H, W = 1, 9, 4, 4
+    cls = nd.array(rng.rand(B, 2 * A, H, W).astype("float32"))
+    deltas = nd.array((rng.rand(B, 4 * A, H, W) * 0.1).astype("float32"))
+    info = nd.array(onp.array([[64, 64, 1.0]], "float32"))
+    rois = mx.nd.contrib.MultiProposal(cls, deltas, info,
+                                       scales=(4, 8, 16), ratios=(0.5, 1, 2),
+                                       rpn_pre_nms_top_n=50,
+                                       rpn_post_nms_top_n=10)
+    assert rois.shape == (10, 5)
+    r = rois.asnumpy()
+    assert (r[:, 1] <= r[:, 3]).all() and (r[:, 2] <= r[:, 4]).all()
+    assert (r[:, 1:] >= 0).all()
+
+
+def test_fft_roundtrip():
+    rng = onp.random.RandomState(4)
+    x = nd.array(rng.rand(3, 8).astype("float32"))
+    f = mx.nd.contrib.fft(x)
+    assert f.shape == (3, 16)
+    back = mx.nd.contrib.ifft(f) / 8  # ref convention: unnormalized inverse
+    assert_almost_equal(back.asnumpy(), x.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sync_batch_norm_alias():
+    from incubator_mxnet_tpu.gluon import nn
+    bn = nn.SyncBatchNorm(in_channels=4, num_devices=8)
+    bn.initialize()
+    x = nd.random.normal(shape=(2, 4, 3, 3))
+    assert bn(x).shape == (2, 4, 3, 3)
+
+
+def test_box_nms_per_class_default():
+    # force_suppress default False: different class ids never suppress
+    rows = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [1, 0.8, 0.1, 0.1, 2.1, 2.1],  # overlaps but different class -> kept
+    ], "float32")
+    out = mx.nd.contrib.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                                id_index=0).asnumpy()[0]
+    assert out[0, 1] == 0.9 and out[1, 1] == 0.8
+    # force_suppress=True suppresses across classes
+    out2 = mx.nd.contrib.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                                 id_index=0, force_suppress=True).asnumpy()[0]
+    assert (out2[1] == -1).all()
+
+
+def test_linalg_trian_roundtrip_and_grads():
+    import jax
+    from incubator_mxnet_tpu import autograd
+    A = nd.array(onp.array([[2.0, 1.0, 0.5], [1.0, 3.0, 0.2],
+                            [0.5, 0.2, 4.0]], "float32"))
+    # reference semantics: offset sign selects the triangle
+    up = nd.linalg.extracttrian(A, offset=1)
+    assert up.shape == (3,)
+    assert_almost_equal(up.asnumpy(), [1.0, 0.5, 0.2])
+    back = nd.linalg.maketrian(up, offset=1)
+    assert back.shape == (3, 3)
+    assert back.asnumpy()[0, 1] == 1.0 and back.asnumpy()[1, 0] == 0.0
+    lo = nd.linalg.extracttrian(A, offset=-1)
+    assert_almost_equal(nd.linalg.maketrian(lo, offset=-1).asnumpy()[1, 0], 1.0)
+    # syevd rides the tape now
+    A.attach_grad()
+    with autograd.record():
+        U, L = nd.linalg.syevd(A)
+        loss = (L * L).sum()
+    loss.backward()
+    assert float(nd.norm(A.grad).asnumpy()) > 0.1
