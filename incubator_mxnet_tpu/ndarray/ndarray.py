@@ -92,6 +92,20 @@ class NDArray:
     def stype(self):
         return "default"  # sparse stypes: dense-only on TPU (SURVEY §7 hard part f)
 
+    def __getstate__(self):
+        # pickle as host numpy: crosses process boundaries (DataLoader
+        # multiprocessing workers) without dragging device buffers along.
+        # NB: a pickle round-trip (or deepcopy) lands on the DEFAULT device
+        # — device placement is process-local state, not data
+        return {"data": self.asnumpy()}
+
+    def __setstate__(self, state):
+        self._data = jnp.asarray(state["data"])
+        self._ctx = None
+        self._in_graph = False
+        self._grad_req = "write"
+        self.grad_buf = None
+
     def asnumpy(self):
         return onp.asarray(self._data)
 
@@ -432,10 +446,22 @@ def _apply(fn, *inputs):
     """Execute a pure JAX function on NDArray inputs, eagerly; tape if recording.
 
     This is the single choke point every op goes through — the TPU analog of
-    Imperative::Invoke (src/imperative/imperative.cc:89).
+    Imperative::Invoke (src/imperative/imperative.cc:89). When the profiler
+    runs with profile_imperative, every op is timed (synced) and aggregated
+    — the per-op engine instrumentation of the reference's profiler.
     """
+    from .. import profiler as _prof
+    profiling = _prof.imperative_active()
+    if profiling:
+        import time as _time
+        t0 = _time.time() * 1e6
     data = [x._data for x in inputs]
     out = fn(*data)
+    if profiling:
+        name = getattr(fn, "__qualname__", None) or \
+            getattr(fn, "__name__", "op")
+        _prof.record_op(name, t0,
+                        list(out) if isinstance(out, (tuple, list)) else [out])
     if isinstance(out, (tuple, list)):
         outs = [NDArray(o) for o in out]
         if autograd.is_recording():

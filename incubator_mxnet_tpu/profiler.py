@@ -12,9 +12,14 @@ import threading
 import time
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
-           "scope", "Marker", "record_event"]
+           "scope", "Marker", "record_event", "device_memory"]
 
-_CONFIG = {"filename": "profile.json", "aggregate_stats": True}
+_CONFIG = {"filename": "profile.json", "aggregate_stats": True,
+           # profile_imperative: instrument EVERY eager op at the _apply
+           # choke point (ref per-op engine profiling, profiler.h:251).
+           # Each op is synced to time real device work — turn off to
+           # profile async pipelining instead.
+           "profile_imperative": True}
 _STATE = {"running": False, "jax_trace_dir": None}
 _EVENTS = []
 _LOCK = threading.Lock()
@@ -54,13 +59,18 @@ def state():
 
 
 def record_event(name, categories="host", start_us=None, dur_us=None):
-    """Record one host-side event (complete-event 'X' phase)."""
+    """Record one host-side event (complete-event 'X' phase).
+
+    The per-event trace list is bounded (config max_events, default 500k;
+    oldest-first semantics: recording stops at the cap, aggregation
+    continues) so long profiled runs do not grow memory without bound."""
     if not _STATE["running"]:
         return
     with _LOCK:
-        _EVENTS.append({"name": name, "cat": categories, "ph": "X",
-                        "ts": start_us if start_us is not None else time.time() * 1e6,
-                        "dur": dur_us or 0, "pid": 0, "tid": threading.get_ident()})
+        if len(_EVENTS) < _CONFIG.get("max_events", 500_000):
+            _EVENTS.append({"name": name, "cat": categories, "ph": "X",
+                            "ts": start_us if start_us is not None else time.time() * 1e6,
+                            "dur": dur_us or 0, "pid": 0, "tid": threading.get_ident()})
         agg = _AGG.setdefault(name, {"count": 0, "total_us": 0.0, "max_us": 0.0})
         agg["count"] += 1
         agg["total_us"] += dur_us or 0
@@ -100,6 +110,43 @@ class scope:
         scope._current.value = self._old
 
 
+def imperative_active():
+    """Fast check used by ndarray._apply (the eager dispatch choke point)."""
+    return _STATE["running"] and _CONFIG.get("profile_imperative", True)
+
+
+def record_op(name, t0_us, outs):
+    """Record one eager op: syncs outputs so duration covers device work.
+    Ops inside a jit trace (compiled-step build) are skipped — they are not
+    executions, and the device profile covers the compiled program."""
+    import jax
+    if any(isinstance(o, jax.core.Tracer) for o in outs):
+        return
+    try:
+        jax.block_until_ready([o for o in outs])
+    except Exception:
+        pass
+    prefix = getattr(scope._current, "value", "")
+    record_event("op:" + prefix + name, "operator", t0_us,
+                 time.time() * 1e6 - t0_us)
+
+
+def device_memory():
+    """Per-device memory stats (bytes_in_use/peak) via PJRT
+    (≙ the reference's memory profiler counters, profiler.h MemoryProfiler)."""
+    import jax
+    out = {}
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats() or {}
+        except Exception:
+            s = {}
+        out[str(d)] = {k: s[k] for k in
+                       ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                       if k in s}
+    return out
+
+
 def pause(profile_process="worker"):
     _STATE["running"] = False
 
@@ -109,12 +156,16 @@ def resume(profile_process="worker"):
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate stats table (ref aggregate_stats.cc)."""
-    lines = ["%-40s %8s %12s %12s" % ("Name", "Calls", "Total(us)", "Max(us)")]
+    """Aggregate stats table (ref aggregate_stats.cc), busiest first."""
+    lines = ["%-48s %8s %12s %10s %10s"
+             % ("Name", "Calls", "Total(us)", "Avg(us)", "Max(us)")]
     with _LOCK:
-        for name, agg in sorted(_AGG.items()):
-            lines.append("%-40s %8d %12.1f %12.1f"
-                         % (name[:40], agg["count"], agg["total_us"], agg["max_us"]))
+        order = sorted(_AGG.items(), key=lambda kv: -kv[1]["total_us"])
+        for name, agg in order:
+            lines.append("%-48s %8d %12.1f %10.1f %10.1f"
+                         % (name[:48], agg["count"], agg["total_us"],
+                            agg["total_us"] / max(agg["count"], 1),
+                            agg["max_us"]))
         if reset:
             _AGG.clear()
     return "\n".join(lines)

@@ -266,3 +266,18 @@ def test_model_zoo_small():
     net.initialize()
     out = net(nd.random.normal(shape=(1, 3, 32, 32)))
     assert out.shape == (1, 10)
+
+
+def test_dataloader_multiprocess_workers():
+    # spawned process workers (ref dataloader.py:27-131 mp+shm pipeline)
+    X = onp.arange(40, dtype="float32").reshape(20, 2)
+    y = onp.arange(20, dtype="float32")
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    dl = gluon.data.DataLoader(ds, batch_size=4, num_workers=2,
+                               thread_pool=False)
+    got = []
+    for xb, yb in dl:
+        assert xb.shape == (4, 2)
+        got.extend(yb.asnumpy().tolist())
+    assert sorted(got) == list(range(20))
+    assert sum(1 for _ in dl) == 5  # second epoch reuses the worker pool

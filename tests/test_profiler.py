@@ -1,0 +1,53 @@
+"""Profiler per-op instrumentation (ref python/mxnet/profiler.py tests +
+src/profiler/profiler.h per-op engine stats)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, profiler
+
+
+def test_per_op_instrumentation_and_aggregates():
+    profiler.set_state("run")
+    try:
+        x = nd.random.normal(shape=(32, 32))
+        y = (x * 2.0 + 1.0).sum()
+        y.wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "op:" in table and "Calls" in table
+    # ops recorded with nonzero durations
+    lines = [l for l in table.splitlines() if l.startswith("op:")]
+    assert len(lines) >= 2, table
+
+
+def test_ops_inside_jit_trace_not_recorded():
+    import jax
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    try:
+        def f(a):
+            return (nd.NDArray(a) * 3.0)._data
+        out = jax.jit(f)(nd.ones((4,))._data)
+        out.block_until_ready()
+    finally:
+        profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert not any(l.startswith("op:") for l in table.splitlines()), table
+
+
+def test_device_memory_stats():
+    mem = profiler.device_memory()
+    assert isinstance(mem, dict) and len(mem) >= 1
+
+
+def test_scope_prefixes_op_names():
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    try:
+        with profiler.scope("myphase:"):
+            (nd.ones((4,)) + 1.0).wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "op:myphase:" in table, table

@@ -1,0 +1,77 @@
+"""Eager dispatch overhead measurement (SURVEY §7 hard part (a): "eager perf
+without the async engine").
+
+Measures, on the current backend:
+  1. eager op dispatch rate (chained small adds, async — PJRT queues them)
+  2. the same chain fully synced per op (upper bound on per-op cost)
+  3. the same computation as ONE jitted program
+and prints one JSON line. The framework's answer to the reference's async
+dependency engine is visible in the numbers: eager dispatch is async (XLA
+queues work, Python runs ahead) and the HOT path (TrainStep) compiles the
+whole step so per-op overhead vanishes entirely.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    from incubator_mxnet_tpu import nd
+
+    n_ops = 200
+    x = nd.random.normal(shape=(256, 256))
+
+    # warmup (compile the add kernel)
+    y = x
+    for _ in range(4):
+        y = y + 1.0
+    y.wait_to_read()
+
+    # 1. async eager chain
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(n_ops):
+        y = y + 1.0
+    dispatch_s = time.perf_counter() - t0     # python+dispatch only
+    y.wait_to_read()
+    total_s = time.perf_counter() - t0
+
+    # 2. synced per op
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(n_ops):
+        y = y + 1.0
+        y.wait_to_read()
+    synced_s = time.perf_counter() - t0
+
+    # 3. one fused program
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fused(v):
+        for _ in range(n_ops):
+            v = v + 1.0
+        return v
+
+    fused(x._data).block_until_ready()
+    t0 = time.perf_counter()
+    fused(x._data).block_until_ready()
+    fused_s = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "eager_dispatch_overhead",
+        "backend": jax.devices()[0].platform,
+        "ops": n_ops,
+        "dispatch_us_per_op": round(dispatch_s / n_ops * 1e6, 2),
+        "async_total_us_per_op": round(total_s / n_ops * 1e6, 2),
+        "synced_us_per_op": round(synced_s / n_ops * 1e6, 2),
+        "fused_us_per_op": round(fused_s / n_ops * 1e6, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
