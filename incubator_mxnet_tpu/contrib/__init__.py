@@ -3,3 +3,4 @@ from . import amp  # noqa
 from . import quantization  # noqa
 from . import tensorboard  # noqa
 from . import onnx  # noqa
+from . import serving  # noqa
