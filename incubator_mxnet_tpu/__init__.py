@@ -18,15 +18,16 @@ __version__ = "0.1.0"
 # Multi-process (DCN) workers: jax.distributed must come up BEFORE anything
 # touches the XLA backend, and importing this package initialises it (device
 # queries in context/ndarray). tools/launch.py sets this env per worker.
-import os as _os
+# (config only touches os — safe this early.)
+from . import config as _config
 
-if int(_os.environ.get("MXTPU_NUM_PROC", "1")) > 1 and \
-        _os.environ.get("MXTPU_COORD_ADDR"):
+if _config.get_env("MXTPU_NUM_PROC") > 1 and \
+        _config.get_env("MXTPU_COORD_ADDR"):
     import jax as _jax
     if not _jax.distributed.is_initialized():  # user may have done it already
-        _jax.distributed.initialize(_os.environ["MXTPU_COORD_ADDR"],
-                                    int(_os.environ["MXTPU_NUM_PROC"]),
-                                    int(_os.environ.get("MXTPU_PROC_ID", "0")))
+        _jax.distributed.initialize(_config.get_env("MXTPU_COORD_ADDR"),
+                                    _config.get_env("MXTPU_NUM_PROC"),
+                                    _config.get_env("MXTPU_PROC_ID"))
 
 from . import base
 from .base import MXNetError
@@ -63,6 +64,7 @@ from . import monitor
 from .monitor import Monitor
 from . import operator
 from . import subgraph
+from . import config
 from . import engine
 from . import runtime
 from . import util

@@ -1,0 +1,63 @@
+"""Typed environment-variable registry (ref docs/faq/env_var.md and the
+dmlc::Parameter idiom — every knob declared, typed, and documented in ONE
+place instead of scattered os.environ reads).
+
+``describe()`` renders the registry (the env_var.md analog);
+``get_env(name)`` is the typed accessor every subsystem uses.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["ENV_VARS", "get_env", "describe"]
+
+ENV_VARS = {
+    # name: (type, default, doc)
+    "MXTPU_COORD_ADDR": (
+        str, None,
+        "jax.distributed coordinator host:port. Set by tools/launch.py; "
+        "initialises the runtime at package import (multi-host DCN)."),
+    "MXTPU_NUM_PROC": (
+        int, 1, "Number of distributed worker processes (tools/launch.py)."),
+    "MXTPU_PROC_ID": (
+        int, 0, "This worker's process id in [0, MXTPU_NUM_PROC)."),
+    "MXTPU_FLASH_INTERPRET": (
+        bool, False,
+        "Run the flash-attention Pallas kernels in interpret mode on CPU "
+        "(CI/testing; ops/attention.py)."),
+    "MXTPU_NO_NATIVE": (
+        bool, False,
+        "Disable the native C++ library even if it builds (forces the "
+        "pure-Python IO tiers)."),
+    "JAX_PLATFORMS": (
+        str, None,
+        "Backend selection (jax): 'cpu' forces the virtual-device CPU path "
+        "used by tests and DataLoader process workers."),
+    "XLA_FLAGS": (
+        str, None,
+        "XLA compiler flags; tests use "
+        "--xla_force_host_platform_device_count=8 for the virtual mesh."),
+}
+
+
+def get_env(name):
+    """Typed read of a registered variable (raises on unknown names)."""
+    if name not in ENV_VARS:
+        raise KeyError("unregistered env var %r — add it to config.ENV_VARS"
+                       % name)
+    typ, default, _doc = ENV_VARS[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.strip().lower() not in ("0", "", "false", "no", "off")
+    return typ(raw)
+
+
+def describe():
+    """Render the registry as the env_var.md-style table."""
+    lines = ["%-24s %-6s %-10s %s" % ("Variable", "Type", "Default", "Doc")]
+    for name, (typ, default, doc) in sorted(ENV_VARS.items()):
+        lines.append("%-24s %-6s %-10s %s"
+                     % (name, typ.__name__, str(default), doc))
+    return "\n".join(lines)

@@ -1,3 +1,4 @@
 """mx.io namespace (ref python/mxnet/io/__init__.py)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,  # noqa
-                 PrefetchingIter, ImageRecordIter, MNISTIter, CSVIter)
+                 PrefetchingIter, ImageRecordIter, MNISTIter, CSVIter,
+                 LibSVMIter, ImageDetRecordIter)

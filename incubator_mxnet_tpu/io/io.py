@@ -17,7 +17,8 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "ImageRecordIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "ImageRecordIter", "MNISTIter", "CSVIter",
+           "LibSVMIter", "ImageDetRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -282,7 +283,8 @@ class ImageRecordIter(DataIter):
                  label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
                  num_parts=1, part_index=0, preprocess_threads=4, round_batch=True,
-                 seed=0, path_imgidx=None, prefetch_buffer=2, resize=0, **kwargs):
+                 seed=0, path_imgidx=None, prefetch_buffer=2, resize=0,
+                 force_python=False, **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         from concurrent.futures import ThreadPoolExecutor
@@ -299,8 +301,8 @@ class ImageRecordIter(DataIter):
         self._pipe_batch = 0
         try:
             from ..native import lib as _native_lib
-            if _native_lib.available() and data_shape[0] == 3 and \
-                    _payload_is_jpeg(path_imgrec):
+            if not force_python and _native_lib.available() and \
+                    data_shape[0] == 3 and _payload_is_jpeg(path_imgrec):
                 self._native_pipe = _native_lib.NativeImagePipeline(
                     path_imgrec, batch_size, data_shape,
                     label_width=label_width, resize_short=resize,
@@ -311,7 +313,7 @@ class ImageRecordIter(DataIter):
                     part_index=part_index, num_parts=num_parts)
         except Exception:
             self._native_pipe = None
-        if self._native_pipe is None:
+        if self._native_pipe is None and not force_python:
             try:
                 from ..native import lib as _native_lib
                 if _native_lib.available():
@@ -355,6 +357,7 @@ class ImageRecordIter(DataIter):
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._rec_lock = threading.Lock()
         self._cursor = 0
         self._round = round_batch
         self.reset()
@@ -381,11 +384,13 @@ class ImageRecordIter(DataIter):
 
     def _read_record(self, i):
         from .. import recordio
-        if self._keys is not None:
-            raw = self._rec.read_idx(self._keys[i])
-        else:
-            self._rec.record.seek(self._offsets[i])
-            raw = self._rec.read()
+        # seek+read must be atomic: decode workers share ONE file handle
+        with self._rec_lock:
+            if self._keys is not None:
+                raw = self._rec.read_idx(self._keys[i])
+            else:
+                self._rec.record.seek(self._offsets[i])
+                raw = self._rec.read()
         header, img = recordio.unpack_img(raw, iscolor=1)
         return header, img
 
@@ -467,10 +472,14 @@ class ImageRecordIter(DataIter):
         self._cursor += self.batch_size
         results = list(self._pool.map(self._process, idxs))
         data = onp.stack([r[0] for r in results])
+        return DataBatch([nd.array(data)], [self._stack_labels(results)],
+                         pad=pad)
+
+    def _stack_labels(self, results):
         labels = onp.asarray([onp.ravel(r[1])[:self._label_width] if
                               onp.ndim(r[1]) else r[1] for r in results],
                              dtype="float32")
-        return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
+        return nd.array(labels)
 
 
 class MNISTIter(NDArrayIter):
@@ -516,3 +525,146 @@ class CSVIter(DataIter):
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+class LibSVMIter(DataIter):
+    """ref src/io/iter_libsvm.cc — sparse libsvm text ("label idx:val ...")
+    streamed as CSR batches.
+
+    Batches carry CSRNDArray data (ndarray/sparse.py); models consume them
+    via ``sparse.dot(csr, dense)`` or densify with ``tostype('default')``.
+    Feature indices are 0-based like the reference (use ``indexing_mode``
+    below for 1-based files).
+    """
+
+    def __init__(self, data_libsvm=None, data_shape=None, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 indexing_mode=0, **kwargs):
+        super().__init__(batch_size)
+        self._round = round_batch
+        if tuple(label_shape) != (1,):
+            raise NotImplementedError(
+                "LibSVMIter supports scalar labels (label_shape=(1,))")
+        from ..ndarray import sparse as _sp
+        self._sp = _sp
+        n_feat = data_shape[0] if isinstance(data_shape, (tuple, list)) \
+            else int(data_shape)
+        self._n_feat = n_feat
+        labels, rows = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = []
+                for tok in parts[1:]:
+                    k, v = tok.split(":")
+                    col = int(k) - indexing_mode
+                    if not 0 <= col < n_feat:
+                        raise ValueError(
+                            "libsvm feature index %s out of range [0, %d) — "
+                            "1-based files need indexing_mode=1" % (k, n_feat))
+                    row.append((col, float(v)))
+                rows.append(row)
+        if label_libsvm is not None:
+            labels = [float(l.split()[0]) for l in open(label_libsvm)
+                      if l.strip()]
+        self._rows = rows
+        self._labels = onp.asarray(labels, "float32")
+        self._cursor = 0
+        self._n = len(rows)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._n_feat))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= self._n:
+            raise StopIteration
+        idxs = []
+        for j in range(self.batch_size):
+            k = self._cursor + j
+            if k >= self._n:
+                # round_batch wraps to the head; otherwise repeat the tail
+                k = k % self._n if self._round else self._n - 1
+            idxs.append(k)
+        pad = max(0, self._cursor + self.batch_size - self._n)
+        self._cursor += self.batch_size
+        data, cols, indptr = [], [], [0]
+        for i in idxs:
+            for k, v in self._rows[i]:
+                cols.append(k)
+                data.append(v)
+            indptr.append(len(cols))
+        csr = self._sp.CSRNDArray(
+            onp.asarray(data, "float32"), onp.asarray(cols, "int32"),
+            onp.asarray(indptr, "int32"), (self.batch_size, self._n_feat))
+        label = nd.array(self._labels[idxs])
+        return DataBatch([csr], [label], pad=pad)
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """ref src/io/iter_image_det_recordio.cc — detection records: the extra
+    label section holds [header_width, obj_width, (id, xmin, ymin, xmax,
+    ymax) * n_obj] normalized boxes; labels are padded to
+    (batch, label_pad, obj_width) and boxes FLIP WITH the image when
+    rand_mirror fires.
+
+    Python-tier only (force_python — the native pipeline's fixed label_width
+    does not fit variable object counts); decode still rides the thread pool.
+    """
+
+    def __init__(self, label_pad_width=16, object_width=5, **kwargs):
+        self._label_pad = label_pad_width
+        self._obj_width = object_width
+        kwargs.setdefault("label_width", label_pad_width * object_width)
+        kwargs["force_python"] = True
+        super().__init__(**kwargs)
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label", (self.batch_size, self._label_pad,
+                                   self._obj_width))]
+
+    def _augment(self, header, img):
+        ow = self._obj_width
+        lab = onp.asarray(header.label, "float32").ravel()
+        if lab.size >= 2 and lab.size > ow:
+            hw, obj_w = int(lab[0]), int(lab[1])
+            objs = lab[hw:]
+            objs = objs[: (objs.size // obj_w) * obj_w].reshape(-1, obj_w)
+            objs = objs.copy()  # header label views can be read-only
+        else:
+            objs = onp.zeros((0, ow), "float32")
+        mirrored = self._rand_mirror and self._rng.rand() < 0.5
+        c, h, w = self._data_shape
+        ih, iw = img.shape[:2]
+        if ih != h or iw != w:
+            from PIL import Image
+            img = onp.asarray(Image.fromarray(img).resize((w, h)))
+        if img.ndim == 2:
+            img = onp.stack([img] * 3, axis=-1)
+        if mirrored:
+            img = img[:, ::-1]
+            if len(objs):
+                x1 = objs[:, 1].copy()
+                objs[:, 1] = 1.0 - objs[:, 3]
+                objs[:, 3] = 1.0 - x1
+        chw = img.transpose(2, 0, 1).astype("float32")
+        chw = (chw - self._mean) / self._std
+        padded = -onp.ones((self._label_pad, ow), "float32")
+        n = min(len(objs), self._label_pad)
+        if n:
+            padded[:n, : objs.shape[1]] = objs[:n, :ow]
+        return chw, padded
+
+    def _stack_labels(self, results):
+        return nd.array(onp.stack([r[1] for r in results]))

@@ -90,6 +90,9 @@ def _load():
 
 
 def available():
+    from ..config import get_env
+    if get_env("MXTPU_NO_NATIVE"):
+        return False
     lib = _load()
     return bool(lib)
 

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +25,8 @@ __all__ = ["flash_attention", "flash_attention_supported"]
 
 
 def _interpret():
-    return os.environ.get("MXTPU_FLASH_INTERPRET", "0") == "1"
+    from ..config import get_env
+    return get_env("MXTPU_FLASH_INTERPRET")
 
 
 def _blocked_reference(q, k, v, causal, scale):

@@ -27,10 +27,10 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
     if jax.distributed.is_initialized():  # already up (package import)
         _STATE["initialized"] = True
         return
-    coordinator_address = coordinator_address or os.environ.get("MXTPU_COORD_ADDR")
-    num_processes = num_processes or int(os.environ.get("MXTPU_NUM_PROC", "1"))
-    process_id = process_id if process_id is not None else int(
-        os.environ.get("MXTPU_PROC_ID", "0"))
+    from ..config import get_env
+    coordinator_address = coordinator_address or get_env("MXTPU_COORD_ADDR")
+    num_processes = num_processes or get_env("MXTPU_NUM_PROC")
+    process_id = process_id if process_id is not None else get_env("MXTPU_PROC_ID")
     if num_processes > 1 and coordinator_address:
         jax.distributed.initialize(coordinator_address, num_processes, process_id)
     _STATE["initialized"] = True

@@ -1,0 +1,98 @@
+"""LibSVMIter + ImageDetRecordIter (ref src/io/iter_libsvm.cc,
+iter_image_det_recordio.cc)."""
+import io as pyio
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, recordio
+from incubator_mxnet_tpu.ndarray import sparse
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "train.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n"
+                 "0 1:1.0\n"
+                 "1 0:0.5 2:3.0 3:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b1 = it.next()
+    csr = b1.data[0]
+    assert csr.stype == "csr"
+    dense = csr.asnumpy()
+    assert_almost_equal(dense[0], [1.5, 0, 0, 2.0])
+    assert_almost_equal(dense[1], [0, 1.0, 0, 0])
+    assert b1.label[0].asnumpy().tolist() == [1.0, 0.0]
+    b2 = it.next()
+    assert b2.pad == 1  # wrapped
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+    # the CSR batch feeds sparse.dot (the linear-model path)
+    w = nd.array(onp.ones((4, 1), "float32"))
+    out = sparse.dot(csr, w)
+    assert_almost_equal(out.asnumpy()[:, 0], [3.5, 1.0])
+
+
+def _write_det_rec(path, n=6):
+    from PIL import Image
+    w = recordio.MXRecordIO(str(path), "w")
+    rng = onp.random.RandomState(0)
+    for i in range(n):
+        img = Image.fromarray(rng.randint(0, 255, (32, 32, 3), dtype=onp.uint8))
+        buf = pyio.BytesIO()
+        img.save(buf, format="JPEG")
+        # det label: [header_width=2, obj_width=5, id,x1,y1,x2,y2 per object]
+        objs = [[float(i % 3), 0.1, 0.2, 0.6, 0.8],
+                [float((i + 1) % 3), 0.3, 0.3, 0.9, 0.9]][: 1 + i % 2]
+        label = onp.asarray([2, 5] + [v for o in objs for v in o], "float32")
+        hdr = recordio.IRHeader(len(label), label, i, 0)
+        w.write(recordio.pack(hdr, buf.getvalue()))
+    w.close()
+
+
+def test_image_det_record_iter(tmp_path):
+    rec = tmp_path / "det.rec"
+    _write_det_rec(rec)
+    it = mx.io.ImageDetRecordIter(path_imgrec=str(rec), data_shape=(3, 16, 16),
+                                  batch_size=3, label_pad_width=4,
+                                  shuffle=False)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 16, 16)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (3, 4, 5)
+    # record 0 has one object with known coords
+    assert_almost_equal(lab[0, 0], [0.0, 0.1, 0.2, 0.6, 0.8],
+                        rtol=1e-5, atol=1e-6)
+    assert (lab[0, 1] == -1).all()  # padding rows
+    # record 1 has two objects
+    assert (lab[1, 1] != -1).any()
+
+
+def test_image_det_record_iter_mirror_flips_boxes(tmp_path):
+    rec = tmp_path / "det.rec"
+    _write_det_rec(rec)
+    it = mx.io.ImageDetRecordIter(path_imgrec=str(rec), data_shape=(3, 16, 16),
+                                  batch_size=6, label_pad_width=4,
+                                  rand_mirror=True, seed=3, shuffle=False)
+    lab = it.next().label[0].asnumpy()
+    valid = lab[lab[..., 0] >= 0]
+    # mirrored boxes stay normalized and ordered
+    assert (valid[:, 1] <= valid[:, 3]).all()
+    assert (valid[:, 1] >= 0).all() and (valid[:, 3] <= 1).all()
+
+
+def test_libsvm_index_validation(tmp_path):
+    p = tmp_path / "bad.libsvm"
+    p.write_text("1 4:2.0\n")  # out of range for 4 features (0-based)
+    with pytest.raises(ValueError):
+        mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=1)
+    # 1-based file parses with indexing_mode=1
+    p2 = tmp_path / "one.libsvm"
+    p2.write_text("1 1:2.0 4:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p2), data_shape=(4,), batch_size=1,
+                          indexing_mode=1)
+    assert_almost_equal(it.next().data[0].asnumpy()[0], [2.0, 0, 0, 1.0])

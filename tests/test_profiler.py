@@ -51,3 +51,14 @@ def test_scope_prefixes_op_names():
         profiler.set_state("stop")
     table = profiler.dumps(reset=True)
     assert "op:myphase:" in table, table
+
+
+def test_env_registry():
+    from incubator_mxnet_tpu import config
+    assert config.get_env("MXTPU_NUM_PROC") >= 1
+    assert config.get_env("MXTPU_FLASH_INTERPRET") in (True, False)
+    table = config.describe()
+    assert "MXTPU_COORD_ADDR" in table and "Doc" in table
+    import pytest
+    with pytest.raises(KeyError):
+        config.get_env("NOT_A_VAR")
