@@ -568,8 +568,12 @@ class LibSVMIter(DataIter):
                     row.append((col, float(v)))
                 rows.append(row)
         if label_libsvm is not None:
-            labels = [float(l.split()[0]) for l in open(label_libsvm)
-                      if l.strip()]
+            with open(label_libsvm) as lf:
+                labels = [float(l.split()[0]) for l in lf if l.strip()]
+            if len(labels) != len(rows):
+                raise ValueError(
+                    "label file has %d rows but data file has %d"
+                    % (len(labels), len(rows)))
         self._rows = rows
         self._labels = onp.asarray(labels, "float32")
         self._cursor = 0

@@ -6,7 +6,6 @@ set by tools/launch.py (MXTPU_COORD_ADDR / MXTPU_NUM_PROC / MXTPU_PROC_ID).
 """
 from __future__ import annotations
 
-import os
 
 import jax
 

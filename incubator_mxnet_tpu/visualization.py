@@ -3,12 +3,51 @@ from __future__ import annotations
 
 import json
 
+import numpy as onp
+
 __all__ = ["print_summary", "plot_network"]
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """ref visualization.py print_summary — layer table of a Symbol graph."""
+def _node_shapes(symbol, shape):
+    """Output shape per internal node + per-arg shapes, via one eval_shape."""
+    import jax
+    from .ndarray import NDArray
+
+    # auto-created label vars (SoftmaxOutput etc.) have no deferred shape
+    # rule — default them to (batch,); grad_req='null' skips grad buffers
+    binds = dict(shape)
+    batch = next(iter(shape.values()))[0]
+    for v in symbol.get_internals():
+        if v.is_var and getattr(v, "_is_label", False) and v.name not in binds:
+            binds[v.name] = (batch,)
+    ex = symbol.simple_bind(grad_req="null", **binds)
+    arg_shapes = {k: tuple(v.shape) for k, v in ex.arg_dict.items()}
+    internals = [s for s in symbol.get_internals() if not s.is_var]
+
+    def fn(binds):
+        b = {k: NDArray(v) for k, v in binds.items()}
+        cache = {}
+        outs = []
+        for s in internals:
+            o = s.eval_imperative(b, _cache=cache)
+            outs.append(o[0]._data if isinstance(o, (list, tuple)) else o._data)
+        return outs
+
+    binds = {k: jax.ShapeDtypeStruct(v, onp.float32)
+             for k, v in arg_shapes.items()}
+    outs = jax.eval_shape(fn, binds)
+    return arg_shapes, {s.name: tuple(o.shape)
+                        for s, o in zip(internals, outs)}
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """ref visualization.py print_summary — layer table with output shapes
+    and parameter counts (needs ``shape={'data': (...), ...}``)."""
     nodes = json.loads(symbol.tojson())["nodes"]
+    arg_shapes, out_shapes = ({}, {})
+    if shape:
+        arg_shapes, out_shapes = _node_shapes(symbol, shape)
     fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
     positions = [int(line_length * p) for p in positions]
 
@@ -23,12 +62,22 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
     print("_" * line_length)
     print_row(fields)
     print("=" * line_length)
+    total = 0
+    data_names = set(shape or ())
     for node in nodes:
         if node["op"] == "null":
             continue
-        prev = ", ".join(nodes[i[0]]["name"] for i in node["inputs"])
-        print_row(["%s (%s)" % (node["name"], node["op"]), "", "", prev])
+        ins = [nodes[i[0]] for i in node["inputs"]]
+        prev = ", ".join(n["name"] for n in ins)
+        n_params = sum(int(onp.prod(arg_shapes[n["name"]])) for n in ins
+                       if n["op"] == "null" and n["name"] in arg_shapes
+                       and n["name"] not in data_names)
+        total += n_params
+        print_row(["%s (%s)" % (node["name"], node["op"]),
+                   str(out_shapes.get(node["name"], "")), str(n_params), prev])
     print("=" * line_length)
+    print("Total params: %d" % total)
+    return total
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None, dtype=None,

@@ -103,3 +103,15 @@ def test_subgraph_partition_respects_external_consumers():
     binds = {k: nd.array(rng.randn(3, 3).astype("float32")) for k in ("x", "w")}
     assert_almost_equal(out.eval(**binds)[0].asnumpy(),
                         part.eval(**binds)[0].asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_print_summary_params_and_shapes(capsys):
+    sym = mx.sym
+    d = sym.var("data")
+    c = sym.Convolution(d, kernel=(3, 3), num_filter=8, name="c1")
+    out = sym.FullyConnected(sym.flatten(c), num_hidden=10, flatten=False,
+                             name="fc")
+    total = mx.viz.print_summary(out, shape={"data": (2, 3, 8, 8)})
+    txt = capsys.readouterr().out
+    assert total == (8 * 3 * 3 * 3 + 8) + (10 * 288 + 10)
+    assert "(2, 8, 6, 6)" in txt and "Total params" in txt
