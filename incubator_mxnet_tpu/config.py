@@ -25,6 +25,11 @@ ENV_VARS = {
         bool, False,
         "Run the flash-attention Pallas kernels in interpret mode on CPU "
         "(CI/testing; ops/attention.py)."),
+    "MXTPU_FLASH_FORCE": (
+        bool, False,
+        "Use the flash-attention kernels for every LEGAL shape, overriding "
+        "the narrow-head (D<128) short-S profitability heuristic — opt in "
+        "when the composite's B*H*S^2 score memory nears OOM."),
     "MXTPU_NO_NATIVE": (
         bool, False,
         "Disable the native C++ library even if it builds (forces the "

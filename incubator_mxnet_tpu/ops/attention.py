@@ -21,7 +21,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "flash_attention_supported"]
+__all__ = ["flash_attention", "flash_attention_supported",
+           "flash_attention_legal"]
 
 
 def _interpret():
@@ -40,7 +41,10 @@ def _blocked_reference(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def flash_attention_supported(q_shape, block_q=128, block_k=128):
+def flash_attention_legal(q_shape, block_q=128, block_k=128):
+    """Capability: the kernels can run this shape. D rides each BlockSpec as
+    the FULL last dim (legal for any size when equal to the array dim);
+    8-alignment keeps sublanes packed."""
     B, H, S, D = q_shape
     try:
         import jax.experimental.pallas  # noqa
@@ -50,7 +54,24 @@ def flash_attention_supported(q_shape, block_q=128, block_k=128):
         plat = jax.devices()[0].platform
         if plat not in ("tpu", "axon"):
             return False
-    return S % block_q == 0 and S % block_k == 0 and D % 128 == 0
+    return S % block_q == 0 and S % block_k == 0 and D % 8 == 0
+
+
+def flash_attention_supported(q_shape, block_q=128, block_k=128):
+    """Legality AND profitability: D=64-style narrow heads leave MXU lanes
+    half-empty, so the kernel only engages once S is long enough that the
+    composite's (S,S) materialization hits HBM pressure (v5e, H=16: parity
+    at 4k, 6.3x faster at 8k — and the composite's score memory scales with
+    B*H*S^2, so real batches hit the cliff earlier). Set MXTPU_FLASH_FORCE=1
+    to override the heuristic (e.g. large B*H at moderate S nearing OOM);
+    interpret mode ignores it so CI exercises every legal shape."""
+    if not flash_attention_legal(q_shape, block_q, block_k):
+        return False
+    B, H, S, D = q_shape
+    if D % 128 != 0 and S < 4096 and not _interpret():
+        from ..config import get_env
+        return get_env("MXTPU_FLASH_FORCE")
+    return True
 
 
 # --------------------------------------------------------------- forward

@@ -22,6 +22,28 @@ def _rand_qkv(B=1, H=2, S=256, D=128, seed=0):
                  for _ in range(3))
 
 
+@pytest.mark.parametrize("D", [64, 128])
+def test_flash_head_dims(D):
+    # standard head dims (BERT/GPT use 64) ride the kernels too; in
+    # interpret mode the profitability heuristic is bypassed, so this
+    # EXERCISES the kernels at D=64 (on hardware, narrow heads engage at
+    # long S or under MXTPU_FLASH_FORCE)
+    from incubator_mxnet_tpu.ops import attention as A
+    q, k, v = _rand_qkv(D=D)
+    assert A.flash_attention_legal(q.shape)
+    assert A.flash_attention_supported(q.shape)  # interpret mode: kernel runs
+    out = A.flash_attention(q, k, v, True)
+    ref = A._blocked_reference(q, k, v, True, 1.0 / onp.sqrt(D))
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-4
+    g = jax.grad(lambda a, b, c: jnp.sum(A.flash_attention(a, b, c, True)),
+                 (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        A._blocked_reference(a, b, c, True, 1.0 / onp.sqrt(D))),
+        (0, 1, 2))(q, k, v)
+    for x, y in zip(g, gr):
+        assert float(jnp.max(jnp.abs(x - y)) / jnp.max(jnp.abs(y))) < 1e-3
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_forward_matches_composite(causal):
     from incubator_mxnet_tpu.ops import attention as A
