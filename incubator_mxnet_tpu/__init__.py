@@ -51,7 +51,7 @@ from . import parallel
 from . import recordio
 from . import io
 from . import model
-from .model import save_checkpoint, load_checkpoint
+from .model import save_checkpoint, load_checkpoint, FeedForward
 from . import symbol
 from . import symbol as sym
 from .executor import Executor
@@ -65,6 +65,8 @@ from .monitor import Monitor
 from . import operator
 from . import subgraph
 from . import config
+from . import error
+from . import registry
 from . import engine
 from . import runtime
 from . import util

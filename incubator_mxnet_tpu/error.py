@@ -1,0 +1,55 @@
+"""Typed exceptions (ref python/mxnet/error.py).
+
+The reference maps C++ error prefixes onto Python exception types via
+register_error; here errors originate in Python/JAX, so the hierarchy exists
+for API parity and for user code that catches the typed classes.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "ValueError", "TypeError",
+           "IndexError", "NotImplementedForSymbol", "register"]
+
+_ERROR_REGISTRY = {}
+
+
+def register(name_or_cls):
+    """ref error.py register — map an error-prefix name to a class."""
+    def do_register(cls, name):
+        _ERROR_REGISTRY[name] = cls
+        return cls
+    if isinstance(name_or_cls, str):
+        return lambda cls: do_register(cls, name_or_cls)
+    return do_register(name_or_cls, name_or_cls.__name__)
+
+
+@register
+class InternalError(MXNetError):
+    """Framework-internal invariant violation (ref error.py InternalError)."""
+
+
+@register
+class ValueError(MXNetError, ValueError):  # noqa: A001 — ref shadows builtins
+    pass
+
+
+@register
+class TypeError(MXNetError, TypeError):  # noqa: A001
+    pass
+
+
+@register
+class IndexError(MXNetError, IndexError):  # noqa: A001
+    pass
+
+
+class NotImplementedForSymbol(MXNetError):
+    """ref base.py NotImplementedForSymbol — nd-only op called on a Symbol."""
+
+    def __init__(self, function, alias=None, *args):
+        super().__init__()
+        self.function = function.__name__ if callable(function) else function
+
+    def __str__(self):
+        return "Function %s is not implemented for Symbol." % self.function

@@ -72,10 +72,18 @@ class Executor:
                 return cache[key]
             if s.is_var:
                 if s.name not in self.arg_dict:
-                    if getattr(s, "_is_label", False):
-                        # labels default to zeros of batch size (filled at fit)
+                    # name-suffix heuristic covers JSON-reloaded graphs whose
+                    # vars lost the _is_label attr (same rule as param_names)
+                    if getattr(s, "_is_label", False) or \
+                            s.name.endswith("_label"):
+                        # inference binds (for_training=False) omit label
+                        # shapes: default to zeros of (batch,) — loss-layer
+                        # forwards ignore labels outside training
+                        batch = next(a.shape[0]
+                                     for a in self.arg_dict.values())
+                        self.arg_dict[s.name] = nd.zeros((batch,))
+                    else:
                         raise ValueError("unbound variable %r" % s.name)
-                    raise ValueError("unbound variable %r" % s.name)
                 cache[key] = self.arg_dict[s.name]
                 return cache[key]
             if base_key not in cache:

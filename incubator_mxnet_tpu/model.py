@@ -52,3 +52,113 @@ def load_checkpoint(prefix, epoch):
         symbol = sym_load(sym_file)
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy training API (ref python/mxnet/model.py:403 FeedForward) —
+    a thin veneer over Module, kept for reference-era scripts; new code
+    should use Module or Gluon."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self._opt_kwargs = {k: v for k, v in kwargs.items()
+                            if k in ("learning_rate", "momentum", "wd",
+                                     "clip_gradient", "rescale_grad")}
+        self._module = None
+
+    def _mod(self):
+        from .module import Module
+        if self._module is None:
+            self._module = Module(self.symbol, context=self.ctx)
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """ref model.py FeedForward.fit."""
+        from . import io as mx_io
+        if not hasattr(X, "provide_data"):
+            X = mx_io.NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                                  shuffle=True)
+        mod = self._mod()
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer, optimizer_params=self._opt_kwargs,
+                initializer=self.initializer, arg_params=self.arg_params,
+                aux_params=self.aux_params, begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch, monitor=monitor,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def _ensure_ready(self, data_iter):
+        """Bind+install params for inference (the load() -> predict() path —
+        ref FeedForward._init_predictor)."""
+        mod = self._mod()
+        if not mod.binded:
+            mod.bind(data_shapes=data_iter.provide_data,
+                     label_shapes=None, for_training=False)
+        if not mod.params_initialized:
+            if self.arg_params is None:
+                raise ValueError("FeedForward has no parameters: call fit() "
+                                 "or construct with arg_params")
+            mod.set_params(self.arg_params, self.aux_params or {})
+        return mod
+
+    def predict(self, X, num_batch=None):
+        """ref model.py FeedForward.predict (multi-output symbols return a
+        list, matching the reference)."""
+        from . import io as mx_io
+        import numpy as onp
+
+        def to_np(o):
+            return o.asnumpy() if hasattr(o, "asnumpy") else onp.asarray(o)
+
+        if not hasattr(X, "provide_data"):
+            X = mx_io.NDArrayIter(X, None, batch_size=self.numpy_batch_size)
+        outs = self._ensure_ready(X).predict(X, num_batch=num_batch)
+        if isinstance(outs, (list, tuple)):
+            return to_np(outs[0]) if len(outs) == 1 else [to_np(o) for o in outs]
+        return to_np(outs)
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        from . import metric as metric_mod
+        m = metric_mod.create(eval_metric)
+        res = self._ensure_ready(X).score(X, m, num_batch=num_batch)
+        return dict(res)[m.name] if res else None
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None else
+                        (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        from .symbol import load as sym_load
+        sym = sym_load("%s-symbol.json" % prefix)
+        arg, aux = load_params(prefix, epoch)
+        return FeedForward(sym, ctx=ctx, arg_params=arg, aux_params=aux,
+                           begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, optimizer="sgd",
+               initializer=None, **kwargs):
+        """ref model.py FeedForward.create — construct + fit."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            optimizer=optimizer, initializer=initializer,
+                            **kwargs)
+        return model.fit(X, y)

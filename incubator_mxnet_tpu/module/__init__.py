@@ -2,3 +2,4 @@
 from .base_module import BaseModule  # noqa
 from .module import Module  # noqa
 from .bucketing_module import BucketingModule  # noqa
+from .sequential_module import SequentialModule  # noqa

@@ -80,6 +80,28 @@ class Module(BaseModule):
             self.init_params(arg_params=arg_params, aux_params=aux_params)
 
     @property
+    def data_names(self):
+        return list(self._data_names)
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def output_names(self):
+        return self.symbol.list_outputs()
+
+    @property
+    def output_shapes(self):
+        """(name, shape) of current outputs (ref module.py output_shapes);
+        populated once the executor has run (bind zero-materializes)."""
+        outs = getattr(self._exec, "outputs", None) if self._exec else None
+        if not outs:
+            return None
+        return list(zip(self.symbol.list_outputs(),
+                        [tuple(o.shape) for o in outs]))
+
+    @property
     def param_names(self):
         return [n for n in self._exec.arg_dict
                 if n not in self._data_names and n not in self._label_names

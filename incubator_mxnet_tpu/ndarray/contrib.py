@@ -258,3 +258,90 @@ def fft(data, compute_size=None):
 def ifft(data, compute_size=None):
     from ..ops import detection
     return detection.ifft(data, compute_size)
+
+
+# ---------------------------------------------------------------- misc
+# (ref src/operator/contrib/: adaptive_avg_pooling, boolean_mask,
+#  index_copy, gradient multiplier, quadratic, allclose, arange_like)
+def AdaptiveAvgPooling2D(data, output_size=1):
+    """ref contrib/adaptive_avg_pooling.cc — NCHW adaptive average pool."""
+    import jax.numpy as jnp
+    from .ndarray import _apply
+    osz = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def fn(x):
+        B, C, H, W = x.shape
+        oh, ow = osz
+        # reference bin edges: start=floor(i*H/oh), end=ceil((i+1)*H/oh)
+        # (bins OVERLAP when H % oh != 0)
+        rows = [jnp.mean(x[:, :, (i * H) // oh: -(-((i + 1) * H) // oh), :],
+                         axis=2, keepdims=True) for i in range(oh)]
+        xr = jnp.concatenate(rows, axis=2)
+        cols = [jnp.mean(xr[:, :, :, (j * W) // ow: -(-((j + 1) * W) // ow)],
+                         axis=3, keepdims=True) for j in range(ow)]
+        return jnp.concatenate(cols, axis=3)
+
+    return _apply(fn, data)
+
+
+def boolean_mask(data, index, axis=0):
+    """ref contrib/boolean_mask.cc — dynamic-shape op, eager only."""
+    import numpy as onp
+    from .ndarray import NDArray
+    mask = onp.asarray(index._data if isinstance(index, NDArray) else index
+                       ).astype(bool)
+    arr = onp.asarray(data._data)
+    from . import array as _array
+    return _array(onp.compress(mask, arr, axis=axis))
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """ref contrib/index_copy.cc — rows of new_tensor written at index_vector."""
+    from .ndarray import _apply
+
+    def fn(old, idx, new):
+        return old.at[idx.astype("int32")].set(new)
+
+    return _apply(fn, old_tensor, index_vector, new_tensor)
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """ref contrib/gradient_multiplier_op.cc — identity fwd, scaled grad."""
+    import jax
+    from .ndarray import _apply
+
+    @jax.custom_vjp
+    def gm(x):
+        return x
+
+    gm.defvjp(lambda x: (x, None), lambda _, g: (g * scalar,))
+    return _apply(gm, data)
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """ref contrib/quadratic_op.cc — a*x^2 + b*x + c (the tutorial op)."""
+    from .ndarray import _apply
+    return _apply(lambda x: a * x * x + b * x + c, data)
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    """ref contrib/allclose_op.cc — scalar 1/0 output."""
+    import jax.numpy as jnp
+    from .ndarray import _apply
+    return _apply(lambda x, y: jnp.allclose(x, y, rtol, atol, equal_nan)
+                  .astype(jnp.float32), a, b)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    """ref contrib/arange_like — arange shaped like data (or its axis)."""
+    import jax.numpy as jnp
+    from .ndarray import _apply
+
+    def fn(x):
+        if axis is None:
+            n = x.size
+            return (start + step * jnp.arange(n)).reshape(x.shape)
+        return start + step * jnp.arange(x.shape[axis])
+
+    return _apply(fn, data)

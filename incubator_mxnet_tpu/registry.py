@@ -1,0 +1,66 @@
+"""Generic object registry (ref python/mxnet/registry.py get/alias/create).
+
+The reference generates register()/alias()/create() function triples for
+optimizers, initializers, metrics, ...; the same factory lives here so
+subsystems (and user libraries) share one idiom.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    """ref registry.py get_register_func."""
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "%s must subclass %s" % (klass, base_class)
+        reg[(name or klass.__name__).lower()] = klass
+        return klass
+
+    register.__doc__ = "Register a %s" % nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """ref registry.py get_alias_func."""
+    reg = _registry(base_class, nickname)
+
+    def alias(name):
+        def do(klass):
+            reg[name.lower()] = klass
+            return klass
+        return do
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """ref registry.py get_create_func — create('name', **kw), create('{json}'),
+    or pass an instance through."""
+    reg = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        name = args[0]
+        args = args[1:]
+        if name.startswith("{"):
+            spec = json.loads(name)
+            name = spec.pop("__name__" if "__name__" in spec else "name")
+            kwargs = dict(spec, **kwargs)
+        if name.lower() not in reg:
+            raise ValueError("unknown %s %r (have: %s)"
+                             % (nickname, name, sorted(reg)))
+        return reg[name.lower()](*args, **kwargs)
+
+    return create

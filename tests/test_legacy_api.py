@@ -1,0 +1,152 @@
+"""Legacy API parity: FeedForward, SequentialModule, registry, error, misc
+contrib ops (ref python/mxnet/model.py:403, module/sequential_module.py,
+registry.py, error.py, src/operator/contrib/)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    d = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(d, num_hidden=16,
+                                                flatten=False), act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=4, flatten=False)
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def test_feedforward_fit_predict():
+    rng = onp.random.RandomState(0)
+    w = rng.randn(10, 4).astype("float32")
+    X = rng.randn(128, 10).astype("float32")
+    y = X.dot(w).argmax(1).astype("float32")
+    model = mx.FeedForward(_mlp(), num_epoch=8, optimizer="sgd",
+                           learning_rate=0.5, numpy_batch_size=32,
+                           initializer=mx.init.Xavier())
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert pred.shape == (128, 4)
+    assert (pred.argmax(1) == y).mean() > 0.8
+    assert model.arg_params  # captured after fit
+
+
+def test_sequential_module_forward_backward():
+    from incubator_mxnet_tpu.io import DataBatch
+    d1 = mx.sym.var("data")
+    feat = mx.sym.Activation(mx.sym.FullyConnected(
+        d1, num_hidden=8, flatten=False, name="f1"), act_type="relu")
+    d2 = mx.sym.var("data")
+    head = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        d2, num_hidden=3, flatten=False, name="f2"), name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, label_names=[]))
+    seq.add(mx.mod.Module(head), take_labels=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = onp.random.RandomState(1)
+    batch = DataBatch([nd.array(rng.randn(4, 6).astype("float32"))],
+                      [nd.array(rng.randint(0, 3, 4).astype("float32"))])
+    seq.forward(batch, is_train=True)
+    out = seq.get_outputs()[0]
+    assert out.shape == (4, 3)
+    seq.backward()
+    seq.update()
+    arg, _ = seq.get_params()
+    assert "f1_weight" in arg and "f2_weight" in arg
+
+
+def test_registry_roundtrip():
+    from incubator_mxnet_tpu import registry
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    reg = registry.get_register_func(Base, "thing")
+    alias = registry.get_alias_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @reg
+    @alias("short")
+    class MyThing(Base):
+        pass
+
+    assert isinstance(create("mything"), MyThing)
+    assert isinstance(create("short"), MyThing)
+    assert create('{"name": "mything", "x": 5}').x == 5
+    inst = MyThing()
+    assert create(inst) is inst
+    with pytest.raises(ValueError):
+        create("nope")
+
+
+def test_error_types():
+    from incubator_mxnet_tpu import error
+    assert issubclass(error.ValueError, mx.MXNetError)
+    with pytest.raises(mx.MXNetError):
+        raise error.InternalError("boom")
+    e = error.NotImplementedForSymbol(lambda: None)
+    assert "Symbol" in str(e)
+
+
+def test_contrib_misc_ops():
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=2)
+    assert out.shape == (1, 1, 2, 2)
+    assert abs(float(out.asnumpy()[0, 0, 0, 0]) - 2.5) < 1e-5
+
+    data = nd.array(onp.arange(6, dtype="float32").reshape(3, 2))
+    masked = mx.nd.contrib.boolean_mask(data, nd.array([1.0, 0.0, 1.0]))
+    assert masked.shape == (2, 2)
+
+    old = nd.zeros((4, 2))
+    updated = mx.nd.contrib.index_copy(old, nd.array([1, 3], dtype="int32"),
+                                       nd.array(onp.ones((2, 2), "float32")))
+    assert updated.asnumpy()[1].sum() == 2 and updated.asnumpy()[0].sum() == 0
+
+    q = mx.nd.contrib.quadratic(nd.array([2.0]), a=1.0, b=2.0, c=3.0)
+    assert float(q.asnumpy()) == 11.0
+
+    assert float(mx.nd.contrib.allclose(nd.ones((3,)),
+                                        nd.ones((3,))).asnumpy()) == 1.0
+
+    ar = mx.nd.contrib.arange_like(nd.zeros((2, 3)))
+    assert ar.shape == (2, 3) and float(ar.asnumpy()[1, 2]) == 5.0
+
+    # gradientmultiplier: identity forward, scaled backward
+    from incubator_mxnet_tpu import autograd
+    xg = nd.array([3.0])
+    xg.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.gradientmultiplier(xg, scalar=2.5)
+    y.backward()
+    assert float(xg.grad.asnumpy()) == 2.5
+
+
+def test_feedforward_load_predict(tmp_path):
+    rng = onp.random.RandomState(0)
+    X = rng.randn(32, 10).astype("float32")
+    y = rng.randint(0, 4, 32).astype("float32")
+    model = mx.FeedForward(_mlp(), num_epoch=1, numpy_batch_size=16,
+                           initializer=mx.init.Xavier())
+    model.fit(X, y)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, epoch=1)
+    loaded = mx.FeedForward.load(prefix, 1)
+    pred = loaded.predict(X)  # load -> predict with no fit
+    assert pred.shape == (32, 4)
+    assert_almost_equal(pred, model.predict(X), rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_pool_non_divisible():
+    # H=3 -> 2 bins must OVERLAP per the reference's floor/ceil edges
+    x = nd.array(onp.arange(9, dtype="float32").reshape(1, 1, 3, 3))
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=2)
+    # bin rows [0,2) and [1,3): out[0,0] = mean of x[0:2, 0:2]
+    assert abs(float(out.asnumpy()[0, 0, 0, 0]) - onp.arange(9).reshape(3, 3)[0:2, 0:2].mean()) < 1e-5
