@@ -22,7 +22,7 @@ from ..ndarray import NDArray, _apply
 
 class MultiHeadAttention(HybridBlock):
     def __init__(self, units, num_heads, dropout=0.0, attention="dense",
-                 sp_axis="sp", tp_axis=None, **kwargs):
+                 sp_axis="sp", tp_axis=None, causal=False, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
@@ -30,6 +30,7 @@ class MultiHeadAttention(HybridBlock):
         self._dropout = dropout
         self._attention = attention
         self._sp_axis = sp_axis
+        self._causal = causal
         with self.name_scope():
             self.query = nn.Dense(units, flatten=False, in_units=units)
             self.key = nn.Dense(units, flatten=False, in_units=units)
@@ -50,20 +51,29 @@ class MultiHeadAttention(HybridBlock):
         k = self.key(x).reshape((B, S, H, D)).transpose((0, 2, 1, 3))
         v = self.value(x).reshape((B, S, H, D)).transpose((0, 2, 1, 3))
 
+        causal = self._causal
         if self._attention == "ring":
             from ..parallel.ring_attention import ring_attention
             from ..parallel.mesh import current_mesh
             mesh = current_mesh()
             out = _apply(lambda qd, kd, vd: ring_attention(
-                qd, kd, vd, mesh=mesh, axis=self._sp_axis), q, k, v)
+                qd, kd, vd, mesh=mesh, axis=self._sp_axis, causal=causal),
+                q, k, v)
         elif self._attention == "flash":
             from ..ops.attention import flash_attention
-            out = _apply(lambda qd, kd, vd: flash_attention(qd, kd, vd, False),
+            out = _apply(lambda qd, kd, vd: flash_attention(qd, kd, vd, causal),
                          q, k, v)
         else:
             scale = 1.0 / math.sqrt(D)
             scores = nd.batch_dot(q.reshape((B * H, S, D)),
                                   k.reshape((B * H, S, D)), transpose_b=True) * scale
+            if causal:
+                def causal_mask(sc):
+                    import jax.numpy as jnp
+                    qi = jnp.arange(S)[:, None]
+                    ki = jnp.arange(S)[None, :]
+                    return jnp.where(qi >= ki, sc, -1e9)
+                scores = _apply(causal_mask, scores)
             if mask is not None:
                 scores = scores.reshape((B, H, S, S)) + (1.0 - mask) * -1e9
                 scores = scores.reshape((B * H, S, S))
