@@ -4,3 +4,4 @@ from . import quantization  # noqa
 from . import tensorboard  # noqa
 from . import onnx  # noqa
 from . import serving  # noqa
+from . import text  # noqa

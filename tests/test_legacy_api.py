@@ -150,3 +150,35 @@ def test_adaptive_pool_non_divisible():
     out = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=2)
     # bin rows [0,2) and [1,3): out[0,0] = mean of x[0:2, 0:2]
     assert abs(float(out.asnumpy()[0, 0, 0, 0]) - onp.arange(9).reshape(3, 3)[0:2, 0:2].mean()) < 1e-5
+
+
+def test_contrib_text_vocab_and_embedding(tmp_path):
+    from collections import Counter
+    from incubator_mxnet_tpu.contrib import text
+
+    c = text.count_tokens_from_str("the cat sat on the mat\nthe dog")
+    assert c["the"] == 3 and c["cat"] == 1
+
+    vocab = text.Vocabulary(c, min_freq=1, reserved_tokens=["<pad>"])
+    assert vocab.idx_to_token[0] == "<unk>" and vocab.idx_to_token[1] == "<pad>"
+    assert vocab.idx_to_token[2] == "the"  # most frequent first
+    assert vocab.to_indices("the") == 2
+    assert vocab.to_indices(["the", "zzz"]) == [2, 0]  # unknown -> 0
+    assert vocab.to_tokens(2) == "the"
+
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("cat 1.0 2.0\ndog 3.0 4.0\n")
+    emb = text.CustomEmbedding(file_path=str(emb_file), vocabulary=vocab)
+    assert emb.vec_len == 2
+    v = emb.get_vecs_by_tokens("cat").asnumpy()
+    assert_almost_equal(v, [1.0, 2.0])
+    assert emb.get_vecs_by_tokens("the").asnumpy().sum() == 0  # no vector
+    emb.update_token_vectors("the", nd.array([[9.0, 9.0]]))
+    assert_almost_equal(emb.get_vecs_by_tokens("the").asnumpy(), [9.0, 9.0])
+
+    # vocabulary built FROM the file
+    emb2 = text.create("customembedding", file_path=str(emb_file))
+    assert set(emb2.idx_to_token) >= {"<unk>", "cat", "dog"}
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        text.create("glove")
