@@ -5,3 +5,4 @@ from . import tensorboard  # noqa
 from . import onnx  # noqa
 from . import serving  # noqa
 from . import text  # noqa
+from . import svrg  # noqa
