@@ -1,18 +1,29 @@
 #!/usr/bin/env python
 """im2rec — build RecordIO image datasets (ref tools/im2rec.py / im2rec.cc).
 
-Usage: python tools/im2rec.py <prefix> <root> [--list] [--recursive]
-       python tools/im2rec.py <prefix> <root>          # pack from prefix.lst
-List file format (tab-separated): index \t label \t relative/path.jpg
+Usage:
+  python tools/im2rec.py <prefix> <root> --list [--recursive]
+        [--train-ratio R] [--test-ratio T] [--shuffle] [--exts .jpg .png]
+  python tools/im2rec.py <prefix> <root>           # pack from prefix.lst
+        [--resize N] [--quality Q] [--num-thread N] [--center-crop]
+        [--pack-label]
+
+List file format (tab-separated, ref im2rec.py):
+  index \t label [\t extra labels...] \t relative/path.jpg
+With --train-ratio/--test-ratio the list is split into prefix_train.lst /
+prefix_val.lst / prefix_test.lst like the reference tool.
 """
 import argparse
 import os
+import random
 import sys
+from concurrent.futures import ThreadPoolExecutor
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def make_list(prefix, root, recursive=True, exts=(".jpg", ".jpeg", ".png")):
+def make_list(prefix, root, recursive=True, exts=(".jpg", ".jpeg", ".png"),
+              train_ratio=1.0, test_ratio=0.0, shuffle=False, seed=0):
     entries = []
     if recursive:
         classes = sorted(d for d in os.listdir(root)
@@ -21,36 +32,83 @@ def make_list(prefix, root, recursive=True, exts=(".jpg", ".jpeg", ".png")):
         for c in classes:
             for dirpath, _, files in os.walk(os.path.join(root, c)):
                 for f in sorted(files):
-                    if f.lower().endswith(exts):
+                    if f.lower().endswith(tuple(exts)):
                         rel = os.path.relpath(os.path.join(dirpath, f), root)
                         entries.append((label_map[c], rel))
     else:
         for f in sorted(os.listdir(root)):
-            if f.lower().endswith(exts):
+            if f.lower().endswith(tuple(exts)):
                 entries.append((0, f))
-    with open(prefix + ".lst", "w") as out:
-        for i, (label, rel) in enumerate(entries):
-            out.write("%d\t%f\t%s\n" % (i, float(label), rel))
-    print("wrote %s.lst (%d entries)" % (prefix, len(entries)))
+    if shuffle:
+        random.Random(seed).shuffle(entries)
+
+    def write(name, chunk):
+        with open(name, "w") as out:
+            for i, (label, rel) in enumerate(chunk):
+                out.write("%d\t%f\t%s\n" % (i, float(label), rel))
+        print("wrote %s (%d entries)" % (name, len(chunk)))
+
+    n = len(entries)
+    n_train = int(n * train_ratio)
+    n_test = int(n * test_ratio)
+    if train_ratio < 1.0 or test_ratio > 0.0:
+        write(prefix + "_train.lst", entries[:n_train])
+        if n_test:
+            write(prefix + "_test.lst", entries[n_train:n_train + n_test])
+        if n_train + n_test < n:
+            write(prefix + "_val.lst", entries[n_train + n_test:])
+    else:
+        write(prefix + ".lst", entries)
 
 
-def pack(prefix, root, quality=95, resize=0):
-    from incubator_mxnet_tpu import recordio, image
-
-    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
-    n = 0
-    with open(prefix + ".lst") as f:
+def _read_list(lst_path):
+    with open(lst_path) as f:
         for line in f:
             parts = line.strip().split("\t")
             if len(parts) < 3:
                 continue
-            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
-            img = image.imread(os.path.join(root, rel))
-            if resize:
-                img = image.resize_short(img, resize)
-            header = recordio.IRHeader(0, label, idx, 0)
-            rec.write_idx(idx, recordio.pack_img(header, img.asnumpy(),
-                                                 quality=quality))
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0, num_thread=1, center_crop=False,
+         pack_label=False):
+    from incubator_mxnet_tpu import recordio, image
+
+    items = list(_read_list(prefix + ".lst"))
+
+    def encode(item):
+        idx, labels, rel = item
+        img = image.imread(os.path.join(root, rel))
+        if resize:
+            img = image.resize_short(img, resize)
+        if center_crop:
+            a = img.asnumpy()
+            h, w = a.shape[:2]
+            s = min(h, w)
+            y0, x0 = (h - s) // 2, (w - s) // 2
+            from incubator_mxnet_tpu import nd
+            img = nd.array(a[y0:y0 + s, x0:x0 + s])
+        if pack_label and len(labels) > 1:
+            import numpy as onp
+            header = recordio.IRHeader(len(labels),
+                                       onp.asarray(labels, "float32"), idx, 0)
+        else:
+            header = recordio.IRHeader(0, labels[0], idx, 0)
+        return idx, recordio.pack_img(header, img.asnumpy(), quality=quality)
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    if num_thread > 1:
+        # encode in parallel (PIL releases the GIL for JPEG work), write
+        # in list order for deterministic records — ref im2rec.py workers
+        with ThreadPoolExecutor(max_workers=num_thread) as pool:
+            for idx, payload in pool.map(encode, items):
+                rec.write_idx(idx, payload)
+                n += 1
+    else:
+        for item in items:
+            idx, payload = encode(item)
+            rec.write_idx(idx, payload)
             n += 1
     rec.close()
     print("packed %d records into %s.rec" % (n, prefix))
@@ -62,12 +120,22 @@ if __name__ == "__main__":
     ap.add_argument("root")
     ap.add_argument("--list", action="store_true")
     ap.add_argument("--recursive", action="store_true", default=True)
+    ap.add_argument("--exts", nargs="+", default=[".jpg", ".jpeg", ".png"])
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--test-ratio", type=float, default=0.0)
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--resize", type=int, default=0)
     ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--num-thread", type=int, default=1)
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--pack-label", action="store_true")
     args = ap.parse_args()
     if args.list:
-        make_list(args.prefix, args.root, args.recursive)
+        make_list(args.prefix, args.root, args.recursive, tuple(args.exts),
+                  args.train_ratio, args.test_ratio, args.shuffle, args.seed)
     else:
         if not os.path.exists(args.prefix + ".lst"):
-            make_list(args.prefix, args.root, args.recursive)
-        pack(args.prefix, args.root, args.quality, args.resize)
+            make_list(args.prefix, args.root, args.recursive, tuple(args.exts))
+        pack(args.prefix, args.root, args.quality, args.resize,
+             args.num_thread, args.center_crop, args.pack_label)
