@@ -155,3 +155,46 @@ def test_linalg_trian_roundtrip_and_grads():
         loss = (L * L).sum()
     loss.backward()
     assert float(nd.norm(A.grad).asnumpy()) > 0.1
+
+
+def test_roi_align_numeric_gradient():
+    from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+    rng = onp.random.RandomState(0)
+    data = rng.rand(1, 2, 6, 6).astype("float32")
+    rois = onp.array([[0, 0.5, 0.5, 5.0, 5.0]], "float32")
+
+    def fn(d):
+        return det.roi_align(d, nd.array(rois), (2, 2), spatial_scale=1.0)
+
+    check_numeric_gradient(fn, [data], rtol=2e-2, atol=2e-3)
+
+
+def test_bilinear_sampler_numeric_gradient():
+    from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+    rng = onp.random.RandomState(1)
+    data = rng.rand(1, 1, 5, 5).astype("float32")
+    # strictly interior, off-grid sample points (bilinear is non-smooth at
+    # integer pixel coords, which breaks finite differences)
+    grid = (rng.rand(1, 2, 4, 4) * 1.2 - 0.6).astype("float32") + 0.013
+
+    def fn(d):
+        return det.bilinear_sampler(d, nd.array(grid))
+
+    check_numeric_gradient(fn, [data], rtol=2e-2, atol=2e-3)
+
+    def fn_g(g):
+        return det.bilinear_sampler(nd.array(data), g)
+
+    check_numeric_gradient(fn_g, [grid], rtol=3e-2, atol=3e-3)
+
+
+def test_spatial_transformer_numeric_gradient_theta():
+    from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+    rng = onp.random.RandomState(2)
+    data = rng.rand(1, 1, 6, 6).astype("float32")
+    theta = onp.array([[0.9, 0.05, 0.013, -0.04, 0.87, 0.02]], "float32")
+
+    def fn(t):
+        return det.spatial_transformer(nd.array(data), t, target_shape=(6, 6))
+
+    check_numeric_gradient(fn, [theta], rtol=3e-2, atol=3e-3)
