@@ -33,10 +33,12 @@ def potrf(A, lower=True):
 
 
 def potri(A, lower=True):
+    """Inverse of the ORIGINAL matrix from its Cholesky factor (ref la_op.cc
+    potri): lower factor L means B = L L^T, upper factor U means B = U^T U."""
     def fn(a):
-        inv = jnp.linalg.inv(jnp.matmul(a, jnp.swapaxes(a, -1, -2)) if not lower
-                             else jnp.matmul(a, jnp.swapaxes(a, -1, -2)))
-        return inv
+        at = jnp.swapaxes(a, -1, -2)
+        b = jnp.matmul(a, at) if lower else jnp.matmul(at, a)
+        return jnp.linalg.inv(b)
     return _apply(fn, A)
 
 

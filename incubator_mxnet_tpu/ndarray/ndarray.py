@@ -163,7 +163,11 @@ class NDArray:
 
     # ------------------------------------------------------------- movement
     def copy(self):
-        return NDArray(jnp.array(self._data), ctx=self._ctx)
+        # identity through _apply: gradients flow through copies (the
+        # reference's _copy op is differentiable too)
+        out = _apply(lambda x: jnp.array(x), self)
+        out._ctx = self._ctx
+        return out
 
     def copyto(self, other):
         if isinstance(other, NDArray):

@@ -65,10 +65,16 @@ class ndarray(NDArray):
         return _apply_np(lambda x: x.astype(_np_dtype(dtype)), self)
 
     def copy(self):
-        return ndarray(jnp.array(self._data))
+        # through _apply so the autograd tape links the copy to its source
+        out = _apply_np(lambda x: jnp.array(x), self)
+        return out
 
     def as_nd_ndarray(self):
-        return NDArray(self._data)
+        """Classic-NDArray view that STAYS ON THE TAPE (an identity op —
+        constructing a bare NDArray here would silently cut gradients)."""
+        out = _nd_mod._apply(lambda x: x, self)
+        out.__class__ = NDArray
+        return out
 
     @property
     def T(self):

@@ -292,3 +292,124 @@ def test_moments_diag():
     assert_almost_equal(v, x.var(axis=0), rtol=1e-4, atol=1e-5)
     d = nd.diag(nd.array(x[:3, :3]))
     assert_almost_equal(d, onp.diag(x[:3, :3]))
+
+
+# ---------------------------------------------------------------- batch 2
+def test_la_op_family():
+    rng = onp.random.RandomState(0)
+    A = rng.randn(3, 3).astype("float32")
+    spd = A @ A.T + 3 * onp.eye(3, dtype="float32")
+    L = nd.linalg.potrf(nd.array(spd))
+    assert_almost_equal(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-4, atol=1e-4)
+    # potri takes the CHOLESKY FACTOR, returns inv of the original
+    inv = nd.linalg.potri(L)
+    assert_almost_equal(inv.asnumpy() @ spd, onp.eye(3), rtol=1e-3, atol=1e-3)
+    # trsm solves L x = alpha*b
+    b = rng.randn(3, 2).astype("float32")
+    x = nd.linalg.trsm(L, nd.array(b))
+    assert_almost_equal(L.asnumpy() @ x.asnumpy(), b, rtol=1e-4, atol=1e-4)
+    # syrk / gemm2
+    s = nd.linalg.syrk(nd.array(A), alpha=2.0)
+    assert_almost_equal(s.asnumpy(), 2 * A @ A.T, rtol=1e-4, atol=1e-4)
+    g = nd.linalg.gemm2(nd.array(A), nd.array(A), transpose_b=True)
+    assert_almost_equal(g.asnumpy(), A @ A.T, rtol=1e-4, atol=1e-4)
+    # sumlogdiag on the cholesky factor = 0.5*logdet
+    sld = nd.linalg.sumlogdiag(L)
+    assert abs(float(sld.asnumpy()) - 0.5 * onp.linalg.slogdet(spd)[1]) < 1e-3
+
+
+def test_einsum_gradients():
+    rng = onp.random.RandomState(1)
+    a = rng.rand(3, 4).astype("float32")
+    b = rng.rand(4, 5).astype("float32")
+    from incubator_mxnet_tpu import np as mnp
+
+    def fn(x, y):
+        return mnp.einsum("ij,jk->ik", x, y).as_nd_ndarray()
+
+    check_numeric_gradient(fn, [a, b], rtol=1e-2, atol=1e-3)
+
+
+def test_broadcast_edge_cases():
+    a = nd.ones((1, 3, 1))
+    b = nd.ones((4, 1, 5))
+    assert (a + b).shape == (4, 3, 5)
+    assert nd.broadcast_to(nd.ones((1, 3)), shape=(2, 3)).shape == (2, 3)
+    # degenerate axes in reductions
+    z = nd.zeros((0, 3))
+    assert nd.sum(z).asnumpy() == 0.0
+    assert nd.sum(nd.ones((2, 3)), axis=(), keepdims=True).shape in ((2, 3), (1, 1))
+
+
+def test_topk_ordering_and_pick():
+    x = nd.array(onp.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]]))
+    top = nd.topk(x, k=2, ret_typ="value")
+    assert_almost_equal(top.asnumpy(), [[3.0, 2.0], [5.0, 4.0]])
+    picked = nd.pick(x, nd.array([2.0, 1.0]))
+    assert_almost_equal(picked.asnumpy(), [2.0, 5.0])
+
+
+def test_sequence_ops_with_lengths():
+    x = nd.array(onp.arange(12, dtype="float32").reshape(3, 2, 2))  # (S,B,E)
+    lens = nd.array([2.0, 3.0])
+    masked = nd.SequenceMask(x, sequence_length=lens, use_sequence_length=True,
+                             value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2, 0] == -1).all() and (m[2, 1] != -1).all()
+    last = nd.SequenceLast(x, sequence_length=lens, use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x.asnumpy()[1, 0])
+    rev = nd.SequenceReverse(x, sequence_length=lens, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+
+
+def test_norm_and_l2_normalization_grad():
+    rng = onp.random.RandomState(2)
+    x = rng.rand(4, 5).astype("float32") + 0.1
+
+    def fn(a):
+        return nd.L2Normalization(a)
+
+    check_numeric_gradient(fn, [x], rtol=2e-2, atol=2e-3)
+
+
+def test_softmax_temperature_and_axis():
+    x = nd.array(onp.array([[1.0, 2.0, 3.0]]))
+    s1 = nd.softmax(x, temperature=2.0).asnumpy()
+    e = onp.exp(onp.array([0.5, 1.0, 1.5]))
+    assert_almost_equal(s1[0], e / e.sum(), rtol=1e-5, atol=1e-6)
+    x2 = nd.array(onp.random.RandomState(3).rand(2, 3, 4).astype("float32"))
+    s_ax = nd.softmax(x2, axis=1).asnumpy()
+    assert_almost_equal(s_ax.sum(1), onp.ones((2, 4)), rtol=1e-5, atol=1e-6)
+
+
+def test_clip_gradient_semantics():
+    x = onp.array([-2.0, 0.5, 3.0], "float32")
+
+    def fn(a):
+        return nd.clip(a, -1.0, 1.0)
+
+    check_numeric_gradient(fn, [x], rtol=1e-2, atol=1e-3)
+
+
+def test_take_and_gather_nd_grad():
+    rng = onp.random.RandomState(4)
+    w = rng.rand(6, 3).astype("float32")
+    idx = nd.array([0.0, 2.0, 2.0, 5.0])
+
+    def fn(a):
+        return nd.take(a, idx)
+
+    # duplicate indices must ACCUMULATE gradients (scatter-add semantics)
+    check_numeric_gradient(fn, [w], rtol=1e-2, atol=1e-3)
+
+
+def test_where_and_masking_grad():
+    rng = onp.random.RandomState(5)
+    a = rng.rand(3, 3).astype("float32")
+    b = rng.rand(3, 3).astype("float32")
+    cond = nd.array((onp.arange(9).reshape(3, 3) % 2).astype("float32"))
+
+    def fn(x, y):
+        return nd.where(cond, x, y)
+
+    check_numeric_gradient(fn, [a, b], rtol=1e-2, atol=1e-3)
