@@ -186,3 +186,128 @@ class transforms:
             if onp.random.rand() < 0.5:
                 x = x[:, ::-1, :] if not isinstance(x, NDArray) else nd.flip(x, 1)
             return (x,) + args if args else x
+
+    class RandomFlipTopBottom:
+        def __call__(self, x, *args):
+            if onp.random.rand() < 0.5:
+                x = x[::-1, :, :] if not isinstance(x, NDArray) else nd.flip(x, 0)
+            return (x,) + args if args else x
+
+    class CenterCrop:
+        """ref transforms.CenterCrop — HWC center window (pads if smaller)."""
+
+        def __init__(self, size):
+            self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+        def __call__(self, x, *args):
+            a = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            tw, th = self._size
+            h, w = a.shape[:2]
+            y0 = max(0, (h - th) // 2)
+            x0 = max(0, (w - tw) // 2)
+            out = a[y0:y0 + th, x0:x0 + tw]
+            if out.shape[0] < th or out.shape[1] < tw:
+                pad = onp.zeros((th, tw) + a.shape[2:], a.dtype)
+                pad[:out.shape[0], :out.shape[1]] = out
+                out = pad
+            out = nd.array(out)
+            return (out,) + args if args else out
+
+    class RandomCrop:
+        """ref transforms.RandomCrop — random HWC window (zero-pads edges)."""
+
+        def __init__(self, size, pad=0):
+            self._size = (size, size) if isinstance(size, int) else tuple(size)
+            self._pad = pad
+
+        def __call__(self, x, *args):
+            a = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            if self._pad:
+                p = self._pad
+                a = onp.pad(a, ((p, p), (p, p), (0, 0)))
+            tw, th = self._size
+            h, w = a.shape[:2]
+            y0 = onp.random.randint(0, max(1, h - th + 1))
+            x0 = onp.random.randint(0, max(1, w - tw + 1))
+            out = nd.array(a[y0:y0 + th, x0:x0 + tw])
+            return (out,) + args if args else out
+
+    class RandomResizedCrop:
+        """ref transforms.RandomResizedCrop — random area/ratio crop + resize."""
+
+        def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+            self._size = (size, size) if isinstance(size, int) else tuple(size)
+            self._scale = scale
+            self._ratio = ratio
+
+        def __call__(self, x, *args):
+            import jax.image
+            a = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            h, w = a.shape[:2]
+            for _ in range(10):
+                area = h * w * onp.random.uniform(*self._scale)
+                ar = onp.random.uniform(*self._ratio)
+                cw = int(round(onp.sqrt(area * ar)))
+                ch = int(round(onp.sqrt(area / ar)))
+                if cw <= w and ch <= h:
+                    y0 = onp.random.randint(0, h - ch + 1)
+                    x0 = onp.random.randint(0, w - cw + 1)
+                    a = a[y0:y0 + ch, x0:x0 + cw]
+                    break
+            tw, th = self._size
+            out = nd.NDArray(jax.image.resize(
+                a.astype("float32"), (th, tw) + a.shape[2:],
+                method="linear").astype(a.dtype))
+            return (out,) + args if args else out
+
+    class RandomBrightness:
+        def __init__(self, brightness):
+            self._b = brightness
+
+        def __call__(self, x, *args):
+            f = 1.0 + onp.random.uniform(-self._b, self._b)
+            out = x * f
+            return (out,) + args if args else out
+
+    class RandomContrast:
+        def __init__(self, contrast):
+            self._c = contrast
+
+        def __call__(self, x, *args):
+            f = 1.0 + onp.random.uniform(-self._c, self._c)
+            mean = float(nd.mean(_to_nd_img(x)).asnumpy())
+            out = _to_nd_img(x) * f + mean * (1.0 - f)
+            return (out,) + args if args else out
+
+    class RandomSaturation:
+        def __init__(self, saturation):
+            self._s = saturation
+
+        def __call__(self, x, *args):
+            f = 1.0 + onp.random.uniform(-self._s, self._s)
+            img = _to_nd_img(x)
+            gray = nd.mean(img, axis=-1, keepdims=True)
+            out = img * f + gray * (1.0 - f)
+            return (out,) + args if args else out
+
+    class RandomColorJitter:
+        """brightness/contrast/saturation jitter in random order."""
+
+        def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+            self._ts = []
+            if brightness:
+                self._ts.append(transforms.RandomBrightness(brightness))
+            if contrast:
+                self._ts.append(transforms.RandomContrast(contrast))
+            if saturation:
+                self._ts.append(transforms.RandomSaturation(saturation))
+
+        def __call__(self, x, *args):
+            order = onp.random.permutation(len(self._ts)) if self._ts else []
+            for i in order:
+                x = self._ts[i](x)
+            return (x,) + args if args else x
+
+
+def _to_nd_img(x):
+    return x if isinstance(x, NDArray) else nd.array(onp.asarray(x))

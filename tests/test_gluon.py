@@ -281,3 +281,21 @@ def test_dataloader_multiprocess_workers():
         got.extend(yb.asnumpy().tolist())
     assert sorted(got) == list(range(20))
     assert sum(1 for _ in dl) == 5  # second epoch reuses the worker pool
+
+
+def test_vision_transforms_batch2():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms as T
+    img = nd.array(onp.random.RandomState(0).randint(
+        0, 255, (32, 40, 3)).astype("float32"))
+    assert T.CenterCrop(24)(img).shape == (24, 24, 3)
+    assert T.RandomCrop(16, pad=2)(img).shape == (16, 16, 3)
+    assert T.RandomResizedCrop(20)(img).shape == (20, 20, 3)
+    onp.random.seed(0)
+    assert T.RandomFlipTopBottom()(img).shape == img.shape
+    out = T.RandomColorJitter(brightness=0.3, contrast=0.3,
+                              saturation=0.3)(img)
+    assert out.shape == img.shape
+    comp = T.Compose([T.RandomResizedCrop(16), T.ToTensor(),
+                      T.Normalize([0.5] * 3, [0.5] * 3)])
+    t = comp(img.astype("uint8") if hasattr(img, "astype") else img)
+    assert t.shape == (3, 16, 16)
