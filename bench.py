@@ -94,9 +94,10 @@ def main():
             "metric": "bert_large_512_train_tok_per_sec_per_chip",
             "value": round(tok_s, 0), "unit": "tok/s",
             "mfu": round(bert_mfu, 4),
-            "note": "220M-param BERT (U=1024,L=12,H=16,S=512,b64) bf16 "
-                    "flash-attention fused train step; MFU = 6*P*T + "
-                    "12*L*B*S^2*U attention FLOPs over chip peak",
+            "note": "220M-param BERT (U=1024,L=12,H=8 (D=128 heads ride "
+                    "the Pallas flash kernels),S=512,b64) bf16 fused train "
+                    "step; MFU = 6*P*T + 12*L*B*S^2*U attention FLOPs over "
+                    "chip peak",
         },
     }))
 
@@ -111,7 +112,7 @@ def bench_transformer(peak):
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, jit, models
 
-    B, S, V, U, L, H = 64, 512, 32768, 1024, 12, 16
+    B, S, V, U, L, H = 64, 512, 32768, 1024, 12, 8
     mx.random.seed(0)
     net = models.BERTModel(vocab_size=V, units=U, hidden_size=4 * U,
                            num_layers=L, num_heads=H, max_length=S,
