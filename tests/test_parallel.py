@@ -302,3 +302,29 @@ def test_kvstore_api():
     out2 = nd.zeros((2,))
     kv2.pull(0, out=out2)
     assert_almost_equal(out2, [0.9, 0.9], rtol=1e-5, atol=1e-6)
+
+
+def test_ring_attention_gradients():
+    _need_devices(8)
+    import jax.numpy as jnp
+    mesh = parallel.make_mesh({"sp": 8})
+    rng = onp.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 64, 16).astype("float32"))
+               for _ in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.sin(parallel.ring_attention(q, k, v, mesh=mesh,
+                                                       causal=True)))
+
+    def ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / 4.0
+        qi = jnp.arange(64)[:, None]
+        ki = jnp.arange(64)[None, :]
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+        p = jax.nn.softmax(s, -1)
+        return jnp.sum(jnp.sin(jnp.einsum("bhqk,bhkd->bhqd", p, v)))
+
+    g = jax.grad(loss, (0, 1, 2))(q, k, v)
+    gr = jax.grad(ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b))) < 1e-4
