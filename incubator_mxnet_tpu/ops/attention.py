@@ -33,11 +33,13 @@ def _interpret():
 def _auto_block(S):
     """Largest MXU-friendly block dividing S — measured on v5e: 512 blocks
     are 1.3-3.5x faster than 128 across D=64/128, S=512..8192 (fewer grid
-    steps, better VMEM reuse)."""
+    steps, better VMEM reuse). None when no candidate divides S — such
+    shapes are NOT kernel-legal (a whole-S block would blow VMEM) and take
+    the XLA composite fallback."""
     for b in (512, 256, 128):
         if S % b == 0:
             return b
-    return S
+    return None
 
 
 def _resolve_blocks(S, block_q, block_k):
@@ -61,6 +63,8 @@ def flash_attention_legal(q_shape, block_q=None, block_k=None):
     8-alignment keeps sublanes packed."""
     B, H, S, D = q_shape
     block_q, block_k = _resolve_blocks(S, block_q, block_k)
+    if block_q is None or block_k is None:
+        return False
     try:
         import jax.experimental.pallas  # noqa
     except ImportError:

@@ -22,6 +22,14 @@ def _rand_qkv(B=1, H=2, S=256, D=128, seed=0):
                  for _ in range(3))
 
 
+def test_auto_block_rejects_odd_lengths():
+    from incubator_mxnet_tpu.ops import attention as A
+    assert not A.flash_attention_legal((1, 2, 200, 128))  # no block divides
+    assert not A.flash_attention_supported((1, 2, 200, 128))
+    out = A.flash_attention(*_rand_qkv(S=200))  # falls back, no crash
+    assert out.shape == (1, 2, 200, 128)
+
+
 @pytest.mark.parametrize("D", [64, 128])
 def test_flash_head_dims(D):
     # standard head dims (BERT/GPT use 64) ride the kernels too; in
@@ -55,13 +63,15 @@ def test_flash_forward_matches_composite(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_backward_matches_composite(causal):
+@pytest.mark.parametrize("block", [None, 128])  # 128 forces a multi-block
+def test_flash_backward_matches_composite(causal, block):               # grid
     from incubator_mxnet_tpu.ops import attention as A
     q, k, v = _rand_qkv()
     scale = 1.0 / onp.sqrt(q.shape[-1])
 
     def loss_flash(q, k, v):
-        return jnp.sum(jnp.sin(A.flash_attention(q, k, v, causal)))
+        return jnp.sum(jnp.sin(A.flash_attention(q, k, v, causal, None,
+                                                 block, block)))
 
     def loss_ref(q, k, v):
         return jnp.sum(jnp.sin(A._blocked_reference(q, k, v, causal, scale)))
