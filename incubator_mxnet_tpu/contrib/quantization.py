@@ -13,7 +13,8 @@ from .. import ndarray as nd
 from ..ndarray import NDArray, _apply
 
 __all__ = ["quantize", "dequantize", "requantize", "calib_minmax", "calib_entropy",
-           "quantize_model", "QuantizedDense"]
+           "quantize_model", "quantize_net", "QuantizedDense",
+           "QuantizedDenseBlock", "QuantizedConv2DBlock"]
 
 
 def quantize(data, min_range=None, max_range=None, out_type="int8"):
@@ -155,3 +156,117 @@ def quantize_model(net, calib_data=None, calib_mode="minmax", num_calib_batches=
             lo, hi = -1.0, 1.0
         quantized[layer.name] = QuantizedDense(layer, lo, hi)
     return quantized
+
+
+class QuantizedDenseBlock:
+    """HybridBlock-compatible int8 Dense replacement (real int8 matmul,
+    int32 accumulation — ref quantized_fully_connected.cc)."""
+
+    def __init__(self, dense_block, calib_min, calib_max):
+        self._inner = QuantizedDense(dense_block, calib_min, calib_max)
+        self.name = getattr(dense_block, "name", "quantized_dense")
+        self._children = {}
+        self._flatten = getattr(dense_block, "_flatten", True)
+        self._act_type = getattr(dense_block, "act_type", None)
+
+    def __call__(self, x):
+        if self._flatten and len(x.shape) > 2:
+            x = x.reshape((x.shape[0], -1))
+        out = self._inner(x)
+        if self._act_type is not None:
+            out = nd.Activation(out, act_type=self._act_type)
+        return out
+
+    def collect_params(self, select=None):
+        return {}
+
+
+class QuantizedConv2DBlock:
+    """QDQ (fake-quant) int8 Conv2D replacement: weights and activations
+    quantize->dequantize around the fp conv. The reference runs native int8
+    conv kernels (quantized_conv.cc); on TPU the convolution itself stays
+    bf16/fp32 on the MXU while the numerics match int8 storage — documented
+    divergence (XLA has no int8 conv path)."""
+
+    def __init__(self, conv_block, calib_min, calib_max):
+        self._conv = conv_block
+        w = conv_block.weight.data()
+        wq, wmin, wmax = quantize(w)
+        self._w_deq = dequantize(wq, wmin, wmax)
+        self._cmin, self._cmax = calib_min, calib_max
+        self.name = getattr(conv_block, "name", "quantized_conv")
+        self._children = {}
+
+    def __call__(self, x):
+        xq, xmin, xmax = quantize(x, self._cmin, self._cmax)
+        x_deq = dequantize(xq, xmin, xmax)
+        arr = self._conv.weight.data()   # the live NDArray wrapper
+        saved = arr._data
+        arr._data = self._w_deq._data
+        try:
+            return self._conv(x_deq)
+        finally:
+            arr._data = saved
+
+    def collect_params(self, select=None):
+        return {}
+
+
+def quantize_net(net, calib_data=None, calib_mode="minmax",
+                 num_calib_batches=4, quantize_conv=True,
+                 exclude_layers=()):
+    """Graph-level int8 conversion of a Gluon net (ref contrib/
+    quantization.py quantize_net): Dense layers become real-int8 matmul
+    blocks, Conv2D layers become QDQ blocks, swapped IN PLACE so the
+    returned net runs end-to-end. Calibration collects per-layer input
+    ranges over ``calib_data`` (minmax or KL-entropy)."""
+    from ..gluon import nn
+
+    stats = {}
+
+    def make_hook(key):
+        def hook(blk, inputs, output):
+            stats.setdefault(key, []).append(inputs[0])
+        return hook
+
+    targets = []  # (parent, attr_or_child_key, block, kind)
+
+    def walk(b):
+        for key, child in list(b._children.items()):
+            if isinstance(child, nn.Dense) and child.name not in exclude_layers:
+                targets.append((b, key, child, "dense"))
+            elif quantize_conv and isinstance(child, nn.Conv2D) and \
+                    child.name not in exclude_layers:
+                targets.append((b, key, child, "conv"))
+            else:
+                walk(child)
+
+    walk(net)
+    handles = [c.register_forward_hook(make_hook(id(c)))
+               for _, _, c, _ in targets]
+    if calib_data is not None:
+        for i, batch in enumerate(calib_data):
+            if i >= num_calib_batches:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            x = x.data[0] if hasattr(x, "data") else x
+            net(x)
+    for h in handles:
+        if hasattr(h, "detach"):
+            h.detach()
+
+    for parent, key, block, kind in targets:
+        acts = stats.get(id(block))
+        if acts:
+            lo, hi = (calib_entropy(acts) if calib_mode == "entropy"
+                      else calib_minmax(acts))
+        else:
+            lo, hi = -1.0, 1.0
+        q = QuantizedDenseBlock(block, lo, hi) if kind == "dense" else \
+            QuantizedConv2DBlock(block, lo, hi)
+        parent._children[key] = q
+        # attribute references (self.fc = Dense(...)) must follow too
+        for attr, val in list(vars(parent).items()):
+            if val is block:
+                object.__setattr__(parent, attr, q)
+    return net
