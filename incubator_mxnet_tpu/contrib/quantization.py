@@ -159,57 +159,100 @@ def quantize_model(net, calib_data=None, calib_mode="minmax", num_calib_batches=
 
 
 class QuantizedDenseBlock:
-    """HybridBlock-compatible int8 Dense replacement (real int8 matmul,
-    int32 accumulation — ref quantized_fully_connected.cc)."""
-
-    def __init__(self, dense_block, calib_min, calib_max):
-        self._inner = QuantizedDense(dense_block, calib_min, calib_max)
-        self.name = getattr(dense_block, "name", "quantized_dense")
-        self._children = {}
-        self._flatten = getattr(dense_block, "_flatten", True)
-        self._act_type = getattr(dense_block, "act_type", None)
-
-    def __call__(self, x):
-        if self._flatten and len(x.shape) > 2:
-            x = x.reshape((x.shape[0], -1))
-        out = self._inner(x)
-        if self._act_type is not None:
-            out = nd.Activation(out, act_type=self._act_type)
-        return out
-
-    def collect_params(self, select=None):
-        return {}
+    pass  # replaced below (kept for pickle name stability)
 
 
-class QuantizedConv2DBlock:
-    """QDQ (fake-quant) int8 Conv2D replacement: weights and activations
-    quantize->dequantize around the fp conv. The reference runs native int8
-    conv kernels (quantized_conv.cc); on TPU the convolution itself stays
-    bf16/fp32 on the MXU while the numerics match int8 storage — documented
-    divergence (XLA has no int8 conv path)."""
+def _make_quantized_classes():
+    """Built lazily so contrib.quantization does not import gluon at module
+    import (package init order)."""
+    global QuantizedDenseBlock, QuantizedConv2DBlock
+    from ..gluon.block import HybridBlock
 
-    def __init__(self, conv_block, calib_min, calib_max):
-        self._conv = conv_block
-        w = conv_block.weight.data()
-        wq, wmin, wmax = quantize(w)
-        self._w_deq = dequantize(wq, wmin, wmax)
-        self._cmin, self._cmax = calib_min, calib_max
-        self.name = getattr(conv_block, "name", "quantized_conv")
-        self._children = {}
+    class _QuantizedDenseBlock(HybridBlock):
+        """Int8 Dense replacement — a REAL Block (save/cast/apply keep
+        working on the quantized net; this block owns no Parameters)."""
 
-    def __call__(self, x):
-        xq, xmin, xmax = quantize(x, self._cmin, self._cmax)
-        x_deq = dequantize(xq, xmin, xmax)
-        arr = self._conv.weight.data()   # the live NDArray wrapper
-        saved = arr._data
-        arr._data = self._w_deq._data
-        try:
-            return self._conv(x_deq)
-        finally:
-            arr._data = saved
+        def __init__(self, dense_block, calib_min, calib_max, **kw):
+            super().__init__(**kw)
+            self._inner = QuantizedDense(dense_block, calib_min, calib_max)
+            self._flatten = getattr(dense_block, "_flatten", True)
+            self._act_type = getattr(dense_block, "act_type", None)
 
-    def collect_params(self, select=None):
-        return {}
+        def forward(self, x):
+            if self._flatten and len(x.shape) > 2:
+                x = x.reshape((x.shape[0], -1))
+            out = self._inner(x)
+            if self._act_type is not None:
+                out = nd.Activation(out, act_type=self._act_type)
+            return out
+
+    class _QuantizedConv2DBlock(HybridBlock):
+        """QDQ (fake-quant) int8 Conv2D replacement: weights and
+        activations quantize->dequantize around the fp conv. The reference
+        runs native int8 conv kernels (quantized_conv.cc); XLA has no int8
+        conv path, so storage numerics are int8 while the MXU conv stays
+        bf16/fp32 — documented divergence."""
+
+        def __init__(self, conv_block, calib_min, calib_max, **kw):
+            super().__init__(**kw)
+            w = conv_block.weight.data()
+            wq, wmin, wmax = quantize(w)
+            self._w_deq = dequantize(wq, wmin, wmax)
+            self._conv = conv_block  # NOT registered: its hooks/params stay out
+            self.__dict__["_conv"] = conv_block
+            self._cmin, self._cmax = calib_min, calib_max
+
+        def forward(self, x):
+            xq, xmin, xmax = quantize(x, self._cmin, self._cmax)
+            x_deq = dequantize(xq, xmin, xmax)
+            arr = self._conv.weight.data()   # the live NDArray wrapper
+            saved = arr._data
+            arr._data = self._w_deq._data
+            try:
+                return self._conv.forward(x_deq)  # bypass hooks/cache
+            finally:
+                arr._data = saved
+
+    QuantizedDenseBlock = _QuantizedDenseBlock
+    QuantizedConv2DBlock = _QuantizedConv2DBlock
+    return _QuantizedDenseBlock, _QuantizedConv2DBlock
+
+
+QuantizedConv2DBlock = None
+
+
+def _calibrate(net, layers, calib_data, calib_mode, num_calib_batches):
+    """Shared hook-based range collection (used by quantize_model and
+    quantize_net): returns {id(layer): (lo, hi)}."""
+    stats = {}
+
+    def make_hook(key):
+        def hook(blk, inputs, output):
+            stats.setdefault(key, []).append(inputs[0])
+        return hook
+
+    handles = [l.register_forward_hook(make_hook(id(l))) for l in layers]
+    try:
+        if calib_data is not None:
+            for i, batch in enumerate(calib_data):
+                if i >= num_calib_batches:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                x = x.data[0] if hasattr(x, "data") else x
+                net(x)
+    finally:
+        for h in handles:
+            if h is not None:
+                h.detach()
+    out = {}
+    for l in layers:
+        acts = stats.get(id(l))
+        if acts:
+            out[id(l)] = (calib_entropy(acts) if calib_mode == "entropy"
+                          else calib_minmax(acts))
+        else:
+            out[id(l)] = (-1.0, 1.0)
+    return out
 
 
 def quantize_net(net, calib_data=None, calib_mode="minmax",
@@ -219,54 +262,58 @@ def quantize_net(net, calib_data=None, calib_mode="minmax",
     quantization.py quantize_net): Dense layers become real-int8 matmul
     blocks, Conv2D layers become QDQ blocks, swapped IN PLACE so the
     returned net runs end-to-end. Calibration collects per-layer input
-    ranges over ``calib_data`` (minmax or KL-entropy)."""
+    ranges over ``calib_data`` (minmax or KL-entropy). Compiled-forward
+    caches are invalidated after the swap (a hybridized net would otherwise
+    keep running its cached fp32 program)."""
     from ..gluon import nn
+    QD, QC = _make_quantized_classes()
 
-    stats = {}
+    def is_target(b):
+        if isinstance(b, nn.Dense) and b.name not in exclude_layers:
+            return "dense"
+        if quantize_conv and isinstance(b, nn.Conv2D) and \
+                b.name not in exclude_layers:
+            return "conv"
+        return None
 
-    def make_hook(key):
-        def hook(blk, inputs, output):
-            stats.setdefault(key, []).append(inputs[0])
-        return hook
-
-    targets = []  # (parent, attr_or_child_key, block, kind)
+    root_kind = is_target(net)
+    targets = []  # (parent, child_key, block, kind)
 
     def walk(b):
         for key, child in list(b._children.items()):
-            if isinstance(child, nn.Dense) and child.name not in exclude_layers:
-                targets.append((b, key, child, "dense"))
-            elif quantize_conv and isinstance(child, nn.Conv2D) and \
-                    child.name not in exclude_layers:
-                targets.append((b, key, child, "conv"))
+            kind = is_target(child)
+            if kind:
+                targets.append((b, key, child, kind))
             else:
                 walk(child)
 
-    walk(net)
-    handles = [c.register_forward_hook(make_hook(id(c)))
-               for _, _, c, _ in targets]
-    if calib_data is not None:
-        for i, batch in enumerate(calib_data):
-            if i >= num_calib_batches:
-                break
-            x = batch[0] if isinstance(batch, (list, tuple)) else batch
-            x = x.data[0] if hasattr(x, "data") else x
-            net(x)
-    for h in handles:
-        if hasattr(h, "detach"):
-            h.detach()
+    if not root_kind:
+        walk(net)
+    layers = [net] if root_kind else [t[2] for t in targets]
+    ranges = _calibrate(net, layers, calib_data, calib_mode,
+                        num_calib_batches)
 
+    def wrap(block, kind):
+        lo, hi = ranges[id(block)]
+        return QD(block, lo, hi) if kind == "dense" else QC(block, lo, hi)
+
+    if root_kind:
+        return wrap(net, root_kind)
     for parent, key, block, kind in targets:
-        acts = stats.get(id(block))
-        if acts:
-            lo, hi = (calib_entropy(acts) if calib_mode == "entropy"
-                      else calib_minmax(acts))
-        else:
-            lo, hi = -1.0, 1.0
-        q = QuantizedDenseBlock(block, lo, hi) if kind == "dense" else \
-            QuantizedConv2DBlock(block, lo, hi)
+        q = wrap(block, kind)
         parent._children[key] = q
         # attribute references (self.fc = Dense(...)) must follow too
         for attr, val in list(vars(parent).items()):
             if val is block:
                 object.__setattr__(parent, attr, q)
+
+    # invalidate compiled-forward caches everywhere: a hybridized net would
+    # otherwise keep executing the cached fp32 program for known shapes
+    def clear(b):
+        if hasattr(b, "_cached_fn"):
+            b._cached_fn = None
+        for c in b._children.values():
+            clear(c)
+
+    clear(net)
     return net

@@ -77,6 +77,24 @@ def _name_counter(hint):
     return "%s%d" % (hint, count)
 
 
+class HookHandle:
+    """Detachable hook registration (ref python/mxnet/gluon/utils.py HookHandle)."""
+
+    def __init__(self, hooks_list, hook):
+        self._list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.detach()
+
+
 class Block:
     """Base building block (ref gluon/block.py:229)."""
 
@@ -123,10 +141,13 @@ class Block:
         self._children[name or str(len(self._children))] = block
 
     def register_forward_hook(self, hook):
+        """Returns a detachable handle (ref block.py HookHandle)."""
         self._forward_hooks.append(hook)
+        return HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
+        return HookHandle(self._forward_pre_hooks, hook)
 
     def collect_params(self, select=None):
         ret = ParameterDict(self._params.prefix)
