@@ -114,48 +114,23 @@ class QuantizedDense:
 
 
 def quantize_model(net, calib_data=None, calib_mode="minmax", num_calib_batches=4):
-    """Quantize Dense layers of a gluon net for int8 inference
-    (ref contrib/quantization.py quantize_model / quantize_net)."""
+    """Per-layer int8 Dense handles (legacy API; quantize_net below swaps a
+    whole net in place). Ref contrib/quantization.py quantize_model."""
     from ..gluon import nn
 
-    # collect activation stats per Dense layer via forward hooks
-    stats = {}
-
-    def make_hook(key):
-        def hook(blk, inputs, output):
-            stats.setdefault(key, []).append(inputs[0])
-        return hook
-
-    handles = []
     dense_layers = []
 
     def walk(b):
         if isinstance(b, nn.Dense):
             dense_layers.append(b)
-            b.register_forward_hook(make_hook(id(b)))
         for c in b._children.values():
             walk(c)
 
     walk(net)
-    if calib_data is not None:
-        for i, batch in enumerate(calib_data):
-            if i >= num_calib_batches:
-                break
-            x = batch[0] if isinstance(batch, (list, tuple)) else batch
-            net(x)
-
-    quantized = {}
-    for layer in dense_layers:
-        acts = stats.get(id(layer))
-        if acts:
-            if calib_mode == "entropy":
-                lo, hi = calib_entropy(acts)
-            else:
-                lo, hi = calib_minmax(acts)
-        else:
-            lo, hi = -1.0, 1.0
-        quantized[layer.name] = QuantizedDense(layer, lo, hi)
-    return quantized
+    ranges = _calibrate(net, dense_layers, calib_data, calib_mode,
+                        num_calib_batches)
+    return {layer.name: QuantizedDense(layer, *ranges[id(layer)])
+            for layer in dense_layers}
 
 
 class QuantizedDenseBlock:
