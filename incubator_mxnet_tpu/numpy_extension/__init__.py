@@ -18,9 +18,14 @@ __all__ = ["set_np", "reset_np", "is_np_array", "use_np", "cpu", "gpu", "tpu",
 def _wrap(nd_fn):
     def op(*args, **kwargs):
         out = nd_fn(*args, **kwargs)
+        # re-class IN PLACE: constructing fresh np_ndarrays here would cut
+        # the autograd tape (backward is keyed by output object identity)
         if isinstance(out, (list, tuple)):
-            return type(out)(np_ndarray(o._data) for o in out)
-        return np_ndarray(out._data)
+            for o in out:
+                o.__class__ = np_ndarray
+            return out
+        out.__class__ = np_ndarray
+        return out
     return op
 
 

@@ -206,3 +206,17 @@ def test_np_random_distributions():
     assert onp.allclose(d.asnumpy().sum(-1), 1.0, atol=1e-5)
     p = np.random.permutation(5)
     assert sorted(p.tolist()) == [0, 1, 2, 3, 4]
+
+
+def test_npx_ops_stay_on_tape():
+    # npx wrappers must not cut the autograd tape (regression: fresh
+    # np_ndarray construction zeroed gradients through npx ops)
+    x = np.array([[1.0, -2.0, 3.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = npx.relu(x)
+        s = (y * y).sum()
+    s.backward()
+    g = x.grad.asnumpy()
+    assert_almost_equal(g, [[2.0, 0.0, 6.0]], rtol=1e-5, atol=1e-6)
+    assert isinstance(npx.softmax(x), type(x))
