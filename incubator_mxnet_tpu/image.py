@@ -158,8 +158,109 @@ class ColorNormalizeAug(Augmenter):
         return color_normalize(src, self.mean, self.std)
 
 
+class BrightnessJitterAug(Augmenter):
+    """ref image.py BrightnessJitterAug."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """ref image.py ContrastJitterAug (luminance-anchored)."""
+
+    _coef = onp.array([0.299, 0.587, 0.114], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
+        a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
+        gray = (a[..., :3] * self._coef).sum()
+        gray = 3.0 * (1.0 - alpha) / a.size * gray
+        return src * alpha + gray
+
+
+class SaturationJitterAug(Augmenter):
+    """ref image.py SaturationJitterAug."""
+
+    _coef = onp.array([0.299, 0.587, 0.114], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.saturation, self.saturation)
+        a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
+        gray = (a[..., :3] * self._coef).sum(-1, keepdims=True)
+        out = a * alpha + gray * (1.0 - alpha)
+        return nd.array(out.astype(a.dtype)) if hasattr(src, "asnumpy") else out
+
+
+class ColorJitterAug(Augmenter):
+    """ref image.py ColorJitterAug — random-order composition."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = []
+        if brightness:
+            self._augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self._augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self._augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        for i in onp.random.permutation(len(self._augs)):
+            src = self._augs[i](src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """ref image.py LightingAug — AlexNet-style PCA noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, "float32")
+        self.eigvec = onp.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1).astype("float32")
+        return src + nd.array(rgb)
+
+
+class RandomGrayAug(Augmenter):
+    """ref image.py RandomGrayAug."""
+
+    _coef = onp.array([[0.299], [0.587], [0.114]], "float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.rand() < self.p:
+            a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
+            gray = a @ self._coef
+            a = onp.repeat(gray, 3, axis=-1)
+            return nd.array(a) if hasattr(src, "asnumpy") else a
+        return src
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
-                    rand_mirror=False, mean=None, std=None, **kwargs):
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    **kwargs):
     """ref image.py CreateAugmenter."""
     auglist = []
     if resize > 0:
@@ -172,6 +273,16 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is not None or std is not None:
         if mean is True:
             mean = onp.array([123.68, 116.28, 103.53])

@@ -299,3 +299,23 @@ def test_vision_transforms_batch2():
                       T.Normalize([0.5] * 3, [0.5] * 3)])
     t = comp(img.astype("uint8") if hasattr(img, "astype") else img)
     assert t.shape == (3, 16, 16)
+
+
+def test_image_jitter_augmenters():
+    from incubator_mxnet_tpu import image
+    img = nd.array(onp.random.RandomState(0).randint(
+        0, 255, (32, 32, 3)).astype("float32"))
+    augs = image.CreateAugmenter((3, 28, 28), rand_crop=True, rand_mirror=True,
+                                 brightness=0.2, contrast=0.2, saturation=0.2,
+                                 pca_noise=0.1, rand_gray=0.3,
+                                 mean=True, std=True)
+    names = [type(a).__name__ for a in augs]
+    assert "ColorJitterAug" in names and "LightingAug" in names
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (28, 28, 3)
+    assert bool(onp.isfinite(out.asnumpy()).all())
+    # gray aug with p=1 collapses channels
+    g = image.RandomGrayAug(1.0)(img).asnumpy()
+    assert onp.allclose(g[..., 0], g[..., 1], atol=1e-4)
