@@ -17,7 +17,12 @@ from .ndarray import NDArray
 __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "Augmenter",
            "ResizeAug", "RandomCropAug", "CenterCropAug", "HorizontalFlipAug",
-           "CastAug", "ColorNormalizeAug", "CreateAugmenter", "ImageIter"]
+           "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
+           "LightingAug", "RandomGrayAug", "CreateAugmenter", "ImageIter"]
+
+# ITU-R BT.601 luma weights — single source for every color augmenter
+_LUMA_COEF = onp.array([0.299, 0.587, 0.114], "float32")
 
 
 def imdecode(buf, flag=1, to_rgb=True, **kwargs):
@@ -171,37 +176,44 @@ class BrightnessJitterAug(Augmenter):
 
 
 class ContrastJitterAug(Augmenter):
-    """ref image.py ContrastJitterAug (luminance-anchored)."""
-
-    _coef = onp.array([0.299, 0.587, 0.114], "float32")
+    """ref image.py ContrastJitterAug (luminance-anchored) — pure nd ops,
+    no per-image device sync on the augmentation path."""
 
     def __init__(self, contrast):
         super().__init__(contrast=contrast)
         self.contrast = contrast
 
     def __call__(self, src):
+        import jax.numpy as jnp
+        from .ndarray import _apply
         alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
-        a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
-        gray = (a[..., :3] * self._coef).sum()
-        gray = 3.0 * (1.0 - alpha) / a.size * gray
-        return src * alpha + gray
+        src = src if isinstance(src, NDArray) else nd.array(src)
+
+        def fn(a):
+            gray = jnp.sum(a[..., :3] * _LUMA_COEF)
+            return a * alpha + 3.0 * (1.0 - alpha) / a.size * gray
+
+        return _apply(fn, src)
 
 
 class SaturationJitterAug(Augmenter):
-    """ref image.py SaturationJitterAug."""
-
-    _coef = onp.array([0.299, 0.587, 0.114], "float32")
+    """ref image.py SaturationJitterAug — pure nd ops (no device sync)."""
 
     def __init__(self, saturation):
         super().__init__(saturation=saturation)
         self.saturation = saturation
 
     def __call__(self, src):
+        import jax.numpy as jnp
+        from .ndarray import _apply
         alpha = 1.0 + onp.random.uniform(-self.saturation, self.saturation)
-        a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
-        gray = (a[..., :3] * self._coef).sum(-1, keepdims=True)
-        out = a * alpha + gray * (1.0 - alpha)
-        return nd.array(out.astype(a.dtype)) if hasattr(src, "asnumpy") else out
+        src = src if isinstance(src, NDArray) else nd.array(src)
+
+        def fn(a):
+            gray = jnp.sum(a[..., :3] * _LUMA_COEF, axis=-1, keepdims=True)
+            return a * alpha + gray * (1.0 - alpha)
+
+        return _apply(fn, src)
 
 
 class ColorJitterAug(Augmenter):
@@ -240,20 +252,23 @@ class LightingAug(Augmenter):
 
 
 class RandomGrayAug(Augmenter):
-    """ref image.py RandomGrayAug."""
-
-    _coef = onp.array([[0.299], [0.587], [0.114]], "float32")
+    """ref image.py RandomGrayAug — pure nd ops (no device sync)."""
 
     def __init__(self, p):
         super().__init__(p=p)
         self.p = p
 
     def __call__(self, src):
+        import jax.numpy as jnp
+        from .ndarray import _apply
         if onp.random.rand() < self.p:
-            a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
-            gray = a @ self._coef
-            a = onp.repeat(gray, 3, axis=-1)
-            return nd.array(a) if hasattr(src, "asnumpy") else a
+            src = src if isinstance(src, NDArray) else nd.array(src)
+
+            def fn(a):
+                gray = jnp.sum(a[..., :3] * _LUMA_COEF, axis=-1, keepdims=True)
+                return jnp.repeat(gray, 3, axis=-1)
+
+            return _apply(fn, src)
         return src
 
 

@@ -19,13 +19,19 @@ def _wrap(nd_fn):
     def op(*args, **kwargs):
         out = nd_fn(*args, **kwargs)
         # re-class IN PLACE: constructing fresh np_ndarrays here would cut
-        # the autograd tape (backward is keyed by output object identity)
+        # the autograd tape (backward is keyed by output object identity).
+        # Identity-returning ops (e.g. eval-mode Dropout) hand back an INPUT
+        # object — re-classing that would corrupt the caller's array, so
+        # route it through a taped identity first.
+        def reclass(o):
+            if any(o is a for a in args):
+                o = _apply_np(lambda x: x, o)
+            o.__class__ = np_ndarray
+            return o
+
         if isinstance(out, (list, tuple)):
-            for o in out:
-                o.__class__ = np_ndarray
-            return out
-        out.__class__ = np_ndarray
-        return out
+            return type(out)(reclass(o) for o in out)
+        return reclass(out)
     return op
 
 

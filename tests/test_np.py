@@ -220,3 +220,13 @@ def test_npx_ops_stay_on_tape():
     g = x.grad.asnumpy()
     assert_almost_equal(g, [[2.0, 0.0, 6.0]], rtol=1e-5, atol=1e-6)
     assert isinstance(npx.softmax(x), type(x))
+
+
+def test_npx_identity_return_does_not_corrupt_input():
+    # eval-mode Dropout returns its input; npx must not re-class the
+    # caller's nd array into numpy semantics
+    from incubator_mxnet_tpu import nd
+    x = nd.zeros((4,))
+    out = npx.dropout(x, p=0.5)  # not recording/training -> identity
+    assert type(x).__name__ == "NDArray"
+    assert isinstance(out, type(np.array([0.0])))
