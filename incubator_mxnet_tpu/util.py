@@ -4,7 +4,8 @@ from __future__ import annotations
 import functools
 import threading
 
-__all__ = ["is_np_shape", "is_np_array", "set_np_shape", "set_np", "reset_np",
+__all__ = ["inspect_tensor",
+           "is_np_shape", "is_np_array", "set_np_shape", "set_np", "reset_np",
            "np_shape", "np_array", "use_np", "getenv", "setenv"]
 
 
@@ -87,3 +88,33 @@ def getenv(name):
 def setenv(name, value):
     import os
     os.environ[name] = value
+
+
+def inspect_tensor(data, tag="", check_nan=True, check_inf=True,
+                   dump_dir=None, logger=None):
+    """Tensor debugging inspector (ref src/common/tensor_inspector.h
+    TensorInspector::print_string/check_value/dump_to_file).
+
+    Logs shape/dtype/min/max/mean/std and NaN/Inf counts for an NDArray (or
+    numpy array); optionally dumps the value as ``<dump_dir>/<tag>.npy``.
+    Returns the stats dict so tests/monitors can assert on it.
+    """
+    import logging as _logging
+    import numpy as onp
+    log = (logger or _logging).info if logger is not False else (lambda *a: None)
+    a = data.asnumpy() if hasattr(data, "asnumpy") else onp.asarray(data)
+    af = a.astype("float64") if a.dtype.kind in "fiu" else None
+    stats = {"tag": tag, "shape": tuple(a.shape), "dtype": str(a.dtype)}
+    if af is not None and af.size:
+        stats.update({
+            "min": float(onp.nanmin(af)), "max": float(onp.nanmax(af)),
+            "mean": float(onp.nanmean(af)), "std": float(onp.nanstd(af)),
+            "nan_count": int(onp.isnan(af).sum()) if check_nan else None,
+            "inf_count": int(onp.isinf(af).sum()) if check_inf else None,
+        })
+    log("inspect[%s]: %s", tag, stats)
+    if dump_dir is not None:
+        import os
+        os.makedirs(dump_dir, exist_ok=True)
+        onp.save(os.path.join(dump_dir, "%s.npy" % (tag or "tensor")), a)
+    return stats

@@ -258,3 +258,15 @@ def test_quantize_net_graph_conversion():
     qnet.save_parameters(str(__import__("tempfile").mktemp()))  # Block API works
     q_acc = (qnet(x).asnumpy().argmax(-1) == label).mean()
     assert q_acc > fp_acc - 0.05, (fp_acc, q_acc)
+
+
+def test_inspect_tensor(tmp_path):
+    from incubator_mxnet_tpu.util import inspect_tensor
+    x = nd.array(onp.array([[1.0, float("nan")], [3.0, float("inf")]]))
+    stats = inspect_tensor(x, tag="probe", dump_dir=str(tmp_path),
+                           logger=False)
+    assert stats["shape"] == (2, 2)
+    assert stats["nan_count"] == 1 and stats["inf_count"] == 1
+    assert stats["min"] == 1.0
+    dumped = onp.load(str(tmp_path / "probe.npy"))
+    assert dumped.shape == (2, 2)
