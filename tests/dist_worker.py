@@ -53,6 +53,20 @@ def main():
     digest = hashlib.sha1(onp.ascontiguousarray(w.asnumpy())).hexdigest()
     print("RESULT params %d %s" % (rank, digest), flush=True)
 
+    # -- 2b. gradient compression rides the cross-process push ----------
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv3.init("c", nd.zeros((4,)))
+    # worker-dependent grads; each is 2-bit quantized BEFORE the allreduce
+    kv3.push("c", nd.array([0.6, -0.7, 0.2, 0.0] if rank == 0 else
+                           [0.4, 0.0, -0.9, 0.1]))
+    outc = nd.zeros((4,))
+    kv3.pull("c", out=outc)
+    # rank0 quantizes to [0.5,-0.5,0,0]; rank1's 0.4/0.1 stay below the
+    # threshold (error feedback keeps them as residual) -> [0,0,-0.5,0]
+    assert onp.allclose(outc.asnumpy(), [0.5, -0.5, -0.5, 0.0]), outc.asnumpy()
+    print("RESULT compress %d ok" % rank, flush=True)
+
     # -- 3. global-mesh SPMD collective across processes ----------------
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

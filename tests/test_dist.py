@@ -41,7 +41,7 @@ def test_dist_sync_two_processes():
     # workers share the stdout pipe, so lines may interleave — parse by regex
     # the tempered token stops a value at a glued "RESULT..." from another worker
     results = re.findall(r"RESULT (\w+) (\d+)(?: ((?:(?!RESULT)\S)+))?", out)
-    for check in ("pushpull", "spmd", "done"):
+    for check in ("pushpull", "compress", "spmd", "done"):
         ranks = {r for c, r, _ in results if c == check}
         assert len(ranks) == NWORKERS, (check, out)
 
