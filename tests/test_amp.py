@@ -1,0 +1,63 @@
+"""AMP (bf16) flow (ref python/mxnet/contrib/amp/amp.py + tests
+test_amp.py): convert_hybrid_block, LossScaler semantics, bf16 training
+end-to-end with fp32 master weights."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, jit
+from incubator_mxnet_tpu.contrib import amp
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_convert_hybrid_block_bf16():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4), gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    bnet = amp.convert_hybrid_block(net)
+    x = nd.ones((2, 4)).astype("bfloat16")
+    out = bnet(x)
+    assert "bfloat16" in str(out.dtype)
+
+
+def test_loss_scaler_dynamics():
+    ls = amp.LossScaler(init_scale=2.0 ** 4, scale_factor=2.0,
+                        scale_window=2)
+    s0 = ls.loss_scale
+    loss = nd.array([1.0])
+    assert float(ls.scale(loss).asnumpy()) == s0
+    # finite grads for scale_window steps -> scale doubles
+    assert ls.check_and_update([nd.ones((2,))]) is True
+    assert ls.check_and_update([nd.ones((2,))]) is True
+    assert ls.loss_scale == s0 * 2
+    # overflow shrinks the scale immediately and skips the step
+    big = nd.array([float("inf"), 1.0])
+    assert ls.check_and_update([big]) is False
+    assert ls.loss_scale == s0
+    # unscale divides grads by the current scale
+    g = nd.array([ls.loss_scale])
+    ls.unscale([g])
+    assert_almost_equal(g.asnumpy(), [1.0])
+
+
+def test_bf16_training_with_master_weights():
+    from incubator_mxnet_tpu.gluon.data.vision import _synthetic
+    data, label = _synthetic(256, (16,), 4, seed=3)
+    x = nd.array(data).astype("bfloat16")
+    y = nd.array(label.astype("float32"))
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+            gluon.nn.Dense(4, in_units=32))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.2, "momentum": 0.9,
+                             "multi_precision": True})
+    step = jit.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
+    first = float(step(x, y).mean().asnumpy())
+    for _ in range(20):
+        last = float(step(x, y).mean().asnumpy())
+    assert last < first * 0.5  # converges in bf16 with fp32 masters
+    # params remain bf16; optimizer state holds fp32 masters
+    p = list(net.collect_params().values())[0]
+    assert "bfloat16" in str(p.data().dtype)
