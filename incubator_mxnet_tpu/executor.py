@@ -29,9 +29,15 @@ class Executor:
         self._label_names = set()
         self._materialize()
         if args_grad is None and grad_req != "null":
+            # ref simple_bind: grad buffers for ALL args (incl. data inputs —
+            # input grads work); label vars excluded (loss layers produce no
+            # label cotangent)
+            labels = {v.name for v in self._walk_vars()
+                      if getattr(v, "_is_label", False)
+                      or v.name.endswith("_label")}
             args_grad = {k: nd.zeros(v.shape, dtype=v.dtype)
                          for k, v in self.arg_dict.items()
-                         if k not in self._data_names()}
+                         if k not in labels}
         self.grad_dict = dict(args_grad or {})
         self.aux_dict = {k: self.arg_dict[k] for k in self._aux_names}
 
@@ -114,8 +120,13 @@ class Executor:
 
         roots = self._symbol._symbols if hasattr(self._symbol, "_symbols") \
             else [self._symbol]
-        for r in roots:
-            ev(r)
+        outs = [ev(r) for r in roots]
+        # outputs (zero-valued) are live right after bind — output_shapes
+        # works before the first forward (ref GraphExecutor behavior)
+        flat = []
+        for o in outs:
+            flat.extend(o if isinstance(o, (list, tuple)) else [o])
+        self.outputs = flat
 
     def _topo_nodes(self):
         seen, order = set(), []

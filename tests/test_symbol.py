@@ -1,0 +1,80 @@
+"""Symbol API surface (ref tests/python/unittest/test_symbol.py subset)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _net():
+    d = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(d, num_hidden=8, name="fc1"),
+                          act_type="relu")
+    return mx.sym.FullyConnected(h, num_hidden=3, flatten=False, name="fc2")
+
+
+def test_list_arguments_and_outputs():
+    out = _net()
+    args = out.list_arguments()
+    assert args[0] == "data"
+    assert {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"} <= set(args)
+    assert out.list_outputs() == ["fc2_output"]
+
+
+def test_infer_shape_and_internals():
+    out = _net()
+    ex = out.simple_bind(data=(4, 6))
+    assert ex.arg_dict["fc1_weight"].shape == (8, 6)
+    assert ex.arg_dict["fc2_weight"].shape == (3, 8)
+    # outputs live right after bind (ref GraphExecutor)
+    assert ex.outputs[0].shape == (4, 3)
+    internals = out.get_internals()
+    names = [s.name for s in internals]
+    assert "fc1" in names and "fc2" in names
+
+
+def test_group_and_multi_output_eval():
+    a = mx.sym.var("a")
+    g = mx.sym.Group([a * 2, a + 1])
+    outs = g.eval(a=nd.array([1.0, 2.0]))
+    assert_almost_equal(outs[0].asnumpy(), [2.0, 4.0])
+    assert_almost_equal(outs[1].asnumpy(), [2.0, 3.0])
+
+
+def test_json_roundtrip_with_layers():
+    out = _net()
+    js = out.tojson()
+    back = mx.sym.load_json(js)
+    assert set(back.list_arguments()) == set(out.list_arguments())
+    rng = onp.random.RandomState(0)
+    binds = {"data": nd.array(rng.randn(2, 6).astype("float32"))}
+    ex1 = out.simple_bind(data=(2, 6))
+    ex2 = back.simple_bind(data=(2, 6))
+    for k, v in ex1.arg_dict.items():
+        ex2.arg_dict[k]._data = v._data
+    o1 = ex1.forward(data=binds["data"])[0]
+    o2 = ex2.forward(data=binds["data"])[0]
+    assert_almost_equal(o1.asnumpy(), o2.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_attr_and_wd_mult():
+    d = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=2, name="fc")
+    fc._set_attr(__lr_mult__="2.0")
+    assert fc.attr("__lr_mult__") == "2.0"
+    assert fc.attr_dict()["fc"]["__lr_mult__"] == "2.0"
+
+
+def test_symbol_arith_and_grad():
+    from incubator_mxnet_tpu.executor import Executor
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = (a * b + a) / 2.0
+    ex = out.simple_bind(a=(3,), b=(3,))
+    ex.arg_dict["a"]._data = nd.array([1.0, 2.0, 3.0])._data
+    ex.arg_dict["b"]._data = nd.array([4.0, 5.0, 6.0])._data
+    y = ex.forward(is_train=True)[0]
+    assert_almost_equal(y.asnumpy(), [(1*4+1)/2, (2*5+2)/2, (3*6+3)/2])
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["a"].asnumpy(),
+                        (onp.array([4.0, 5.0, 6.0]) + 1) / 2)
