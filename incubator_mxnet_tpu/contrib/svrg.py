@@ -56,12 +56,13 @@ class SVRGModule(Module):
         arg, aux = self.get_params()
         self._mod_aux.set_params(arg, aux)
         train_data.reset()
+        params = set(self._mod_aux.param_names)  # NEVER input/data grads
         sums, nbatch = {}, 0
         for batch in train_data:
             self._mod_aux.forward(batch, is_train=True)
             self._mod_aux.backward()
             for name, g in self._mod_aux._exec.grad_dict.items():
-                if g is None:
+                if g is None or name not in params:
                     continue
                 sums[name] = g.copy() if name not in sums else sums[name] + g
             nbatch += 1
@@ -74,8 +75,9 @@ class SVRGModule(Module):
             return  # before the first full-grad pass: plain SGD step
         self._mod_aux.forward(data_batch, is_train=True)
         self._mod_aux.backward()
+        params = set(self.param_names)
         for name, g in self._exec.grad_dict.items():
-            if g is None or name not in self._mu:
+            if g is None or name not in self._mu or name not in params:
                 continue
             g_tilde = self._mod_aux._exec.grad_dict.get(name)
             if g_tilde is not None:

@@ -19,7 +19,7 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, inputs_need_grad=True):
         self._symbol = symbol
         self._ctx = ctx
         self.arg_dict = dict(args or {})
@@ -29,15 +29,17 @@ class Executor:
         self._label_names = set()
         self._materialize()
         if args_grad is None and grad_req != "null":
-            # ref simple_bind: grad buffers for ALL args (incl. data inputs —
-            # input grads work); label vars excluded (loss layers produce no
-            # label cotangent)
+            # ref simple_bind: grad buffers for all args incl. data inputs
+            # (input grads work) unless inputs_need_grad=False (Module's
+            # default — saves a batch-sized buffer + per-step write); label
+            # vars always excluded (loss layers produce no label cotangent)
             labels = {v.name for v in self._walk_vars()
                       if getattr(v, "_is_label", False)
                       or v.name.endswith("_label")}
+            skip = labels if inputs_need_grad else                 labels | self._data_names()
             args_grad = {k: nd.zeros(v.shape, dtype=v.dtype)
                          for k, v in self.arg_dict.items()
-                         if k not in labels}
+                         if k not in skip}
         self.grad_dict = dict(args_grad or {})
         self.aux_dict = {k: self.arg_dict[k] for k in self._aux_names}
 

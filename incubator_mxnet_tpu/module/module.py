@@ -70,7 +70,8 @@ class Module(BaseModule):
                 shapes[desc[0]] = tuple(desc[1])
         args = {k: nd.zeros(v) for k, v in shapes.items()}
         self._exec = Executor(self.symbol, self._context, args,
-                              grad_req=grad_req if for_training else "null")
+                              grad_req=grad_req if for_training else "null",
+                              inputs_need_grad=inputs_need_grad)
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
         self.binded = True

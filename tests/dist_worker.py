@@ -62,9 +62,11 @@ def main():
                            [0.4, 0.0, -0.9, 0.1]))
     outc = nd.zeros((4,))
     kv3.pull("c", out=outc)
-    # rank0 quantizes to [0.5,-0.5,0,0]; rank1's 0.4/0.1 stay below the
-    # threshold (error feedback keeps them as residual) -> [0,0,-0.5,0]
-    assert onp.allclose(outc.asnumpy(), [0.5, -0.5, -0.5, 0.0]), outc.asnumpy()
+    # rank0 quantizes to [0.5,-0.5,0,0]; every other rank's 0.4/0.1 stay
+    # below the threshold (error feedback keeps them as residual)
+    # -> [0, 0, -0.5, 0] each
+    want = [0.5, -0.5, -0.5 * (nworkers - 1), 0.0]
+    assert onp.allclose(outc.asnumpy(), want), (outc.asnumpy(), want)
     print("RESULT compress %d ok" % rank, flush=True)
 
     # -- 3. global-mesh SPMD collective across processes ----------------
