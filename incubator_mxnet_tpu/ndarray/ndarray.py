@@ -1570,3 +1570,109 @@ def load(fname):
     if fmt == "list":
         return [items[str(i)] for i in range(len(items))]
     return items
+
+
+def smooth_l1(data, scalar=1.0, **kw):
+    """ref tensor/elemwise_unary_op.cc smooth_l1 (Huber with sigma=scalar)."""
+    s2 = float(scalar) ** 2
+
+    def fn(x):
+        ax = jnp.abs(x)
+        return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+    return _apply(fn, data)
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, **kw):
+    """ref elemwise_unary_op: clip(alpha*x + beta, 0, 1)."""
+    return _apply(lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), data)
+
+
+def softmax_cross_entropy(data, label, **kw):
+    """ref loss_binary_op.cc softmax_cross_entropy — summed batch loss."""
+
+    def fn(x, y):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        picked = jnp.take_along_axis(logp, y.astype(jnp.int32)[:, None],
+                                     axis=-1)
+        return -jnp.sum(picked)
+
+    return _apply(fn, data, _to_nd(label))
+
+
+def digamma(data, **kw):
+    """ref elemwise_unary_op psi/digamma."""
+    import jax.scipy.special as jss
+    return _apply(jss.digamma, data)
+
+
+def khatri_rao(*args, **kw):
+    """ref contrib/krprod.cc khatri_rao — column-wise Kronecker product."""
+
+    def fn(*mats):
+        out = mats[0]
+        for m in mats[1:]:
+            out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+        return out
+
+    return _apply(fn, *args)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    """ref init_op linspace."""
+    return NDArray(jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                                dtype=_np_dtype(dtype)))
+
+
+def trace(data, offset=0, axis1=0, axis2=1, **kw):
+    return _apply(lambda x: jnp.trace(x, offset, axis1, axis2), data)
+
+
+def meshgrid(*arrays, indexing="xy"):
+    outs = jnp.meshgrid(*[a._data for a in arrays], indexing=indexing)
+    return [NDArray(o) for o in outs]
+
+
+def unravel_index(data, shape=None, **kw):
+    """ref ravel.cc unravel_index: flat ids -> (ndim, N) coordinates."""
+
+    def fn(x):
+        coords = jnp.unravel_index(x.astype(jnp.int32), shape)
+        return jnp.stack(coords, axis=0)
+
+    return _apply(fn, data)
+
+
+def ravel_multi_index(data, shape=None, **kw):
+    """ref ravel.cc ravel_multi_index: (ndim, N) coords -> flat ids."""
+
+    def fn(x):
+        idx = tuple(x[i].astype(jnp.int32) for i in range(x.shape[0]))
+        return jnp.ravel_multi_index(idx, shape, mode="clip")
+
+    return _apply(fn, data)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):
+    """ref sample_multinomial_op — rows of probabilities -> samples."""
+    from . import random as _rnd
+    n = shape if isinstance(shape, int) else int(onp.prod(shape))
+
+    def fn(p, key):
+        logits = jnp.log(jnp.maximum(p, 1e-37))
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=(n,) + p.shape[:-1]).T
+
+    key = _rnd._next_key()
+    out = _apply(lambda p: fn(p, key), data)
+    return out.astype(dtype) if dtype != "int32" else out
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None, **kw):
+    from .contrib import arange_like as _al
+    return _al(data, start, step, axis)
+
+
+__all__ += ["smooth_l1", "hard_sigmoid", "softmax_cross_entropy", "digamma",
+            "khatri_rao", "linspace", "trace", "meshgrid", "unravel_index",
+            "ravel_multi_index", "multinomial", "arange_like"]

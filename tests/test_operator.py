@@ -413,3 +413,29 @@ def test_where_and_masking_grad():
         return nd.where(cond, x, y)
 
     check_numeric_gradient(fn, [a, b], rtol=1e-2, atol=1e-3)
+
+
+def test_classic_op_additions():
+    # smooth_l1 (Huber, sigma=1): quadratic inside, linear outside
+    out = nd.smooth_l1(nd.array([0.1, 2.0])).asnumpy()
+    assert_almost_equal(out, [0.005, 1.5], rtol=1e-5, atol=1e-6)
+    assert_almost_equal(nd.hard_sigmoid(nd.array([0.0])).asnumpy(), [0.5])
+    # softmax_cross_entropy sums over the batch
+    logits = onp.eye(3, dtype="float32") * 5
+    sce = float(nd.softmax_cross_entropy(nd.array(logits),
+                                         nd.array([0.0, 1.0, 2.0])).asnumpy())
+    ref = -3 * onp.log(onp.exp(5) / (onp.exp(5) + 2))
+    assert abs(sce - ref) < 1e-3
+    assert nd.khatri_rao(nd.ones((2, 3)), nd.ones((4, 3))).shape == (8, 3)
+    assert_almost_equal(nd.digamma(nd.array([1.0])).asnumpy(), [-0.5772157],
+                        rtol=1e-4, atol=1e-5)
+    assert nd.linspace(0, 1, 5).shape == (5,)
+    assert float(nd.trace(nd.array(onp.eye(3, dtype="float32"))).asnumpy()) == 3.0
+    xs, ys = nd.meshgrid(nd.arange(3), nd.arange(2))
+    assert xs.shape == (2, 3)
+    coords = nd.unravel_index(nd.array([5.0]), shape=(2, 3)).asnumpy()
+    assert coords.T.tolist() == [[1, 2]]
+    flat = nd.ravel_multi_index(nd.array([[1.0], [2.0]]), shape=(2, 3))
+    assert int(flat.asnumpy()[0]) == 5
+    # multinomial with a degenerate distribution is deterministic
+    assert (nd.multinomial(nd.array([[0.0, 1.0]]), shape=6).asnumpy() == 1).all()
