@@ -89,6 +89,8 @@ _SIMPLE_OPS = [
     "sequence_mask", "SequenceMask", "SequenceLast", "SequenceReverse",
     "make_loss", "BlockGrad", "identity", "L2Normalization", "LRN",
     "UpSampling", "BilinearResize2D", "slice_like", "amp_cast",
+    "smooth_l1", "hard_sigmoid", "softmax_cross_entropy", "digamma",
+    "khatri_rao", "trace",
 ]
 _g = globals()
 for _name in _SIMPLE_OPS:
