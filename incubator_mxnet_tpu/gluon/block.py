@@ -398,12 +398,25 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        import json
-        with open(symbol_file) as f:
-            meta = json.load(f)
-        raise NotImplementedError(
-            "SymbolBlock.imports of serialized graphs: use gluon save/load_parameters "
-            "+ model re-construction (graph JSON import is format %s)" % meta.get("format"))
+        """Load a serialized graph (+.params) as a Block
+        (ref block.py:1311 SymbolBlock.imports)."""
+        from .. import symbol as mxsym
+        sym = mxsym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        name_to_var = {v.name: v for v in sym.get_internals() if v.is_var}
+        inputs = [name_to_var[n] for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            loaded = nd.load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[-1]  # strip arg:/aux: prefixes
+                if name in block.params:
+                    p = block.params.get(name)
+                    p.shape = tuple(v.shape)
+                    p.initialize(init="zeros", force_reinit=True)
+                    p.set_data(v)
+        return block
 
     def forward(self, *args):
         bindings = {i.name: a for i, a in zip(self._inputs, args)}

@@ -78,3 +78,29 @@ def test_symbol_arith_and_grad():
     ex.backward()
     assert_almost_equal(ex.grad_dict["a"].asnumpy(),
                         (onp.array([4.0, 5.0, 6.0]) + 1) / 2)
+
+
+def test_symbol_block_imports(tmp_path):
+    # save a trained-ish symbol+params, re-import as a Gluon block
+    out = _net()
+    ex = out.simple_bind(data=(2, 6))
+    rng = onp.random.RandomState(0)
+    params = {}
+    for k, v in ex.arg_dict.items():
+        if k == "data":
+            continue
+        arr = nd.array(rng.randn(*v.shape).astype("float32") * 0.1)
+        ex.arg_dict[k]._data = arr._data
+        params["arg:" + k] = arr
+    x = nd.array(rng.randn(2, 6).astype("float32"))
+    ref = ex.forward(data=x)[0]
+
+    sym_file = str(tmp_path / "m-symbol.json")
+    par_file = str(tmp_path / "m.params")
+    out.save(sym_file)
+    nd.save(par_file, params)
+
+    from incubator_mxnet_tpu.gluon import SymbolBlock
+    blk = SymbolBlock.imports(sym_file, ["data"], par_file)
+    got = blk(x)
+    assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-5, atol=1e-6)
