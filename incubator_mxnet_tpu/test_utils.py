@@ -68,9 +68,20 @@ def rand_shape_nd(num_dim, dim=10):
 
 def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None,
                  scale=1.0):
-    if stype != "default":
-        raise NotImplementedError("dense-only TPU build")
-    return nd.array(onp.random.uniform(-scale, scale, size=shape).astype(dtype), ctx=ctx)
+    """ref test_utils.py rand_ndarray — density controls sparse fill."""
+    dense = onp.random.uniform(-scale, scale, size=shape).astype(dtype)
+    if stype == "default":
+        return nd.array(dense, ctx=ctx)
+    if density is None:
+        density = 0.5
+    mask = onp.random.rand(*shape) < density
+    if stype == "row_sparse":
+        row_mask = onp.random.rand(shape[0]) < density
+        dense = dense * row_mask.reshape((-1,) + (1,) * (len(shape) - 1))
+        return nd.array(dense).tostype("row_sparse")
+    if stype == "csr":
+        return nd.array(dense * mask).tostype("csr")
+    raise ValueError("unknown stype %r" % stype)
 
 
 def simple_forward(sym_or_fn, ctx=None, is_train=False, **inputs):
