@@ -408,14 +408,19 @@ class SymbolBlock(HybridBlock):
         inputs = [name_to_var[n] for n in input_names]
         block = SymbolBlock(sym, inputs)
         if param_file is not None:
-            loaded = nd.load(param_file)
-            for k, v in loaded.items():
-                name = k.split(":", 1)[-1]  # strip arg:/aux: prefixes
-                if name in block.params:
-                    p = block.params.get(name)
-                    p.shape = tuple(v.shape)
-                    p.initialize(init="zeros", force_reinit=True)
-                    p.set_data(v)
+            loaded = {k.split(":", 1)[-1]: v  # strip arg:/aux: prefixes
+                      for k, v in nd.load(param_file).items()}
+            extra = set(loaded) - set(block.params.keys())
+            missing = set(block.params.keys()) - set(loaded)
+            if extra or missing:
+                raise AssertionError(
+                    "params file does not match the graph: missing %s, "
+                    "extra %s" % (sorted(missing), sorted(extra)))
+            for name, v in loaded.items():
+                p = block.params.get(name)
+                p.shape = tuple(v.shape)
+                p.initialize(init="zeros", ctx=ctx, force_reinit=True)
+                p.set_data(v)
         return block
 
     def forward(self, *args):

@@ -74,13 +74,13 @@ def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None
         return nd.array(dense, ctx=ctx)
     if density is None:
         density = 0.5
-    mask = onp.random.rand(*shape) < density
     if stype == "row_sparse":
         row_mask = onp.random.rand(shape[0]) < density
         dense = dense * row_mask.reshape((-1,) + (1,) * (len(shape) - 1))
-        return nd.array(dense).tostype("row_sparse")
+        return nd.array(dense, ctx=ctx).tostype("row_sparse")
     if stype == "csr":
-        return nd.array(dense * mask).tostype("csr")
+        mask = onp.random.rand(*shape) < density
+        return nd.array(dense * mask, ctx=ctx).tostype("csr")
     raise ValueError("unknown stype %r" % stype)
 
 

@@ -104,3 +104,15 @@ def test_symbol_block_imports(tmp_path):
     blk = SymbolBlock.imports(sym_file, ["data"], par_file)
     got = blk(x)
     assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_block_imports_validates_params(tmp_path):
+    import pytest
+    out = _net()
+    sym_file = str(tmp_path / "m-symbol.json")
+    par_file = str(tmp_path / "m.params")
+    out.save(sym_file)
+    nd.save(par_file, {"arg:fc1_weight": nd.zeros((8, 6))})  # incomplete
+    from incubator_mxnet_tpu.gluon import SymbolBlock
+    with pytest.raises(AssertionError):
+        SymbolBlock.imports(sym_file, ["data"], par_file)
