@@ -1,0 +1,35 @@
+// Minimal C++ inference client (ref cpp-package/example/inference).
+//
+// Usage: predict <model.mxtpu> <input.bin>
+// Reads input 0 as raw float32 bytes from input.bin, runs one forward,
+// prints output 0 as one float per line (parsed by tests/test_cpp_package.py).
+//
+// Build: g++ -O3 -std=c++17 predict.cc -I../include -ldl -o predict
+#include <cstdio>
+#include <fstream>
+#include <vector>
+
+#include "mxnet_tpu_cpp/predictor.hpp"
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <model.mxtpu> <input.bin>\n", argv[0]);
+    return 2;
+  }
+  try {
+    mxnet_tpu_cpp::Predictor pred(argv[1]);
+
+    std::ifstream in(argv[2], std::ios::binary);
+    std::vector<char> buf((std::istreambuf_iterator<char>(in)),
+                          std::istreambuf_iterator<char>());
+    pred.SetInputBytes(0, buf.data(), static_cast<int64_t>(buf.size()));
+    pred.Forward();
+
+    std::vector<float> out = pred.GetOutput(0);
+    for (float v : out) std::printf("%.6e\n", v);
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,167 @@
+// mxnet_tpu_cpp — header-only C++ inference API over the flat C predict ABI
+// (ref cpp-package/include/mxnet-cpp over c_api.h; predict surface ref
+// src/c_api/c_predict_api.cc).
+//
+// Zero build-time dependencies: the library is resolved at runtime with
+// dlopen (path from MXTPU_PREDICT_LIB, or "libmxtpu_predict.so" on the
+// loader path), so a client compiles with just `g++ app.cc -ldl`.
+//
+//   mxnet_tpu_cpp::Predictor pred("model.mxtpu");
+//   pred.SetInput(0, batch);                 // std::vector<float>
+//   pred.Forward();
+//   std::vector<float> out = pred.GetOutput(0);
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+namespace detail {
+
+struct Api {
+  void* so;
+  const char* (*GetLastError)();
+  int (*Create)(const char*, void**);
+  int (*NumInputs)(void*, int*);
+  int (*NumOutputs)(void*, int*);
+  int (*GetInputShape)(void*, int, int64_t*, int, int*);
+  int (*GetOutputShape)(void*, int, int64_t*, int, int*);
+  int (*GetInputDType)(void*, int, char*, int);
+  int (*GetOutputDType)(void*, int, char*, int);
+  int (*SetInput)(void*, int, const void*, int64_t);
+  int (*Forward)(void*);
+  int (*GetOutput)(void*, int, void*, int64_t);
+  int (*Free)(void*);
+
+  template <typename T>
+  void Sym(T& fn, const char* name) {
+    fn = reinterpret_cast<T>(dlsym(so, name));
+    if (!fn)
+      throw std::runtime_error(std::string("missing symbol ") + name);
+  }
+
+  static Api& Get() {
+    static Api api = Load();
+    return api;
+  }
+
+  static Api Load() {
+    const char* path = getenv("MXTPU_PREDICT_LIB");
+    if (!path || !*path) path = "libmxtpu_predict.so";
+    Api a{};
+    a.so = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+    if (!a.so)
+      throw std::runtime_error(std::string("cannot dlopen ") + path + ": " +
+                               dlerror());
+    a.Sym(a.GetLastError, "MXTPUPredGetLastError");
+    a.Sym(a.Create, "MXTPUPredCreate");
+    a.Sym(a.NumInputs, "MXTPUPredNumInputs");
+    a.Sym(a.NumOutputs, "MXTPUPredNumOutputs");
+    a.Sym(a.GetInputShape, "MXTPUPredGetInputShape");
+    a.Sym(a.GetOutputShape, "MXTPUPredGetOutputShape");
+    a.Sym(a.GetInputDType, "MXTPUPredGetInputDType");
+    a.Sym(a.GetOutputDType, "MXTPUPredGetOutputDType");
+    a.Sym(a.SetInput, "MXTPUPredSetInput");
+    a.Sym(a.Forward, "MXTPUPredForward");
+    a.Sym(a.GetOutput, "MXTPUPredGetOutput");
+    a.Sym(a.Free, "MXTPUPredFree");
+    return a;
+  }
+};
+
+inline void Check(int rc) {
+  if (rc != 0)
+    throw std::runtime_error(Api::Get().GetLastError());
+}
+
+}  // namespace detail
+
+class Predictor {
+ public:
+  explicit Predictor(const std::string& artifact_path) {
+    detail::Check(detail::Api::Get().Create(artifact_path.c_str(), &handle_));
+  }
+  ~Predictor() {
+    if (handle_) detail::Api::Get().Free(handle_);
+  }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+
+  int NumInputs() const {
+    int n = 0;
+    detail::Check(detail::Api::Get().NumInputs(handle_, &n));
+    return n;
+  }
+  int NumOutputs() const {
+    int n = 0;
+    detail::Check(detail::Api::Get().NumOutputs(handle_, &n));
+    return n;
+  }
+
+  std::vector<int64_t> InputShape(int i) const {
+    return Shape(detail::Api::Get().GetInputShape, i);
+  }
+  std::vector<int64_t> OutputShape(int i) const {
+    return Shape(detail::Api::Get().GetOutputShape, i);
+  }
+  std::string InputDType(int i) const {
+    return DType(detail::Api::Get().GetInputDType, i);
+  }
+  std::string OutputDType(int i) const {
+    return DType(detail::Api::Get().GetOutputDType, i);
+  }
+
+  // Raw-buffer interface (any dtype).
+  void SetInputBytes(int i, const void* data, int64_t nbytes) {
+    detail::Check(detail::Api::Get().SetInput(handle_, i, data, nbytes));
+  }
+  void GetOutputBytes(int i, void* data, int64_t nbytes) const {
+    detail::Check(detail::Api::Get().GetOutput(handle_, i, data, nbytes));
+  }
+
+  // float32 convenience (the common deployment dtype, as in the reference).
+  void SetInput(int i, const std::vector<float>& data) {
+    SetInputBytes(i, data.data(),
+                  static_cast<int64_t>(data.size() * sizeof(float)));
+  }
+  std::vector<float> GetOutput(int i) const {
+    if (OutputDType(i) != "float32")
+      throw std::runtime_error("GetOutput(float) on dtype " + OutputDType(i) +
+                               " — use GetOutputBytes");
+    std::vector<int64_t> s = OutputShape(i);
+    int64_t n = 1;
+    for (int64_t d : s) n *= d;
+    std::vector<float> out(static_cast<size_t>(n));
+    GetOutputBytes(i, out.data(), n * static_cast<int64_t>(sizeof(float)));
+    return out;
+  }
+
+  void Forward() { detail::Check(detail::Api::Get().Forward(handle_)); }
+
+ private:
+  template <typename Fn>
+  std::vector<int64_t> Shape(Fn fn, int i) const {
+    int64_t buf[16];
+    int ndim = 0;
+    detail::Check(fn(handle_, i, buf, 16, &ndim));
+    return std::vector<int64_t>(buf, buf + ndim);
+  }
+  template <typename Fn>
+  std::string DType(Fn fn, int i) const {
+    char buf[32];
+    detail::Check(fn(handle_, i, buf, sizeof(buf)));
+    return buf;
+  }
+
+  void* handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp

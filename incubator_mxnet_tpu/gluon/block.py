@@ -420,7 +420,7 @@ class SymbolBlock(HybridBlock):
                 p = block.params.get(name)
                 p.shape = tuple(v.shape)
                 p.initialize(init="zeros", ctx=ctx, force_reinit=True)
-                p.set_data(v)
+                p.set_data(v if ctx is None else v.as_in_context(ctx))
         return block
 
     def forward(self, *args):

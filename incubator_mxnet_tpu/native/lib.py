@@ -16,10 +16,13 @@ _LIB = None
 
 
 def build(force=False):
-    """Compile src/*.cc into libmxtpu.so with g++ -O3 -pthread -ljpeg."""
+    """Compile src/*.cc into libmxtpu.so with g++ -O3 -pthread -ljpeg.
+
+    c_predict_api.cc is excluded — it embeds CPython and builds into its
+    own libmxtpu_predict.so (see build_predict)."""
     srcs = sorted(
         os.path.join(_DIR, "src", f) for f in os.listdir(os.path.join(_DIR, "src"))
-        if f.endswith(".cc"))
+        if f.endswith(".cc") and f != "c_predict_api.cc")
     if os.path.exists(_SO) and not force and \
             os.path.getmtime(_SO) >= max(os.path.getmtime(s) for s in srcs):
         return _SO
@@ -27,6 +30,30 @@ def build(force=False):
            *srcs, "-o", _SO, "-ljpeg"]
     subprocess.run(cmd, check=True, capture_output=True)
     return _SO
+
+
+_PREDICT_SO = os.path.join(_DIR, "libmxtpu_predict.so")
+
+
+def build_predict(force=False):
+    """Compile the C predict API (embedded CPython) into libmxtpu_predict.so.
+
+    Include/link flags come from sysconfig of THIS interpreter, so the
+    library embeds a matching libpython (ref c_predict_api deployment)."""
+    import sysconfig
+    src = os.path.join(_DIR, "src", "c_predict_api.cc")
+    if os.path.exists(_PREDICT_SO) and not force and \
+            os.path.getmtime(_PREDICT_SO) >= os.path.getmtime(src):
+        return _PREDICT_SO
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = "python" + (sysconfig.get_config_var("LDVERSION")
+                      or "%d.%d" % sys.version_info[:2])
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-I", inc, "-L", libdir, "-Wl,-rpath," + libdir,
+           "-l" + ver, "-ldl", "-o", _PREDICT_SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _PREDICT_SO
 
 
 def _load():

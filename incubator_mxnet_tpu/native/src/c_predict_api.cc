@@ -1,0 +1,344 @@
+// C predict API — flat C ABI for running exported .mxtpu serving artifacts
+// from C/C++ without writing any Python (ref src/c_api/c_predict_api.cc:
+// MXPredCreate/SetInput/Forward/GetOutputShape/GetOutput/Free; error
+// convention ref MXGetLastError).
+//
+// Design (TPU-native): the artifact is a serialized COMPILED program
+// (StableHLO via jax.export — see contrib/serving.py), not an op graph, so
+// there is no operator registry to re-implement natively. This library
+// embeds a CPython interpreter to host the XLA runtime that executes the
+// artifact — the same layering as the reference, where c_predict_api.cc is
+// a thin shim over the full core; here the "core" is the Python/JAX layer
+// by design (SURVEY §7). The ABI itself is pure C: opaque handles, raw
+// byte buffers, int return codes, thread-local error strings.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC c_predict_api.cc
+//        -I$(python3-config --includes) -lpython3.12 -o libmxtpu_predict.so
+// Loading from an already-running Python process (ctypes) also works: the
+// library detects the live interpreter and just uses it.
+//
+// Thread-safety: calls are serialized through the GIL; distinct handles
+// may be used from distinct threads.
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_err;
+
+int fail(const std::string& msg) {
+  g_err = msg;
+  return -1;
+}
+
+// Fetch the pending Python exception into g_err.
+int fail_py(const char* where) {
+  std::string msg = std::string(where) + ": python error";
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    if (value) {
+      PyObject* s = PyObject_Str(value);
+      if (s) {
+        const char* c = PyUnicode_AsUTF8(s);
+        if (c) msg = std::string(where) + ": " + c;
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+    PyErr_Clear();
+  }
+  return fail(msg);
+}
+
+std::once_flag g_init_once;
+bool g_init_ok = false;
+std::string g_init_err;
+
+// Directory containing this .so → repo root two levels up
+// (<root>/incubator_mxnet_tpu/native/libmxtpu_predict.so).
+std::string repo_root_from_so() {
+  Dl_info info;
+  if (!dladdr(reinterpret_cast<void*>(&repo_root_from_so), &info) ||
+      !info.dli_fname)
+    return "";
+  std::string p(info.dli_fname);
+  for (int up = 0; up < 3; ++up) {
+    auto pos = p.find_last_of('/');
+    if (pos == std::string::npos) return "";
+    p.resize(pos);
+  }
+  return p;
+}
+
+void init_python() {
+  if (Py_IsInitialized()) {  // hosted inside a live interpreter (ctypes)
+    g_init_ok = true;
+    return;
+  }
+  PyConfig config;
+  PyConfig_InitPythonConfig(&config);
+  const char* exe = getenv("MXTPU_PYTHON");
+  if (exe && *exe) {
+    PyStatus st = PyConfig_SetBytesString(&config, &config.executable, exe);
+    if (PyStatus_Exception(st)) {
+      PyConfig_Clear(&config);
+      g_init_err = "bad MXTPU_PYTHON";
+      return;
+    }
+  }
+  PyStatus st = Py_InitializeFromConfig(&config);
+  PyConfig_Clear(&config);
+  if (PyStatus_Exception(st)) {
+    g_init_err = std::string("Py_InitializeFromConfig failed: ") +
+                 (st.err_msg ? st.err_msg : "?");
+    return;
+  }
+  std::string root = repo_root_from_so();
+  if (!root.empty()) {
+    std::string quoted;  // escape for a single-quoted python literal
+    for (char ch : root) {
+      if (ch == '\\' || ch == '\'') quoted += '\\';
+      quoted += ch;
+    }
+    std::string code = "import sys; sys.path.insert(0, '" + quoted + "')";
+    PyRun_SimpleString(code.c_str());
+  }
+  // Pin the JAX platform from the caller's env BEFORE any framework import:
+  // the deployment env's sitecustomize may register accelerator plugins that
+  // would otherwise win during package import (backend init is first-touch).
+  PyRun_SimpleString(
+      "import os\n"
+      "if os.environ.get('JAX_PLATFORMS'):\n"
+      "    import jax\n"
+      "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n");
+  g_init_ok = true;
+  // Drop the GIL acquired by initialization so PyGILState_Ensure works
+  // from any caller thread (including this one).
+  PyEval_SaveThread();
+}
+
+// RAII: ensure interpreter + hold GIL for the scope.
+struct Gil {
+  PyGILState_STATE state;
+  bool ok;
+  Gil() : ok(false) {
+    std::call_once(g_init_once, init_python);
+    if (!g_init_ok) return;
+    state = PyGILState_Ensure();
+    ok = true;
+  }
+  ~Gil() {
+    if (ok) PyGILState_Release(state);
+  }
+};
+
+PyObject* embed_module() {  // borrowed-style: cached strong ref
+  static PyObject* mod = nullptr;
+  if (!mod)
+    mod = PyImport_ImportModule("incubator_mxnet_tpu.native._predict_embed");
+  return mod;
+}
+
+struct PredHandle {
+  PyObject* state;  // strong ref to _PredState
+};
+
+// Call module fn with args; returns new ref or null.
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* mod = embed_module();
+  if (!mod) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return r;
+}
+
+int get_int(const char* fn, PredHandle* h, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(O)", h->state);
+  PyObject* r = call(fn, args);
+  Py_DECREF(args);
+  if (!r) return fail_py(fn);
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) return fail_py(fn);
+  return 0;
+}
+
+int get_shape(const char* fn, PredHandle* h, int index, int64_t* out_shape,
+              int cap, int* out_ndim) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(Oi)", h->state, index);
+  PyObject* r = call(fn, args);
+  Py_DECREF(args);
+  if (!r) return fail_py(fn);
+  Py_ssize_t n = PyTuple_Size(r);
+  *out_ndim = (int)n;
+  if (out_shape) {
+    if (n > cap) {
+      Py_DECREF(r);
+      return fail("shape buffer too small");
+    }
+    for (Py_ssize_t i = 0; i < n; ++i)
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int get_dtype(const char* fn, PredHandle* h, int index, char* buf, int cap) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(Oi)", h->state, index);
+  PyObject* r = call(fn, args);
+  Py_DECREF(args);
+  if (!r) return fail_py(fn);
+  const char* s = PyUnicode_AsUTF8(r);
+  if (!s || (int)strlen(s) + 1 > cap) {
+    Py_DECREF(r);
+    return fail("dtype buffer too small");
+  }
+  snprintf(buf, cap, "%s", s);
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPUPredGetLastError() { return g_err.c_str(); }
+
+// Load a .mxtpu serving artifact (contrib/serving.export_model output).
+// ≙ MXPredCreate (the artifact replaces symbol-json + param-blob).
+int MXTPUPredCreate(const char* artifact_path, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(s)", artifact_path);
+  if (!args) return fail_py("MXTPUPredCreate");
+  PyObject* st = call("create", args);
+  Py_DECREF(args);
+  if (!st) return fail_py("MXTPUPredCreate");
+  auto* h = new PredHandle{st};
+  *out = h;
+  return 0;
+}
+
+int MXTPUPredNumInputs(void* handle, int* out) {
+  return get_int("num_inputs", static_cast<PredHandle*>(handle), out);
+}
+
+int MXTPUPredNumOutputs(void* handle, int* out) {
+  return get_int("num_outputs", static_cast<PredHandle*>(handle), out);
+}
+
+int MXTPUPredGetInputShape(void* handle, int index, int64_t* shape, int cap,
+                           int* out_ndim) {
+  return get_shape("input_shape", static_cast<PredHandle*>(handle), index,
+                   shape, cap, out_ndim);
+}
+
+int MXTPUPredGetOutputShape(void* handle, int index, int64_t* shape, int cap,
+                            int* out_ndim) {
+  return get_shape("output_shape", static_cast<PredHandle*>(handle), index,
+                   shape, cap, out_ndim);
+}
+
+// dtype as its numpy name ("float32", "int8", "bfloat16", ...).
+int MXTPUPredGetInputDType(void* handle, int index, char* buf, int cap) {
+  return get_dtype("input_dtype", static_cast<PredHandle*>(handle), index,
+                   buf, cap);
+}
+
+int MXTPUPredGetOutputDType(void* handle, int index, char* buf, int cap) {
+  return get_dtype("output_dtype", static_cast<PredHandle*>(handle), index,
+                   buf, cap);
+}
+
+// data: C-contiguous row-major buffer of exactly the input's
+// shape-product x dtype-size bytes. ≙ MXPredSetInput.
+int MXTPUPredSetInput(void* handle, int index, const void* data,
+                      int64_t nbytes) {
+  auto* h = static_cast<PredHandle*>(handle);
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* view = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+  if (!view) return fail_py("MXTPUPredSetInput");
+  PyObject* args = Py_BuildValue("(OiN)", h->state, index, view);
+  if (!args) {
+    Py_DECREF(view);
+    return fail_py("MXTPUPredSetInput");
+  }
+  PyObject* r = call("set_input", args);
+  Py_DECREF(args);  // releases view too ("N")
+  if (!r) return fail_py("MXTPUPredSetInput");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredForward(void* handle) {
+  auto* h = static_cast<PredHandle*>(handle);
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(O)", h->state);
+  PyObject* r = call("forward", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUPredForward");
+  Py_DECREF(r);
+  return 0;
+}
+
+// Copies output `index` into data (must be exactly the output's byte size).
+// ≙ MXPredGetOutput.
+int MXTPUPredGetOutput(void* handle, int index, void* data, int64_t nbytes) {
+  auto* h = static_cast<PredHandle*>(handle);
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(Oi)", h->state, index);
+  PyObject* r = call("output_bytes", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUPredGetOutput");
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return fail_py("MXTPUPredGetOutput");
+  }
+  if (len != nbytes) {
+    Py_DECREF(r);
+    return fail("output size mismatch: have " + std::to_string(len) +
+                " bytes, caller gave " + std::to_string(nbytes));
+  }
+  memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredFree(void* handle) {
+  auto* h = static_cast<PredHandle*>(handle);
+  if (Py_IsInitialized()) {
+    PyGILState_STATE s = PyGILState_Ensure();
+    Py_XDECREF(h->state);
+    PyGILState_Release(s);
+  }
+  delete h;
+  return 0;
+}
+
+int mxtpu_predict_abi_version() { return 1; }
+
+}  // extern "C"
