@@ -1,0 +1,128 @@
+"""Exception propagation + concurrent-inference safety.
+
+Refs: tests/python/unittest/test_exc_handling.py (errors from async engine
+ops must surface to the caller and leave the session usable),
+tests/cpp/thread_safety_test.cc + src/imperative/cached_op_threadsafe.cc
+(one CachedOp served from many threads), and
+tests/python/unittest/test_thread_local.py (per-thread autograd state).
+
+TPU-native mapping: eager dispatch validates shapes/dtypes synchronously
+(tracing is eager even though device execution is async), so op errors are
+raised AT THE CALL SITE as typed mx.error exceptions; a hybridized block's
+compiled executable is immutable and therefore safely shared across
+threads (jax jit dispatch is thread-safe); autograd recording scopes are
+per-thread, matching Imperative's thread-local is_recording_
+(include/mxnet/imperative.h:206-212).
+"""
+import concurrent.futures as futures
+import threading
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu import error as mx_error
+
+
+def test_op_error_is_typed_and_session_survives():
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception) as ei:
+        nd.dot(a, b).wait_to_read()
+    # still a catchable standard failure; afterwards the session works
+    assert ei.value is not None
+    c = nd.dot(a, nd.ones((3, 4)))
+    assert c.shape == (2, 4)
+    onp.testing.assert_allclose(c.asnumpy(), 3 * onp.ones((2, 4)), rtol=0)
+
+
+def test_error_inside_record_leaves_tape_usable():
+    x = nd.ones((2, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()
+        with pytest.raises(Exception):
+            nd.dot(x, nd.ones((3, 3)))  # fails mid-record
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.ones((2, 2)))
+    # a fresh record scope afterwards is clean
+    with autograd.record():
+        z = (x * 3).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 3 * onp.ones((2, 2)))
+
+
+def test_typed_errors_registry():
+    # mx.error exposes the reference's typed exception surface (error.py)
+    assert issubclass(mx_error.ValueError, ValueError)
+    assert issubclass(mx_error.TypeError, TypeError)
+    assert issubclass(mx_error.MXNetError, RuntimeError)
+    with pytest.raises(mx_error.NotImplementedForSymbol):
+        raise mx_error.NotImplementedForSymbol(len, None)
+
+
+def test_trainer_survives_failed_forward():
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    with pytest.raises(Exception):
+        with autograd.record():
+            net(nd.ones((2, 5)))  # wrong in_units
+    # the failed step must not poison params or optimizer state
+    with autograd.record():
+        loss = gluon.loss.L2Loss()(net(nd.ones((2, 4))), nd.zeros((2, 3)))
+    loss.backward()
+    trainer.step(2)
+    assert onp.isfinite(net.weight.data().asnumpy()).all()
+
+
+def test_concurrent_inference_one_cached_op():
+    """N threads share ONE hybridized block (ref cached_op_threadsafe.cc)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+            gluon.nn.Dense(8, in_units=32))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    xs = [nd.array(onp.random.RandomState(i).randn(4, 16).astype("float32"))
+          for i in range(8)]
+    expected = [net(x).asnumpy() for x in xs]  # warm the cache, get truth
+
+    def run(i):
+        out = net(xs[i % len(xs)])
+        return i % len(xs), out.asnumpy()
+
+    with futures.ThreadPoolExecutor(max_workers=8) as ex:
+        for idx, got in ex.map(run, range(64)):
+            onp.testing.assert_allclose(got, expected[idx], rtol=1e-6)
+
+
+def test_autograd_recording_is_thread_local():
+    """record() in one thread must not leak into another
+    (ref Imperative per-thread is_recording_, test_thread_local.py)."""
+    seen = {}
+    gate_in = threading.Barrier(2, timeout=30)
+
+    def recorder():
+        x = nd.ones((2, 2))
+        x.attach_grad()
+        with autograd.record():
+            y = (x * 2).sum()
+            gate_in.wait()          # other thread samples while we record
+            gate_in.wait()
+            y.backward()
+        seen["grad"] = x.grad.asnumpy()
+
+    def bystander():
+        gate_in.wait()
+        seen["other_recording"] = autograd.is_recording()
+        gate_in.wait()
+
+    t1 = threading.Thread(target=recorder)
+    t2 = threading.Thread(target=bystander)
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert seen["other_recording"] is False
+    onp.testing.assert_allclose(seen["grad"], 2 * onp.ones((2, 2)))
