@@ -938,3 +938,224 @@ __all__ += [
     "diag_indices", "tril_indices", "triu_indices", "allclose", "isclose",
     "array_equal", "ptp", "may_share_memory",
 ]
+
+
+# ------------------------------------------------------------ batch 3:
+# window fns, nan-reductions, linalg completion, misc parity
+# (ref python/mxnet/numpy __all__ — blackman/hamming/hanning windows from
+#  src/operator/numpy/np_window_op.cc; eig family np_eig.cc; tensorinv/
+#  tensorsolve np_tensorinv_op.cc/np_tensorsolve_op.cc)
+def blackman(M, dtype=None, ctx=None):
+    return ndarray(_ctx_put(jnp.blackman(M), ctx))
+
+
+def hamming(M, dtype=None, ctx=None):
+    return ndarray(_ctx_put(jnp.hamming(M), ctx))
+
+
+def hanning(M, dtype=None, ctx=None):
+    return ndarray(_ctx_put(jnp.hanning(M), ctx))
+
+
+def empty_like(prototype, dtype=None, order="C", ctx=None):
+    # XLA has no uninitialized buffers; zeros is the deterministic choice
+    return ndarray(jnp.zeros_like(_to(prototype)._data,
+                                  dtype=_np_dtype(dtype) if dtype else None))
+
+
+def fabs(x):
+    return _apply_np(jnp.fabs, _to(x))
+
+
+def isneginf(x):
+    return ndarray(jnp.isneginf(_to(x)._data))
+
+
+def isposinf(x):
+    return ndarray(jnp.isposinf(_to(x)._data))
+
+
+def ldexp(x1, x2):
+    # exponent must be integral (jnp.ldexp contract); the reference's
+    # np_ldexp accepts float exponents, so cast like it truncates
+    def fn(a, b):
+        return jnp.ldexp(a, b.astype(jnp.int32)
+                         if not jnp.issubdtype(b.dtype, jnp.integer) else b)
+    return _apply_np(fn, _to(x1), _to(x2))
+
+
+def logaddexp(x1, x2):
+    return _apply_np(jnp.logaddexp, _to(x1), _to(x2))
+
+
+def polyval(p, x):
+    return _apply_np(jnp.polyval, _to(p), _to(x))
+
+
+def vdot(a, b):
+    return _apply_np(jnp.vdot, _to(a), _to(b))
+
+
+def shape(a):
+    return tuple(_to(a).shape)
+
+
+def shares_memory(a, b, max_work=None):
+    return False  # immutable jax buffers: no writable aliasing (see may_share_memory)
+
+
+def diag_indices_from(arr):
+    idx = onp.diag_indices_from(onp.empty(_to(arr).shape))
+    return tuple(ndarray(jnp.asarray(i)) for i in idx)
+
+
+def median(a, axis=None, keepdims=False):
+    return _apply_np(lambda x: jnp.median(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nansum(a, axis=None, keepdims=False):
+    return _apply_np(lambda x: jnp.nansum(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nanmax(a, axis=None, keepdims=False):
+    return _apply_np(lambda x: jnp.nanmax(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nanmin(a, axis=None, keepdims=False):
+    return _apply_np(lambda x: jnp.nanmin(x, axis=axis, keepdims=keepdims), _to(a))
+
+
+def nanargmax(a, axis=None):
+    return ndarray(jnp.nanargmax(_to(a)._data, axis=axis))
+
+
+def nanargmin(a, axis=None):
+    return ndarray(jnp.nanargmin(_to(a)._data, axis=axis))
+
+
+def nancumsum(a, axis=None):
+    return _apply_np(lambda x: jnp.nancumsum(x, axis=axis), _to(a))
+
+
+def take_along_axis(arr, indices, axis):
+    return _apply_np(lambda x: jnp.take_along_axis(x, _to(indices)._data, axis),
+                     _to(arr))
+
+
+def isin(element, test_elements, invert=False):
+    return ndarray(jnp.isin(_to(element)._data, _to(test_elements)._data,
+                            invert=invert))
+
+
+def in1d(ar1, ar2, invert=False):
+    return ndarray(jnp.isin(_to(ar1)._data.ravel(), _to(ar2)._data,
+                            invert=invert))
+
+
+def union1d(ar1, ar2):
+    # eager-only (result shape is data-dependent); host set-op like the
+    # reference's CPU kernels
+    return ndarray(jnp.asarray(onp.union1d(_to(ar1).asnumpy(), _to(ar2).asnumpy())))
+
+
+def intersect1d(ar1, ar2):
+    return ndarray(jnp.asarray(onp.intersect1d(_to(ar1).asnumpy(), _to(ar2).asnumpy())))
+
+
+def setdiff1d(ar1, ar2):
+    return ndarray(jnp.asarray(onp.setdiff1d(_to(ar1).asnumpy(), _to(ar2).asnumpy())))
+
+
+def real(x):
+    return _apply_np(jnp.real, _to(x))
+
+
+def imag(x):
+    return _apply_np(jnp.imag, _to(x))
+
+
+def conj(x):
+    return _apply_np(jnp.conj, _to(x))
+
+
+def positive(x):
+    return _apply_np(jnp.positive, _to(x))
+
+
+def float_power(x1, x2):
+    return _apply_np(jnp.float_power, _to(x1), _to(x2))
+
+
+def fmod(x1, x2):
+    return _apply_np(jnp.fmod, _to(x1), _to(x2))
+
+
+def divmod(x1, x2):  # noqa: A001
+    q = _apply_np(jnp.floor_divide, _to(x1), _to(x2))
+    r = _apply_np(jnp.remainder, _to(x1), _to(x2))
+    return q, r
+
+
+def gcd(x1, x2):
+    return ndarray(jnp.gcd(_to(x1)._data, _to(x2)._data))
+
+
+def lcm(x1, x2):
+    return ndarray(jnp.lcm(_to(x1)._data, _to(x2)._data))
+
+
+def rollaxis(a, axis, start=0):
+    return _apply_np(lambda x: jnp.rollaxis(x, axis, start), _to(a))
+
+
+def sinc(x):
+    return _apply_np(jnp.sinc, _to(x))
+
+
+def copysign(x1, x2):
+    return _apply_np(jnp.copysign, _to(x1), _to(x2))
+
+
+def rint(x):
+    return _apply_np(jnp.rint, _to(x))
+
+
+def _linalg_eig(self, a):
+    """General (non-symmetric) eig: XLA supports it on CPU only, so this is
+    the host-fallback path (the reference's numpy_op_fallback.py idiom)."""
+    w, v = onp.linalg.eig(_to(a).asnumpy())
+    return ndarray(jnp.asarray(w)), ndarray(jnp.asarray(v))
+
+
+def _linalg_eigvals(self, a):
+    return ndarray(jnp.asarray(onp.linalg.eigvals(_to(a).asnumpy())))
+
+
+def _linalg_eigvalsh(self, a, UPLO="L"):
+    return ndarray(jnp.linalg.eigvalsh(_to(a)._data, UPLO=UPLO))
+
+
+def _linalg_tensorinv(self, a, ind=2):
+    return _apply_np(lambda x: jnp.linalg.tensorinv(x, ind=ind), _to(a))
+
+
+def _linalg_tensorsolve(self, a, b, axes=None):
+    return _apply_np(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                     _to(a), _to(b))
+
+
+_NPLinalg.eig = _linalg_eig
+_NPLinalg.eigvals = _linalg_eigvals
+_NPLinalg.eigvalsh = _linalg_eigvalsh
+_NPLinalg.tensorinv = _linalg_tensorinv
+_NPLinalg.tensorsolve = _linalg_tensorsolve
+
+__all__ += [
+    "blackman", "hamming", "hanning", "empty_like", "fabs", "isneginf",
+    "isposinf", "ldexp", "logaddexp", "polyval", "vdot", "shape",
+    "shares_memory", "diag_indices_from", "median", "nansum", "nanmax",
+    "nanmin", "nanargmax", "nanargmin", "nancumsum", "take_along_axis",
+    "isin", "in1d", "union1d", "intersect1d", "setdiff1d", "real", "imag",
+    "conj", "positive", "float_power", "fmod", "divmod", "gcd", "lcm",
+    "rollaxis", "sinc", "copysign", "rint",
+]

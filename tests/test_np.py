@@ -230,3 +230,97 @@ def test_npx_identity_return_does_not_corrupt_input():
     out = npx.dropout(x, p=0.5)  # not recording/training -> identity
     assert type(x).__name__ == "NDArray"
     assert isinstance(out, type(np.array([0.0])))
+
+
+def test_np_batch3_windows_and_misc():
+    for name in ("blackman", "hamming", "hanning"):
+        got = getattr(np, name)(8).asnumpy()
+        want = getattr(onp, name)(8)
+        assert_almost_equal(got, want.astype("float32"), rtol=1e-5, atol=1e-6)
+    x = onp.array([[-1.5, 2.5], [3.5, -4.5]], "float32")
+    a = np.array(x)
+    assert_almost_equal(np.fabs(a), onp.fabs(x))
+    assert_almost_equal(np.rint(a), onp.rint(x))
+    assert_almost_equal(np.copysign(a, np.array([[1.0, -1], [-1, 1]])),
+                        onp.copysign(x, onp.array([[1.0, -1], [-1, 1]])))
+    assert_almost_equal(np.polyval(np.array([1.0, 0, -2]), a),
+                        onp.polyval(onp.array([1.0, 0, -2]), x), rtol=1e-5)
+    assert np.shape(a) == (2, 2)
+    assert np.empty_like(a).shape == (2, 2)
+    assert np.shares_memory(a, a) is False
+    v = np.vdot(a, a)
+    assert_almost_equal(v, onp.vdot(x, x), rtol=1e-5)
+    assert_almost_equal(np.logaddexp(a, a), onp.logaddexp(x, x), rtol=1e-5)
+    assert_almost_equal(np.ldexp(np.array([1.0, 2.0]), np.array([2, 3])),
+                        onp.ldexp(onp.array([1.0, 2.0]), onp.array([2, 3])))
+
+
+def test_np_batch3_nan_reductions():
+    x = onp.array([[1.0, onp.nan, 3.0], [onp.nan, 5.0, 6.0]], "float32")
+    a = np.array(x)
+    assert_almost_equal(np.nansum(a), onp.nansum(x))
+    assert_almost_equal(np.nansum(a, axis=0), onp.nansum(x, axis=0))
+    assert_almost_equal(np.nanmax(a, axis=1), onp.nanmax(x, axis=1))
+    assert_almost_equal(np.nanmin(a), onp.nanmin(x))
+    assert int(np.nanargmax(a)) == int(onp.nanargmax(x))
+    assert int(np.nanargmin(a)) == int(onp.nanargmin(x))
+    assert_almost_equal(np.nancumsum(a, axis=1), onp.nancumsum(x, axis=1))
+    assert_almost_equal(np.median(np.array([3.0, 1.0, 2.0])), 2.0)
+
+
+def test_np_batch3_set_ops_and_indexing():
+    a = np.array([1, 2, 3, 4, 5])
+    b = np.array([3, 4, 9])
+    assert np.isin(a, b).asnumpy().tolist() == [False, False, True, True, False]
+    assert np.in1d(a, b).asnumpy().tolist() == [False, False, True, True, False]
+    assert np.union1d(a, b).asnumpy().tolist() == [1, 2, 3, 4, 5, 9]
+    assert np.intersect1d(a, b).asnumpy().tolist() == [3, 4]
+    assert np.setdiff1d(a, b).asnumpy().tolist() == [1, 2, 5]
+    x = onp.random.rand(3, 4).astype("float32")
+    idx = onp.argsort(x, axis=1)
+    got = np.take_along_axis(np.array(x), np.array(idx.astype("int32")), 1)
+    assert_almost_equal(got, onp.take_along_axis(x, idx, 1))
+    r, c = np.diag_indices_from(np.zeros((3, 3)))
+    assert r.asnumpy().tolist() == [0, 1, 2]
+
+
+def test_np_batch3_arith_parity():
+    x = onp.array([7.0, -7.0, 7.5], "float32")
+    y = onp.array([3.0, 3.0, -2.0], "float32")
+    a, b = np.array(x), np.array(y)
+    q, r = np.divmod(a, b)
+    qe, re_ = onp.divmod(x, y)
+    assert_almost_equal(q, qe)
+    assert_almost_equal(r, re_)
+    assert_almost_equal(np.fmod(a, b), onp.fmod(x, y))
+    assert_almost_equal(np.float_power(np.array([2.0, 3.0]), 2.0),
+                        onp.float_power(onp.array([2.0, 3.0]), 2.0), rtol=1e-6)
+    assert np.gcd(np.array([12, 8]).astype("int32"),
+                  np.array([18, 12]).astype("int32")).asnumpy().tolist() == [6, 4]
+    assert np.lcm(np.array([4, 6]).astype("int32"),
+                  np.array([6, 4]).astype("int32")).asnumpy().tolist() == [12, 12]
+    assert_almost_equal(np.positive(a), x)
+    assert_almost_equal(np.sinc(np.array([0.0, 0.5])),
+                        onp.sinc(onp.array([0.0, 0.5], "float32")), rtol=1e-5)
+    assert_almost_equal(np.real(a), x)
+    assert_almost_equal(np.imag(a), onp.zeros_like(x))
+    assert np.rollaxis(np.zeros((2, 3, 4)), 2).shape == (4, 2, 3)
+    assert np.isneginf(np.array([-onp.inf, 1.0])).asnumpy().tolist() == [True, False]
+    assert np.isposinf(np.array([onp.inf, 1.0])).asnumpy().tolist() == [True, False]
+
+
+def test_np_batch3_linalg_completion():
+    m = onp.array([[2.0, 0.0], [0.0, 3.0]], "float32")
+    w, v = np.linalg.eig(np.array(m))
+    assert sorted(onp.real(w.asnumpy()).tolist()) == [2.0, 3.0]
+    wv = np.linalg.eigvals(np.array(m))
+    assert sorted(onp.real(wv.asnumpy()).tolist()) == [2.0, 3.0]
+    sym = onp.array([[2.0, 1.0], [1.0, 2.0]], "float32")
+    wh = np.linalg.eigvalsh(np.array(sym))
+    assert_almost_equal(wh, onp.linalg.eigvalsh(sym), rtol=1e-5)
+    a4 = onp.random.rand(2, 2, 2, 2).astype("float32") + 2 * onp.eye(4).reshape(2, 2, 2, 2).astype("float32")
+    inv4 = np.linalg.tensorinv(np.array(a4), ind=2)
+    assert_almost_equal(inv4, onp.linalg.tensorinv(a4, ind=2), rtol=1e-3, atol=1e-4)
+    bvec = onp.random.rand(2, 2).astype("float32")
+    sol = np.linalg.tensorsolve(np.array(a4), np.array(bvec))
+    assert_almost_equal(sol, onp.linalg.tensorsolve(a4, bvec), rtol=1e-3, atol=1e-4)
