@@ -1,3 +1,5 @@
-"""gluon.contrib (ref python/mxnet/gluon/contrib/) — estimator et al."""
+"""gluon.contrib (ref python/mxnet/gluon/contrib/)."""
 from . import estimator  # noqa
 from . import nn  # noqa
+from . import cnn  # noqa
+from . import rnn  # noqa

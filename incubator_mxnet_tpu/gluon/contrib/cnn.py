@@ -1,0 +1,104 @@
+"""gluon.contrib.cnn (ref python/mxnet/gluon/contrib/cnn/conv_layers.py:
+DeformableConvolution, ModulatedDeformableConvolution).
+
+The offset (and DCNv2 mask) branch is an ordinary convolution initialized
+to zeros, exactly like the reference; the deformable sampling itself is
+the einsum/gather lowering in ops/deformable.py.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import _apply
+from ...ops.deformable import deformable_conv2d
+from ..block import HybridBlock
+
+__all__ = ["DeformableConvolution", "ModulatedDeformableConvolution"]
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable conv v1 (ref conv_layers.py DeformableConvolution).
+
+    Two branches: `offset = Conv(x)` (zero-init so training starts as a
+    plain conv) and the deformable conv consuming (x, offset).
+    """
+
+    _use_mask = False
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros", offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", num_deformable_group=1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._kernel = _pair(kernel_size)
+        self._strides = _pair(strides)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._ndg = num_deformable_group
+        self._activation = activation
+        K = self._kernel[0] * self._kernel[1]
+        off_ch = self._ndg * (3 if self._use_mask else 2) * K
+        with self.name_scope():
+            from ..nn import Conv2D
+            self._offset = Conv2D(off_ch, self._kernel, self._strides,
+                                  self._padding, self._dilation,
+                                  in_channels=in_channels,
+                                  weight_initializer=offset_weight_initializer,
+                                  bias_initializer=offset_bias_initializer,
+                                  prefix="offset_")
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels) + self._kernel,
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer) \
+                if use_bias else None
+
+    def _ensure_init(self, x):
+        if self.weight._data is None:
+            self.weight.shape = (self._channels, x.shape[1]) + self._kernel
+            self.weight._finish_deferred_init()
+
+    def forward(self, x):
+        self._ensure_init(x)
+        K = self._kernel[0] * self._kernel[1]
+        raw = self._offset(x)
+        use_mask, use_bias = self._use_mask, self.bias is not None
+        if use_mask:
+            off = raw.slice_axis(axis=1, begin=0, end=self._ndg * 2 * K)
+            m = nd.sigmoid(
+                raw.slice_axis(axis=1, begin=self._ndg * 2 * K, end=None))
+            args = [x, off, m, self.weight.data()]
+        else:
+            args = [x, raw, self.weight.data()]
+        if use_bias:
+            args.append(self.bias.data())
+
+        def fn(*ds):
+            i = 2
+            mm = ds[i] if use_mask else None
+            i += use_mask
+            ww = ds[i]
+            bb = ds[i + 1] if use_bias else None
+            return deformable_conv2d(
+                ds[0], ds[1], ww, bias=bb, kernel=self._kernel,
+                stride=self._strides, pad=self._padding,
+                dilate=self._dilation, num_deformable_group=self._ndg,
+                mask=mm)
+
+        out = _apply(fn, *args)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """DCNv2 (ref conv_layers.py ModulatedDeformableConvolution): adds a
+    sigmoid modulation mask per sampling tap."""
+
+    _use_mask = True

@@ -30,3 +30,76 @@ class Identity(HybridSequential):
 
     def forward(self, x):
         return x
+
+
+class SparseEmbedding(HybridSequential):
+    """ref contrib/nn/basic_layers.py SparseEmbedding: embedding whose
+    gradient is row_sparse. TPU-native: delegates to nn.Embedding with
+    sparse_grad=True — the compiled step keeps the gather VJP as a scatter
+    (never materializing the dense gradient inside the program), which is
+    the XLA equivalent of the reference's row_sparse grad."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        from ..nn import Embedding
+        with self.name_scope():
+            self.add(Embedding(input_dim, output_dim, dtype=dtype,
+                               weight_initializer=weight_initializer,
+                               sparse_grad=True))
+
+
+class _PixelShuffle(HybridSequential):
+    """Base pixel shuffle (ref contrib/nn/basic_layers.py PixelShuffle*D;
+    Shi et al. 2016): rearrange channels into upscaled spatial dims via
+    reshape+transpose — pure layout ops, free under XLA fusion."""
+
+    def __init__(self, factor, dims, **kwargs):
+        super().__init__(**kwargs)
+        self._dims = dims
+        f = factor if isinstance(factor, (tuple, list)) else (factor,) * dims
+        self._factor = tuple(int(v) for v in f)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import _apply
+        fs = self._factor
+        d = self._dims
+
+        def fn(a):
+            N = a.shape[0]
+            C = a.shape[1]
+            spatial = a.shape[2:]
+            prod = 1
+            for v in fs:
+                prod *= v
+            c_out = C // prod
+            # (N, c_out, f1..fd, s1..sd) → interleave fi after si
+            a = a.reshape((N, c_out) + fs + spatial)
+            perm = [0, 1]
+            for i in range(d):
+                perm += [2 + d + i, 2 + i]
+            a = a.transpose(perm)
+            out_spatial = tuple(s * f for s, f in zip(spatial, fs))
+            return a.reshape((N, c_out) + out_spatial)
+
+        return _apply(fn, x)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor, **kw):
+        super().__init__(factor, 1, **kw)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor, **kw):
+        super().__init__(factor, 2, **kw)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor, **kw):
+        super().__init__(factor, 3, **kw)
+
+
+__all__ += ["SparseEmbedding", "PixelShuffle1D", "PixelShuffle2D",
+            "PixelShuffle3D"]

@@ -1,0 +1,248 @@
+"""Contrib RNN cells (ref python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py
+and rnn_cell.py: Conv{1,2,3}D{RNN,LSTM,GRU}Cell, VariationalDropoutCell,
+LSTMPCell).
+
+TPU-native: the conv cells are ordinary convolutions feeding the same gate
+math as the dense cells — XLA fuses gate elementwise chains into the conv
+epilogue; cells compose with the fused `lax.scan` unroll in rnn_layer the
+same way the dense cells do.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..rnn.rnn_cell import RecurrentCell, ModifierCell, LSTMCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "VariationalDropoutCell", "LSTMPCell"]
+
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
+
+
+class _ConvRNNCellBase(RecurrentCell):
+    """Shared machinery of the conv cells (ref conv_rnn_cell.py _BaseConvRNNCell).
+
+    input_shape: (C, *spatial) without the batch axis — state shape must be
+    known up front (it feeds begin_state), unlike dense cells' deferred
+    input_size.
+    """
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
+                 dims=2, num_gates=1, **kwargs):
+        super().__init__(**kwargs)
+        self._dims = dims
+        self._input_shape = tuple(input_shape)
+        self._hc = hidden_channels
+        self._activation = activation
+        self._num_gates = num_gates
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 != 1:
+                raise ValueError(
+                    "h2h_kernel must be odd so the state keeps its spatial "
+                    "shape; got %s" % (self._h2h_kernel,))
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2
+                              for d, k in zip(self._h2h_dilate, self._h2h_kernel))
+        in_c, spatial = self._input_shape[0], self._input_shape[1:]
+        self._state_spatial = tuple(
+            (s + 2 * p - d * (k - 1) - 1) + 1
+            for s, p, d, k in zip(spatial, self._i2h_pad, self._i2h_dilate,
+                                  self._i2h_kernel))
+        g = num_gates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(g * hidden_channels, in_c) + self._i2h_kernel)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(g * hidden_channels, hidden_channels) + self._h2h_kernel)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(g * hidden_channels,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(g * hidden_channels,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hc) + self._state_spatial
+        n = 2 if isinstance(self, _ConvLSTMMixin) else 1
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[3 - self._dims:]}
+                for _ in range(n)]
+
+    def _conv_gates(self, inputs, state):
+        ones = (1,) * self._dims
+        i2h = nd.Convolution(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                             kernel=self._i2h_kernel, stride=ones,
+                             dilate=self._i2h_dilate, pad=self._i2h_pad,
+                             num_filter=self._num_gates * self._hc)
+        h2h = nd.Convolution(state, self.h2h_weight.data(), self.h2h_bias.data(),
+                             kernel=self._h2h_kernel, stride=ones,
+                             dilate=self._h2h_dilate, pad=self._h2h_pad,
+                             num_filter=self._num_gates * self._hc)
+        return i2h, h2h
+
+
+class _ConvRNNMixin:
+    def _alias(self):
+        return "conv_rnn"
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._conv_gates(inputs, states[0])
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMMixin:
+    def _alias(self):
+        return "conv_lstm"
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._conv_gates(inputs, states[0])
+        gates = i2h + h2h
+        i, f, g, o = nd.split(gates, 4, axis=1)
+        in_gate = nd.sigmoid(i)
+        forget = nd.sigmoid(f)
+        transform = nd.Activation(g, act_type=self._activation)
+        out_gate = nd.sigmoid(o)
+        next_c = forget * states[1] + in_gate * transform
+        next_h = out_gate * nd.Activation(next_c, act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUMixin:
+    def _alias(self):
+        return "conv_gru"
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._conv_gates(inputs, states[0])
+        i_r, i_z, i_n = nd.split(i2h, 3, axis=1)
+        h_r, h_z, h_n = nd.split(h2h, 3, axis=1)
+        reset = nd.sigmoid(i_r + h_r)
+        update = nd.sigmoid(i_z + h_z)
+        newmem = nd.Activation(i_n + reset * h_n, act_type=self._activation)
+        out = (1.0 - update) * newmem + update * states[0]
+        return out, [out]
+
+
+def _make_cell(name, mixin, dims, gates):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
+                 **kwargs):
+        _ConvRNNCellBase.__init__(
+            self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+            i2h_pad=i2h_pad, i2h_dilate=i2h_dilate, h2h_dilate=h2h_dilate,
+            activation=activation, dims=dims, num_gates=gates, **kwargs)
+    cls = type(name, (mixin, _ConvRNNCellBase), {"__init__": __init__})
+    cls.__doc__ = ("%s (ref conv_rnn_cell.py %s): convolutional recurrence "
+                   "over %dD feature maps." % (name, name, dims))
+    return cls
+
+
+Conv1DRNNCell = _make_cell("Conv1DRNNCell", _ConvRNNMixin, 1, 1)
+Conv2DRNNCell = _make_cell("Conv2DRNNCell", _ConvRNNMixin, 2, 1)
+Conv3DRNNCell = _make_cell("Conv3DRNNCell", _ConvRNNMixin, 3, 1)
+Conv1DLSTMCell = _make_cell("Conv1DLSTMCell", _ConvLSTMMixin, 1, 4)
+Conv2DLSTMCell = _make_cell("Conv2DLSTMCell", _ConvLSTMMixin, 2, 4)
+Conv3DLSTMCell = _make_cell("Conv3DLSTMCell", _ConvLSTMMixin, 3, 4)
+Conv1DGRUCell = _make_cell("Conv1DGRUCell", _ConvGRUMixin, 1, 3)
+Conv2DGRUCell = _make_cell("Conv2DGRUCell", _ConvGRUMixin, 2, 3)
+Conv3DGRUCell = _make_cell("Conv3DGRUCell", _ConvGRUMixin, 3, 3)
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask reused across all time steps (ref rnn_cell.py
+    VariationalDropoutCell, Gal & Ghahramani)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self.reset()
+
+    def reset(self):
+        super().reset()
+        self._mask_in = None
+        self._mask_state = None
+        self._mask_out = None
+
+    def _mask(self, cache_name, x, rate):
+        mask = getattr(self, cache_name)
+        if mask is None:
+            keep = 1.0 - rate
+            mask = nd.random.uniform(shape=x.shape) < keep
+            mask = mask.astype(x.dtype) / keep
+            setattr(self, cache_name, mask)
+        return x * mask
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        if autograd.is_training():
+            if self._drop_inputs:
+                inputs = self._mask("_mask_in", inputs, self._drop_inputs)
+            if self._drop_states:
+                states = [self._mask("_mask_state", states[0], self._drop_states)] \
+                    + list(states[1:])
+        out, nstates = self.base_cell(inputs, states)
+        if autograd.is_training() and self._drop_outputs:
+            out = self._mask("_mask_out", out, self._drop_outputs)
+        return out, nstates
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (ref rnn_cell.py LSTMPCell,
+    Sak et al. 2014). States: [r (projected), c]."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def _ensure_init(self, x):
+        if self.i2h_weight._data is None:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.h2r_weight,
+                      self.i2h_bias, self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._ensure_init(inputs)
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=4 * self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=4 * self._hidden_size, flatten=False)
+        gates = i2h + h2h
+        i, f, g, o = nd.split(gates, 4, axis=-1)
+        next_c = nd.sigmoid(f) * states[1] + nd.sigmoid(i) * nd.tanh(g)
+        next_h = nd.sigmoid(o) * nd.tanh(next_c)
+        next_r = nd.FullyConnected(next_h, self.h2r_weight.data(), None,
+                                   num_hidden=self._projection_size,
+                                   no_bias=True, flatten=False)
+        return next_r, [next_r, next_c]

@@ -1,0 +1,166 @@
+"""gluon.contrib layers (ref tests/python/unittest/test_gluon_contrib.py):
+conv RNN cells, VariationalDropoutCell, LSTMPCell, PixelShuffle,
+SparseEmbedding, DeformableConvolution.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu.gluon.contrib import nn as cnn_, cnn, rnn as crnn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.mark.parametrize("cls,gates,dims", [
+    (crnn.Conv1DRNNCell, 1, 1), (crnn.Conv2DRNNCell, 1, 2),
+    (crnn.Conv3DRNNCell, 1, 3), (crnn.Conv1DLSTMCell, 4, 1),
+    (crnn.Conv2DLSTMCell, 4, 2), (crnn.Conv3DLSTMCell, 4, 3),
+    (crnn.Conv1DGRUCell, 3, 1), (crnn.Conv2DGRUCell, 3, 2),
+    (crnn.Conv3DGRUCell, 3, 3),
+])
+def test_conv_rnn_cells(cls, gates, dims):
+    spatial = (8, 8, 8)[:dims]
+    cell = cls(input_shape=(4,) + spatial, hidden_channels=6,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 4) + spatial)
+    states = cell.begin_state(batch_size=2)
+    out, nstates = cell(x, states)
+    assert out.shape == (2, 6) + spatial
+    n_states = 2 if "LSTM" in cls.__name__ else 1
+    assert len(nstates) == n_states
+    assert onp.isfinite(out.asnumpy()).all()
+    # weight shape carries the gate count
+    assert cell.i2h_weight.shape[0] == gates * 6
+
+
+def test_conv_lstm_unroll_and_grad():
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 5, 5), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 4, 3, 5, 5))  # (N, T, C, H, W)
+    for p in cell.collect_params().values():
+        p.grad_req = "write"
+    with autograd.record():
+        outputs, states = cell.unroll(4, x, layout="NTC", merge_outputs=False)
+        loss = sum(o.sum() for o in outputs)
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert g.shape == cell.i2h_weight.shape
+    assert float(nd.abs(g).sum().asscalar()) > 0
+
+
+def test_variational_dropout_cell_mask_is_constant_over_time():
+    base = crnn.LSTMPCell(hidden_size=8, projection_size=5, input_size=6)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5, drop_outputs=0.5)
+    cell.initialize()
+    x = [nd.ones((3, 6)) for _ in range(3)]
+    states = cell.begin_state(batch_size=3)
+    with autograd.record(train_mode=True):
+        outs = []
+        for t in range(3):
+            o, states = cell(x[t], states)
+            outs.append(o)
+    # same input each step + same mask → zeroed input positions identical;
+    # the output mask zeroes the same units every step
+    z0 = outs[0].asnumpy() == 0.0
+    z1 = outs[1].asnumpy() == 0.0
+    assert (z0 == z1).mean() > 0.9  # overwhelmingly the same pattern
+    cell.reset()
+    assert cell._mask_in is None
+
+
+def test_lstmp_cell_shapes():
+    cell = crnn.LSTMPCell(hidden_size=16, projection_size=7)
+    cell.initialize()
+    x = nd.random.normal(shape=(4, 10))
+    out, states = cell(x, cell.begin_state(batch_size=4))
+    assert out.shape == (4, 7)
+    assert states[0].shape == (4, 7)     # r
+    assert states[1].shape == (4, 16)    # c
+    assert cell.state_info(4)[0]["shape"] == (4, 7)
+
+
+def test_pixel_shuffle():
+    for dims, cls, factor in ((1, cnn_.PixelShuffle1D, 2),
+                              (2, cnn_.PixelShuffle2D, 2),
+                              (3, cnn_.PixelShuffle3D, 2)):
+        spatial = (4,) * dims
+        c = 3 * (2 ** dims)
+        x = nd.random.normal(shape=(2, c) + spatial)
+        layer = cls(factor)
+        out = layer(x)
+        assert out.shape == (2, 3) + tuple(8 for _ in range(dims))
+    # 2D value check vs manual depth-to-space
+    x = nd.array(onp.arange(2 * 4 * 2 * 2, dtype="float32").reshape(2, 4, 2, 2))
+    got = cnn_.PixelShuffle2D(2)(x).asnumpy()
+    a = x.asnumpy().reshape(2, 1, 2, 2, 2, 2).transpose(0, 1, 4, 2, 5, 3)
+    want = a.reshape(2, 1, 4, 4)
+    assert_almost_equal(got, want)
+
+
+def test_sparse_embedding_trains():
+    emb = cnn_.SparseEmbedding(20, 8)
+    emb.initialize()
+    tok = nd.array(onp.array([[1, 2], [3, 4]], "int32"))
+    trainer = gluon.Trainer(emb.collect_params(), "sgd", {"learning_rate": 1.0})
+    before = list(emb.collect_params().values())[0].data().asnumpy().copy()
+    with autograd.record():
+        loss = (emb(tok) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    after = list(emb.collect_params().values())[0].data().asnumpy()
+    changed = onp.abs(after - before).sum(axis=1) > 0
+    assert set(onp.nonzero(changed)[0].tolist()) == {1, 2, 3, 4}
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """Zero-init offsets → identical to a plain convolution (the property
+    the reference's zero offset_initializer is designed around)."""
+    mx.random.seed(0)
+    dcn = cnn.DeformableConvolution(5, kernel_size=(3, 3), padding=(1, 1),
+                                    in_channels=3)
+    dcn.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 3, 9, 9))
+    out = dcn(x)
+    assert out.shape == (2, 5, 9, 9)
+    ref = nd.Convolution(x, dcn.weight.data(), dcn.bias.data(),
+                         kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                         num_filter=5)
+    assert_almost_equal(out, ref.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_offsets_shift_sampling():
+    dcn = cnn.DeformableConvolution(1, kernel_size=(1, 1), in_channels=1,
+                                    use_bias=False)
+    dcn.initialize()
+    dcn.weight.set_data(nd.ones((1, 1, 1, 1)))
+    # hand-build: offset +1 in x shifts sampling one pixel right
+    from incubator_mxnet_tpu.ops.deformable import deformable_conv2d
+    import jax.numpy as jnp
+    img = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    off = onp.zeros((1, 2, 4, 4), "float32")
+    off[:, 1] = 1.0  # (y, x) pairs: x += 1
+    got = onp.asarray(deformable_conv2d(
+        jnp.asarray(img), jnp.asarray(off), jnp.ones((1, 1, 1, 1), jnp.float32),
+        kernel=(1, 1)))
+    want = onp.zeros_like(img)
+    want[..., :, :3] = img[..., :, 1:]  # shifted left view; border zero-pads
+    want[..., :, 3] = 0
+    assert_almost_equal(got, want)
+
+
+def test_modulated_deformable_conv_trains():
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(cnn.ModulatedDeformableConvolution(4, kernel_size=(3, 3),
+                                               padding=(1, 1), in_channels=2),
+            gluon.nn.Activation("relu"))
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 2, 6, 6))
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(2)
+    assert onp.isfinite(loss.asnumpy()).all()
