@@ -57,6 +57,7 @@ from . import symbol as sym
 from .executor import Executor
 from . import module
 from . import module as mod
+from . import rnn
 from . import models
 from . import ops
 from . import profiler

@@ -33,7 +33,7 @@ class DataBatch:
     """ref io.py DataBatch."""
 
     def __init__(self, data, label=None, pad=None, index=None,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None):
         if data is not None and not isinstance(data, (list, tuple)):
             data = [data]
         if label is not None and not isinstance(label, (list, tuple)):
@@ -44,6 +44,8 @@ class DataBatch:
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        if bucket_key is not None:  # ref rnn/io.py bucketed batches
+            self.bucket_key = bucket_key
 
 
 class DataIter:

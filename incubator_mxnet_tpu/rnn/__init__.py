@@ -1,0 +1,8 @@
+"""Legacy symbolic RNN API — mx.rnn (ref python/mxnet/rnn/)."""
+from .rnn_cell import *  # noqa
+from .io import *  # noqa
+from .rnn import *  # noqa
+
+from . import rnn_cell, io, rnn  # noqa
+
+__all__ = rnn_cell.__all__ + io.__all__ + rnn.__all__

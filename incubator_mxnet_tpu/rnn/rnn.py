@@ -1,0 +1,42 @@
+"""RNN checkpoint helpers (ref python/mxnet/rnn/rnn.py).
+
+The reference packs/unpacks fused cuDNN parameter blobs around
+save/load_checkpoint; our cells keep weights unfused (one named array per
+gate matrix — see rnn_cell.py FusedRNNCell docstring), so pack/unpack are
+identity and these reduce to the plain model checkpoint with cell-aware
+round-tripping.
+"""
+from __future__ import annotations
+
+from .. import model as _model
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
+
+
+def _cells_list(cells):
+    return cells if isinstance(cells, (list, tuple)) else [cells]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """ref rnn.py save_rnn_checkpoint."""
+    for cell in _cells_list(cells):
+        arg_params = cell.unpack_weights(arg_params)
+    _model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """ref rnn.py load_rnn_checkpoint."""
+    sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+    for cell in _cells_list(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback (ref rnn.py do_rnn_checkpoint)."""
+    period = max(1, period)
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

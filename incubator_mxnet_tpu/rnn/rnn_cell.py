@@ -1,0 +1,506 @@
+"""Legacy symbolic RNN cell API (ref python/mxnet/rnn/rnn_cell.py) — cells
+that BUILD Symbol graphs, for the Module/BucketingModule training path.
+
+TPU-native: each unrolled step is plain Symbol composition; the bound
+executor compiles the whole unrolled sequence as one XLA program, so the
+reference's fused-kernel distinction (FusedRNNCell = cuDNN) collapses —
+FusedRNNCell here is a stacked/bidirectional composition with the same
+parameter sharing, and unfuse() returns the equivalent explicit stack.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+from ..symbol.symbol import Symbol, _auto_name
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams(object):
+    """Container for cell weights (ref rnn_cell.py RNNParams): one shared
+    namespace so stacked/bidirectional cells reuse variables by name."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.var(name, **kwargs)
+        return self._params[name]
+
+
+def _begin_state_op(d, num_hidden=0):
+    """(batch_of(d), num_hidden) zeros — registered so graph JSON reloads
+    (symbol.load_json resolves ops by name through _OP_TABLE)."""
+    from .. import ndarray as nd
+    return nd.zeros((d.shape[0], num_hidden), dtype=d.dtype)
+
+
+def _register_begin_state():
+    from ..symbol import _OP_TABLE
+    _OP_TABLE.setdefault("_begin_state", _begin_state_op)
+
+
+_register_begin_state()
+
+
+def _zeros_like_batch(x, num_hidden, name):
+    """Deferred zero state: (batch_of(x), num_hidden) materialized at bind
+    time — the symbolic analog of begin_state's runtime batch size."""
+    return Symbol(op=_begin_state_op, op_name="_begin_state", inputs=[x],
+                  kwargs={"num_hidden": num_hidden}, name=name)
+
+
+class BaseRNNCell(object):
+    """ref rnn_cell.py BaseRNNCell."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._init_counter = -1
+        self._counter = -1
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [s["shape"] for s in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    def begin_state(self, func=None, like=None, **kwargs):
+        """States for step 0. With `like` (a data Symbol) the batch dim is
+        deferred to bind; otherwise func/kwargs must fix a concrete shape
+        (func=sym.zeros, batch_size=N)."""
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
+            if like is not None:
+                states.append(_zeros_like_batch(like, info["shape"][1], name))
+            elif func is not None:
+                shape = (kwargs.get("batch_size", 0),) + tuple(info["shape"][1:])
+                assert shape[0] > 0, \
+                    "begin_state without `like` needs batch_size > 0"
+                states.append(func(shape))
+            else:
+                states.append(sym.var(name))
+        return states
+
+    def unpack_weights(self, args):
+        """ref unpack_weights — our cells keep weights unfused already."""
+        return dict(args)
+
+    def pack_weights(self, args):
+        return dict(args)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """ref rnn_cell.py unroll: inputs is a (N,T,C) Symbol (layout NTC),
+        a (T,N,C) Symbol (TNC), or a list of T (N,C) Symbols."""
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+        else:
+            seq = []
+            for t in range(length):
+                s = sym.slice_axis(inputs, axis=axis, begin=t, end=t + 1)
+                seq.append(sym.squeeze(s, axis=axis))
+        states = begin_state if begin_state is not None \
+            else self.begin_state(like=seq[0])
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = sym.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+def _defer(v, shape_fn):
+    """Mark a cell weight for bind-time shape inference (executor.py:102
+    materializes it from the consuming op's data shape)."""
+    if not hasattr(v, "_deferred_shape_fn"):
+        v._deferred_shape_fn = shape_fn
+        v._is_param = True
+    return v
+
+
+class RNNCell(BaseRNNCell):
+    """Elman cell on symbols (ref rnn_cell.py RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_", params=None):
+        super().__init__(prefix, params)
+        n = num_hidden
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = _defer(self.params.get("i2h_weight"), lambda s: (n, s[-1]))
+        self._iB = _defer(self.params.get("i2h_bias"), lambda s: (n,))
+        self._hW = _defer(self.params.get("h2h_weight"), lambda s: (n, n))
+        self._hB = _defer(self.params.get("h2h_bias"), lambda s: (n,))
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden, flatten=False,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                 num_hidden=self._num_hidden, flatten=False,
+                                 name="%sh2h" % name)
+        output = sym.Activation(i2h + h2h, act_type=self._activation,
+                                name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM on symbols, i,f,g,o gate order (ref rnn_cell.py LSTMCell)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix, params)
+        n = num_hidden
+        self._num_hidden = num_hidden
+        # forget_bias is recorded as a var attr (ref LSTMBias initializer);
+        # name-based initializers set biases to zeros, so training starts
+        # with forget gates at sigmoid(0) unless the user re-inits
+        self._iW = _defer(self.params.get("i2h_weight"), lambda s: (4 * n, s[-1]))
+        self._iB = _defer(self.params.get("i2h_bias", __forget_bias__=forget_bias),
+                          lambda s: (4 * n,))
+        self._hW = _defer(self.params.get("h2h_weight"), lambda s: (4 * n, n))
+        self._hB = _defer(self.params.get("h2h_bias"), lambda s: (4 * n,))
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        n = self._num_hidden
+        i2h = sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=4 * n, flatten=False,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                 num_hidden=4 * n, flatten=False,
+                                 name="%sh2h" % name)
+        gates = i2h + h2h
+        ig = sym.sigmoid(sym.slice_axis(gates, axis=-1, begin=0, end=n))
+        fg = sym.sigmoid(sym.slice_axis(gates, axis=-1, begin=n, end=2 * n))
+        gg = sym.tanh(sym.slice_axis(gates, axis=-1, begin=2 * n, end=3 * n))
+        og = sym.sigmoid(sym.slice_axis(gates, axis=-1, begin=3 * n, end=4 * n))
+        next_c = fg * states[1] + ig * gg
+        next_h = og * sym.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU on symbols (ref rnn_cell.py GRUCell)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix, params)
+        n = num_hidden
+        self._num_hidden = num_hidden
+        self._iW = _defer(self.params.get("i2h_weight"), lambda s: (3 * n, s[-1]))
+        self._iB = _defer(self.params.get("i2h_bias"), lambda s: (3 * n,))
+        self._hW = _defer(self.params.get("h2h_weight"), lambda s: (3 * n, n))
+        self._hB = _defer(self.params.get("h2h_bias"), lambda s: (3 * n,))
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        n = self._num_hidden
+        i2h = sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=3 * n, flatten=False,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                 num_hidden=3 * n, flatten=False,
+                                 name="%sh2h" % name)
+        ir = sym.slice_axis(i2h, axis=-1, begin=0, end=n)
+        iz = sym.slice_axis(i2h, axis=-1, begin=n, end=2 * n)
+        in_ = sym.slice_axis(i2h, axis=-1, begin=2 * n, end=3 * n)
+        hr = sym.slice_axis(h2h, axis=-1, begin=0, end=n)
+        hz = sym.slice_axis(h2h, axis=-1, begin=n, end=2 * n)
+        hn = sym.slice_axis(h2h, axis=-1, begin=2 * n, end=3 * n)
+        reset = sym.sigmoid(ir + hr)
+        update = sym.sigmoid(iz + hz)
+        newmem = sym.tanh(in_ + reset * hn)
+        out = (sym.ones_like(update) - update) * newmem + update * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells (ref rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum((c.state_info for c in self._cells), [])
+
+    def begin_state(self, **kwargs):
+        return sum((c.begin_state(**kwargs) for c in self._cells), [])
+
+    def __call__(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        num_cells = len(self._cells)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = None if begin_state is None else begin_state[p:p + n]
+            inputs, st = cell.unroll(
+                length, inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def reset(self):
+        super().reset()
+        for c in self._cells:
+            c.reset()
+
+
+class BidirectionalCell(BaseRNNCell):
+    """l/r cells over the sequence both ways, outputs concatenated
+    (ref rnn_cell.py BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params)
+        self._l_cell = l_cell
+        self._r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l_cell.state_info + self._r_cell.state_info
+
+    def begin_state(self, **kwargs):
+        return self._l_cell.begin_state(**kwargs) + \
+            self._r_cell.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell can only be unrolled")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            seq = [sym.squeeze(sym.slice_axis(inputs, axis=axis, begin=t,
+                                              end=t + 1), axis=axis)
+                   for t in range(length)]
+        else:
+            seq = list(inputs)
+        nl = len(self._l_cell.state_info)
+        lst = None if begin_state is None else begin_state[:nl]
+        rst = None if begin_state is None else begin_state[nl:]
+        l_out, l_states = self._l_cell.unroll(length, seq, begin_state=lst,
+                                              layout=layout, merge_outputs=None)
+        r_out, r_states = self._r_cell.unroll(length, list(reversed(seq)),
+                                              begin_state=rst, layout=layout,
+                                              merge_outputs=None)
+        r_out = list(reversed(r_out))
+        outputs = [sym.concat(l, r, dim=-1,
+                              name="%st%d" % (self._output_prefix, t))
+                   for t, (l, r) in enumerate(zip(l_out, r_out))]
+        if merge_outputs:
+            outputs = sym.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states
+
+    def reset(self):
+        super().reset()
+        self._l_cell.reset()
+        self._r_cell.reset()
+
+
+class FusedRNNCell(BaseRNNCell):
+    """ref rnn_cell.py FusedRNNCell (the cuDNN path). On TPU the unrolled
+    graph compiles to one XLA program either way, so this is the stacked
+    (optionally bidirectional) composition with fused-style naming;
+    unfuse() returns the explicit SequentialRNNCell."""
+
+    _MODES = {"rnn_relu": (RNNCell, {"activation": "relu"}),
+              "rnn_tanh": (RNNCell, {"activation": "tanh"}),
+              "lstm": (LSTMCell, {}),
+              "gru": (GRUCell, {})}
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, prefix=None, params=None):
+        if mode not in self._MODES:
+            raise ValueError("mode must be one of %s" % list(self._MODES))
+        prefix = prefix if prefix is not None else "%s_" % mode
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._stack = self.unfuse()
+
+    def unfuse(self):
+        cls, kw = self._MODES[self._mode]
+        stack = SequentialRNNCell()
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    cls(self._num_hidden, prefix="%sl%d_" % (self._prefix, i),
+                        **kw),
+                    cls(self._num_hidden, prefix="%sr%d_" % (self._prefix, i),
+                        **kw),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(cls(self._num_hidden,
+                              prefix="%sl%d_" % (self._prefix, i), **kw))
+            if self._dropout > 0 and i < self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%s_dropout%d_" % (self._prefix, i)))
+        return stack
+
+    @property
+    def state_info(self):
+        return self._stack.state_info
+
+    def begin_state(self, **kwargs):
+        return self._stack.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        return self._stack(inputs, states)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        return self._stack.unroll(length, inputs, begin_state=begin_state,
+                                  layout=layout, merge_outputs=merge_outputs)
+
+
+class DropoutCell(BaseRNNCell):
+    """ref rnn_cell.py DropoutCell."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self._dropout > 0:
+            inputs = sym.Dropout(inputs, p=self._dropout)
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """ref rnn_cell.py ModifierCell."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix="", params=None)
+        self.base_cell = base_cell
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, **kwargs):
+        return self.base_cell.begin_state(**kwargs)
+
+    def reset(self):
+        super().reset()
+        self.base_cell.reset()
+
+
+class ZoneoutCell(ModifierCell):
+    """ref rnn_cell.py ZoneoutCell: randomly keep previous output."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        if self.zoneout_outputs > 0:
+            prev = self._prev_output if self._prev_output is not None \
+                else sym.zeros_like(out)
+            mask = sym.Dropout(sym.ones_like(out), p=self.zoneout_outputs)
+            # Dropout scales by 1/(1-p): renormalize to a 0/1 keep mask
+            keep = sym.minimum(mask, sym.ones_like(mask))
+            out = keep * out + (sym.ones_like(keep) - keep) * prev
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    """ref rnn_cell.py ResidualCell: output += input."""
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        return out + inputs, next_states

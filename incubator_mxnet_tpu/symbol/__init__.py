@@ -97,6 +97,21 @@ for _name in _SIMPLE_OPS:
     _g[_name] = _symbolize(getattr(nd, _name), _name)
     __all__.append(_name)
 slice = _symbolize(nd.slice, "slice")
+
+# operator-sugar node names (Symbol.__add__ etc., symbol.py _binop) so
+# graph JSON containing them reloads; the *_scalar variants resolve through
+# the kwargs-driven impls in symbol.py
+from .symbol import _scalar_binop_fn as _sbf  # noqa: E402
+
+for _opname, _fn in [("_plus", nd.add), ("_minus", nd.subtract),
+                     ("_mul", nd.multiply), ("_div", nd.divide),
+                     ("_pow", nd.power), ("_greater", nd.greater),
+                     ("_greater_equal", nd.greater_equal),
+                     ("_lesser", nd.lesser), ("_lesser_equal", nd.lesser_equal),
+                     ("_mod", nd.modulo)]:
+    _OP_TABLE[_opname] = _fn
+    _OP_TABLE[_opname + "_scalar"] = _sbf(_fn)
+_OP_TABLE["negative"] = nd.negative
 Concat = _g["concat"]
 SliceChannel = _g["split"]
 Flatten = _g["flatten"]

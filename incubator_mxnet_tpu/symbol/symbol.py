@@ -219,10 +219,11 @@ class Symbol:
         if isinstance(other, Symbol):
             ins = [other, self] if reverse else [self, other]
             return Symbol(op=fn, op_name=op_name, inputs=ins)
-        if reverse:
-            return Symbol(op=lambda a: fn(_const(other, a), a), op_name=op_name,
-                          inputs=[self])
-        return Symbol(op=lambda a: fn(a, other), op_name=op_name, inputs=[self])
+        # scalar operand: kept in kwargs so tojson/load_json round-trips
+        # (ref _plus_scalar etc. op family)
+        return Symbol(op=_scalar_binop_fn(fn), op_name=op_name + "_scalar",
+                      inputs=[self],
+                      kwargs={"scalar": other, "reverse": bool(reverse)})
 
     def __add__(self, o): return self._binop(o, nd.add, "_plus")
     def __radd__(self, o): return self._binop(o, nd.add, "_plus", True)
@@ -326,6 +327,19 @@ def _auto_name(hint):
 
 def _const(v, like):
     return v
+
+
+_SCALAR_FNS = {}
+
+
+def _scalar_binop_fn(fn):
+    """Kwargs-driven scalar-binop impl, one cached fn per base op so
+    load_json can resolve '<name>_scalar' nodes (see symbol/__init__)."""
+    if fn not in _SCALAR_FNS:
+        def op(a, scalar=0.0, reverse=False, _fn=fn):
+            return _fn(_const(scalar, a), a) if reverse else _fn(a, scalar)
+        _SCALAR_FNS[fn] = op
+    return _SCALAR_FNS[fn]
 
 
 def var(name, shape=None, dtype=None, lr_mult=None, wd_mult=None, init=None,

@@ -1,0 +1,146 @@
+"""Legacy symbolic mx.rnn API (ref tests/python/unittest/test_rnn.py and
+the bucketing examples, example/rnn/bucketing/)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _unroll_outputs(cell, T=3, N=2, C=4, merge=False):
+    data = mx.sym.var("data")
+    outputs, states = cell.unroll(T, data, merge_outputs=merge)
+    out = outputs if merge else mx.sym.Group(list(outputs) + list(states))
+    x = nd.random.normal(shape=(N, T, C))
+    exe = out.bind(args={"data": x})
+    return exe.forward()
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(8, prefix="r_")
+    outs = _unroll_outputs(cell)
+    assert outs[0].shape == (2, 8)      # per-step outputs
+    assert outs[2].shape == (2, 8)
+    assert len(outs) == 3 + 1           # 3 outputs + 1 state
+
+
+def test_lstm_gru_cells_unroll():
+    for cell, n_states in ((mx.rnn.LSTMCell(8, prefix="l_"), 2),
+                           (mx.rnn.GRUCell(8, prefix="g_"), 1)):
+        outs = _unroll_outputs(cell, merge=False)
+        assert len(outs) == 3 + n_states
+        for o in outs:
+            assert onp.isfinite(o.asnumpy()).all()
+
+
+def test_param_sharing_across_time():
+    """Unrolled steps share ONE weight set (the point of RNNParams)."""
+    cell = mx.rnn.LSTMCell(6, prefix="shared_")
+    data = mx.sym.var("data")
+    outputs, _ = cell.unroll(5, data, merge_outputs=True)
+    args = outputs.list_arguments()
+    weights = [a for a in args if a.startswith("shared_")]
+    assert sorted(weights) == ["shared_h2h_bias", "shared_h2h_weight",
+                               "shared_i2h_bias", "shared_i2h_weight"]
+
+
+def test_stacked_and_bidirectional():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="s0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(8, prefix="s1_")))
+    outs = _unroll_outputs(stack, C=8)
+    assert outs[0].shape == (2, 8)
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(5, prefix="fw_"),
+                                  mx.rnn.LSTMCell(5, prefix="bw_"))
+    outs = _unroll_outputs(bi)
+    assert outs[0].shape == (2, 10)     # concat of both directions
+
+
+def test_fused_cell_matches_unfused():
+    fused = mx.rnn.FusedRNNCell(7, num_layers=2, mode="lstm", prefix="f_")
+    outs = _unroll_outputs(fused)
+    assert outs[0].shape == (2, 7)
+    assert len(outs) == 3 + 4           # 2 layers x (h, c)
+    # unfuse shares the same RNNParams namespace
+    stack = fused.unfuse()
+    assert len(stack._cells) == 2
+
+
+def test_zoneout_and_dropout_cells():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.GRUCell(6, prefix="z_"),
+                              zoneout_outputs=0.3)
+    outs = _unroll_outputs(cell)
+    assert outs[0].shape == (2, 6)
+    dc = mx.rnn.DropoutCell(0.5)
+    out, st = dc(mx.sym.var("x"), [])
+    assert st == []
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["a", "b", "c"], ["a", "c"], ["b", "c", "a", "c"],
+             ["c", "b"], ["a", "b", "c", "a"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(vocab) >= 4
+    it = mx.rnn.BucketSentenceIter(coded, batch_size=2, buckets=[3, 4],
+                                   invalid_label=0)
+    batches = list(it)
+    assert batches, "no batches produced"
+    for b in batches:
+        assert b.bucket_key in (3, 4)
+        assert b.data[0].shape == (2, b.bucket_key)
+        # label is data shifted one step left
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        onp.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+
+
+def test_bucketing_module_trains_lstm_lm(tmp_path):
+    """End-to-end: BucketSentenceIter + mx.rnn cells + BucketingModule.fit
+    — the reference's example/rnn/bucketing workflow."""
+    V, E, H = 12, 8, 10
+    rng = onp.random.RandomState(0)
+    sents = [rng.randint(1, V, size=rng.choice([3, 5])).tolist()
+             for _ in range(40)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[3, 5],
+                                   invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(H, prefix="lm_l0_"))
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=V, output_dim=E, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, embed, merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+        lab = mx.sym.reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    bm = mx.module.BucketingModule(sym_gen, default_bucket_key=5)
+    bm.bind(it.provide_data, it.provide_label)
+    bm.init_params(mx.init.Xavier())
+    bm.init_optimizer(optimizer="adam", optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    losses = []
+    for epoch in range(2):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            bm.forward(batch)
+            bm.update_metric(metric, batch.label)
+            bm.backward()
+            bm.update()
+        losses.append(metric.get()[1])
+    assert onp.isfinite(losses).all()
+    assert losses[-1] < losses[0], "perplexity did not improve: %s" % losses
+
+    # checkpoint round-trip through the rnn helpers
+    sym, _, _ = sym_gen(5)
+    arg, aux = bm.get_params()
+    mx.rnn.save_rnn_checkpoint(stack, str(tmp_path / "lm"), 1, sym, arg, aux)
+    sym2, arg2, aux2 = mx.rnn.load_rnn_checkpoint(stack, str(tmp_path / "lm"), 1)
+    assert set(arg2) == set(arg)
