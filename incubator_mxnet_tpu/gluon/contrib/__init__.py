@@ -2,4 +2,5 @@
 from . import estimator  # noqa
 from . import nn  # noqa
 from . import cnn  # noqa
+from . import data  # noqa
 from . import rnn  # noqa

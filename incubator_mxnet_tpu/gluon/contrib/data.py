@@ -1,0 +1,94 @@
+"""gluon.contrib.data (ref python/mxnet/gluon/contrib/data/: sampler.py
+IntervalSampler, text.py WikiText2/WikiText103).
+
+Text datasets honor the reference's on-disk layout (one token stream per
+split file); in this zero-egress build they synthesize a deterministic
+Zipf-distributed corpus when the files are absent, matching the synthetic
+fallback the vision datasets use (gluon/data/vision.py _synthetic).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from ..data.sampler import Sampler
+from ..data.dataset import Dataset
+
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103"]
+
+
+class IntervalSampler(Sampler):
+    """[0, length) visited at stride `interval`, rolling over to each skipped
+    start (ref contrib/data/sampler.py IntervalSampler)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval if self._rollover else 1)
+        for start in starts:
+            yield from range(start, self._length, self._interval)
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))
+
+
+class _WikiText(Dataset):
+    """Token-id sequence dataset cut into fixed-length segments
+    (ref contrib/data/text.py _WikiText): each item is (seq, label) with
+    label the next-token shift, ready for LM training."""
+
+    _vocab_size = 2048
+
+    def __init__(self, root, segment, seq_len, synth_tokens):
+        self._root = os.path.expanduser(root)
+        self._seq_len = seq_len
+        path = os.path.join(self._root, "wiki.%s.tokens" % segment)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                words = f.read().split()
+            vocab = {}
+            ids = onp.array([vocab.setdefault(w, len(vocab)) for w in words],
+                            dtype="int32")
+            self.vocab = vocab
+        else:  # zero-egress synthetic corpus (deterministic per segment)
+            rng = onp.random.RandomState(hash(segment) % (2 ** 31))
+            ids = rng.zipf(1.5, size=synth_tokens).astype("int64")
+            ids = onp.clip(ids, 1, self._vocab_size - 1).astype("int32")
+            self.vocab = None
+        n_seg = (len(ids) - 1) // seq_len
+        ids = ids[: n_seg * seq_len + 1]
+        self._data = ids[:-1].reshape(n_seg, seq_len)
+        self._label = ids[1:].reshape(n_seg, seq_len)
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._data)
+
+
+class WikiText2(_WikiText):
+    """ref contrib/data/text.py WikiText2."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "wikitext-2"),
+                 segment="train", seq_len=35):
+        tokens = {"train": 64 * 1024, "val": 8 * 1024, "test": 8 * 1024}
+        super().__init__(root, segment, seq_len,
+                         tokens.get(segment, 8 * 1024))
+
+
+class WikiText103(_WikiText):
+    """ref contrib/data/text.py WikiText103 (larger synthetic fallback)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "wikitext-103"),
+                 segment="train", seq_len=35):
+        tokens = {"train": 256 * 1024, "val": 16 * 1024, "test": 16 * 1024}
+        super().__init__(root, segment, seq_len,
+                         tokens.get(segment, 16 * 1024))

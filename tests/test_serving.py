@@ -50,3 +50,50 @@ def test_load_rejects_garbage(tmp_path):
     p.write_bytes(b"not a model")
     with pytest.raises(ValueError):
         serving.load(str(p))
+
+
+def test_contrib_data_interval_sampler_and_wikitext():
+    # (placed here to avoid a new jit-heavy test module)
+    from incubator_mxnet_tpu.gluon.contrib import data as cdata
+    assert list(cdata.IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    s = cdata.IntervalSampler(13, interval=3, rollover=False)
+    assert list(s) == [0, 3, 6, 9, 12]
+    assert len(s) == 5
+    ds = cdata.WikiText2(segment="train", seq_len=35)
+    x, y = ds[0]
+    assert x.shape == (35,) and y.shape == (35,)
+    # label is the next-token shift of the same stream
+    x1, _ = ds[1]
+    assert y[-1] == x1[0] or len(ds) == 1
+    assert len(cdata.WikiText2(segment="val", seq_len=35)) < len(ds)
+
+
+def test_standalone_predict_tool(tmp_path):
+    """Amalgamation analog: the single-file predictor runs an artifact
+    WITHOUT importing the framework (subprocess keeps it honest)."""
+    import subprocess
+    import sys as _sys
+    import os as _os
+    net = _net()
+    x = nd.random.normal(shape=(2, 1, 8, 8))
+    path = str(tmp_path / "m.mxtpu")
+    serving.export_model(net, x, path)
+    expected = serving.load(path).predict(x).asnumpy()
+    inp = str(tmp_path / "x.npy")
+    outp = str(tmp_path / "y.npy")
+    onp.save(inp, x.asnumpy())
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    code = ("import sys; sys.argv=['sp', %r, %r, %r]; "
+            "import jax; jax.config.update('jax_platforms','cpu'); "
+            "exec(open(%r).read())"
+            % (path, inp, outp,
+               _os.path.join(root, "tools", "standalone_predict.py")))
+    r = subprocess.run([_sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    got = onp.load(outp)
+    onp.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Standalone .mxtpu predictor — the amalgamation analog (ref
+amalgamation/: a single-file, dependency-minimal predict-only build;
+MXNET_PREDICT_ONLY engine path, src/engine/engine.cc:40-49).
+
+This file is self-contained: it needs only jax + numpy, NOT the
+incubator_mxnet_tpu package — a serving artifact is a serialized compiled
+program (jax.export bytes behind an 8-byte magic), so deployment ships
+exactly {this file, the artifact}. Copy it anywhere JAX runs.
+
+CLI:    python standalone_predict.py model.mxtpu input.npy [out.npy]
+Module: from standalone_predict import load; load("model.mxtpu")(x)
+"""
+import sys
+
+_MAGIC = b"MXTPU\x00v1"
+
+
+def load(path):
+    """Load a .mxtpu artifact → callable(*numpy arrays) -> numpy array(s)."""
+    import jax
+
+    with open(path, "rb") as f:
+        buf = f.read()
+    if not buf.startswith(_MAGIC):
+        raise ValueError("%s is not an mxtpu serving artifact" % path)
+    exp = jax.export.deserialize(buf[len(_MAGIC):])
+
+    def predict(*inputs):
+        import numpy as onp
+        out = exp.call(*inputs)
+        if isinstance(out, (list, tuple)):
+            return tuple(onp.asarray(o) for o in out)
+        return onp.asarray(out)
+
+    predict.input_shapes = [tuple(a.shape) for a in exp.in_avals]
+    predict.output_shapes = [tuple(a.shape) for a in exp.out_avals]
+    predict.input_dtypes = [a.dtype.name for a in exp.in_avals]
+    return predict
+
+
+def main(argv):
+    if len(argv) not in (3, 4):
+        print(__doc__)
+        return 2
+    import numpy as onp
+    fn = load(argv[1])
+    x = onp.load(argv[2])
+    out = fn(x)
+    first = out[0] if isinstance(out, tuple) else out
+    if len(argv) == 4:
+        onp.save(argv[3], first)
+    else:
+        onp.set_printoptions(threshold=64)
+        print(first)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
