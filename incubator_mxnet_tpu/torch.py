@@ -1,0 +1,108 @@
+"""PyTorch interop bridge (ref python/mxnet/torch.py — the legacy
+lua-torch op bridge, modernized for PyTorch).
+
+Three surfaces:
+- ``to_torch`` / ``from_torch``: tensor conversion (DLPack zero-copy on
+  CPU when possible, NumPy otherwise).
+- ``torch_function``: run a differentiable torch function inside the
+  autograd tape — backward is computed by torch.autograd and handed back
+  to our tape, so a torch op composes with nd ops in one loss.
+- ``TorchBlock``: wrap a ``torch.nn.Module`` as a Gluon block (host/CPU
+  execution; the module's own parameters are trained by torch-side
+  gradients through ``torch_function``).
+
+Scope: the bridge executes on host CPU — it is an interop/migration aid
+(the reference's was too), not a TPU compute path; keep hot paths in nd.
+The tape records through tracked leaves, so at least one bridged input
+must have ``attach_grad()`` for loss.backward() to reach the torch side
+(standard autograd semantics). Not imported at package init: importing
+``incubator_mxnet_tpu.torch`` is opt-in so the frameworks stay decoupled.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray import NDArray
+from . import autograd
+
+__all__ = ["to_torch", "from_torch", "torch_function", "TorchBlock"]
+
+
+def _torch():
+    try:
+        import torch as _t
+        return _t
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("the torch bridge needs pytorch installed") from e
+
+
+def to_torch(arr):
+    """NDArray → torch.Tensor (host copy; DLPack when both sides allow)."""
+    t = _torch()
+    data = arr._data if isinstance(arr, NDArray) else arr
+    try:
+        import jax
+        return t.from_dlpack(jax.device_get(data))  # zero/one-copy via CPU
+    except Exception:
+        return t.from_numpy(onp.asarray(data))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor → NDArray."""
+    return NDArray(onp.ascontiguousarray(tensor.detach().cpu().numpy()),
+                   ctx=ctx)
+
+
+def torch_function(fn, *inputs):
+    """Run ``fn(*torch_tensors) -> torch_tensor`` under our autograd tape;
+    the VJP is delegated to torch.autograd (ref torch bridge's
+    forward/backward op pairs)."""
+    t = _torch()
+
+    class _Bridge(autograd.Function):
+        def forward(self, *arrs):
+            self._tins = [
+                t.tensor(onp.asarray(a._data if isinstance(a, NDArray) else a),
+                         requires_grad=True)
+                for a in arrs]
+            with t.enable_grad():
+                out = fn(*self._tins)
+            self._tout = out
+            return NDArray(out.detach().cpu().numpy())
+
+        def backward(self, dout):
+            # full torch backward (not autograd.grad on inputs): gradients
+            # also ACCUMULATE into any torch parameters inside fn, so a
+            # TorchBlock's module is trainable with a torch optimizer off
+            # our tape's loss.backward()
+            t.autograd.backward(self._tout,
+                                grad_tensors=t.tensor(onp.asarray(dout._data)))
+            return tuple(
+                NDArray(onp.zeros(tuple(i.shape),
+                                  onp.asarray(i.detach()).dtype))
+                if i.grad is None else NDArray(i.grad.cpu().numpy())
+                for i in self._tins)
+
+    return _Bridge()(*inputs)
+
+
+class TorchBlock(object):
+    """Wrap a torch.nn.Module for use in imperative flows
+    (≙ the reference's TorchModule op wrappers).
+
+    Forward runs on host CPU. Under autograd.record(), input gradients
+    flow back to the tape via torch_function; the module's own parameters
+    accumulate torch-side .grad, steppable with any torch optimizer —
+    mirroring the split ownership the reference bridge had.
+    """
+
+    def __init__(self, module):
+        self.module = module
+
+    def __call__(self, *inputs):
+        def run(*tins):
+            return self.module(*tins)
+        return torch_function(run, *inputs)
+
+    def parameters(self):
+        return self.module.parameters()

@@ -1,0 +1,67 @@
+"""PyTorch interop bridge (ref python/mxnet/torch.py, tests analog
+tests/python/unittest legacy torch tests)."""
+import numpy as onp
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd, autograd  # noqa: E402
+from incubator_mxnet_tpu import torch as mxt  # noqa: E402
+from incubator_mxnet_tpu.test_utils import assert_almost_equal  # noqa: E402
+
+
+def test_tensor_round_trip():
+    x = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    t = mxt.to_torch(x)
+    assert isinstance(t, torch.Tensor)
+    assert t.shape == (2, 3)
+    back = mxt.from_torch(t)
+    assert_almost_equal(back, x.asnumpy())
+
+
+def test_torch_function_forward_and_grad():
+    x = nd.array(onp.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = mxt.torch_function(lambda a: (a ** 2).sum(), x)
+        z = y * 3.0
+    z.backward()
+    # d(3*sum(x^2))/dx = 6x
+    assert_almost_equal(x.grad, 6 * x.asnumpy(), rtol=1e-5)
+
+
+def test_torch_function_composes_with_nd_ops():
+    x = nd.array(onp.array([1.0, -2.0, 3.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        h = nd.relu(x)                                 # our op
+        y = mxt.torch_function(torch.sigmoid, h)       # torch op
+        loss = (y * y).sum()                           # our op again
+    loss.backward()
+    hs = onp.maximum(x.asnumpy(), 0)
+    sig = 1 / (1 + onp.exp(-hs))
+    want = 2 * sig * (sig * (1 - sig)) * (x.asnumpy() > 0)
+    assert_almost_equal(x.grad, want, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_block_trains_its_module():
+    """loss.backward() on OUR tape accumulates .grad into the torch
+    module's parameters; a torch optimizer steps them."""
+    net = torch.nn.Linear(4, 2)
+    blk = mxt.TorchBlock(net)
+    opt = torch.optim.SGD(net.parameters(), lr=0.5)
+    x = nd.array(onp.random.RandomState(0).randn(8, 4).astype("float32"))
+    x.attach_grad()  # the tape records through a tracked leaf (as in mxnet)
+    losses = []
+    for _ in range(5):
+        opt.zero_grad()
+        with autograd.record():
+            out = blk(x)
+            loss = (out ** 2).mean()
+        loss.backward()
+        assert net.weight.grad is not None
+        assert float(net.weight.grad.abs().sum()) > 0
+        opt.step()
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
