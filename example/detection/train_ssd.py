@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""SSD object-detection training — the reference's example/ssd workflow
+(train_ssd.py over MultiBox* ops) on TPU.
+
+Pipeline: ImageDetRecordIter (bbox-augmenting .rec reader, or a synthetic
+box set when no .rec is given) → SSD forward (anchors, cls_preds,
+loc_preds) → MultiBoxTarget assignment → focal-free SSD loss (softmax CE +
+smooth-L1) → Trainer step. Whole step runs imperatively; pass --hybridize
+to compile the network forward.
+
+Run: python train_ssd.py [--rec data/train.rec] [--epochs 2]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon, models
+from incubator_mxnet_tpu.ops import MultiBoxTarget
+
+
+def synthetic_batches(batch_size, size, steps, seed=0):
+    """Boxes around class-colored squares (no .rec needed)."""
+    rng = onp.random.RandomState(seed)
+    for _ in range(steps):
+        img = rng.rand(batch_size, 3, size, size).astype("float32") * 0.1
+        label = onp.zeros((batch_size, 1, 5), "float32")
+        for i in range(batch_size):
+            cls = rng.randint(0, 3)
+            x1, y1 = rng.uniform(0.05, 0.5, 2)
+            w = rng.uniform(0.2, 0.4)
+            label[i, 0] = (cls, x1, y1, min(x1 + w, 0.95), min(y1 + w, 0.95))
+            xa, ya = int(x1 * size), int(y1 * size)
+            wb = int(w * size)
+            img[i, cls, ya: ya + wb, xa: xa + wb] += 0.8
+        yield nd.array(img), nd.array(label)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rec", default=None, help=".rec file with det labels")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--data-shape", type=int, default=64)
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.005)
+    ap.add_argument("--steps", type=int, default=25, help="steps/epoch (synthetic)")
+    args = ap.parse_args()
+
+    net = models.SSD(num_classes=args.num_classes, base_channels=16)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def batches():
+        if args.rec:
+            from incubator_mxnet_tpu.io import ImageDetRecordIter
+            it = ImageDetRecordIter(args.rec, batch_size=args.batch_size,
+                                    data_shape=(3, args.data_shape,
+                                                args.data_shape))
+            for b in it:
+                yield b.data[0], b.label[0]
+        else:
+            yield from synthetic_batches(args.batch_size, args.data_shape,
+                                         args.steps)
+
+    for epoch in range(args.epochs):
+        tic = time.time()
+        tot, n = 0.0, 0
+        for x, label in batches():
+            with autograd.record():
+                anchors, cls_preds, loc_preds = net(x)
+                with autograd.pause():
+                    loc_t, loc_mask, cls_t = MultiBoxTarget(anchors, label,
+                                                            cls_preds)
+                cl = cls_loss(cls_preds.transpose((0, 2, 1)), cls_t)
+                ll = (nd.smooth_l1(loc_preds - loc_t, scalar=1.0)
+                      * loc_mask).sum(axis=1)
+                loss = cl.sum() + ll.sum()
+            loss.backward()
+            trainer.step(args.batch_size)
+            tot += float(loss.asscalar())
+            n += 1
+        print("epoch %d: loss=%.4f (%.1fs, %d steps)"
+              % (epoch, tot / max(n, 1), time.time() - tic, n))
+
+
+if __name__ == "__main__":
+    main()
