@@ -160,6 +160,17 @@ class KVStore(KVStoreBase):
     def barrier(self):
         nd.waitall()
 
+    def set_server_profiler_state(self, state="stop", **config):
+        """ref include/mxnet/kvstore.h:49 KVStoreServerProfilerCommand /
+        tests/nightly/test_server_profiling.py: workers command the server's
+        profiler. There is no server role here (symmetric SPMD — see
+        DistKVStore), so the command drives THIS process's profiler, which
+        is where all former server work (aggregation + updates) now runs."""
+        from .. import profiler
+        if config:
+            profiler.set_config(**config)
+        profiler.set_state(state)
+
     # ---- helpers -------------------------------------------------------
     def _normalize(self, key, value):
         if isinstance(key, (str, int)):

@@ -286,14 +286,16 @@ def AdaptiveAvgPooling2D(data, output_size=1):
 
 
 def boolean_mask(data, index, axis=0):
-    """ref contrib/boolean_mask.cc — dynamic-shape op, eager only."""
+    """ref contrib/boolean_mask.cc — dynamic-shape op, eager only. The
+    mask is resolved on host (data-dependent shape), but the gather runs
+    through _apply so the tape records it and backward scatters into the
+    kept rows (the reference op's backward)."""
     import numpy as onp
     from .ndarray import NDArray
     mask = onp.asarray(index._data if isinstance(index, NDArray) else index
                        ).astype(bool)
-    arr = onp.asarray(data._data)
-    from . import array as _array
-    return _array(onp.compress(mask, arr, axis=axis))
+    idx = jnp.asarray(onp.nonzero(mask)[0])
+    return _apply(lambda d: jnp.take(d, idx, axis=axis), _to_nd(data))
 
 
 def index_copy(old_tensor, index_vector, new_tensor):

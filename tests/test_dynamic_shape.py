@@ -1,0 +1,79 @@
+"""Dynamic-shape op semantics (ref tests/python/unittest/
+test_dynamic_shape.py — contrib.boolean_mask; np_unique/nonzero).
+
+TPU-native contract: data-dependent output shapes run EAGERLY (host
+round-trip allowed); inside jit/hybridized programs, masking stays
+static-shaped via where/weights (the XLA idiom). Both sides tested."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, np, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_boolean_mask_dynamic_output():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    index = nd.array([1.0, 0.0, 1.0, 0.0])
+    out = nd.contrib.boolean_mask(data, index)
+    assert out.shape == (2, 3)  # data-dependent!
+    assert_almost_equal(out, data.asnumpy()[[0, 2]])
+
+
+def test_boolean_mask_gradient():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    data.attach_grad()
+    index = nd.array([0.0, 1.0, 0.0, 1.0])
+    with autograd.record():
+        out = nd.contrib.boolean_mask(data, index)
+        loss = out.sum()
+    loss.backward()
+    want = onp.zeros((4, 3), "float32")
+    want[[1, 3]] = 1.0
+    assert_almost_equal(data.grad, want)
+
+
+def test_np_unique_nonzero_dynamic():
+    a = np.array([3.0, 1.0, 3.0, 2.0, 1.0])
+    u = np.unique(a)
+    assert u.shape == (3,)
+    nz = np.nonzero(np.array([0.0, 5.0, 0.0, 7.0]))
+    assert nz[0].asnumpy().tolist() == [1, 3]
+
+
+def test_static_masking_inside_compiled_step():
+    """The jit-safe masking idiom: where() keeps shapes static, so the same
+    semantic computation (masked mean) compiles."""
+    from incubator_mxnet_tpu import gluon, jit
+
+    class MaskedMean(gluon.HybridBlock):
+        def forward(self, x, mask):
+            kept = nd.where(mask > 0.5, x, nd.zeros_like(x))
+            return kept.sum() / nd.maximum(mask.sum(), nd.ones_like(mask.sum()))
+
+    net = MaskedMean()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(onp.array([1.0, 2.0, 3.0, 4.0], "float32"))
+    m = nd.array(onp.array([1.0, 0.0, 1.0, 0.0], "float32"))
+    out = net(x, m)
+    assert abs(float(out.asscalar()) - 2.0) < 1e-5
+    # second call with a different mask hits the compiled cache (same shapes)
+    m2 = nd.array(onp.array([0.0, 1.0, 0.0, 1.0], "float32"))
+    assert abs(float(net(x, m2).asscalar()) - 3.0) < 1e-5
+
+
+def test_kvstore_server_profiler_command():
+    """ref tests/nightly/test_server_profiling.py workflow."""
+    import os
+    import tempfile
+    from incubator_mxnet_tpu import profiler
+    kv = mx.kv.create("local")
+    fn = os.path.join(tempfile.mkdtemp(), "server_profile.json")
+    kv.set_server_profiler_state("run", filename=fn)
+    a = nd.ones((4,))
+    kv.init(3, a)
+    kv.push(3, a)
+    kv.pull(3, out=a)
+    kv.set_server_profiler_state("stop")
+    profiler.dump()
+    assert os.path.exists(fn)
