@@ -1676,3 +1676,54 @@ def arange_like(data, start=0.0, step=1.0, axis=None, **kw):
 __all__ += ["smooth_l1", "hard_sigmoid", "softmax_cross_entropy", "digamma",
             "khatri_rao", "linspace", "trace", "meshgrid", "unravel_index",
             "ravel_multi_index", "multinomial", "arange_like"]
+
+
+def im2col(data, kernel, stride=None, dilate=None, pad=None):
+    """ref src/operator/tensor/im2col.cc: (N,C,*spatial) -> sliding patches
+    (N, C*prod(kernel), L). Lowered to lax.conv_general_dilated_patches —
+    XLA's native patch extraction, MXU-layout friendly."""
+    kernel = tuple(kernel)
+    d = len(kernel)
+    stride = tuple(stride) if stride else (1,) * d
+    dilate = tuple(dilate) if dilate else (1,) * d
+    pad = tuple(pad) if pad else (0,) * d
+
+    def fn(x):
+        out = lax.conv_general_dilated_patches(
+            x, filter_shape=kernel, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate)
+        return out.reshape(out.shape[0], out.shape[1], -1)
+    return _apply(fn, data)
+
+
+def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
+    """ref src/operator/tensor/im2col.cc col2im: scatter-add patches back to
+    (N, C, *output_size) — computed as the exact linear transpose (jax.vjp)
+    of im2col, which IS the reference's definition of the op."""
+    kernel = tuple(kernel)
+    output_size = tuple(output_size)
+    d = len(kernel)
+    stride = tuple(stride) if stride else (1,) * d
+    dilate = tuple(dilate) if dilate else (1,) * d
+    pad = tuple(pad) if pad else (0,) * d
+    k_prod = 1
+    for k in kernel:
+        k_prod *= k
+
+    def fn(col):
+        N = col.shape[0]
+        C = col.shape[1] // k_prod
+
+        def fwd(img):
+            out = lax.conv_general_dilated_patches(
+                img, filter_shape=kernel, window_strides=stride,
+                padding=[(p, p) for p in pad], rhs_dilation=dilate)
+            return out.reshape(out.shape[0], out.shape[1], -1)
+
+        import jax as _jax
+        _, vjp = _jax.vjp(fwd, jnp.zeros((N, C) + output_size, col.dtype))
+        return vjp(col)[0]
+    return _apply(fn, data)
+
+
+__all__ += ["im2col", "col2im"]

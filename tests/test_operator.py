@@ -439,3 +439,32 @@ def test_classic_op_additions():
     assert int(flat.asnumpy()[0]) == 5
     # multinomial with a degenerate distribution is deterministic
     assert (nd.multinomial(nd.array([[0.0, 1.0]]), shape=6).asnumpy() == 1).all()
+
+
+def test_im2col_col2im():
+    """ref tensor/im2col.cc; col2im is im2col's exact linear transpose."""
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    col = nd.im2col(x, kernel=(2, 2), stride=(1, 1))
+    assert col.shape == (1, 4, 9)
+    # patch 0 is the top-left 2x2 window, flattened kernel-major
+    first_patch = col.asnumpy()[0, :, 0]
+    onp.testing.assert_allclose(first_patch, [0, 1, 4, 5])
+    # col2im(ones) = per-pixel patch coverage counts
+    back = nd.col2im(nd.ones_like(col), (4, 4), kernel=(2, 2), stride=(1, 1))
+    want = onp.array([[1, 2, 2, 1], [2, 4, 4, 2], [2, 4, 4, 2], [1, 2, 2, 1]],
+                     "float32")
+    onp.testing.assert_allclose(back.asnumpy()[0, 0], want)
+    # round trip: conv via im2col matmul == nd.Convolution
+    w = nd.random.normal(shape=(3, 1, 2, 2))
+    ref = nd.Convolution(x, w, None, kernel=(2, 2), stride=(1, 1),
+                         num_filter=3, no_bias=True)
+    col_mat = col.reshape((4, 9))
+    got = nd.dot(w.reshape((3, 4)), col_mat).reshape((1, 3, 3, 3))
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                                atol=1e-5)
+    # gradient flows (col2im is the VJP)
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.im2col(x, kernel=(2, 2), stride=(1, 1)).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy()[0, 0], want)
