@@ -28,3 +28,16 @@ def Custom(*inputs, op_type, **kwargs):
     """Dispatch a registered custom op (ref mx.nd.Custom; operator.py)."""
     from ..operator import Custom as _custom
     return _custom(*inputs, op_type=op_type, **kwargs)
+from .optimizer_ops import *  # noqa
+from . import optimizer_ops as _optimizer_ops  # noqa
+# legacy top-level CamelCase ops (ref mx.nd namespace: roi_pooling.cc,
+# bilinear_sampler.cc, spatial_transformer.cc, batch_norm_v1.cc aliases)
+from ..ops.detection import (  # noqa: E402
+    roi_pooling as ROIPooling,
+    bilinear_sampler as BilinearSampler,
+    grid_generator as GridGenerator,
+    spatial_transformer as SpatialTransformer,
+)
+from .ndarray import BatchNorm as BatchNorm_v1  # noqa: E402  (v1 ≡ modern here)
+from .ndarray import Convolution as Convolution_v1  # noqa: E402
+from .ndarray import Pooling as Pooling_v1  # noqa: E402

@@ -1727,3 +1727,135 @@ def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
 
 
 __all__ += ["im2col", "col2im"]
+
+
+def add_n(*args, **kw):
+    """Sum of all inputs (ref tensor/elemwise_sum.cc add_n)."""
+    import functools
+    import operator
+    return _apply(lambda *xs: functools.reduce(operator.add, xs), *args)
+
+
+def batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (ref tensor/indexing_op.cc batch_take)."""
+    def fn(x, idx):
+        return jnp.take_along_axis(
+            x, idx.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+    return _apply(fn, a, _to_nd(indices))
+
+
+def depth_to_space(data, block_size):
+    """(N, C*b^2, H, W) -> (N, C, H*b, W*b) (ref tensor/matrix_op.cc
+    depth_to_space, DCR order)."""
+    b = block_size
+
+    def fn(x):
+        N, C, H, W = x.shape
+        c = C // (b * b)
+        y = x.reshape(N, b, b, c, H, W)
+        y = y.transpose(0, 3, 4, 1, 5, 2)
+        return y.reshape(N, c, H * b, W * b)
+    return _apply(fn, data)
+
+
+def space_to_depth(data, block_size):
+    """(N, C, H*b, W*b) -> (N, C*b^2, H, W), inverse of depth_to_space."""
+    b = block_size
+
+    def fn(x):
+        N, C, Hb, Wb = x.shape
+        H, W = Hb // b, Wb // b
+        y = x.reshape(N, C, H, b, W, b)
+        y = y.transpose(0, 3, 5, 1, 2, 4)
+        return y.reshape(N, C * b * b, H, W)
+    return _apply(fn, data)
+
+
+def shape_array(data):
+    """Shape as an int64 array (ref tensor/matrix_op.cc shape_array)."""
+    return NDArray(jnp.asarray(data.shape, jnp.int64))
+
+
+def size_array(data):
+    """Element count as a (1,) int64 array (ref size_array)."""
+    return NDArray(jnp.asarray([data.size], jnp.int64))
+
+
+def argmax_channel(data):
+    """argmax over axis 1 (ref broadcast_reduce_op_index.cc argmax_channel)."""
+    return _apply(lambda x: jnp.argmax(x, axis=1).astype(x.dtype), data)
+
+
+def cast_storage(data, stype):
+    """dense <-> row_sparse/csr conversion (ref tensor/cast_storage.cc);
+    delegates to the sparse storage classes (nd.sparse)."""
+    return data.tostype(stype)
+
+
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9, **kw):
+    """ref plugin sparse-reg op: identity forward; the KL sparseness
+    penalty contributed to the backward is not replicated (document-level
+    parity — penalty scheduling belongs in the loss here)."""
+    return _apply(lambda x: x, data)
+
+
+__all__ += ["add_n", "batch_take", "depth_to_space", "space_to_depth",
+            "shape_array", "size_array", "argmax_channel", "cast_storage",
+            "IdentityAttachKLSparseReg"]
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Cost volume between two feature maps (ref src/operator/correlation.cc,
+    FlowNet). Output (N, D*D, H, W), D = 2*(max_displacement//stride2)+1:
+    channel k is the per-pixel correlation of data1 with data2 displaced by
+    the k-th (dy, dx) offset — a static displacement loop XLA unrolls, each
+    tap an elementwise product + channel mean (kernel_size=1 form; larger
+    kernels average over the window)."""
+    if kernel_size % 2 != 1:
+        raise ValueError("kernel_size must be odd")
+    d = max_displacement // stride2
+    offs = [(dy * stride2, dx * stride2)
+            for dy in range(-d, d + 1) for dx in range(-d, d + 1)]
+    kh = kernel_size // 2
+
+    def fn(x1, x2):
+        N, C, H, W = x1.shape
+        p = pad_size + d * stride2 + kh
+        x2p = jnp.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+        outs = []
+        for dy, dx in offs:
+            sh = x2p[:, :, p + dy - kh: p + dy + kh + H - 2 * kh + kh,
+                     p + dx - kh: p + dx + kh + W - 2 * kh + kh]
+            sh = sh[:, :, :H, :W]
+            if is_multiply:
+                v = (x1 * sh).mean(axis=1)
+            else:
+                v = -jnp.abs(x1 - sh).mean(axis=1)
+            outs.append(v)
+        out = jnp.stack(outs, axis=1)
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+    return _apply(fn, data1, data2)
+
+
+def Crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=None,
+         **kw):
+    """Legacy crop op (ref src/operator/crop.cc): crop data[0] to h_w, or
+    to data[1]'s spatial shape when two inputs are given."""
+    x = data[0]
+    if len(data) == 2:
+        th, tw = data[1].shape[2], data[1].shape[3]
+    else:
+        th, tw = h_w
+    H, W = x.shape[2], x.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = offset
+    return _apply(lambda a: a[:, :, oy: oy + th, ox: ox + tw], x)
+
+
+__all__ += ["Correlation", "Crop"]

@@ -1,0 +1,365 @@
+"""Optimizer-as-op surface (ref src/operator/optimizer_op.cc — the
+reference runs EVERY optimizer step as one of these fused device ops;
+optimizer.py dispatches to them).
+
+Here the hot path is the fused TrainStep (updates compiled into the step
+program with donated buffers — jit.py), but the eager op API is kept for
+custom training loops and kvstore updaters. In-place contract matches the
+reference: state args are mutated, the new weight is written to ``out``
+(usually the weight itself).
+
+All formulas are stated in the docstrings; wd/rescale/clip handling
+follows optimizer_op.cc: grad' = clip(rescale_grad * grad) then wd folds
+in where the reference folds it.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = [
+    "sgd_update", "sgd_mom_update", "mp_sgd_update", "mp_sgd_mom_update",
+    "nag_mom_update", "mp_nag_mom_update", "adam_update", "rmsprop_update",
+    "rmspropalex_update", "ftrl_update", "ftml_update", "signsgd_update",
+    "signum_update", "lamb_update_phase1", "lamb_update_phase2",
+    "mp_lamb_update_phase1", "mp_lamb_update_phase2",
+    "multi_sgd_update", "multi_sgd_mom_update", "multi_mp_sgd_update",
+    "multi_mp_sgd_mom_update", "preloaded_multi_sgd_update",
+    "preloaded_multi_sgd_mom_update", "preloaded_multi_mp_sgd_update",
+    "preloaded_multi_mp_sgd_mom_update", "multi_sum_sq", "multi_lars",
+    "all_finite", "multi_all_finite", "reset_arrays",
+]
+
+
+def _rg(grad, rescale_grad, clip_gradient):
+    g = grad._data.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+def _write(out, weight, val):
+    tgt = out if out is not None else weight
+    tgt._data = val.astype(tgt._data.dtype)
+    return tgt
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True, out=None):
+    """w -= lr * (grad' + wd*w)   (ref sgd_update)."""
+    g = _rg(grad, rescale_grad, clip_gradient)
+    w = weight._data.astype(jnp.float32)
+    return _write(out, weight, w - lr * (g + wd * w))
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+                   out=None):
+    """mom = momentum*mom - lr*(grad' + wd*w); w += mom (ref sgd_mom_update)."""
+    g = _rg(grad, rescale_grad, clip_gradient)
+    w = weight._data.astype(jnp.float32)
+    m = momentum * mom._data - lr * (g + wd * w)
+    mom._data = m.astype(mom._data.dtype)
+    return _write(out, weight, w + m)
+
+
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, out=None):
+    """Multi-precision: math on fp32 master weight32, weight = cast back
+    (ref mp_sgd_update)."""
+    g = _rg(grad, rescale_grad, clip_gradient)
+    w32 = weight32._data - lr * (g + wd * weight32._data)
+    weight32._data = w32
+    return _write(out, weight, w32)
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    g = _rg(grad, rescale_grad, clip_gradient)
+    m = momentum * mom._data - lr * (g + wd * weight32._data)
+    mom._data = m
+    w32 = weight32._data + m
+    weight32._data = w32
+    return _write(out, weight, w32)
+
+
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """Nesterov: g'' = grad' + wd*w; mom = momentum*mom + g'';
+    w -= lr*(g'' + momentum*mom)   (ref nag_mom_update)."""
+    g = _rg(grad, rescale_grad, clip_gradient)
+    w = weight._data.astype(jnp.float32)
+    g = g + wd * w
+    m = momentum * mom._data + g
+    mom._data = m.astype(mom._data.dtype)
+    return _write(out, weight, w - lr * (g + momentum * m))
+
+
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    g = _rg(grad, rescale_grad, clip_gradient) + wd * weight32._data
+    m = momentum * mom._data + g
+    mom._data = m
+    w32 = weight32._data - lr * (g + momentum * m)
+    weight32._data = w32
+    return _write(out, weight, w32)
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True, out=None):
+    """m=b1*m+(1-b1)*g'; v=b2*v+(1-b2)*g'^2; w -= lr*m/(sqrt(v)+eps) with
+    g' = grad'+wd*w. NO bias correction inside the op — the python
+    Optimizer passes the corrected lr, exactly as the reference splits it
+    (ref adam_update)."""
+    w = weight._data.astype(jnp.float32)
+    g = _rg(grad, rescale_grad, clip_gradient) + wd * w
+    m = beta1 * mean._data + (1 - beta1) * g
+    v = beta2 * var._data + (1 - beta2) * g * g
+    mean._data = m
+    var._data = v
+    return _write(out, weight, w - lr * m / (jnp.sqrt(v) + epsilon))
+
+
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
+                   out=None):
+    """n = (1-g1)*g'^2 + g1*n; w -= lr*g'/sqrt(n+eps) (ref rmsprop_update)."""
+    w = weight._data.astype(jnp.float32)
+    g = _rg(grad, rescale_grad, clip_gradient) + wd * w
+    nn = (1 - gamma1) * g * g + gamma1 * n._data
+    n._data = nn
+    new_w = w - lr * g / jnp.sqrt(nn + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return _write(out, weight, new_w)
+
+
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0, out=None):
+    """Graves' centered RMSProp (ref rmspropalex_update):
+    n=(1-g1)gr^2+g1*n; g=(1-g1)gr+g1*g; delta=g2*delta - lr*gr/sqrt(n-g^2+eps);
+    w += delta."""
+    w = weight._data.astype(jnp.float32)
+    gr = _rg(grad, rescale_grad, clip_gradient) + wd * w
+    nn = (1 - gamma1) * gr * gr + gamma1 * n._data
+    gg = (1 - gamma1) * gr + gamma1 * g._data
+    d = gamma2 * delta._data - lr * gr / jnp.sqrt(nn - gg * gg + epsilon)
+    n._data, g._data, delta._data = nn, gg, d
+    new_w = w + d
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return _write(out, weight, new_w)
+
+
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """FTRL-proximal (ref ftrl_update):
+    z += g' - (sqrt(n+g'^2)-sqrt(n))/lr * w; n += g'^2;
+    w = -(z - sign(z)*l1) / ((beta+sqrt(n))/lr + wd)  where |z|>l1 else 0."""
+    w = weight._data.astype(jnp.float32)
+    g = _rg(grad, rescale_grad, clip_gradient)
+    new_n = n._data + g * g
+    z._data = z._data + g - (jnp.sqrt(new_n) - jnp.sqrt(n._data)) / lr * w
+    n._data = new_n
+    new_w = jnp.where(
+        jnp.abs(z._data) > lamda1,
+        -(z._data - jnp.sign(z._data) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        0.0)
+    return _write(out, weight, new_w)
+
+
+def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0, out=None):
+    """FTML (ref ftml_update, Zheng & Kwok 2017)."""
+    w = weight._data.astype(jnp.float32)
+    g = _rg(grad, rescale_grad, clip_grad) + wd * w
+    new_v = beta2 * v._data + (1 - beta2) * g * g
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d._data
+    new_z = beta1 * z._data + (1 - beta1) * g - sigma * w
+    v._data, d._data, z._data = new_v, d_t, new_z
+    return _write(out, weight, -new_z / d_t)
+
+
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    """w -= lr*(sign(g') + wd*w) (ref signsgd_update)."""
+    g = _rg(grad, rescale_grad, clip_gradient)
+    w = weight._data.astype(jnp.float32)
+    return _write(out, weight, w - lr * (jnp.sign(g) + wd * w))
+
+
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, out=None):
+    """mom = momentum*mom - (1-momentum)*(g' + wd*w);
+    w = (1 - lr*wd_lh)*w + lr*sign(mom)   (ref signum_update)."""
+    w = weight._data.astype(jnp.float32)
+    g = _rg(grad, rescale_grad, clip_gradient) + wd * w
+    m = momentum * mom._data - (1 - momentum) * g
+    mom._data = m.astype(mom._data.dtype)
+    return _write(out, weight, (1 - lr * wd_lh) * w + lr * jnp.sign(m))
+
+
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """LAMB phase 1 (ref lamb_update_phase1): returns the raw update
+    direction m̂/(sqrt(v̂)+eps) + wd*w; phase 2 applies the layer-wise
+    trust ratio."""
+    w = weight._data.astype(jnp.float32)
+    g = _rg(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean._data + (1 - beta1) * g
+    v = beta2 * var._data + (1 - beta2) * g * g
+    mean._data, var._data = m, v
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    upd = m / (jnp.sqrt(v) + epsilon) + wd * w
+    res = NDArray(upd) if out is None else _write(out, None, upd)
+    return res
+
+
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0, out=None):
+    """LAMB phase 2 (ref lamb_update_phase2): w -= lr * (r1/r2) * g with
+    r1=||w|| (optionally clipped to bounds), r2=||g||; ratio 1 when either
+    norm is 0."""
+    w = weight._data.astype(jnp.float32)
+    r1v = r1._data if isinstance(r1, NDArray) else jnp.asarray(r1)
+    r2v = r2._data if isinstance(r2, NDArray) else jnp.asarray(r2)
+    if lower_bound is not None and lower_bound > 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v, 1.0)
+    return _write(out, weight, w - lr * ratio * g._data)
+
+
+mp_lamb_update_phase1 = lamb_update_phase1   # master weights are the fp32 ones
+mp_lamb_update_phase2 = lamb_update_phase2
+
+
+def _multi(fn, weights, grads, states_list, lrs, wds, out=None, **kw):
+    outs = out if out is not None else weights
+    for i, (w, g) in enumerate(zip(weights, grads)):
+        st = [s[i] for s in states_list]
+        fn(w, g, *st, lrs[i], wd=wds[i], out=outs[i], **kw)
+    return outs
+
+
+def multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=-1.0, out=None):
+    """ref multi_sgd_update: one call, many tensors."""
+    return _multi(lambda w, g, lr, wd, out: sgd_update(
+        w, g, lr, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient, out=out), weights, grads, [], lrs, wds,
+        out=out)
+
+
+def multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    return _multi(lambda w, g, m, lr, wd, out: sgd_mom_update(
+        w, g, m, lr, momentum=momentum, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient, out=out), weights, grads, [moms],
+        lrs, wds, out=out)
+
+
+def multi_mp_sgd_update(weights, grads, weights32, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=-1.0, out=None):
+    return _multi(lambda w, g, w32, lr, wd, out: mp_sgd_update(
+        w, g, w32, lr, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient, out=out), weights, grads, [weights32],
+        lrs, wds, out=out)
+
+
+def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
+                            momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0, out=None):
+    return _multi(lambda w, g, m, w32, lr, wd, out: mp_sgd_mom_update(
+        w, g, m, w32, lr, momentum=momentum, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient, out=out), weights, grads,
+        [moms, weights32], lrs, wds, out=out)
+
+
+def _as_list_scalars(arr):
+    import numpy as onp
+    return [float(x) for x in onp.asarray(
+        arr._data if isinstance(arr, NDArray) else arr)]
+
+
+def preloaded_multi_sgd_update(weights, grads, lrs, wds, **kw):
+    """lrs/wds live on device as tensors (ref preloaded_multi_sgd_update)."""
+    return multi_sgd_update(weights, grads, _as_list_scalars(lrs),
+                            _as_list_scalars(wds), **kw)
+
+
+def preloaded_multi_sgd_mom_update(weights, grads, moms, lrs, wds, **kw):
+    return multi_sgd_mom_update(weights, grads, moms, _as_list_scalars(lrs),
+                                _as_list_scalars(wds), **kw)
+
+
+def preloaded_multi_mp_sgd_update(weights, grads, weights32, lrs, wds, **kw):
+    return multi_mp_sgd_update(weights, grads, weights32,
+                               _as_list_scalars(lrs), _as_list_scalars(wds),
+                               **kw)
+
+
+def preloaded_multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs,
+                                      wds, **kw):
+    return multi_mp_sgd_mom_update(weights, grads, moms, weights32,
+                                   _as_list_scalars(lrs),
+                                   _as_list_scalars(wds), **kw)
+
+
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, one (n,) result (ref multi_sum_sq — feeds
+    multi_lars)."""
+    arrs = arrays[:num_arrays] if num_arrays else arrays
+    return NDArray(jnp.stack([jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                              for a in arrs]))
+
+
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001, eps=1e-8,
+               rescale_grad=1.0, out=None):
+    """LARS layer-wise lr adjustment (ref multi_lars):
+    lr_i *= eta*||w||/(||g||*rescale + wd*||w|| + eps) when ||w||,||g|| > 0."""
+    w_n = jnp.sqrt(weights_sum_sq._data)
+    g_n = jnp.sqrt(grads_sum_sq._data) * rescale_grad
+    ratio = eta * w_n / (g_n + wds._data * w_n + eps)
+    new = jnp.where((w_n > 0) & (g_n > 0), lrs._data * ratio, lrs._data)
+    if out is not None:
+        out._data = new
+        return out
+    return NDArray(new)
+
+
+def all_finite(data, init_output=True, out=None):
+    """1.0 iff every element is finite (ref all_finite — AMP overflow
+    check)."""
+    ok = jnp.isfinite(data._data).all().astype(jnp.float32).reshape(1)
+    if out is not None:
+        out._data = ok if init_output else out._data * ok
+        return out
+    return NDArray(ok)
+
+
+def multi_all_finite(*arrays, num_arrays=None, init_output=True, out=None):
+    arrs = arrays[:num_arrays] if num_arrays else arrays
+    ok = jnp.stack([jnp.isfinite(a._data).all() for a in arrs]) \
+        .all().astype(jnp.float32).reshape(1)
+    if out is not None:
+        out._data = ok if init_output else out._data * ok
+        return out
+    return NDArray(ok)
+
+
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero every array in place (ref reset_arrays — grad clearing)."""
+    arrs = arrays[:num_arrays] if num_arrays else arrays
+    for a in arrs:
+        a._data = jnp.zeros_like(a._data)

@@ -1,0 +1,158 @@
+"""Optimizer-as-op eager surface (ref src/operator/optimizer_op.cc,
+tests analog tests/python/unittest/test_optimizer.py op-level checks)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _w(shape=(4, 3), seed=0):
+    return nd.array(onp.random.RandomState(seed).randn(*shape).astype("float32"))
+
+
+def test_sgd_update_matches_formula():
+    w, g = _w(), _w(seed=1)
+    w0, g0 = w.asnumpy(), g.asnumpy()
+    nd.sgd_update(w, g, lr=0.1, wd=0.01, out=w)
+    assert_almost_equal(w, w0 - 0.1 * (g0 + 0.01 * w0), rtol=1e-6)
+
+
+def test_sgd_mom_update_state_and_weight():
+    w, g = _w(), _w(seed=1)
+    mom = nd.zeros(w.shape)
+    w0, g0 = w.asnumpy(), g.asnumpy()
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    m1 = -0.1 * g0
+    assert_almost_equal(mom, m1, rtol=1e-6)
+    assert_almost_equal(w, w0 + m1, rtol=1e-6)
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    m2 = 0.9 * m1 - 0.1 * g0
+    assert_almost_equal(mom, m2, rtol=1e-5)
+
+
+def test_clip_and_rescale():
+    w = nd.zeros((3,))
+    g = nd.array(onp.array([10.0, -10.0, 0.5], "float32"))
+    nd.sgd_update(w, g, lr=1.0, rescale_grad=0.5, clip_gradient=1.0, out=w)
+    assert_almost_equal(w, [-1.0, 1.0, -0.25], rtol=1e-6)
+
+
+def test_mp_sgd_update_keeps_master_fp32():
+    w32 = _w()
+    w16 = nd.array(w32.asnumpy()).astype("bfloat16")
+    g = _w(seed=1)
+    nd.mp_sgd_update(w16, g, w32, lr=0.1, out=w16)
+    assert w16.dtype == onp.dtype("bfloat16") or str(w16.dtype) == "bfloat16"
+    # master math in fp32
+    assert_almost_equal(w32, _w().asnumpy() - 0.1 * g.asnumpy(), rtol=1e-6)
+
+
+def test_adam_update_two_steps():
+    w, g = _w(), _w(seed=1)
+    m, v = nd.zeros(w.shape), nd.zeros(w.shape)
+    w0, g0 = w.asnumpy(), g.asnumpy()
+    nd.adam_update(w, g, m, v, lr=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                   out=w)
+    me = 0.1 * g0
+    ve = 0.001 * g0 * g0
+    assert_almost_equal(m, me, rtol=1e-5)
+    assert_almost_equal(v, ve, rtol=1e-5)
+    assert_almost_equal(w, w0 - 0.01 * me / (onp.sqrt(ve) + 1e-8), rtol=1e-5)
+
+
+def test_signsgd_signum():
+    w = nd.zeros((3,))
+    g = nd.array(onp.array([2.0, -3.0, 0.0], "float32"))
+    nd.signsgd_update(w, g, lr=0.5, out=w)
+    assert_almost_equal(w, [-0.5, 0.5, 0.0], rtol=1e-6)
+    mom = nd.zeros((3,))
+    nd.signum_update(w, g, mom, lr=0.5, momentum=0.9, out=w)
+    assert onp.isfinite(w.asnumpy()).all()
+
+
+def test_ftrl_sparsifies():
+    w = _w((8,))
+    z, n = nd.zeros((8,)), nd.zeros((8,))
+    g = nd.array(onp.full(8, 1e-4, "float32"))
+    for _ in range(3):
+        nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=1.0, out=w)
+    # tiny gradients + strong l1 → exact zeros (the FTRL property)
+    assert (w.asnumpy() == 0).all()
+
+
+def test_lamb_phases():
+    w, g = _w(), _w(seed=1)
+    m, v = nd.zeros(w.shape), nd.zeros(w.shape)
+    upd = nd.lamb_update_phase1(w, g, m, v, t=1, wd=0.01)
+    r1 = nd.norm(w)
+    r2 = nd.norm(upd)
+    w0 = w.asnumpy()
+    nd.lamb_update_phase2(w, upd, r1, r2, lr=0.1, out=w)
+    ratio = float(r1.asscalar()) / float(r2.asscalar())
+    assert_almost_equal(w, w0 - 0.1 * ratio * upd.asnumpy(), rtol=1e-5)
+
+
+def test_multi_and_preloaded_sgd():
+    ws = [_w(seed=i) for i in range(3)]
+    gs = [_w(seed=10 + i) for i in range(3)]
+    w0 = [w.asnumpy() for w in ws]
+    nd.multi_sgd_update(ws, gs, lrs=[0.1, 0.2, 0.3], wds=[0.0, 0.0, 0.0])
+    for i in range(3):
+        assert_almost_equal(ws[i], w0[i] - [0.1, 0.2, 0.3][i] * gs[i].asnumpy(),
+                            rtol=1e-5)
+    ws2 = [_w(seed=i) for i in range(2)]
+    gs2 = [_w(seed=20 + i) for i in range(2)]
+    w02 = [w.asnumpy() for w in ws2]
+    nd.preloaded_multi_sgd_update(ws2, gs2, nd.array([0.1, 0.1]),
+                                  nd.array([0.0, 0.0]))
+    for i in range(2):
+        assert_almost_equal(ws2[i], w02[i] - 0.1 * gs2[i].asnumpy(), rtol=1e-5)
+
+
+def test_lars_and_sum_sq():
+    ws = [nd.ones((4,)), nd.ones((2,)) * 2]
+    ss = nd.multi_sum_sq(*ws)
+    assert_almost_equal(ss, [4.0, 8.0], rtol=1e-6)
+    lrs = nd.array([0.1, 0.1])
+    wds = nd.array([0.0, 0.0])
+    new = nd.multi_lars(lrs, ss, ss, wds, eta=1.0, eps=0.0)
+    assert_almost_equal(new, [0.1, 0.1], rtol=1e-5)  # ||w||/||g|| = 1
+
+
+def test_all_finite_and_reset():
+    a = nd.array(onp.array([1.0, 2.0], "float32"))
+    b = nd.array(onp.array([onp.inf, 1.0], "float32"))
+    assert float(nd.all_finite(a).asscalar()) == 1.0
+    assert float(nd.all_finite(b).asscalar()) == 0.0
+    assert float(nd.multi_all_finite(a, b).asscalar()) == 0.0
+    nd.reset_arrays(a, b)
+    assert (a.asnumpy() == 0).all() and (b.asnumpy() == 0).all()
+
+
+def test_tensor_op_batch():
+    # add_n / batch_take / depth_to_space round trip / shape & size arrays
+    a, b, c = nd.ones((2, 2)), nd.ones((2, 2)) * 2, nd.ones((2, 2)) * 3
+    assert_almost_equal(nd.add_n(a, b, c), onp.full((2, 2), 6.0))
+    x = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    idx = nd.array(onp.array([1, 0, 3], "float32"))
+    assert_almost_equal(nd.batch_take(x, idx), [1.0, 4.0, 11.0])
+    y = nd.array(onp.random.RandomState(0).rand(2, 12, 3, 3).astype("float32"))
+    rt = nd.space_to_depth(nd.depth_to_space(y, 2), 2)
+    assert_almost_equal(rt, y.asnumpy())
+    assert nd.shape_array(y).asnumpy().tolist() == [2, 12, 3, 3]
+    assert int(nd.size_array(y).asnumpy()[0]) == 2 * 12 * 3 * 3
+    z = nd.array(onp.array([[1.0, 3.0, 2.0], [9.0, 1.0, 1.0]], "float32"))
+    assert_almost_equal(nd.argmax_channel(z), [1.0, 0.0])
+
+
+def test_correlation_and_crop():
+    x = nd.array(onp.random.RandomState(0).rand(1, 4, 6, 6).astype("float32"))
+    out = nd.Correlation(x, x, max_displacement=1)
+    assert out.shape == (1, 9, 6, 6)
+    mid = out.asnumpy()[0, 4]  # zero displacement = mean over C of x*x
+    assert_almost_equal(mid, (x.asnumpy()[0] ** 2).mean(axis=0), rtol=1e-5)
+    c = nd.Crop(x, offset=(1, 2), h_w=(3, 3))
+    assert_almost_equal(c, x.asnumpy()[:, :, 1:4, 2:5])
+    like = nd.zeros((1, 4, 2, 2))
+    assert nd.Crop(x, like, center_crop=True).shape == (1, 4, 2, 2)
