@@ -41,3 +41,4 @@ from ..ops.detection import (  # noqa: E402
 from .ndarray import BatchNorm as BatchNorm_v1  # noqa: E402  (v1 ≡ modern here)
 from .ndarray import Convolution as Convolution_v1  # noqa: E402
 from .ndarray import Pooling as Pooling_v1  # noqa: E402
+from .rnn_op import RNN, rnn_param_size  # noqa: E402

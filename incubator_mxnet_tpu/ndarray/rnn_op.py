@@ -1,0 +1,124 @@
+"""Monolithic fused RNN op (ref src/operator/rnn-inl.h / rnn.cc — the
+stateful cuDNN-backed `RNN` op the reference's gluon rnn_layer rides).
+
+TPU-native: the packed flat parameter vector keeps the reference's cuDNN
+layout (all weights layer-major then all biases — see _unpack), and the
+recurrence is the same `lax.scan` lowering the gluon layer uses; under
+jit the whole multi-layer stack compiles to one XLA while-loop program.
+Layout is TNC, matching the reference op's requirement.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import NDArray, _apply, _to_nd
+
+__all__ = ["RNN", "rnn_param_size"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+def _dims(mode, input_size, state_size, num_layers, bidirectional):
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    shapes = []      # (kind, layer, dir, shape) in PACKING ORDER: weights first
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else D * state_size
+        for d in range(D):
+            shapes.append(("wi", layer, d, (G * state_size, isz)))
+            shapes.append(("wh", layer, d, (G * state_size, state_size)))
+    for layer in range(num_layers):
+        for d in range(D):
+            shapes.append(("bi", layer, d, (G * state_size,)))
+            shapes.append(("bh", layer, d, (G * state_size,)))
+    return shapes
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False):
+    """Flat parameter count (ref rnn-inl.h GetRnnParamSize)."""
+    total = 0
+    for _, _, _, shp in _dims(mode, input_size, state_size, num_layers,
+                              bidirectional):
+        n = 1
+        for s in shp:
+            n *= s
+        total += n
+    return total
+
+
+def _unpack(params, shapes):
+    out = {}
+    off = 0
+    for kind, layer, d, shp in shapes:
+        n = 1
+        for s in shp:
+            n *= s
+        out[(kind, layer, d)] = params[off: off + n].reshape(shp)
+        off += n
+    return out, off
+
+
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, **kw):
+    """data (T, N, I); parameters flat packed vector; state (L*D, N, H);
+    state_cell (lstm only). Returns output (T, N, D*H), or with
+    state_outputs=True the [output, hy(, cy)] list (ref rnn.cc outputs)."""
+    assert mode in _GATES, mode
+    assert state_size, "state_size required"
+    T, N, I = data.shape
+    D = 2 if bidirectional else 1
+    shapes = _dims(mode, I, state_size, num_layers, bidirectional)
+    act = "relu" if mode == "rnn_relu" else "tanh"
+    has_cell = mode == "lstm"
+
+    def fn(x, params, h0, *maybe_c):
+        from ..gluon.rnn.rnn_layer import _lstm_step, _gru_step, _rnn_step
+        c0 = maybe_c[0] if maybe_c else None
+        w, used = _unpack(params, shapes)
+        out = x
+        h_out, c_out = [], []
+        for layer in range(num_layers):
+            dir_outs = []
+            for d in range(D):
+                idx = layer * D + d
+                seq = out if d == 0 else jnp.flip(out, 0)
+                wi, wh = w[("wi", layer, d)], w[("wh", layer, d)]
+                bi, bh = w[("bi", layer, d)], w[("bh", layer, d)]
+                if has_cell:
+                    def step(carry, x_t, _wi=wi, _wh=wh, _bi=bi, _bh=bh):
+                        h, c = carry
+                        h2, c2 = _lstm_step(h, c, x_t, _wi, _wh, _bi, _bh)
+                        return (h2, c2), h2
+                    (hT, cT), ys = lax.scan(step, (h0[idx], c0[idx]), seq)
+                    c_out.append(cT)
+                elif mode == "gru":
+                    def step(h, x_t, _wi=wi, _wh=wh, _bi=bi, _bh=bh):
+                        h2 = _gru_step(h, x_t, _wi, _wh, _bi, _bh)
+                        return h2, h2
+                    hT, ys = lax.scan(step, h0[idx], seq)
+                else:
+                    def step(h, x_t, _wi=wi, _wh=wh, _bi=bi, _bh=bh):
+                        h2 = _rnn_step(h, x_t, _wi, _wh, _bi, _bh, act)
+                        return h2, h2
+                    hT, ys = lax.scan(step, h0[idx], seq)
+                h_out.append(hT)
+                if d == 1:
+                    ys = jnp.flip(ys, 0)
+                dir_outs.append(ys)
+            out = dir_outs[0] if D == 1 else jnp.concatenate(dir_outs, -1)
+        hy = jnp.stack(h_out, 0)
+        if has_cell:
+            return out, hy, jnp.stack(c_out, 0)
+        return out, hy
+
+    args = [data, _to_nd(parameters), state] + ([state_cell] if has_cell else [])
+    res = _apply(lambda *a: fn(*a), *args)
+    if has_cell:
+        out, hy, cy = res
+        return [out, hy, cy] if state_outputs else out
+    out, hy = res
+    return [out, hy] if state_outputs else out

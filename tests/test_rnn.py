@@ -112,3 +112,58 @@ def test_residual_dropout_cells():
     dcell = rnn.DropoutCell(0.5)
     out2, _ = dcell(x, [])
     assert out2.shape == (2, 4)
+
+
+def test_monolithic_rnn_op_matches_gluon_layer():
+    """nd.RNN with the packed parameter vector == gluon LSTM layer with the
+    same weights (ref rnn-inl.h packing: all weights layer-major, then all
+    biases)."""
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon
+    from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    mx.random.seed(0)
+    layer = gluon.rnn.LSTM(H, num_layers=L, layout="TNC")
+    layer.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(T, N, I))
+    layer(x)  # finish deferred init
+
+    # pack gluon's per-layer weights into the reference flat layout
+    flat = []
+    for l in range(L):
+        flat.append(layer._i2h[l].data().asnumpy().ravel())
+        flat.append(layer._h2h[l].data().asnumpy().ravel())
+    for l in range(L):
+        flat.append(layer._i2hb[l].data().asnumpy().ravel())
+        flat.append(layer._h2hb[l].data().asnumpy().ravel())
+    params = nd.array(onp.concatenate(flat))
+    from incubator_mxnet_tpu.ndarray.rnn_op import rnn_param_size
+    assert params.shape[0] == rnn_param_size("lstm", I, H, L)
+
+    h0 = nd.zeros((L, N, H))
+    c0 = nd.zeros((L, N, H))
+    out, hy, cy = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L,
+                         mode="lstm", state_outputs=True)
+    want, (hw, cw) = layer(x, [nd.zeros((L, N, H)), nd.zeros((L, N, H))])
+    assert_almost_equal(out, want.asnumpy(), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(hy, hw.asnumpy(), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(cy, cw.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_monolithic_rnn_op_gru_bidirectional():
+    import numpy as onp
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.ndarray.rnn_op import rnn_param_size
+
+    T, N, I, H = 4, 2, 3, 5
+    n = rnn_param_size("gru", I, H, num_layers=1, bidirectional=True)
+    params = nd.array(onp.random.RandomState(0).randn(n).astype("float32") * 0.1)
+    h0 = nd.zeros((2, N, H))
+    x = nd.random.normal(shape=(T, N, I))
+    out, hy = nd.RNN(x, params, h0, state_size=H, num_layers=1, mode="gru",
+                     bidirectional=True, state_outputs=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hy.shape == (2, N, H)
+    assert onp.isfinite(out.asnumpy()).all()
