@@ -60,6 +60,15 @@ def calib_entropy(activations, num_bins=8001, num_quantized_bins=255):
     a = onp.abs(onp.concatenate([x.asnumpy().ravel() for x in activations]))
     amax = float(a.max()) or 1.0
     hist, edges = onp.histogram(a, bins=num_bins, range=(0, amax))
+    t = _entropy_threshold(hist, edges, num_quantized_bins)
+    return -t, t
+
+
+def _entropy_threshold(hist, edges, num_quantized_bins=255):
+    """KL-optimal |threshold| from a |activation| histogram (the op-level
+    entry the calibrate_entropy contrib op shares — ref calibrate.cc)."""
+    num_bins = len(hist)
+    amax = float(edges[-1]) or 1.0
     best_kl, best_t = onp.inf, amax
     for i in range(num_quantized_bins, num_bins, num_bins // 64 or 1):
         t = edges[i]
@@ -82,7 +91,7 @@ def calib_entropy(activations, num_bins=8001, num_quantized_bins=255):
         kl = float((p_n[mask] * onp.log(p_n[mask] / q_n[mask])).sum())
         if kl < best_kl:
             best_kl, best_t = kl, t
-    return -best_t, best_t
+    return best_t
 
 
 class QuantizedDense:

@@ -347,3 +347,279 @@ def arange_like(data, start=0.0, step=1.0, axis=None):
         return start + step * jnp.arange(x.shape[axis])
 
     return _apply(fn, data)
+
+
+# ---------------------------------------------------------------- batch 4:
+# reference _contrib_* op-surface parity (NNVM registry names)
+def _alias_ops():
+    """MultiBox*/SyncBatchNorm/SparseEmbedding exist as blocks/ops
+    elsewhere; the reference ALSO registers them as nd.contrib ops."""
+    from ..ops.multibox import MultiBoxPrior, MultiBoxTarget, MultiBoxDetection
+    return MultiBoxPrior, MultiBoxTarget, MultiBoxDetection
+
+
+MultiBoxPrior, MultiBoxTarget, MultiBoxDetection = _alias_ops()
+
+
+def SyncBatchNorm(data, gamma, beta, moving_mean, moving_var, **kw):
+    """ref contrib/sync_batch_norm-inl.h: cross-device BN. On an SPMD mesh
+    batch stats are already computed over the global (sharded) batch inside
+    the compiled program, so this IS BatchNorm here (documented in
+    gluon/nn SyncBatchNorm)."""
+    from .ndarray import BatchNorm
+    kw.pop("ndev", None)
+    kw.pop("key", None)
+    return BatchNorm(data, gamma, beta, moving_mean, moving_var, **kw)
+
+
+def SparseEmbedding(data, weight, input_dim=None, output_dim=None, **kw):
+    """ref contrib SparseEmbedding op: embedding with row_sparse grad; the
+    gather VJP is already a scatter (see gluon.contrib.nn.SparseEmbedding)."""
+    from .ndarray import Embedding
+    return Embedding(data, weight, input_dim=input_dim, output_dim=output_dim)
+
+
+def index_array(data, axes=None):
+    """Coordinates of every element (ref contrib/index_array.cc):
+    shape data.shape + (len(axes),), int64."""
+    import numpy as onp
+    shp = tuple(data.shape)
+    axes_ = tuple(range(len(shp))) if axes is None else tuple(axes)
+    grids = onp.indices(shp)
+    out = onp.stack([grids[a] for a in axes_], axis=-1).astype(onp.int64)
+    from . import array as _array
+    return _array(out)
+
+
+def getnnz(data, axis=None):
+    """Stored-value count of a CSR (ref contrib/nnz.cc)."""
+    import numpy as onp
+    from .sparse import CSRNDArray
+    assert isinstance(data, CSRNDArray), "getnnz expects CSR storage"
+    from . import array as _array
+    if axis is None:
+        return _array(onp.asarray([data.data.shape[0]], onp.int64))
+    assert axis == 0, "getnnz supports axis=None or 0"
+    ptr = onp.asarray(data.indptr._data)
+    return _array((ptr[1:] - ptr[:-1]).astype(onp.int64))
+
+
+def edge_id(data, u, v):
+    """CSR edge lookup (ref contrib/edge_id op, DGL): out[i] = value at
+    (u[i], v[i]) or -1 when absent. Eager host op (data-dependent)."""
+    import numpy as onp
+    ptr = onp.asarray(data.indptr._data).astype(onp.int64)
+    idx = onp.asarray(data.indices._data).astype(onp.int64)
+    val = onp.asarray(data.data._data)
+    uu = onp.asarray(u._data).astype(onp.int64)
+    vv = onp.asarray(v._data).astype(onp.int64)
+    out = onp.full(uu.shape, -1.0, onp.float32)
+    for i, (r, c) in enumerate(zip(uu, vv)):
+        lo, hi = ptr[r], ptr[r + 1]
+        pos = lo + onp.searchsorted(idx[lo:hi], c)
+        if pos < hi and idx[pos] == c:
+            out[i] = val[pos]
+    from . import array as _array
+    return _array(out)
+
+
+def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5, out=None):
+    """Row-wise AdaGrad (ref contrib/optimizer_op.cc group_adagrad_update):
+    history += mean_dim(grad^2); w -= lr * grad / sqrt(history + eps)."""
+    g = grad._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h = history._data + jnp.mean(g * g, axis=tuple(range(1, g.ndim)),
+                                 keepdims=True)
+    history._data = h
+    new_w = weight._data - lr * g / jnp.sqrt(h + epsilon)
+    tgt = out if out is not None else weight
+    tgt._data = new_w.astype(tgt._data.dtype)
+    return tgt
+
+
+# interleaved MHA matmuls (ref contrib/transformer.cc:
+# _contrib_interleaved_matmul_* — the reference's fused-attention helpers).
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """qkv (S, B, H*3*D) head-interleaved -> scores (B*H, S, S) scaled by
+    1/sqrt(D)."""
+    def fn(qkv):
+        S, B, HD3 = qkv.shape
+        D = HD3 // (heads * 3)
+        x = qkv.reshape(S, B, heads, 3, D)
+        q, k = x[:, :, :, 0], x[:, :, :, 1]          # (S,B,H,D)
+        q = q.transpose(1, 2, 0, 3).reshape(B * heads, S, D)
+        k = k.transpose(1, 2, 0, 3).reshape(B * heads, S, D)
+        return jnp.einsum("bqd,bkd->bqk", q, k) / jnp.sqrt(D).astype(qkv.dtype)
+    return _apply(fn, queries_keys_values)
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    """qkv (S,B,H*3*D) + att (B*H,S,S) -> context (S, B, H*D)."""
+    def fn(qkv, att):
+        S, B, HD3 = qkv.shape
+        D = HD3 // (heads * 3)
+        v = qkv.reshape(S, B, heads, 3, D)[:, :, :, 2]    # (S,B,H,D)
+        v = v.transpose(1, 2, 0, 3).reshape(B * heads, S, D)
+        ctx = jnp.einsum("bqk,bkd->bqd", att, v)          # (B*H,S,D)
+        return ctx.reshape(B, heads, S, D).transpose(2, 0, 1, 3) \
+            .reshape(S, B, heads * D)
+    return _apply(fn, queries_keys_values, attention)
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    """q (Sq,B,H*D), kv (Sk,B,H*2*D) -> scores (B*H, Sq, Sk)."""
+    def fn(q, kv):
+        Sq, B, HD = q.shape
+        D = HD // heads
+        Sk = kv.shape[0]
+        qq = q.reshape(Sq, B, heads, D).transpose(1, 2, 0, 3) \
+            .reshape(B * heads, Sq, D)
+        kk = kv.reshape(Sk, B, heads, 2, D)[:, :, :, 0] \
+            .transpose(1, 2, 0, 3).reshape(B * heads, Sk, D)
+        return jnp.einsum("bqd,bkd->bqk", qq, kk) / jnp.sqrt(D).astype(q.dtype)
+    return _apply(fn, queries, keys_values)
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    """kv (Sk,B,H*2*D) + att (B*H,Sq,Sk) -> context (Sq, B, H*D)."""
+    def fn(kv, att):
+        Sk, B, HD2 = kv.shape
+        D = HD2 // (heads * 2)
+        v = kv.reshape(Sk, B, heads, 2, D)[:, :, :, 1] \
+            .transpose(1, 2, 0, 3).reshape(B * heads, Sk, D)
+        ctx = jnp.einsum("bqk,bkd->bqd", att, v)
+        Sq = att.shape[1]
+        return ctx.reshape(B, heads, Sq, D).transpose(2, 0, 1, 3) \
+            .reshape(Sq, B, heads * D)
+    return _apply(fn, keys_values, attention)
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched reference boxes as regression targets
+    (ref contrib/bounding_box.cc BoxEncode). corner format in/out of the
+    center-parameterized (dx,dy,dw,dh) encoding; samples>0 marks positives.
+    Returns (targets (B,N,4), masks (B,N,4))."""
+    def fn(smp, mat, anc, ref):
+        ga = jnp.take_along_axis(
+            ref, mat.astype(jnp.int32)[..., None].repeat(4, -1), axis=1)
+        ax, ay = (anc[..., 0] + anc[..., 2]) / 2, (anc[..., 1] + anc[..., 3]) / 2
+        aw, ah = anc[..., 2] - anc[..., 0], anc[..., 3] - anc[..., 1]
+        gx, gy = (ga[..., 0] + ga[..., 2]) / 2, (ga[..., 1] + ga[..., 3]) / 2
+        gw, gh = ga[..., 2] - ga[..., 0], ga[..., 3] - ga[..., 1]
+        t = jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                       jnp.log(jnp.maximum(gw / aw, 1e-12)),
+                       jnp.log(jnp.maximum(gh / ah, 1e-12))], axis=-1)
+        t = (t - jnp.asarray(means)) / jnp.asarray(stds)
+        mask = (smp > 0.5)[..., None].astype(t.dtype)
+        return t * mask, mask
+    res = _apply(lambda s, m, a, r: fn(s, m, a, r),
+                 samples, matches, anchors, refs)
+    return res
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """Inverse of box_encode (ref BoxDecode): regression deltas + anchors
+    -> corner boxes (B,N,4)."""
+    def fn(d, anc):
+        if format == "corner":
+            ax = (anc[..., 0] + anc[..., 2]) / 2
+            ay = (anc[..., 1] + anc[..., 3]) / 2
+            aw = anc[..., 2] - anc[..., 0]
+            ah = anc[..., 3] - anc[..., 1]
+        else:  # center
+            ax, ay, aw, ah = (anc[..., 0], anc[..., 1], anc[..., 2],
+                              anc[..., 3])
+        dx, dy = d[..., 0] * std0, d[..., 1] * std1
+        dw, dh = d[..., 2] * std2, d[..., 3] * std3
+        if clip is not None and clip > 0:
+            dw = jnp.minimum(dw, clip)
+            dh = jnp.minimum(dh, clip)
+        cx, cy = dx * aw + ax, dy * ah + ay
+        w, h = jnp.exp(dw) * aw, jnp.exp(dh) * ah
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+    return _apply(fn, data, _to_nd(anchors))
+
+
+def RROIAlign(data, rois, pooled_size, spatial_scale, sampling_ratio=2):
+    """Rotated ROI align (ref contrib RROIAlign): rois
+    (R, 6) = (batch_idx, cx, cy, w, h, angle_rad); bilinear sampling on the
+    rotated grid via the shared gather helper."""
+    from ..ops.detection import _bilinear_gather
+    PH, PW = pooled_size
+    s = sampling_ratio
+
+    def fn(x, r):
+        R = r.shape[0]
+        cx, cy = r[:, 1] * spatial_scale, r[:, 2] * spatial_scale
+        w, h = r[:, 3] * spatial_scale, r[:, 4] * spatial_scale
+        ang = r[:, 5]
+        iy = (jnp.arange(PH * s) + 0.5) / (PH * s) - 0.5   # [-.5,.5) grid
+        ix = (jnp.arange(PW * s) + 0.5) / (PW * s) - 0.5
+        gy, gx = jnp.meshgrid(iy, ix, indexing="ij")       # (PH*s, PW*s)
+        # rotate local (gx*w, gy*h) by angle then translate to center
+        ca, sa = jnp.cos(ang), jnp.sin(ang)
+        lx = gx[None] * w[:, None, None]
+        ly = gy[None] * h[:, None, None]
+        xs = cx[:, None, None] + lx * ca[:, None, None] - ly * sa[:, None, None]
+        ys = cy[:, None, None] + lx * sa[:, None, None] + ly * ca[:, None, None]
+        batch_idx = r[:, 0].astype(jnp.int32)
+        per_roi = x[batch_idx]                              # (R, C, H, W)
+        sampled = _bilinear_gather(per_roi, ys, xs)         # (R, C, PH*s, PW*s)
+        C = x.shape[1]
+        return sampled.reshape(R, C, PH, s, PW, s).mean(axis=(3, 5))
+    return _apply(fn, data, _to_nd(rois))
+
+
+def quantize(data, min_range, max_range, out_type="int8"):
+    """op alias of contrib.quantization.quantize (ref quantize.cc)."""
+    from ..contrib import quantization as q
+    return q.quantize(data, float(min_range.asscalar()),
+                      float(max_range.asscalar()), out_type)
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """ref quantize_v2.cc: ranges from calibration or from the data."""
+    from ..contrib import quantization as q
+    return q.quantize(data, min_calib_range, max_calib_range, out_type)
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    from ..contrib import quantization as q
+    return q.dequantize(data, min_range, max_range, out_type)
+
+
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    from ..contrib import quantization as q
+    return q.requantize(data, min_range, max_range, min_calib_range,
+                        max_calib_range)
+
+
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """ref quantization/calibrate.cc: KL-optimal threshold from a histogram.
+    Delegates to the same entropy search quantize_net uses."""
+    import numpy as onp
+    from ..contrib.quantization import _entropy_threshold
+    h = onp.asarray(hist._data if hasattr(hist, "_data") else hist)
+    e = onp.asarray(hist_edges._data if hasattr(hist_edges, "_data")
+                    else hist_edges)
+    thr = _entropy_threshold(h, e, num_quantized_bins)
+    from . import array as _array
+    return (_array(onp.asarray([-thr], onp.float32)),
+            _array(onp.asarray([thr], onp.float32)))
+
+
+__all__ += [
+    "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection", "SyncBatchNorm",
+    "SparseEmbedding", "index_array", "getnnz", "edge_id",
+    "group_adagrad_update", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt", "box_encode", "box_decode",
+    "RROIAlign", "quantize", "quantize_v2", "dequantize", "requantize",
+    "calibrate_entropy",
+]

@@ -1,0 +1,116 @@
+"""Contrib op-surface parity batch (ref src/operator/contrib/:
+transformer.cc interleaved matmuls, bounding_box.cc box_encode/decode,
+index_array.cc, nnz.cc, edge_id, group_adagrad, RROIAlign,
+quantize/calibrate op aliases)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+c = nd.contrib
+
+
+def test_interleaved_matmul_selfatt():
+    H, D = 4, 8
+    qkv = nd.array(onp.random.RandomState(1).randn(5, 2, H * 3 * D)
+                   .astype("float32"))
+    sc = c.interleaved_matmul_selfatt_qk(qkv, heads=H)
+    assert sc.shape == (2 * H, 5, 5)
+    x = qkv.asnumpy().reshape(5, 2, H, 3, D)
+    q = x[:, :, :, 0].transpose(1, 2, 0, 3).reshape(2 * H, 5, D)
+    k = x[:, :, :, 1].transpose(1, 2, 0, 3).reshape(2 * H, 5, D)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3).reshape(2 * H, 5, D)
+    ref = onp.einsum("bqd,bkd->bqk", q, k) / onp.sqrt(D)
+    assert_almost_equal(sc, ref, rtol=1e-4, atol=1e-5)
+    att = nd.softmax(sc, axis=-1)
+    ctx = c.interleaved_matmul_selfatt_valatt(qkv, att, heads=H)
+    ref_ctx = onp.einsum("bqk,bkd->bqd", att.asnumpy(), v) \
+        .reshape(2, H, 5, D).transpose(2, 0, 1, 3).reshape(5, 2, H * D)
+    assert_almost_equal(ctx, ref_ctx, rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_matmul_encdec():
+    H, D, Sq, Sk, B = 2, 4, 3, 6, 2
+    q = nd.array(onp.random.RandomState(0).randn(Sq, B, H * D).astype("float32"))
+    kv = nd.array(onp.random.RandomState(1).randn(Sk, B, H * 2 * D)
+                  .astype("float32"))
+    sc = c.interleaved_matmul_encdec_qk(q, kv, heads=H)
+    assert sc.shape == (B * H, Sq, Sk)
+    att = nd.softmax(sc, axis=-1)
+    ctx = c.interleaved_matmul_encdec_valatt(kv, att, heads=H)
+    assert ctx.shape == (Sq, B, H * D)
+    assert onp.isfinite(ctx.asnumpy()).all()
+
+
+def test_box_encode_decode_roundtrip():
+    anc = nd.array(onp.array([[[0.1, 0.1, 0.3, 0.3],
+                               [0.5, 0.5, 0.9, 0.9]]], "float32"))
+    refs = nd.array(onp.array([[[0.12, 0.1, 0.32, 0.31]]], "float32"))
+    smp = nd.array(onp.array([[1.0, 0.0]], "float32"))
+    mat = nd.array(onp.array([[0.0, 0.0]], "float32"))
+    t, m = c.box_encode(smp, mat, anc, refs)
+    assert m.asnumpy()[0, 1].sum() == 0      # negative sample masked out
+    dec = c.box_decode(t, anc)
+    assert_almost_equal(dec.asnumpy()[0, 0], refs.asnumpy()[0, 0], atol=1e-5)
+
+
+def test_index_array():
+    x = nd.zeros((2, 3))
+    idx = c.index_array(x)
+    assert idx.shape == (2, 3, 2)
+    assert idx.asnumpy()[1, 2].tolist() == [1, 2]
+    only_ax1 = c.index_array(x, axes=(1,))
+    assert only_ax1.asnumpy()[1, 2].tolist() == [2]
+
+
+def test_getnnz_edge_id():
+    from incubator_mxnet_tpu.ndarray import sparse
+    m = sparse.csr_matrix((nd.array([1.0, 2.0, 3.0]),
+                           nd.array([1, 0, 2]), nd.array([0, 1, 3])),
+                          shape=(2, 3))
+    assert int(c.getnnz(m).asscalar()) == 3
+    assert c.getnnz(m, axis=0).asnumpy().tolist() == [1, 2]
+    eid = c.edge_id(m, nd.array([0, 1, 0]), nd.array([1, 2, 0]))
+    assert eid.asnumpy().tolist() == [1.0, 3.0, -1.0]
+
+
+def test_group_adagrad_update():
+    w, g, h = nd.ones((3, 4)), nd.ones((3, 4)), nd.zeros((3, 1))
+    c.group_adagrad_update(w, g, h, lr=1.0)
+    assert_almost_equal(h, onp.ones((3, 1)), rtol=1e-6)
+    assert_almost_equal(w, onp.full((3, 4), 1 - 1 / onp.sqrt(1 + 1e-5)),
+                        rtol=1e-2, atol=1e-7)  # fp32 catastrophic cancel near 1
+
+
+def test_rroialign_zero_angle_is_roialign():
+    img = nd.array(onp.arange(64, dtype="float32").reshape(1, 1, 8, 8))
+    # full-image unrotated roi centered at (3.5, 3.5), size 8
+    rois = nd.array(onp.array([[0, 3.5, 3.5, 8.0, 8.0, 0.0]], "float32"))
+    out = c.RROIAlign(img, rois, (2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    a = img.asnumpy()[0, 0]
+    quads = onp.array([[a[:4, :4].mean(), a[:4, 4:].mean()],
+                       [a[4:, :4].mean(), a[4:, 4:].mean()]])
+    assert_almost_equal(out.asnumpy()[0, 0], quads, rtol=0.1)
+    # 180-degree rotation flips the pooled map
+    rois_pi = nd.array(onp.array([[0, 3.5, 3.5, 8.0, 8.0, onp.pi]], "float32"))
+    out_pi = c.RROIAlign(img, rois_pi, (2, 2), spatial_scale=1.0)
+    assert_almost_equal(out_pi.asnumpy()[0, 0], quads[::-1, ::-1], rtol=0.1)
+
+
+def test_quantize_op_aliases():
+    x = nd.array(onp.linspace(-1, 1, 16).astype("float32"))
+    q, mn, mx_ = c.quantize_v2(x, min_calib_range=-1.0, max_calib_range=1.0)
+    deq = c.dequantize(q, mn, mx_)
+    assert_almost_equal(deq, x.asnumpy(), atol=2e-2)
+    hist, edges = onp.histogram(onp.abs(x.asnumpy()), bins=64, range=(0, 1.0))
+    lo, hi = c.calibrate_entropy(nd.array(hist.astype("float32")),
+                                 nd.array(edges.astype("float32")),
+                                 num_quantized_bins=15)
+    assert float(hi.asscalar()) > 0 and float(lo.asscalar()) < 0
+
+
+def test_contrib_aliases_exist():
+    assert c.MultiBoxPrior is not None
+    assert c.SyncBatchNorm is not None and c.SparseEmbedding is not None
