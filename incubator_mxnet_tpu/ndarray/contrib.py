@@ -623,3 +623,218 @@ __all__ += [
     "RROIAlign", "quantize", "quantize_v2", "dequantize", "requantize",
     "calibrate_entropy",
 ]
+
+
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Marked Hawkes process log-likelihood (ref contrib/hawkes_ll.cc):
+    intensity lam_k*(t) = mu_k + alpha_k beta_k sum_{t_i<t, y_i=k}
+    exp(-beta_k (t - t_i)). Inputs: lda (N,K) background mu, alpha/beta
+    (K,), state (N,K) decayed-counter memory s_k(0), ragged lags/marks
+    (N,T) with valid_length (N,), observation horizon max_time (N,).
+    Returns (loglik (N,), out_state (N,K) = s_k(max_time)). Lowered to one
+    lax.scan over the sequence axis (the reference's per-sample loop)."""
+    def fn(mu, a, b, st0, lg, mk, vl, mt):
+        N, T = lg.shape
+        K = mu.shape[1]
+        mki = mk.astype(jnp.int32)
+
+        def step(carry, inp):
+            st, last, t, ll, j = carry
+            lag_j, mark_j = inp
+            t2 = t + lag_j
+            oh = jax.nn.one_hot(mark_j, K, dtype=mu.dtype)        # (N,K)
+            take = lambda m2: jnp.take_along_axis(
+                m2, mark_j[:, None], 1)[:, 0]
+            d = t2 - take(last)
+            a_ci, b_ci = a[mark_j], b[mark_j]
+            st_ci, mu_ci = take(st), take(mu)
+            ed = jnp.exp(-b_ci * d)
+            lam = mu_ci + a_ci * b_ci * st_ci * ed
+            comp = mu_ci * d + a_ci * st_ci * (1 - ed)
+            valid = j < vl                                        # (N,)
+            ll2 = ll + jnp.where(valid, jnp.log(lam) - comp, 0.0)
+            upd = (valid[:, None] * oh) > 0
+            st2 = jnp.where(upd, (1 + st_ci * ed)[:, None], st)
+            last2 = jnp.where(upd, t2[:, None], last)
+            return (st2, last2, jnp.where(valid, t2, t), ll2, j + 1), None
+
+        init = (st0, jnp.zeros_like(st0), jnp.zeros(N, mu.dtype),
+                jnp.zeros(N, mu.dtype), jnp.zeros((), jnp.float32))
+        (st, last, _, ll, _), _ = lax.scan(step, init, (lg.T, mki.T))
+        d = mt[:, None] - last
+        ed = jnp.exp(-b[None, :] * d)
+        ll = ll - (mu * d + a[None, :] * st * (1 - ed)).sum(axis=1)
+        return ll, st * ed
+
+    res = _apply(lambda *xs: fn(*xs), _to_nd(lda), _to_nd(alpha), _to_nd(beta),
+                 _to_nd(state), _to_nd(lags), _to_nd(marks),
+                 _to_nd(valid_length), _to_nd(max_time))
+    return res
+
+
+__all__ += ["hawkesll"]
+
+
+# ---- DGL graph-sampling ops (ref contrib/dgl_graph.cc) -------------------
+def _csr_parts(g):
+    import numpy as onp
+    return (onp.asarray(g.data._data), onp.asarray(g.indices._data).astype(onp.int64),
+            onp.asarray(g.indptr._data).astype(onp.int64), g.shape)
+
+
+def _make_csr(vals, idx, ptr, shape):
+    import numpy as onp
+    from .sparse import CSRNDArray
+    from . import array as _array
+    return CSRNDArray(_array(onp.asarray(vals)),
+                      _array(onp.asarray(idx, onp.int64).astype("int64")),
+                      _array(onp.asarray(ptr, onp.int64).astype("int64")),
+                      shape)
+
+
+def _neighbor_sample(csr, seeds, num_hops, num_neighbor, max_num_vertices,
+                     probability=None, seed=0):
+    import numpy as onp
+    vals, idx, ptr, shape = _csr_parts(csr)
+    rng = onp.random.RandomState(seed)
+    seeds = onp.asarray(seeds._data).astype(onp.int64)
+    seeds = seeds[seeds >= 0]
+    layer = {int(v): 0 for v in seeds}
+    frontier = list(seeds)
+    edges = {}                      # (u, v) -> value
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for u in frontier:
+            lo, hi = ptr[u], ptr[u + 1]
+            nbrs = idx[lo:hi]
+            evals = vals[lo:hi]
+            if len(nbrs) == 0:
+                continue
+            k = min(num_neighbor, len(nbrs))
+            if probability is not None:
+                p = onp.asarray(probability._data)[nbrs]
+                p = p / p.sum() if p.sum() > 0 else None
+                sel = rng.choice(len(nbrs), size=k, replace=False, p=p)
+            else:
+                sel = rng.choice(len(nbrs), size=k, replace=False)
+            for s in sel:
+                v = int(nbrs[s])
+                edges[(int(u), v)] = evals[s]
+                if v not in layer:
+                    layer[v] = hop
+                    nxt.append(v)
+        frontier = nxt
+    verts = sorted(layer)[:max_num_vertices]
+    vset = set(verts)
+    out_v = onp.full(max_num_vertices + 1, -1, onp.int64)
+    out_v[: len(verts)] = verts
+    out_v[-1] = len(verts)
+    out_layer = onp.full(max_num_vertices, -1, onp.int64)
+    out_layer[: len(verts)] = [layer[v] for v in verts]
+    # sub-CSR in ORIGINAL ids (reference keeps the input shape)
+    rows = [[] for _ in range(shape[0])]
+    for (u, v), e in sorted(edges.items()):
+        if u in vset and v in vset:
+            rows[u].append((v, e))
+    new_ptr = [0]
+    new_idx, new_vals = [], []
+    for r in rows:
+        for v, e in sorted(r):
+            new_idx.append(v)
+            new_vals.append(e)
+        new_ptr.append(len(new_idx))
+    from . import array as _array
+    return (_array(out_v), _make_csr(new_vals, new_idx, new_ptr, shape),
+            _array(out_layer))
+
+
+def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100, seed=0):
+    """Uniform neighbor sampling for GNN mini-batches (ref dgl_graph.cc
+    _contrib_dgl_csr_neighbor_uniform_sample). Per seed array returns
+    (vertices padded to max_num_vertices+1 with count in the last slot,
+    sampled sub-CSR in original ids, per-vertex sample layer). Eager host
+    op — sampling is data-dependent."""
+    outs = []
+    for s in seed_arrays:
+        outs.extend(_neighbor_sample(csr_matrix, s, num_hops, num_neighbor,
+                                     max_num_vertices, None, seed))
+    return outs
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability, *seed_arrays,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2, max_num_vertices=100,
+                                        seed=0):
+    """Probability-weighted variant (ref _contrib_dgl_csr_neighbor_non_
+    uniform_sample); probability is per-vertex."""
+    outs = []
+    for s in seed_arrays:
+        outs.extend(_neighbor_sample(csr_matrix, s, num_hops, num_neighbor,
+                                     max_num_vertices, probability, seed))
+    return outs
+
+
+def dgl_subgraph(graph, *vids_arrays, return_mapping=False, num_args=None):
+    """Induced subgraph(s) with relabeled vertices (ref dgl_graph.cc
+    _contrib_dgl_subgraph); with return_mapping also emits a CSR whose
+    values are the ORIGINAL edge positions."""
+    import numpy as onp
+    vals, idx, ptr, shape = _csr_parts(graph)
+    outs = []
+    maps = []
+    for va in vids_arrays:
+        vids = onp.asarray(va._data).astype(onp.int64)
+        vids = vids[vids >= 0]
+        relabel = {int(v): i for i, v in enumerate(vids)}
+        n = len(vids)
+        new_ptr, new_idx, new_vals, new_eid = [0], [], [], []
+        for v in vids:
+            lo, hi = ptr[v], ptr[v + 1]
+            ents = [(relabel[int(c)], vals[e], e)
+                    for e, c in zip(range(lo, hi), idx[lo:hi])
+                    if int(c) in relabel]
+            for cc, ee, eid in sorted(ents):
+                new_idx.append(cc)
+                new_vals.append(ee)
+                new_eid.append(eid)
+            new_ptr.append(len(new_idx))
+        outs.append(_make_csr(new_vals, new_idx, new_ptr, (n, n)))
+        maps.append(_make_csr(new_eid, new_idx, new_ptr, (n, n)))
+    return outs + maps if return_mapping else outs
+
+
+def dgl_graph_compact(*graphs, graph_sizes=None, return_mapping=False,
+                      num_args=None):
+    """Trim padded sampled graphs to their true size (ref dgl_graph.cc
+    _contrib_dgl_graph_compact): graph i keeps its first graph_sizes[i]
+    vertices/columns."""
+    import numpy as onp
+    sizes = [int(x) for x in onp.asarray(
+        graph_sizes._data if hasattr(graph_sizes, "_data") else graph_sizes)]
+    outs = []
+    for g, n in zip(graphs, sizes):
+        vals, idx, ptr, _ = _csr_parts(g)
+        new_ptr, new_idx, new_vals = [0], [], []
+        for r in range(n):
+            lo, hi = ptr[r], ptr[r + 1]
+            for e, c in zip(range(lo, hi), idx[lo:hi]):
+                if c < n:
+                    new_idx.append(int(c))
+                    new_vals.append(vals[e])
+            new_ptr.append(len(new_idx))
+        outs.append(_make_csr(new_vals, new_idx, new_ptr, (n, n)))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def dgl_adjacency(graph):
+    """Adjacency with all-ones values (ref _contrib_dgl_adjacency)."""
+    import numpy as onp
+    vals, idx, ptr, shape = _csr_parts(graph)
+    return _make_csr(onp.ones(len(vals), onp.float32), idx, ptr, shape)
+
+
+__all__ += ["dgl_csr_neighbor_uniform_sample",
+            "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+            "dgl_graph_compact", "dgl_adjacency"]

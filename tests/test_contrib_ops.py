@@ -114,3 +114,107 @@ def test_quantize_op_aliases():
 def test_contrib_aliases_exist():
     assert c.MultiBoxPrior is not None
     assert c.SyncBatchNorm is not None and c.SparseEmbedding is not None
+
+
+def test_hawkesll_against_bruteforce():
+    """Brute-force O(T^2) intensity evaluation vs the scan op
+    (ref hawkes_ll.cc docstring math)."""
+    N, T, K = 2, 4, 3
+    rng = onp.random.RandomState(0)
+    mu = onp.array([[1.5, 2.0, 3.0]] * N, "float32")
+    alpha = onp.array([0.2, 0.3, 0.4], "float32")
+    beta = onp.array([1.0, 2.0, 3.0], "float32")
+    lags = rng.rand(N, T).astype("float32") + 0.1
+    marks = rng.randint(0, K, (N, T)).astype("int32")
+    vl = onp.array([3, 4], "float32")
+    mt = onp.array([10.0, 12.0], "float32")
+
+    out, st = c.hawkesll(nd.array(mu), nd.array(alpha), nd.array(beta),
+                         nd.zeros((N, K)), nd.array(lags),
+                         nd.array(marks.astype("float32")), nd.array(vl),
+                         nd.array(mt))
+
+    for i in range(N):
+        times = onp.cumsum(lags[i])[: int(vl[i])]
+        mks = marks[i][: int(vl[i])]
+        ll = 0.0
+        for j, (t, m) in enumerate(zip(times, mks)):
+            lam = mu[i, m] + alpha[m] * beta[m] * sum(
+                onp.exp(-beta[m] * (t - tp))
+                for tp, mp in zip(times[:j], mks[:j]) if mp == m)
+            ll += onp.log(lam)
+        # integral of intensity over (0, mt]
+        for k in range(K):
+            comp = mu[i, k] * mt[i]
+            for t, m in zip(times, mks):
+                if m == k:
+                    comp += alpha[k] * (1 - onp.exp(-beta[k] * (mt[i] - t)))
+            ll -= comp
+        assert abs(float(out.asnumpy()[i]) - ll) < 1e-3, (i, out.asnumpy()[i], ll)
+    # state is the decayed counter at mt
+    for i in range(N):
+        times = onp.cumsum(lags[i])[: int(vl[i])]
+        mks = marks[i][: int(vl[i])]
+        for k in range(K):
+            want = sum(onp.exp(-beta[k] * (mt[i] - t))
+                       for t, m in zip(times, mks) if m == k)
+            assert abs(float(st.asnumpy()[i, k]) - want) < 1e-4
+
+
+def _demo_graph():
+    from incubator_mxnet_tpu.ndarray import sparse
+    data = onp.arange(1, 21, dtype="float32")
+    indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4, 0, 1, 2, 4,
+                         0, 1, 2, 3], "int64")
+    indptr = onp.array([0, 4, 8, 12, 16, 20], "int64")
+    return sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_dgl_neighbor_sample():
+    """Mirrors the reference docstring example (dgl_graph.cc)."""
+    g = _demo_graph()
+    seed = nd.array(onp.arange(5, dtype="float32"))
+    verts, sub, layer = c.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    v = verts.asnumpy().astype(int)
+    assert v[-1] == 5                      # all 5 vertices sampled
+    assert sorted(v[:5].tolist()) == [0, 1, 2, 3, 4]
+    assert (layer.asnumpy()[:5] == 0).all()  # seeds are layer 0
+    dense = sub.tostype("default").asnumpy()
+    assert dense.shape == (5, 5)
+    # each row sampled at most num_neighbor edges, values from the source
+    orig = g.tostype("default").asnumpy()
+    nz = dense != 0
+    assert (nz.sum(axis=1) <= 2).all()
+    assert (dense[nz] == orig[nz]).all()
+
+
+def test_dgl_non_uniform_sample_respects_zero_prob():
+    g = _demo_graph()
+    prob = nd.array(onp.array([1.0, 1.0, 0.0, 1.0, 1.0], "float32"))
+    seed = nd.array(onp.array([0.0], "float32"))
+    verts, sub, layer = c.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, seed, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    dense = sub.tostype("default").asnumpy()
+    assert dense[:, 2].sum() == 0          # zero-probability vertex never drawn
+
+
+def test_dgl_subgraph_and_adjacency_and_compact():
+    g = _demo_graph()
+    (sub,) = c.dgl_subgraph(g, nd.array(onp.array([0.0, 2.0, 4.0])))
+    d = sub.tostype("default").asnumpy()
+    assert d.shape == (3, 3)
+    orig = g.tostype("default").asnumpy()
+    # relabeled: new index 1 == old 2, new 2 == old 4
+    assert d[0, 1] == orig[0, 2] and d[1, 2] == orig[2, 4]
+    sub2, mapping = c.dgl_subgraph(g, nd.array(onp.array([0.0, 1.0])),
+                                   return_mapping=True)
+    md = mapping.tostype("default").asnumpy()
+    assert md[0, 1] == 0                   # edge (0,1) was nnz position 0
+    adj = c.dgl_adjacency(g)
+    da = adj.tostype("default").asnumpy()
+    assert set(onp.unique(da).tolist()) == {0.0, 1.0}
+    comp = c.dgl_graph_compact(g, graph_sizes=nd.array([3.0]))
+    dc = comp.tostype("default").asnumpy()
+    assert dc.shape == (3, 3)
+    assert (dc == orig[:3, :3]).all()
