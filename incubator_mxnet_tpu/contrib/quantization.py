@@ -34,12 +34,16 @@ def quantize(data, min_range=None, max_range=None, out_type="int8"):
 
 
 def dequantize(data, min_range, max_range, out_type="float32"):
-    """ref quantization/dequantize.cc."""
+    """ref quantization/dequantize.cc. The quantized-range denominator
+    follows the storage dtype: 127 for int8, 2^31-1 for int32 accumulators
+    (kInt8Range/kInt32Range in the reference)."""
     import jax.numpy as jnp
+    import numpy as onp
 
     lo = float(min_range.asnumpy()[0]) if isinstance(min_range, NDArray) else min_range
     hi = float(max_range.asnumpy()[0]) if isinstance(max_range, NDArray) else max_range
-    scale = max(abs(lo), abs(hi)) / 127.0 or 1.0
+    denom = 127.0 if onp.dtype(data.dtype).itemsize == 1 else float(2 ** 31 - 1)
+    scale = max(abs(lo), abs(hi)) / denom or 1.0
     return _apply(lambda x: x.astype(jnp.float32) * scale, data)
 
 

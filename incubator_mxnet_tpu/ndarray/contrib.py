@@ -838,3 +838,139 @@ def dgl_adjacency(graph):
 __all__ += ["dgl_csr_neighbor_uniform_sample",
             "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
             "dgl_graph_compact", "dgl_adjacency"]
+
+
+# ---- quantized int8 op family (ref src/operator/quantization/) -----------
+# Strategy (documented decision): int8 tensors + float ranges in, int8 out
+# with freshly computed ranges — the dequantize→compute→quantize lowering
+# the reference itself uses for kernels without a native int8 impl
+# (quantization/quantize_graph_pass.cc fallback). XLA fuses the scale
+# arithmetic into the surrounding ops; int8 stays the storage/transfer
+# dtype, which is where the reference's bandwidth win comes from.
+def _q_ranges(*pairs):
+    out = []
+    for mn, mx_ in pairs:
+        out.append(float(mn.asnumpy()[0]) if hasattr(mn, "asnumpy") else mn)
+        out.append(float(mx_.asnumpy()[0]) if hasattr(mx_, "asnumpy") else mx_)
+    return out
+
+
+def _requant_out(x_float):
+    from ..contrib import quantization as q
+    return q.quantize(x_float)
+
+
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=None, no_bias=False,
+                              flatten=True):
+    """ref quantization/quantized_fully_connected.cc."""
+    from ..contrib import quantization as q
+    from .ndarray import FullyConnected
+    d = q.dequantize(data, min_data, max_data)
+    w = q.dequantize(weight, min_weight, max_weight)
+    b = None if no_bias or bias is None else q.dequantize(bias, min_bias, max_bias)
+    out = FullyConnected(d, w, b, num_hidden=num_hidden, no_bias=b is None,
+                         flatten=flatten)
+    return _requant_out(out)
+
+
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None, kernel=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=None,
+                   num_group=1, no_bias=False, layout="NCHW"):
+    """ref quantization/quantized_conv.cc."""
+    from ..contrib import quantization as q
+    from .ndarray import Convolution
+    d = q.dequantize(data, min_data, max_data)
+    w = q.dequantize(weight, min_weight, max_weight)
+    b = None if no_bias or bias is None else q.dequantize(bias, min_bias, max_bias)
+    out = Convolution(d, w, b, kernel=kernel, stride=stride, pad=pad,
+                      dilate=dilate, num_filter=num_filter,
+                      num_group=num_group, no_bias=b is None)
+    return _requant_out(out)
+
+
+def quantized_pooling(data, min_data, max_data, kernel=(2, 2), pool_type="max",
+                      stride=None, pad=(0, 0), global_pool=False, **kw):
+    """ref quantized_pooling.cc — pure int8 (max/avg preserve the range)."""
+    from .ndarray import Pooling
+    out = _apply(lambda x: x.astype(jnp.float32), data)
+    out = Pooling(out, kernel=kernel, pool_type=pool_type,
+                  stride=stride or kernel, pad=pad, global_pool=global_pool)
+    q = _apply(lambda x: jnp.round(x).astype(jnp.int8), out)
+    return q, min_data, max_data
+
+
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """ref quantized_act.cc — relu on int8 keeps the calibrated range."""
+    assert act_type == "relu", "int8 activation supports relu"
+    return (_apply(lambda x: jnp.maximum(x, 0), data), min_data, max_data)
+
+
+def quantized_flatten(data, min_data, max_data):
+    """ref quantized_flatten.cc."""
+    return (_apply(lambda x: x.reshape(x.shape[0], -1), data),
+            min_data, max_data)
+
+
+def quantized_concat(*args, dim=1, num_args=None):
+    """ref quantized_concat.cc: inputs rescaled to the widest range then
+    concatenated. args = d0..dn, min0..minn, max0..maxn (reference input
+    order)."""
+    n = num_args or len(args) // 3
+    datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:3 * n]
+    from ..contrib import quantization as q
+    lo = min(float(m.asnumpy()[0]) if hasattr(m, "asnumpy") else m for m in mins)
+    hi = max(float(m.asnumpy()[0]) if hasattr(m, "asnumpy") else m for m in maxs)
+    parts = [q.dequantize(d, mn, mx_)
+             for d, mn, mx_ in zip(datas, mins, maxs)]
+    cat = _apply(lambda *xs: jnp.concatenate(xs, axis=dim), *parts)
+    return q.quantize(cat, lo, hi)
+
+
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """ref quantized_elemwise_add.cc."""
+    from ..contrib import quantization as q
+    a = q.dequantize(lhs, lhs_min, lhs_max)
+    b = q.dequantize(rhs, rhs_min, rhs_max)
+    return _requant_out(a + b)
+
+
+def quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """ref quantized_elemwise_mul.cc."""
+    from ..contrib import quantization as q
+    a = q.dequantize(lhs, lhs_min, lhs_max)
+    b = q.dequantize(rhs, rhs_min, rhs_max)
+    return _requant_out(a * b)
+
+
+def quantized_embedding(data, weight, min_weight, max_weight, input_dim=None,
+                        output_dim=None):
+    """ref quantized_embedding.cc: int8 table lookup, weight range kept."""
+    out = _apply(lambda idx, w: jnp.take(w, idx.astype(jnp.int32), axis=0),
+                 _to_nd(data), weight)
+    return out, min_weight, max_weight
+
+
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3, min_calib_range=None,
+                         max_calib_range=None, **kw):
+    """ref quantized_batch_norm.cc: folded inference BN on the dequantized
+    stream, requantized to the calibrated output range."""
+    from ..contrib import quantization as q
+    d = q.dequantize(data, min_data, max_data)
+
+    def fn(x, g, b, mm, mv):
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        scale = g.reshape(shape) / jnp.sqrt(mv.reshape(shape) + eps)
+        return x * scale + (b.reshape(shape) - mm.reshape(shape) * scale)
+    out = _apply(fn, d, gamma, beta, moving_mean, moving_var)
+    return q.quantize(out, min_calib_range, max_calib_range)
+
+
+__all__ += ["quantized_fully_connected", "quantized_conv",
+            "quantized_pooling", "quantized_act", "quantized_flatten",
+            "quantized_concat", "quantized_elemwise_add",
+            "quantized_elemwise_mul", "quantized_embedding",
+            "quantized_batch_norm"]

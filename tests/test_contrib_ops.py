@@ -218,3 +218,58 @@ def test_dgl_subgraph_and_adjacency_and_compact():
     dc = comp.tostype("default").asnumpy()
     assert dc.shape == (3, 3)
     assert (dc == orig[:3, :3]).all()
+
+
+def test_quantized_op_family():
+    from incubator_mxnet_tpu.contrib import quantization as q
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.randn(2, 8).astype("float32"))
+    w = nd.array(rng.randn(4, 8).astype("float32") * 0.5)
+    xq, xmn, xmx = q.quantize(x)
+    wq, wmn, wmx = q.quantize(w)
+    out, omn, omx = c.quantized_fully_connected(
+        xq, wq, None, xmn, xmx, wmn, wmx, num_hidden=4, no_bias=True)
+    ref = x.asnumpy() @ w.asnumpy().T
+    deq = q.dequantize(out, omn, omx)
+    assert onp.abs(deq.asnumpy() - ref).max() < 0.15  # int8 resolution
+    # conv
+    img = nd.array(rng.rand(1, 2, 6, 6).astype("float32"))
+    k = nd.array(rng.randn(3, 2, 3, 3).astype("float32") * 0.3)
+    iq, imn, imx = q.quantize(img)
+    kq, kmn, kmx = q.quantize(k)
+    co, cmn, cmx = c.quantized_conv(iq, kq, None, imn, imx, kmn, kmx,
+                                    kernel=(3, 3), num_filter=3, no_bias=True)
+    cref = nd.Convolution(img, k, None, kernel=(3, 3), num_filter=3,
+                          no_bias=True).asnumpy()
+    assert onp.abs(q.dequantize(co, cmn, cmx).asnumpy() - cref).max() < 0.2
+    # pooling / act / flatten keep int8 + range
+    po, pmn, pmx = c.quantized_pooling(iq, imn, imx, kernel=(2, 2))
+    assert str(po.dtype) == "int8" and po.shape == (1, 2, 3, 3)
+    ao, _, _ = c.quantized_act(iq, imn, imx)
+    assert (ao.asnumpy() >= 0).all()
+    fo, _, _ = c.quantized_flatten(iq, imn, imx)
+    assert fo.shape == (1, 2 * 6 * 6)
+    # concat rescales to the widest range
+    y = nd.array(rng.randn(2, 8).astype("float32") * 3)
+    yq, ymn, ymx = q.quantize(y)
+    cc, ccmn, ccmx = c.quantized_concat(xq, yq, xmn, ymn, xmx, ymx, dim=1)
+    assert cc.shape == (2, 16)
+    got = q.dequantize(cc, ccmn, ccmx).asnumpy()
+    want = onp.concatenate([x.asnumpy(), y.asnumpy()], axis=1)
+    assert onp.abs(got - want).max() < 0.2
+    # elemwise + embedding + bn
+    eo, emn, emx = c.quantized_elemwise_add(xq, xq, xmn, xmx, xmn, xmx)
+    assert onp.abs(q.dequantize(eo, emn, emx).asnumpy()
+                   - 2 * x.asnumpy()).max() < 0.2
+    tokens = nd.array(onp.array([[0, 2], [1, 3]], "float32"))
+    emb_w = nd.array(rng.randn(5, 4).astype("float32"))
+    ewq, ewmn, ewmx = q.quantize(emb_w)
+    emb, _, _ = c.quantized_embedding(tokens, ewq, ewmn, ewmx)
+    assert emb.shape == (2, 2, 4)
+    gamma = nd.ones((2,)); beta = nd.zeros((2,))
+    mm = nd.zeros((2,)); mv = nd.ones((2,))
+    bo, bmn, bmx = c.quantized_batch_norm(iq, gamma, beta, mm, mv, imn, imx,
+                                          min_calib_range=-2.0,
+                                          max_calib_range=2.0)
+    assert onp.abs(q.dequantize(bo, bmn, bmx).asnumpy()
+                   - img.asnumpy()).max() < 0.1
