@@ -311,3 +311,35 @@ class transforms:
 
 def _to_nd_img(x):
     return x if isinstance(x, NDArray) else nd.array(onp.asarray(x))
+
+
+class ImageFolderDataset(Dataset):
+    """ref gluon/data/vision/datasets.py ImageFolderDataset: root/<class>/
+    <image files>, labels from sorted class-folder names."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith((".jpg", ".jpeg", ".png", ".bmp")):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __getitem__(self, idx):
+        from ... import image as _image
+        img = _image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

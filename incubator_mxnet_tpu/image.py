@@ -371,3 +371,159 @@ class ImageIter:
             labels.append(label)
         return self._DataBatch([nd.array(onp.stack(datas))],
                                [nd.array(onp.asarray(labels, "float32"))])
+
+
+# ---------------------------------------------------------------- detection
+# (ref python/mxnet/image/detection.py — bbox-aware augmenter pipeline)
+class DetAugmenter:
+    """Base detection augmenter: __call__(src, label) -> (src, label) with
+    label (n_obj, 5) = [cls, x1, y1, x2, y2] normalized (ref detection.py
+    DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only Augmenter into the det pipeline
+    (ref detection.py DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image AND x-coordinates with probability p
+    (ref detection.py DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _random.random() < self.p:
+            src = nd.array(src.asnumpy()[:, ::-1])
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping box centers inside; boxes are clipped and
+    re-normalized (compact form of detection.py DetRandomCropAug's
+    constraint sampling)."""
+
+    def __init__(self, min_crop_scale=0.6, max_attempts=10):
+        self.min_scale = min_crop_scale
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            s = self.min_scale + (1 - self.min_scale) * _random.random()
+            cw, ch = int(w * s), int(h * s)
+            x0 = _random.randint(0, w - cw)
+            y0 = _random.randint(0, h - ch)
+            nx0, ny0 = x0 / w, y0 / h
+            nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+            cx = (label[:, 1] + label[:, 3]) / 2
+            cy = (label[:, 2] + label[:, 4]) / 2
+            keep = (cx > nx0) & (cx < nx1) & (cy > ny0) & (cy < ny1)
+            if not keep.any():
+                continue
+            new = label[keep].copy()
+            new[:, (1, 3)] = (new[:, (1, 3)] - nx0) / (nx1 - nx0)
+            new[:, (2, 4)] = (new[:, (2, 4)] - ny0) / (ny1 - ny0)
+            new[:, 1:] = onp.clip(new[:, 1:], 0.0, 1.0)
+            return fixed_crop(src, x0, y0, cw, ch), new
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_mirror=False,
+                       mean=None, std=None, brightness=0, contrast=0,
+                       saturation=0, **kwargs):
+    """ref detection.py CreateDetAugmenter."""
+    augs = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize)))
+    if rand_crop > 0:
+        augs.append(DetRandomCropAug())
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    augs.append(DetBorrowAug(ResizeAug(max(data_shape[1], data_shape[2]))))
+    augs.append(DetBorrowAug(CenterCropAug((data_shape[2], data_shape[1]))))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                saturation)))
+    if mean is not None or std is not None:
+        augs.append(DetBorrowAug(CastAug()))
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter:
+    """Detection iterator over an imglist (ref detection.py ImageDetIter):
+    items are (label (n,5) ndarray, path); batches carry labels padded to
+    (batch, label_pad, 5) with -1 rows."""
+
+    def __init__(self, batch_size, data_shape, imglist=None, path_imgrec=None,
+                 label_pad_width=16, shuffle=False, aug_list=None, **kwargs):
+        from .io import DataBatch, DataDesc, ImageDetRecordIter
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_pad = label_pad_width
+        self._DataBatch = DataBatch
+        self.provide_data = [DataDesc("data", (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc("label",
+                                       (batch_size, label_pad_width, 5))]
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **kwargs)
+        if path_imgrec:
+            self._rec = ImageDetRecordIter(
+                path_imgrec=path_imgrec, batch_size=batch_size,
+                data_shape=data_shape, shuffle=shuffle,
+                label_pad_width=label_pad_width, **kwargs)
+            self._items = None
+        else:
+            self._rec = None
+            self._items = [(onp.asarray(lbl, "float32").reshape(-1, 5), p)
+                           for lbl, p in (imglist or [])]
+        self._cursor = 0
+        self._shuffle = shuffle
+
+    def reset(self):
+        if self._rec is not None:
+            self._rec.reset()
+        self._cursor = 0
+        if self._shuffle and self._items:
+            _random.shuffle(self._items)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._rec is not None:
+            return self._rec.next()
+        if self._cursor >= len(self._items):
+            raise StopIteration
+        datas, labels = [], []
+        for _ in range(self.batch_size):
+            label, path = self._items[self._cursor % len(self._items)]
+            self._cursor += 1
+            img = imread(path)
+            for aug in self.auglist:
+                img, label = aug(img, label)
+            datas.append(img.asnumpy().transpose((2, 0, 1)))
+            pad = onp.full((self.label_pad, 5), -1.0, "float32")
+            pad[: min(len(label), self.label_pad)] = \
+                label[: self.label_pad]
+            labels.append(pad)
+        return self._DataBatch([nd.array(onp.stack(datas))],
+                               [nd.array(onp.stack(labels))])

@@ -42,3 +42,4 @@ from .ndarray import BatchNorm as BatchNorm_v1  # noqa: E402  (v1 ≡ modern her
 from .ndarray import Convolution as Convolution_v1  # noqa: E402
 from .ndarray import Pooling as Pooling_v1  # noqa: E402
 from .rnn_op import RNN, rnn_param_size  # noqa: E402
+CuDNNBatchNorm = BatchNorm_v1  # ref cudnn_batch_norm.cc — backend alias here

@@ -96,3 +96,60 @@ def test_libsvm_index_validation(tmp_path):
     it = mx.io.LibSVMIter(data_libsvm=str(p2), data_shape=(4,), batch_size=1,
                           indexing_mode=1)
     assert_almost_equal(it.next().data[0].asnumpy()[0], [2.0, 0, 0, 1.0])
+
+
+def _write_png(path, arr):
+    from PIL import Image
+    Image.fromarray(arr).save(path)
+
+
+def test_image_det_iter_python(tmp_path):
+    """ref image/detection.py ImageDetIter over an imglist."""
+    import incubator_mxnet_tpu.image as mimg
+    rng = onp.random.RandomState(0)
+    paths = []
+    for i in range(4):
+        p = str(tmp_path / ("im%d.png" % i))
+        _write_png(p, (rng.rand(32, 40, 3) * 255).astype("uint8"))
+        paths.append(p)
+    imglist = [([[i % 3, 0.2, 0.2, 0.6, 0.7]], p) for i, p in enumerate(paths)]
+    it = mimg.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                           imglist=imglist, label_pad_width=4,
+                           rand_mirror=True, rand_crop=0.5)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 24, 24)
+    assert batch.label[0].shape == (2, 4, 5)
+    lab = batch.label[0].asnumpy()
+    # first row is a real box (cls >= 0, coords in [0,1]); pad rows are -1
+    assert (lab[:, 0, 0] >= 0).all()
+    assert (lab[:, 0, 1:] >= 0).all() and (lab[:, 0, 1:] <= 1).all()
+    assert (lab[:, -1] == -1).all()
+    it.reset()
+    n = sum(1 for _ in it)
+    assert n == 2
+
+
+def test_det_horizontal_flip_flips_boxes():
+    import incubator_mxnet_tpu.image as mimg
+    img = nd.array(onp.arange(4 * 6 * 3, dtype="uint8").reshape(4, 6, 3))
+    label = onp.array([[0, 0.1, 0.2, 0.4, 0.8]], "float32")
+    aug = mimg.DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(img, label)
+    onp.testing.assert_allclose(lab[0, 1:], [0.6, 0.2, 0.9, 0.8], rtol=1e-6)
+    onp.testing.assert_array_equal(out.asnumpy(), img.asnumpy()[:, ::-1])
+
+
+def test_image_folder_dataset(tmp_path):
+    from incubator_mxnet_tpu.gluon.data.vision import ImageFolderDataset
+    rng = onp.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        os.makedirs(str(tmp_path / cls))
+        for i in range(2):
+            _write_png(str(tmp_path / cls / ("%d.png" % i)),
+                       (rng.rand(8, 8, 3) * 255).astype("uint8"))
+    ds = ImageFolderDataset(str(tmp_path))
+    assert ds.synsets == ["cat", "dog"]
+    assert len(ds) == 4
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    assert ds[3][1] == 1
