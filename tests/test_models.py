@@ -167,3 +167,18 @@ def test_gpt_flash_matches_dense():
             del os.environ["MXTPU_FLASH_INTERPRET"]
         else:
             os.environ["MXTPU_FLASH_INTERPRET"] = prior
+
+
+@pytest.mark.parametrize("name", sorted(
+    __import__("incubator_mxnet_tpu").gluon.model_zoo.vision._models))
+def test_model_zoo_all_forward(name):
+    """Every registered zoo architecture instantiates and runs forward
+    (ref tests/python/gpu/test_gluon_model_zoo_gpu.py strategy)."""
+    from incubator_mxnet_tpu.gluon import model_zoo
+    # densenet/inception have fixed-size pooling tails (224/299 designs)
+    size = 299 if "inception" in name else (224 if "densenet" in name else 64)
+    net = model_zoo.vision.get_model(name, classes=7)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 3, size, size)))
+    assert out.shape == (1, 7)
+    assert onp.isfinite(out.asnumpy()).all()
