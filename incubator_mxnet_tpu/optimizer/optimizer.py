@@ -579,8 +579,12 @@ class Updater:
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
-        self.states[index] = self.optimizer.update_multi_precision(
-            index, weight, grad, self.states[index]) or self.states[index]
+        new_state = self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index])
+        # explicit None check: `or` would call __bool__ on an NDArray state
+        # (e.g. SGD momentum buffers) and raise on >1 element
+        if new_state is not None:
+            self.states[index] = new_state
 
     def get_states(self, dump_optimizer=False):
         import pickle

@@ -194,3 +194,23 @@ def test_bn_dropout_training_flow():
     o1 = jit.EvalStep(net)(X).asnumpy()
     o2 = jit.EvalStep(net)(X).asnumpy()
     assert_almost_equal(o1, o2)
+
+
+def test_kvstore_updater_with_momentum_state():
+    """Regression: Updater.__call__ used `x or y` on the returned state —
+    NDArray momentum buffers raised on __bool__ (found by the distributed
+    example; ref updater.py semantics)."""
+    import incubator_mxnet_tpu as mx
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    w = nd.ones((4,))
+    kv.init(7, w)
+    g = nd.ones((4,))
+    kv.push(7, g)
+    kv.pull(7, out=w)
+    first = w.asnumpy().copy()
+    kv.push(7, g)      # second step exercises the saved momentum state
+    kv.pull(7, out=w)
+    assert (w.asnumpy() < first).all()
+    # momentum accelerates: second delta larger than the first
+    assert abs((first - w.asnumpy()).mean()) > abs((1.0 - first).mean())
