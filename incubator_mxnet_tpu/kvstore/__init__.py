@@ -7,3 +7,5 @@ facade. ``local``/``device`` hold one logical copy (SPMD replication is a
 sharding decision); ``dist_*`` map onto jax.distributed multi-host psum.
 """
 from .kvstore import KVStore, KVStoreBase, create, LocalKVStore, DistKVStore  # noqa
+from . import kvstore_server  # noqa  (server-role API compat)
+from .kvstore_server import KVStoreServer  # noqa

@@ -1,0 +1,68 @@
+"""KVStore server-role entry (ref python/mxnet/kvstore_server.py).
+
+The reference launches dedicated server processes (DMLC_ROLE=server) that
+sit in a loop applying optimizer updates pushed by workers. The TPU-native
+dist design is SYMMETRIC SPMD (see DistKVStore): every worker applies the
+identical update to the identically-aggregated gradient, so there is no
+separate server role to run. This module keeps the reference's API shape
+so launch scripts that branch on the role keep working:
+
+- ``KVStoreServer(kv).run()`` — registers the optimizer controller and
+  returns immediately (there is nothing to serve);
+- ``_init_kvstore_server_module()`` — the reference's process entry; here
+  it logs the design note and returns.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer(object):
+    """ref kvstore_server.py KVStoreServer."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging()
+
+    def init_logging(self):
+        self._verbose = int(os.environ.get("MXTPU_KVSTORE_DEBUG", "0"))
+
+    def _controller(self):
+        """ref server_controller: head-0 commands (optimizer blob, sync
+        mode). Commands apply to THIS process's store — the former server
+        work (aggregate + update) runs here."""
+        def server_controller(cmd_id, cmd_body):
+            if cmd_id == 0:          # kController: optimizer payload
+                optimizer = pickle.loads(cmd_body)
+                self.kvstore.set_optimizer(optimizer)
+            elif cmd_id == 1:        # kSetMultiPrecision
+                pass                 # fused step handles master weights
+            elif cmd_id == 2:        # kStopServer
+                pass
+            elif cmd_id == 3:        # kSyncMode
+                pass                 # always sync (DistKVStore docstring)
+            else:
+                logging.warning("server got unknown command %s", cmd_id)
+        return server_controller
+
+    def run(self):
+        """ref KVStoreServer.run — blocks in the reference; symmetric SPMD
+        has no server loop, so this registers the controller and returns."""
+        _ = self._controller()
+        logging.info(
+            "kvstore server role is a no-op in the symmetric SPMD design: "
+            "updates run on every worker against the collectively-reduced "
+            "gradient (see kvstore/kvstore.py DistKVStore)")
+
+
+def _init_kvstore_server_module():
+    """ref kvstore_server.py module entry (invoked when DMLC_ROLE=server)."""
+    role = os.environ.get("DMLC_ROLE", os.environ.get("MXTPU_ROLE", "worker"))
+    if role == "server":
+        from . import kvstore as _kv
+        server = KVStoreServer(_kv.KVStore("local"))
+        server.run()
