@@ -1159,3 +1159,63 @@ __all__ += [
     "conj", "positive", "float_power", "fmod", "divmod", "gcd", "lcm",
     "rollaxis", "sinc", "copysign", "rint",
 ]
+
+
+# ------------------------------------------------------------ batch 4:
+# NumPy dispatch protocol (ref numpy_dispatch_protocol.py +
+# numpy_op_fallback.py): official-numpy functions and ufuncs called ON
+# mx.np arrays dispatch back into this namespace, falling back to host
+# numpy (eager) for anything unimplemented — so onp.mean(a), onp.sin(a),
+# onp.concatenate([a, b]) all work on mx.np.ndarray.
+def _np_dispatch_lookup(name):
+    fn = globals().get(name)
+    if callable(fn):
+        return fn
+    return None
+
+
+def _to_host(v):
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    if isinstance(v, (list, tuple)):
+        return type(v)(_to_host(x) for x in v)
+    return v
+
+
+def _ndarray_array_function(self, func, types, args, kwargs):
+    ours = _np_dispatch_lookup(func.__name__)
+    if ours is not None:
+        try:
+            return ours(*args, **kwargs)
+        except TypeError:
+            pass  # signature mismatch → host fallback below
+    # numpy_op_fallback.py idiom: run official numpy on host copies
+    res = func(*_to_host(args), **{k: _to_host(v) for k, v in kwargs.items()})
+    if isinstance(res, onp.ndarray):
+        return ndarray(jnp.asarray(res))
+    return res
+
+
+def _ndarray_array_ufunc(self, ufunc, method, *inputs, **kwargs):
+    if method != "__call__":
+        return NotImplemented
+    ours = _np_dispatch_lookup(ufunc.__name__)
+    if ours is not None:
+        try:
+            return ours(*inputs)
+        except TypeError:
+            pass
+    res = getattr(onp, ufunc.__name__)(*_to_host(inputs))
+    if isinstance(res, onp.ndarray):
+        return ndarray(jnp.asarray(res))
+    return res
+
+
+def _ndarray_array(self, dtype=None, copy=None):
+    a = self.asnumpy()
+    return a.astype(dtype) if dtype is not None else a
+
+
+ndarray.__array_function__ = _ndarray_array_function
+ndarray.__array_ufunc__ = _ndarray_array_ufunc
+ndarray.__array__ = _ndarray_array

@@ -324,3 +324,25 @@ def test_np_batch3_linalg_completion():
     bvec = onp.random.rand(2, 2).astype("float32")
     sol = np.linalg.tensorsolve(np.array(a4), np.array(bvec))
     assert_almost_equal(sol, onp.linalg.tensorsolve(a4, bvec), rtol=1e-3, atol=1e-4)
+
+
+def test_numpy_dispatch_protocol():
+    """ref numpy_dispatch_protocol.py / numpy_op_fallback.py: official
+    numpy functions dispatch on mx.np arrays."""
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    # function protocol → our impl
+    m = onp.mean(a)
+    assert float(onp.asarray(m)) == 2.5
+    cat = onp.concatenate([a, a])
+    assert cat.shape == (4, 2)
+    assert isinstance(cat, np.ndarray) or isinstance(cat, onp.ndarray)
+    # ufunc protocol
+    s = onp.sin(a)
+    assert_almost_equal(onp.asarray(s), onp.sin(a.asnumpy()), rtol=1e-6)
+    # host fallback for something we don't implement (dispatched but absent
+    # from mx.np: unwrap)
+    r = onp.unwrap(np.array([0.0, 3.0, 6.0, 9.0]))
+    assert_almost_equal(onp.asarray(r),
+                        onp.unwrap(onp.array([0.0, 3.0, 6.0, 9.0])), rtol=1e-6)
+    # __array__ conversion
+    assert onp.asarray(a).shape == (2, 2)
