@@ -33,6 +33,26 @@ class Module(BaseModule):
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
         self._context = context if context is not None else current_context()
+        # Multi-context DP (ref module/executor_group.py
+        # DataParallelExecutorGroup): instead of slicing the batch into
+        # per-context executors, ONE executor runs with the batch sharded
+        # over a dp mesh built from the context list and params replicated —
+        # per-op SPMD inserts the gradient all-reduce (sharding propagation),
+        # which is the TPU-native form of the group's grad aggregation.
+        self._dp_data_sharding = None
+        self._dp_rep_sharding = None
+        if isinstance(self._context, (list, tuple)):
+            ctxs = list(self._context)
+            if len(ctxs) > 1:
+                import numpy as _onp
+                import jax as _jax
+                from jax.sharding import (Mesh as _Mesh,
+                                          NamedSharding as _NS,
+                                          PartitionSpec as _P)
+                mesh = _Mesh(_onp.array([c.jax_device for c in ctxs]), ("dp",))
+                self._dp_data_sharding = _NS(mesh, _P("dp"))
+                self._dp_rep_sharding = _NS(mesh, _P())
+            self._context = ctxs[0]
         self._fixed_param_names = set(fixed_param_names or [])
         self._exec = None
         self._optimizer = None
@@ -181,7 +201,28 @@ class Module(BaseModule):
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
+        if self._dp_data_sharding is not None:
+            self._place_dp(feed)
         self._exec.forward(is_train=is_train, **feed)
+
+    def _place_dp(self, feed):
+        """Shard the feed over dp, keep params/grads replicated (cheap no-op
+        once placed)."""
+        import jax as _jax
+        from .. import ndarray as _nd
+        for name, arr in list(feed.items()):
+            if not isinstance(arr, _nd.NDArray):
+                arr = _nd.array(arr)
+            feed[name] = _nd.NDArray(
+                _jax.device_put(arr._data, self._dp_data_sharding))
+        for d in (self._exec.arg_dict, self._exec.grad_dict):
+            for name, arr in d.items():
+                if name in feed:
+                    continue
+                sh = getattr(arr._data, "sharding", None)
+                if sh != self._dp_rep_sharding:
+                    arr._data = _jax.device_put(arr._data,
+                                                self._dp_rep_sharding)
 
     def backward(self, out_grads=None):
         """ref module.py:629."""

@@ -225,3 +225,44 @@ def test_image_record_pipeline(tmp_path):
                                 num_parts=2, part_index=1)
     b2 = it2.next()
     assert b2.data[0].shape == (2, 3, 12, 12)
+
+
+def test_module_multi_context_data_parallel():
+    """context=[cpu(0)..cpu(3)] trains ONE sharded executor (the
+    DataParallelExecutorGroup analog, module/executor_group.py:144) and
+    matches the single-context loss trajectory."""
+    import jax
+
+    def lenet_sym():
+        data = mx.sym.var("data")
+        fc1 = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=32),
+                                act_type="tanh")
+        fc2 = mx.sym.FullyConnected(fc1, num_hidden=4, flatten=False)
+        return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(16, 10).astype("float32")
+    y = rng.randint(0, 4, 16).astype("float32")
+    batch = mx.io.DataBatch([nd.array(x)], [nd.array(y)])
+
+    def run(ctxs):
+        mx.random.seed(0)
+        mod = mx.module.Module(lenet_sym(), context=ctxs)
+        mod.bind([("data", (16, 10))], [("softmax_label", (16,))])
+        mod.init_params(mx.init.Xavier(rnd_type="uniform", magnitude=1.0))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        losses = []
+        for _ in range(3):
+            mod.forward(batch)
+            out = mod.get_outputs()[0].asnumpy()
+            mod.backward()
+            mod.update()
+            pred = out[onp.arange(16), y.astype(int)]
+            losses.append(-onp.log(onp.maximum(pred, 1e-8)).mean())
+        return losses
+
+    single = run(mx.cpu(0))
+    multi = run([mx.cpu(i) for i in range(4)])
+    onp.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+    assert multi[-1] < multi[0]
