@@ -59,6 +59,13 @@ class MultiHeadAttention(HybridBlock):
             out = _apply(lambda qd, kd, vd: ring_attention(
                 qd, kd, vd, mesh=mesh, axis=self._sp_axis, causal=causal),
                 q, k, v)
+        elif self._attention == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+            from ..parallel.mesh import current_mesh
+            mesh = current_mesh()
+            out = _apply(lambda qd, kd, vd: ulysses_attention(
+                qd, kd, vd, mesh=mesh, axis=self._sp_axis, causal=causal),
+                q, k, v)
         elif self._attention == "flash":
             from ..ops.attention import flash_attention
             out = _apply(lambda qd, kd, vd: flash_attention(qd, kd, vd, causal),

@@ -13,6 +13,7 @@ from .mesh import make_mesh, current_mesh, set_current_mesh, replicated, shard_s
 from .data_parallel import DataParallelTrainStep  # noqa
 from .tensor_parallel import ColParallelDense, RowParallelDense, shard_params  # noqa
 from .ring_attention import ring_attention, local_attention  # noqa
+from .ulysses import ulysses_attention  # noqa
 from .pipeline import PipelineParallel, pipeline_spmd  # noqa
 from .gluon_pipeline import PipelineStack  # noqa
 from .moe import MoELayer, load_balancing_loss  # noqa

@@ -328,3 +328,64 @@ def test_ring_attention_gradients():
     gr = jax.grad(ref, (0, 1, 2))(q, k, v)
     for a, b in zip(g, gr):
         assert float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b))) < 1e-4
+
+
+def test_ulysses_attention_matches_reference():
+    """Head-scatter all-to-all SP (parallel/ulysses.py) == dense attention;
+    heads divisible by the sp axis."""
+    _need_devices(8)
+    import jax.numpy as jnp
+    mesh = parallel.make_mesh({"sp": 8})
+    B, H, S, D = 2, 8, 64, 16
+    rng = onp.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+
+    def ref_attn(q, k, v, causal):
+        s = onp.einsum("bhqd,bhkd->bhqk", q, k) / onp.sqrt(D)
+        if causal:
+            mask = onp.tril(onp.ones((S, S), bool))
+            s = onp.where(mask, s, -onp.inf)
+        p = onp.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return onp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    for causal in (False, True):
+        out = parallel.ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+        ref = ref_attn(onp.asarray(q), onp.asarray(k), onp.asarray(v), causal)
+        assert_almost_equal(onp.asarray(out), ref, rtol=1e-3, atol=1e-4)
+    # ulysses and ring agree with each other too
+    ring = parallel.ring_attention(q, k, v, mesh=mesh, causal=True)
+    uly = parallel.ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    assert_almost_equal(onp.asarray(uly), onp.asarray(ring), rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_ulysses_attention_gradients():
+    _need_devices(8)
+    import jax
+    import jax.numpy as jnp
+    mesh = parallel.make_mesh({"sp": 8})
+    B, H, S, D = 1, 8, 32, 8
+    rng = onp.random.RandomState(4)
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+
+    def loss_u(q, k, v):
+        return jnp.sum(jnp.sin(parallel.ulysses_attention(
+            q, k, v, mesh=mesh, causal=True)))
+
+    def loss_d(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(D)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.sin(jnp.einsum("bhqk,bhkd->bhqd", p, v)))
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        assert_almost_equal(onp.asarray(a), onp.asarray(b), rtol=2e-3,
+                            atol=2e-4)
