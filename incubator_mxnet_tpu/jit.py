@@ -51,7 +51,7 @@ class TrainStep:
     """Compile net forward + loss + backward + optimizer update into one program."""
 
     def __init__(self, net, loss_fn, trainer, batch_axis=0, grad_postprocess=None,
-                 mesh=None, data_axis="dp"):
+                 mesh=None, data_axis="dp", remat=False):
         self.net = net
         self.loss_fn = loss_fn
         self.trainer = trainer
@@ -61,6 +61,10 @@ class TrainStep:
         self.mesh = mesh
         self.data_axis = data_axis
         self.batch_axis = batch_axis
+        # remat: rematerialize the forward during backward (jax.checkpoint)
+        # — trades ~1 extra forward of FLOPs for O(layer) activation memory,
+        # the long-sequence HBM lever (SURVEY §7 guidance)
+        self.remat = remat
 
     # ------------------------------------------------------------------
     def _split_params(self):
@@ -105,10 +109,12 @@ class TrainStep:
             aux_box[:] = [a for a, _ in aux_pairs]
             return loss_scalar, (loss._data, [v for _, v in aux_pairs])
 
+        fwd = jax.checkpoint(inner) if self.remat else inner
+
         def step_fn(t_datas, f_datas, opt_states, input_datas, key, lrs, wds, t,
                     rescale):
             (loss_scalar, (loss_full, aux_vals)), grads = jax.value_and_grad(
-                inner, argnums=0, has_aux=True)(t_datas, f_datas, input_datas, key)
+                fwd, argnums=0, has_aux=True)(t_datas, f_datas, input_datas, key)
             if self._grad_postprocess is not None:
                 grads = self._grad_postprocess(grads)
             new_t, new_opt = [], []

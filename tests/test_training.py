@@ -214,3 +214,37 @@ def test_kvstore_updater_with_momentum_state():
     assert (w.asnumpy() < first).all()
     # momentum accelerates: second delta larger than the first
     assert abs((first - w.asnumpy()).mean()) > abs((1.0 - first).mean())
+
+
+def test_trainstep_remat_matches_plain():
+    """remat=True (jax.checkpoint over the forward) is numerically the
+    same training step — only the memory/FLOPs schedule changes."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, jit
+
+    def build():
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+                gluon.nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-2})
+        return net, tr
+
+    x = nd.array(onp.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = nd.array(onp.random.RandomState(1).randint(0, 4, 4).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    outs = []
+    for remat in (False, True):
+        net, tr = build()
+        step = jit.TrainStep(net, loss_fn, tr, remat=remat)
+        for _ in range(3):
+            loss = step(x, y)
+        outs.append((loss.asnumpy().copy(),
+                     [v.data().asnumpy().copy()
+                      for v in net.collect_params().values()]))
+    onp.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
+    for a, b in zip(outs[0][1], outs[1][1]):
+        onp.testing.assert_allclose(a, b, rtol=1e-6)
