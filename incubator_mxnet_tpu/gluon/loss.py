@@ -262,3 +262,30 @@ class CosineEmbeddingLoss(Loss):
         loss = nd.where(label == 1, 1.0 - cos_sim,
                         nd.relu(cos_sim - self._margin))
         return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Batchwise Smoothed Deep Metric Learning loss (ref loss.py SDMLLoss,
+    Bonadiman et al. 2019): aligned minibatches x1/x2, other rows act as
+    in-batch negatives; KL between softmax(-pairwise_dist) and the
+    smoothed identity."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def forward(self, x1, x2):
+        n = x1.shape[0]
+        diffs = x1.expand_dims(1) - x2.expand_dims(0)       # (N, N, D)
+        distances = (diffs ** 2).sum(axis=2)                # (N, N)
+        gold = nd.one_hot(nd.arange(n), n)
+        labels = gold * (1 - self.smoothing_parameter) \
+            + (1.0 - gold) * (self.smoothing_parameter / (n - 1))
+        log_probabilities = nd.log_softmax(-distances, axis=1)
+        # scale by N like the reference (KLDivLoss averages over the axis)
+        return self.kl_loss(log_probabilities, labels) * n
+
+
+__all__ += ["SDMLLoss"]

@@ -4,7 +4,8 @@ from __future__ import annotations
 from ... import ndarray as nd
 from ..block import HybridBlock
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell", "ModifierCell",
            "DropoutCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
 
 
@@ -352,3 +353,10 @@ class BidirectionalCell(RecurrentCell):
             r_out_rev = r_out_rev.swapaxes(0, axis)
         outputs = nd.concat(l_out, r_out_rev, dim=2)
         return outputs, l_states + r_states
+
+
+# Hybrid aliases (ref rnn_cell.py HybridRecurrentCell/HybridSequentialRNNCell):
+# every cell here is hybridizable — eager and traced paths share one forward —
+# so the Hybrid names are the same classes.
+HybridRecurrentCell = RecurrentCell
+HybridSequentialRNNCell = SequentialRNNCell

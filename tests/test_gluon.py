@@ -319,3 +319,25 @@ def test_image_jitter_augmenters():
     # gray aug with p=1 collapses channels
     g = image.RandomGrayAug(1.0)(img).asnumpy()
     assert onp.allclose(g[..., 0], g[..., 1], atol=1e-4)
+
+
+def test_sdml_loss_learns_alignment():
+    """SDML pulls aligned pairs together (ref loss.py SDMLLoss)."""
+    mx.random.seed(0)
+    emb = gluon.nn.Dense(8, in_units=8, use_bias=False)
+    emb.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SDMLLoss(smoothing_parameter=0.1)
+    rng = onp.random.RandomState(0)
+    base = rng.randn(6, 8).astype("float32")
+    x1 = nd.array(base)
+    x2 = nd.array(base + 0.05 * rng.randn(6, 8).astype("float32"))
+    trainer = gluon.Trainer(emb.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            loss = loss_fn(emb(x1), emb(x2)).sum()
+        loss.backward()
+        trainer.step(6)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0]
