@@ -43,3 +43,34 @@ from .ndarray import Convolution as Convolution_v1  # noqa: E402
 from .ndarray import Pooling as Pooling_v1  # noqa: E402
 from .rnn_op import RNN, rnn_param_size  # noqa: E402
 CuDNNBatchNorm = BatchNorm_v1  # ref cudnn_batch_norm.cc — backend alias here
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
+             mean=None, **kw):
+    """ref ndarray.py imdecode (legacy C-API image decode) — delegates to
+    the image module's decoder."""
+    from ..image import imdecode as _imd
+    return _imd(str_img, flag=1 if channels == 3 else 0)
+
+
+def load_frombuffer(buf):
+    """ref ndarray/utils.py load_frombuffer: deserialize from bytes."""
+    import io as _io
+    from . import serialization as _ser
+    return _ser.load_buffer(buf) if hasattr(_ser, "load_buffer") else \
+        _load_from_bytes(buf)
+
+
+def _load_from_bytes(buf):
+    import io as _io
+    import numpy as _onp
+    import zipfile
+    bio = _io.BytesIO(buf)
+    with _onp.load(bio, allow_pickle=False) as z:
+        data = {k: z[k] for k in z.files}
+    from .ndarray import NDArray
+    import jax.numpy as _jnp
+    out = {k: NDArray(_jnp.asarray(v)) for k, v in data.items()}
+    if set(out) == {"__list_%d" % i for i in range(len(out))}:
+        return [out["__list_%d" % i] for i in range(len(out))]
+    return out

@@ -1859,3 +1859,76 @@ def Crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=None,
 
 
 __all__ += ["Correlation", "Crop"]
+
+
+def moveaxis(data, source, destination):
+    """ref ndarray.py moveaxis."""
+    return _apply(lambda x: jnp.moveaxis(x, source, destination), data)
+
+
+def onehot_encode(indices, out):
+    """ref ndarray.py onehot_encode (legacy): writes one-hot rows into out."""
+    depth = out.shape[1]
+    res = _apply(lambda i: jax.nn.one_hot(i.astype(jnp.int32), depth,
+                                          dtype=out.dtype), indices)
+    out._data = res._data
+    return out
+
+
+def true_divide(lhs, rhs):
+    return divide(lhs, rhs)
+
+
+def histogram(a, bins=10, range=None):
+    """ref tensor/histogram.cc: returns (counts, bin_edges)."""
+    import builtins
+    rng = range if range is not None else (
+        float(a.min().asscalar()), float(builtins.max(
+            float(a.max().asscalar()),
+            float(a.min().asscalar()) + 1e-6)))
+    if isinstance(bins, NDArray):
+        cnt, edges = jnp.histogram(a._data, bins=bins._data)
+    else:
+        cnt, edges = jnp.histogram(a._data, bins=bins, range=rng)
+    return NDArray(cnt), NDArray(edges)
+
+
+def split_v2(ary, indices_or_sections=1, axis=0, squeeze_axis=False):
+    """ref matrix_op.cc split_v2: numpy-style sections OR index points."""
+    sections = tuple(indices_or_sections) \
+        if isinstance(indices_or_sections, (list, tuple)) \
+        else indices_or_sections
+
+    def go(x):
+        parts = jnp.split(x, sections, axis=axis)
+        if squeeze_axis:
+            parts = [p.squeeze(axis) for p in parts]
+        return parts
+    return _apply(go, ary)
+
+
+def from_numpy(ndarray_np, zero_copy=True):
+    """ref ndarray.py from_numpy (dlpack family) — device_put is the copy."""
+    return NDArray(jnp.asarray(ndarray_np))
+
+
+def to_dlpack_for_read(data):
+    """ref to_dlpack_for_read: export via the dlpack protocol. Returns the
+    protocol-bearing object (modern consumers call __dlpack__ themselves —
+    torch.from_dlpack / np.from_dlpack accept it directly)."""
+    return data._data
+
+
+def to_dlpack_for_write(data):
+    """jax buffers are immutable; writable export is a host-copy contract."""
+    return data._data
+
+
+def from_dlpack(dlpack):
+    import jax.dlpack as jdl
+    return NDArray(jdl.from_dlpack(dlpack))
+
+
+__all__ += ["moveaxis", "onehot_encode", "true_divide", "histogram",
+            "split_v2", "from_numpy", "to_dlpack_for_read",
+            "to_dlpack_for_write", "from_dlpack"]

@@ -259,3 +259,23 @@ def embedding_backward(tokens, out_grad, vocab_size):
     onp.add.at(vals, inv, og)
     return RowSparseNDArray(vals, uniq.astype("int32"),
                             (vocab_size, og.shape[-1]))
+
+
+def _sparse_elemwise(fn_name):
+    def op(lhs, rhs):
+        """Module-level elemwise op on sparse/dense operands (ref
+        sparse.py add/subtract/multiply/divide): computes on dense values,
+        returns sparse when sparsity is preserved (add/sub of same-pattern
+        row_sparse), else dense."""
+        from . import ndarray as _nd_mod
+        l = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+        r = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+        return getattr(_nd_mod, fn_name)(l, r)
+    op.__name__ = fn_name
+    return op
+
+
+add = _sparse_elemwise("add")
+subtract = _sparse_elemwise("subtract")
+multiply = _sparse_elemwise("multiply")
+divide = _sparse_elemwise("divide")

@@ -266,3 +266,48 @@ for _n in ["FullyConnected", "Convolution", "BatchNorm", "Activation", "LeakyReL
     _OP_TABLE[_n] = getattr(nd, _n, None)
 
 from . import contrib  # noqa  (symbolic control flow)
+
+
+# creation/scalar symbol ops the reference exposes at module level
+pow = _g["power"]  # noqa: A001  (ref symbol.py pow)
+hypot = _symbolize(nd.hypot, "hypot") if hasattr(nd, "hypot") else None
+histogram = _symbolize(nd.histogram, "histogram")
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False, name=None):
+    """ref symbol.py split_v2 (sections/indices are static attrs)."""
+    return Symbol(op=nd.split_v2, op_name="split_v2", inputs=[data],
+                  kwargs=dict(indices_or_sections=indices_or_sections,
+                              axis=axis, squeeze_axis=squeeze_axis),
+                  name=name)
+
+
+def eye(N, M=None, k=0, dtype="float32", **kw):
+    from .symbol import Symbol
+    return Symbol(op=lambda: nd.eye(N, M, k, dtype=dtype), op_name="eye",
+                  inputs=[])
+
+
+def full(shape, val, dtype="float32", **kw):
+    from .symbol import Symbol
+    return Symbol(op=lambda: nd.full(shape, val, dtype=dtype), op_name="full",
+                  inputs=[])
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", name=None, **kw):
+    from .symbol import Symbol
+    return Symbol(op=lambda: nd.arange(start, stop, step, repeat=repeat,
+                                       dtype=dtype),
+                  op_name="arange", inputs=[], name=name)
+
+
+def linspace(start, stop, num, endpoint=True, dtype="float32", **kw):
+    from .symbol import Symbol
+    import numpy as _onp
+    return Symbol(op=lambda: nd.array(_onp.linspace(
+        start, stop, num, endpoint=endpoint).astype(dtype)),
+        op_name="linspace", inputs=[])
+
+
+__all__ += ["pow", "hypot", "split_v2", "histogram", "eye", "full", "arange",
+            "linspace"]
