@@ -1,0 +1,149 @@
+/* XS glue for the C predict ABI (ref perl-package/AI-MXNetCapi — SWIG in
+ * the reference; plain XS here). Resolves libmxtpu_predict.so at boot via
+ * dlopen (path from MXTPU_PREDICT_LIB or the loader path). */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <dlfcn.h>
+#include <stdint.h>
+
+typedef const char* (*fn_err_t)(void);
+typedef int (*fn_create_t)(const char*, void**);
+typedef int (*fn_int_t)(void*, int*);
+typedef int (*fn_shape_t)(void*, int, int64_t*, int, int*);
+typedef int (*fn_dtype_t)(void*, int, char*, int);
+typedef int (*fn_setin_t)(void*, int, const void*, int64_t);
+typedef int (*fn_fwd_t)(void*);
+typedef int (*fn_getout_t)(void*, int, void*, int64_t);
+typedef int (*fn_free_t)(void*);
+
+static fn_err_t    p_err;
+static fn_create_t p_create;
+static fn_int_t    p_nin, p_nout;
+static fn_shape_t  p_inshape, p_outshape;
+static fn_dtype_t  p_indtype, p_outdtype;
+static fn_setin_t  p_setin;
+static fn_fwd_t    p_fwd;
+static fn_getout_t p_getout;
+static fn_free_t   p_free;
+
+static void ensure_lib(pTHX) {
+    static void* so = NULL;
+    if (so) return;
+    const char* path = getenv("MXTPU_PREDICT_LIB");
+    if (!path || !*path) path = "libmxtpu_predict.so";
+    so = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+    if (!so) croak("cannot dlopen %s: %s", path, dlerror());
+    p_err      = (fn_err_t)   dlsym(so, "MXTPUPredGetLastError");
+    p_create   = (fn_create_t)dlsym(so, "MXTPUPredCreate");
+    p_nin      = (fn_int_t)   dlsym(so, "MXTPUPredNumInputs");
+    p_nout     = (fn_int_t)   dlsym(so, "MXTPUPredNumOutputs");
+    p_inshape  = (fn_shape_t) dlsym(so, "MXTPUPredGetInputShape");
+    p_outshape = (fn_shape_t) dlsym(so, "MXTPUPredGetOutputShape");
+    p_indtype  = (fn_dtype_t) dlsym(so, "MXTPUPredGetInputDType");
+    p_outdtype = (fn_dtype_t) dlsym(so, "MXTPUPredGetOutputDType");
+    p_setin    = (fn_setin_t) dlsym(so, "MXTPUPredSetInput");
+    p_fwd      = (fn_fwd_t)   dlsym(so, "MXTPUPredForward");
+    p_getout   = (fn_getout_t)dlsym(so, "MXTPUPredGetOutput");
+    p_free     = (fn_free_t)  dlsym(so, "MXTPUPredFree");
+    if (!p_create || !p_fwd) croak("libmxtpu_predict.so: missing symbols");
+}
+
+static void check(pTHX_ int rc) {
+    if (rc != 0) croak("%s", p_err ? p_err() : "mxtpu predict error");
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+IV
+_create(path)
+    const char* path
+  CODE:
+    ensure_lib(aTHX);
+    void* h = NULL;
+    check(aTHX_ p_create(path, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+int
+_num_inputs(h)
+    IV h
+  CODE:
+    int n = 0;
+    check(aTHX_ p_nin(INT2PTR(void*, h), &n));
+    RETVAL = n;
+  OUTPUT:
+    RETVAL
+
+int
+_num_outputs(h)
+    IV h
+  CODE:
+    int n = 0;
+    check(aTHX_ p_nout(INT2PTR(void*, h), &n));
+    RETVAL = n;
+  OUTPUT:
+    RETVAL
+
+void
+_output_shape(h, idx)
+    IV h
+    int idx
+  PPCODE:
+    int64_t shp[16];
+    int nd = 0;
+    check(aTHX_ p_outshape(INT2PTR(void*, h), idx, shp, 16, &nd));
+    for (int i = 0; i < nd; ++i)
+        XPUSHs(sv_2mortal(newSViv((IV)shp[i])));
+
+void
+_input_shape(h, idx)
+    IV h
+    int idx
+  PPCODE:
+    int64_t shp[16];
+    int nd = 0;
+    check(aTHX_ p_inshape(INT2PTR(void*, h), idx, shp, 16, &nd));
+    for (int i = 0; i < nd; ++i)
+        XPUSHs(sv_2mortal(newSViv((IV)shp[i])));
+
+void
+_set_input(h, idx, bytes)
+    IV h
+    int idx
+    SV* bytes
+  CODE:
+    STRLEN len;
+    const char* buf = SvPVbyte(bytes, len);
+    check(aTHX_ p_setin(INT2PTR(void*, h), idx, buf, (int64_t)len));
+
+void
+_forward(h)
+    IV h
+  CODE:
+    check(aTHX_ p_fwd(INT2PTR(void*, h)));
+
+SV*
+_get_output(h, idx, nbytes)
+    IV h
+    int idx
+    IV nbytes
+  CODE:
+    SV* out = newSV((STRLEN)nbytes);
+    SvPOK_on(out);
+    check(aTHX_ p_getout(INT2PTR(void*, h), idx, SvPVX(out), (int64_t)nbytes));
+    SvCUR_set(out, (STRLEN)nbytes);
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+_free(h)
+    IV h
+  CODE:
+    p_free(INT2PTR(void*, h));

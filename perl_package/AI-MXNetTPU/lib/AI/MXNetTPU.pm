@@ -1,0 +1,45 @@
+package AI::MXNetTPU;
+# Perl inference binding (ref perl-package/AI-MXNet — full framework there;
+# here the deployment surface: run .mxtpu serving artifacts through the
+# flat C predict ABI, the same contract cpp_package uses).
+use strict;
+use warnings;
+require DynaLoader;
+our @ISA = ('DynaLoader');
+our $VERSION = '0.01';
+bootstrap AI::MXNetTPU $VERSION;
+
+package AI::MXNetTPU::Predictor;
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $path) = @_;
+    my $h = AI::MXNetTPU::_create($path);
+    return bless { handle => $h }, $class;
+}
+
+sub num_inputs  { AI::MXNetTPU::_num_inputs($_[0]{handle}) }
+sub num_outputs { AI::MXNetTPU::_num_outputs($_[0]{handle}) }
+sub input_shape  { my @s = AI::MXNetTPU::_input_shape($_[0]{handle}, $_[1] // 0); \@s }
+sub output_shape { my @s = AI::MXNetTPU::_output_shape($_[0]{handle}, $_[1] // 0); \@s }
+
+# floats in/out as perl lists (pack f* — float32 row-major)
+sub set_input {
+    my ($self, $idx, @vals) = @_;
+    AI::MXNetTPU::_set_input($self->{handle}, $idx, pack('f*', @vals));
+}
+
+sub forward { AI::MXNetTPU::_forward($_[0]{handle}) }
+
+sub get_output {
+    my ($self, $idx) = @_;
+    my $shape = $self->output_shape($idx);
+    my $n = 1; $n *= $_ for @$shape;
+    my $bytes = AI::MXNetTPU::_get_output($self->{handle}, $idx, $n * 4);
+    return [unpack('f*', $bytes)];
+}
+
+sub DESTROY { AI::MXNetTPU::_free($_[0]{handle}) if $_[0]{handle} }
+
+1;

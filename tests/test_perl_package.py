@@ -1,0 +1,89 @@
+"""Perl binding end-to-end (ref perl-package/AI-MXNet; here the predict
+surface over the C ABI): build the XS module with MakeMaker, run a Perl
+client, compare output floats to Python inference bitwise."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.contrib import serving
+from incubator_mxnet_tpu.native import lib as native_lib
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _perl_buildable():
+    if shutil.which("perl") is None:
+        return False
+    r = subprocess.run(
+        ["perl", "-MExtUtils::MakeMaker", "-MConfig",
+         "-e", "print -e qq($Config{archlibexp}/CORE/perl.h) ? 'ok' : 'no'"],
+        capture_output=True, text=True)
+    return r.returncode == 0 and r.stdout.strip() == "ok"
+
+
+def test_perl_binding_end_to_end(tmp_path):
+    if not _perl_buildable():
+        pytest.skip("no perl dev environment")
+    try:
+        so_path = native_lib.build_predict()
+    except Exception as e:
+        pytest.skip("cannot build libmxtpu_predict.so: %s" % e)
+
+    # export a model + expected output
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(5, activation="relu", in_units=6),
+            gluon.nn.Dense(3, in_units=5))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 6))
+    model = str(tmp_path / "m.mxtpu")
+    serving.export_model(net, x, model)
+    expected = serving.load(model).predict(x).asnumpy()
+
+    # build the XS module out-of-tree
+    pkg = str(tmp_path / "AI-MXNetTPU")
+    shutil.copytree(os.path.join(ROOT, "perl_package", "AI-MXNetTPU"), pkg)
+    r = subprocess.run(["perl", "Makefile.PL"], cwd=pkg, capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(["make"], cwd=pkg, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    script = str(tmp_path / "client.pl")
+    with open(script, "w") as f:
+        f.write("""
+use strict; use warnings;
+use lib '%(pkg)s/blib/lib', '%(pkg)s/blib/arch';
+use AI::MXNetTPU;
+my $pred = AI::MXNetTPU::Predictor->new('%(model)s');
+die 'inputs' unless $pred->num_inputs == 1;
+my @in = (%(invals)s);
+$pred->set_input(0, @in);
+$pred->forward;
+my $out = $pred->get_output(0);
+print join(',', @$out), "\\n";
+my $shape = $pred->output_shape(0);
+print join('x', @$shape), "\\n";
+""" % {"pkg": pkg, "model": model,
+            "invals": ",".join("%.9g" % v for v in
+                               x.asnumpy().astype("float32").ravel())})
+
+    env = dict(os.environ)
+    env["MXTPU_PREDICT_LIB"] = so_path
+    env["MXTPU_PYTHON"] = sys.executable
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(["perl", script], capture_output=True, text=True,
+                       env=env, timeout=300)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    got = onp.array([float(v) for v in lines[0].split(",")],
+                    "float32").reshape(2, 3)
+    assert lines[1] == "2x3"
+    onp.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
