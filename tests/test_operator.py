@@ -468,3 +468,31 @@ def test_im2col_col2im():
         loss = nd.im2col(x, kernel=(2, 2), stride=(1, 1)).sum()
     loss.backward()
     onp.testing.assert_allclose(x.grad.asnumpy()[0, 0], want)
+
+
+def test_check_consistency_bf16_sweep():
+    """The reference's check_consistency idiom (test_utils.py:126 — same op
+    at different precisions/backends must agree within dtype tolerance);
+    here fp32 vs bf16 across a representative op set."""
+    from incubator_mxnet_tpu.test_utils import assert_almost_equal
+    rng = onp.random.RandomState(0)
+    x32 = nd.array(rng.rand(8, 16).astype("float32") + 0.5)
+    w32 = nd.array(rng.rand(4, 16).astype("float32") * 0.5)
+    img32 = nd.array(rng.rand(2, 3, 8, 8).astype("float32"))
+    k32 = nd.array(rng.rand(4, 3, 3, 3).astype("float32") * 0.3)
+    cases = [
+        ("fc", lambda d: nd.FullyConnected(
+            x32.astype(d), w32.astype(d), None, num_hidden=4, no_bias=True)),
+        ("conv", lambda d: nd.Convolution(
+            img32.astype(d), k32.astype(d), None, kernel=(3, 3), num_filter=4,
+            no_bias=True, pad=(1, 1))),
+        ("softmax", lambda d: nd.softmax(x32.astype(d), axis=-1)),
+        ("tanh", lambda d: nd.tanh(x32.astype(d))),
+        ("mean", lambda d: x32.astype(d).mean(axis=1)),
+        ("layer_norm", lambda d: nd.LayerNorm(
+            x32.astype(d), nd.ones((16,), dtype=d), nd.zeros((16,), dtype=d))),
+    ]
+    for name, fn in cases:
+        ref = fn("float32").asnumpy().astype("float32")
+        low = fn("bfloat16").astype("float32").asnumpy()
+        assert_almost_equal(low, ref, rtol=5e-2, atol=5e-2)
