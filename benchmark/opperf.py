@@ -18,7 +18,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _op_set(size):
-    import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd
     n = size
     a = nd.random.uniform(shape=(n, n)) + 0.5
@@ -27,6 +26,11 @@ def _op_set(size):
     img = nd.random.uniform(shape=(8, 16, 64, 64))
     w = nd.random.uniform(shape=(32, 16, 3, 3))
     idx = nd.array((nd.random.uniform(shape=(n,)) * (n - 1)).asnumpy())
+    m = max(16, n // 16 * 16)  # batch_dot shapes need /16 divisibility
+    bd_a = nd.random.uniform(shape=(16, m // 16 * 4, m // 4))
+    bd_b = nd.random.uniform(shape=(16, m // 4, m // 16 * 4))
+    bn_g, bn_b = nd.ones((16,)), nd.zeros((16,))
+    bn_mm, bn_mv = nd.zeros((16,)), nd.ones((16,))
     return {
         # elemwise / broadcast
         "add": (lambda: a + b, [a, b]),
@@ -42,18 +46,17 @@ def _op_set(size):
         "sort": (lambda: nd.sort(vec), []),
         # matmul / nn
         "dot": (lambda: nd.dot(a, b), [a, b]),
-        "batch_dot": (lambda: nd.batch_dot(
-            a.reshape((16, n // 16 * 4, n // 4)),
-            b.reshape((16, n // 4, n // 16 * 4))), [a, b]),
+        "batch_dot": (lambda: nd.batch_dot(bd_a, bd_b), [bd_a, bd_b]),
         "FullyConnected": (lambda: nd.FullyConnected(
             a, b, None, num_hidden=n, no_bias=True), [a, b]),
         "Convolution": (lambda: nd.Convolution(
             img, w, None, kernel=(3, 3), num_filter=32, no_bias=True,
             pad=(1, 1)), [img, w]),
         "softmax": (lambda: nd.softmax(a, axis=-1), [a]),
-        "BatchNorm_train": (lambda: nd.BatchNorm(
-            img, nd.ones((16,)), nd.zeros((16,)), nd.zeros((16,)),
-            nd.ones((16,))), [img]),
+        # fwd column = inference-mode kernel; fwd+bwd runs under record()
+        # and therefore times the training kernel (batch stats + VJP)
+        "BatchNorm": (lambda: nd.BatchNorm(
+            img, bn_g, bn_b, bn_mm, bn_mv), [img]),
         # indexing / shapes
         "take": (lambda: nd.take(a, idx), [a]),
         "transpose": (lambda: a.T.copy(), [a]),
@@ -63,7 +66,7 @@ def _op_set(size):
 
 
 def bench_op(name, fn, grad_args, runs, warmup=5):
-    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu import autograd, nd
 
     for _ in range(warmup):
         fn().wait_to_read()
@@ -85,11 +88,12 @@ def bench_op(name, fn, grad_args, runs, warmup=5):
             return loss
 
         for _ in range(warmup):
-            fb().wait_to_read()
+            fb()
+        nd.waitall()  # backward dispatch is async: drain grads, not just loss
         t0 = time.perf_counter()
         for _ in range(runs):
-            out = fb()
-        out.wait_to_read()
+            fb()
+        nd.waitall()
         bwd_us = (time.perf_counter() - t0) / runs * 1e6
     return fwd_us, bwd_us
 
@@ -104,7 +108,14 @@ def main():
     import incubator_mxnet_tpu as mx
     mx.random.seed(0)
     table = _op_set(args.size)
-    names = args.ops.split(",") if args.ops else sorted(table)
+    if args.ops:
+        names = [t.strip() for t in args.ops.split(",") if t.strip()]
+        unknown = [t for t in names if t not in table]
+        if unknown:
+            ap.error("unknown ops %s; choose from: %s"
+                     % (unknown, ", ".join(sorted(table))))
+    else:
+        names = sorted(table)
     results = {}
     print("%-18s %12s %16s" % ("op", "fwd us", "fwd+bwd us"))
     for name in names:
