@@ -34,6 +34,18 @@ ENV_VARS = {
         bool, False,
         "Disable the native C++ library even if it builds (forces the "
         "pure-Python IO tiers)."),
+    "MXTPU_PREDICT_LIB": (
+        str, None,
+        "Path to libmxtpu_predict.so for C/C++/Perl predict clients "
+        "(cpp_package, perl_package); defaults to the loader path."),
+    "MXTPU_PYTHON": (
+        str, None,
+        "Interpreter the embedded C predict API boots (c_predict_api.cc); "
+        "defaults to the build-time python."),
+    "MXTPU_KVSTORE_DEBUG": (
+        int, 0,
+        "Verbose logging in the kvstore server-role facade "
+        "(kvstore_server.py)."),
     "JAX_PLATFORMS": (
         str, None,
         "Backend selection (jax): 'cpu' forces the virtual-device CPU path "

@@ -90,7 +90,7 @@ _SIMPLE_OPS = [
     "make_loss", "BlockGrad", "identity", "L2Normalization", "LRN",
     "UpSampling", "BilinearResize2D", "slice_like", "amp_cast",
     "smooth_l1", "hard_sigmoid", "softmax_cross_entropy", "digamma",
-    "khatri_rao", "trace", "im2col", "col2im", "add_n", "batch_take",
+    "khatri_rao", "trace", "im2col", "col2im", "add_n", "batch_take", "RNN",
     "depth_to_space", "space_to_depth", "shape_array", "size_array",
     "argmax_channel", "Correlation", "Crop",
 ]
