@@ -286,7 +286,7 @@ class ImageRecordIter(DataIter):
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
                  num_parts=1, part_index=0, preprocess_threads=4, round_batch=True,
                  seed=0, path_imgidx=None, prefetch_buffer=2, resize=0,
-                 force_python=False, **kwargs):
+                 force_python=False, dtype="float32", **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         from concurrent.futures import ThreadPoolExecutor
@@ -358,6 +358,13 @@ class ImageRecordIter(DataIter):
         self._std = onp.array([std_r, std_g, std_b], dtype="float32").reshape(3, 1, 1)
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
+        # dtype="uint8": ship raw 0..255 bytes to the device (4x smaller
+        # host->device transfer — the TPU input idiom) and normalize INSIDE
+        # the compiled step; requires identity mean/std here
+        self._out_dtype = dtype
+        if dtype == "uint8":
+            assert not self._mean.any() and (self._std == 1).all(), \
+                "dtype='uint8' ships raw pixels; fold mean/std into the model"
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self._rec_lock = threading.Lock()
         self._cursor = 0
@@ -445,6 +452,8 @@ class ImageRecordIter(DataIter):
                 rem = self._native_pipe.num_records % self.batch_size
                 pad = (self.batch_size - rem) % self.batch_size
             # buffers are reused by the pipeline; nd.array copies to device
+            if self._out_dtype == "uint8":
+                data = onp.clip(data, 0, 255).astype(onp.uint8)
             return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
         if self._native is not None:
             payloads = self._native.next()

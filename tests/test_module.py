@@ -266,3 +266,34 @@ def test_module_multi_context_data_parallel():
     multi = run([mx.cpu(i) for i in range(4)])
     onp.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
     assert multi[-1] < multi[0]
+
+
+def test_image_record_iter_uint8_dtype(tmp_path):
+    """dtype='uint8' ships raw pixels (4x smaller host->device transfer,
+    the TPU input idiom) with values preserved vs the float path."""
+    from incubator_mxnet_tpu import recordio
+    from PIL import Image
+    import io as pyio
+    rng = onp.random.RandomState(0)
+    uri = str(tmp_path / "u8.rec")
+    w = recordio.MXRecordIO(uri, "w")
+    for i in range(6):
+        img = (rng.rand(16, 16, 3) * 255).astype("uint8")
+        bio = pyio.BytesIO()
+        Image.fromarray(img).save(bio, format="JPEG", quality=95)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              bio.getvalue()))
+    w.close()
+    it8 = mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 16, 16),
+                                batch_size=6, dtype="uint8")
+    itf = mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 16, 16),
+                                batch_size=6)
+    b8 = it8.next()
+    bf = itf.next()
+    assert str(b8.data[0].dtype) == "uint8"
+    onp.testing.assert_allclose(b8.data[0].asnumpy().astype("float32"),
+                                bf.data[0].asnumpy(), atol=1.0)
+    # identity mean/std is required for the raw-pixel contract
+    with pytest.raises(AssertionError):
+        mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 16, 16),
+                              batch_size=2, dtype="uint8", mean_r=123.0)
