@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Long-context causal-LM training — the capability the reference lacks
+(SURVEY §5): flash-attention kernels (O(S) memory), gradient
+checkpointing, and optional sequence parallelism (ring or Ulysses) over
+an `sp` mesh axis.
+
+Single chip at S=8192:
+  python train_gpt_longctx.py --seq-len 8192
+Sequence-parallel over 8 (virtual) devices:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python train_gpt_longctx.py --sp 8 --attention ring --seq-len 2048
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=8192)
+    ap.add_argument("--batch-size", type=int, default=1)
+    ap.add_argument("--units", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--attention", default="flash",
+                    choices=["flash", "dense", "ring", "ulysses"])
+    ap.add_argument("--sp", type=int, default=1, help="sequence-parallel axis")
+    ap.add_argument("--remat", action="store_true",
+                    help="gradient-checkpoint the forward (fit longer S)")
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, jit, models, parallel
+
+    mesh = None
+    if args.sp > 1:
+        mesh = parallel.make_mesh({"dp": 1, "sp": args.sp})
+        assert args.attention in ("ring", "ulysses"), \
+            "--sp needs a sequence-parallel attention (--attention ring|ulysses)"
+
+    mx.random.seed(0)
+    net = models.GPTModel(vocab_size=args.vocab, units=args.units,
+                          num_layers=args.layers, num_heads=args.heads,
+                          max_length=args.seq_len, attention=args.attention,
+                          sp_axis="sp" if args.sp > 1 else None)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr,
+                             "multi_precision": True})
+    if mesh is not None:
+        step = parallel.DataParallelTrainStep(net, loss_fn, trainer,
+                                              mesh=mesh)
+    else:
+        step = jit.TrainStep(net, loss_fn, trainer, remat=args.remat)
+
+    # synthetic Zipf corpus (zero egress), next-token objective
+    from incubator_mxnet_tpu.gluon.contrib.data import WikiText2
+    ds = WikiText2(segment="train", seq_len=args.seq_len)
+    vocab_cap = args.vocab
+
+    def batch(i):
+        rows = [onp.asarray(ds[(i + j) % len(ds)][0]) % vocab_cap
+                for j in range(args.batch_size)]
+        return nd.array(onp.stack(rows).astype("int32"))
+
+    tok = batch(0)
+    float(step(tok, tok).mean().asscalar())     # compile
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        tok = batch(i)
+        loss = step(tok, tok)
+    lv = float(loss.mean().asscalar())
+    dt = time.perf_counter() - t0
+    logging.info("S=%d attention=%s remat=%s sp=%d: %.0f tok/s, loss %.3f",
+                 args.seq_len, args.attention, args.remat, args.sp,
+                 args.batch_size * args.seq_len * args.steps / dt, lv)
+
+
+if __name__ == "__main__":
+    main()
