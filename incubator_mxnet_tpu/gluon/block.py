@@ -8,6 +8,7 @@ as a single entry whose VJP is the XLA-differentiated whole graph.
 """
 from __future__ import annotations
 
+import os
 import re
 import threading
 
@@ -219,6 +220,10 @@ class Block:
         out = self.forward(*args)
         for hook in self._forward_hooks:
             hook(self, args, out)
+        if args and all(isinstance(a, NDArray) for a in args):
+            # remember the input signature so export() can emit the serving
+            # artifact without an explicit example (see HybridBlock.export)
+            self._last_inputs = list(args)
         return out
 
     def forward(self, *args):
@@ -361,18 +366,32 @@ class HybridBlock(Block):
                 arr._data = new._data
         return outs[0] if len(outs) == 1 else tuple(outs)
 
-    def export(self, path, epoch=0):
-        """Export params for deployment (ref block.py:1106 HybridBlock.export).
+    def export(self, path, epoch=0, example_inputs=None):
+        """Export for deployment (ref block.py:1106 HybridBlock.export).
 
-        TPU-native: saves parameters (+ a JSON stub describing the entry); the
-        compiled artifact is reproducible by re-jitting on load.
+        TPU-native: saves parameters + a manifest JSON, and — when the
+        input signature is known (``example_inputs`` given, or the block
+        has been called) — a ``<path>.mxtpu`` serving artifact (serialized
+        compiled StableHLO, contrib/serving.py). ``SymbolBlock.imports``
+        on the manifest loads that artifact back as an inference block, so
+        export → imports round-trips like the reference's symbol.json +
+        params contract.
         """
         import json
         params = self._collect_params_with_prefix()
         nd.save("%s-%04d.params" % (path, epoch),
                 {("arg:" + k): v.data() for k, v in params.items()})
+        artifact = None
+        inputs = example_inputs if example_inputs is not None \
+            else getattr(self, "_last_inputs", None)
+        if inputs is not None:
+            from ..contrib import serving
+            artifact = "%s.mxtpu" % path
+            serving.export_model(self, inputs, artifact)
         with open("%s-symbol.json" % path, "w") as f:
-            json.dump({"format": "incubator_mxnet_tpu.hybrid", "class": type(self).__name__},
+            json.dump({"format": "incubator_mxnet_tpu.hybrid",
+                       "class": type(self).__name__,
+                       "artifact": artifact and os.path.basename(artifact)},
                       f)
 
 
@@ -399,8 +418,30 @@ class SymbolBlock(HybridBlock):
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
         """Load a serialized graph (+.params) as a Block
-        (ref block.py:1311 SymbolBlock.imports)."""
+        (ref block.py:1311 SymbolBlock.imports). Accepts either a real
+        Symbol graph JSON or a HybridBlock.export manifest — the latter
+        loads the exported ``.mxtpu`` serving artifact as an
+        inference-only block (params are baked into the program)."""
+        import json as _json
         from .. import symbol as mxsym
+        with open(symbol_file) as f:
+            head = f.read(4096)
+        try:
+            meta = _json.loads(head)
+        except ValueError:
+            meta = None
+        if isinstance(meta, dict) and \
+                meta.get("format") == "incubator_mxnet_tpu.hybrid":
+            artifact = meta.get("artifact")
+            if not artifact:
+                raise ValueError(
+                    "%s is a hybrid-export manifest without a serving "
+                    "artifact; re-export after a forward pass (or with "
+                    "example_inputs) so the .mxtpu program is written"
+                    % symbol_file)
+            apath = os.path.join(os.path.dirname(os.path.abspath(symbol_file)),
+                                 artifact)
+            return _ServedBlock(apath)
         sym = mxsym.load(symbol_file)
         if isinstance(input_names, str):
             input_names = [input_names]
@@ -428,3 +469,18 @@ class SymbolBlock(HybridBlock):
         for name, p in self.params.items():
             bindings[name] = p.data()
         return self._sym.eval_imperative(bindings)
+
+
+class _ServedBlock(Block):
+    """SymbolBlock.imports result for hybrid-export manifests: wraps the
+    .mxtpu serving artifact (compiled program, params baked in) as an
+    inference-only Block."""
+
+    def __init__(self, artifact_path):
+        super().__init__(prefix="", params=None)
+        from ..contrib import serving
+        self._served = serving.load(artifact_path)
+        self._artifact_path = artifact_path
+
+    def forward(self, *args):
+        return self._served.predict(*args)

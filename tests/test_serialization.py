@@ -5,7 +5,7 @@ import numpy as onp
 import pytest
 
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import nd, gluon
 from incubator_mxnet_tpu.ndarray import serialization as ser
 
 
@@ -113,3 +113,26 @@ def test_bfloat16_roundtrip(tmp_path):
     back = nd.load(f)
     assert str(back["w"].dtype) == "bfloat16"
     onp.testing.assert_array_equal(back["w"].asnumpy(), x.asnumpy())
+
+
+def test_hybrid_export_imports_roundtrip(tmp_path):
+    """HybridBlock.export → SymbolBlock.imports round-trips via the .mxtpu
+    serving artifact (the reference's symbol.json+params deployment
+    contract, block.py:1106/1311)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu", in_units=4),
+            gluon.nn.Dense(3, in_units=8))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 4))
+    want = net(x).asnumpy()          # also caches the input signature
+    prefix = str(tmp_path / "deploy")
+    net.export(prefix, epoch=3)
+    import os
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params")
+    assert os.path.exists(prefix + ".mxtpu")
+    blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                    prefix + "-0003.params")
+    got = blk(x).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
