@@ -311,3 +311,70 @@ def linspace(start, stop, num, endpoint=True, dtype="float32", **kw):
 
 __all__ += ["pow", "hypot", "split_v2", "histogram", "eye", "full", "arange",
             "linspace"]
+
+
+# -------------------------------------------------------- sub-namespaces
+# (ref mx.sym.linalg / mx.sym.random / mx.sym.sparse generated namespaces)
+class _SymNS:
+    def __init__(self, name, table):
+        self.__name__ = "symbol." + name
+        for k, v in table.items():
+            setattr(self, k, v)
+
+
+def _sym_linalg_ns():
+    from ..ndarray import linalg as _ndl
+    table = {}
+    for k in dir(_ndl):
+        fn = getattr(_ndl, k)
+        if k.startswith("_") or not callable(fn):
+            continue
+        table[k] = _symbolize(fn, "linalg_" + k)
+        _OP_TABLE["linalg_" + k] = fn
+    return _SymNS("linalg", table)
+
+
+def _sym_random_ns():
+    from ..ndarray import random as _ndr
+
+    def make_creation(fn, opname):
+        def sym_fn(*args, name=None, **kwargs):
+            # creation-style: no Symbol inputs; args fold into the thunk
+            return Symbol(op=lambda: fn(*args, **kwargs), op_name=opname,
+                          inputs=[], name=name)
+        sym_fn.__name__ = opname
+        return sym_fn
+
+    table = {}
+    for k in ["uniform", "normal", "randn", "randint", "exponential",
+              "gamma", "poisson", "negative_binomial",
+              "generalized_negative_binomial", "bernoulli"]:
+        if hasattr(_ndr, k):
+            table[k] = make_creation(getattr(_ndr, k), "random_" + k)
+    for k in ["multinomial", "shuffle"]:  # array-input ops
+        if hasattr(_ndr, k):
+            table[k] = _symbolize(getattr(_ndr, k), "random_" + k)
+            _OP_TABLE["random_" + k] = getattr(_ndr, k)
+    return _SymNS("random", table)
+
+
+def _sym_sparse_ns():
+    """mx.sym.sparse facade: sparse STORAGE is eager-only here (README
+    §Sparse — data-dependent nnz can't live under jit), so the symbolic
+    namespace maps the dense-compatible ops; storage-changing ops raise
+    with the documented decision."""
+    table = {"dot": _g["dot"], "add": _g["add"], "subtract": _g["subtract"],
+             "multiply": _g["multiply"], "divide": _g["divide"]}
+
+    def cast_storage(*a, **k):
+        raise NotImplementedError(
+            "symbolic cast_storage: sparse storage conversion is eager-only "
+            "(data-dependent nnz; see README 'Sparse & async')")
+    table["cast_storage"] = cast_storage
+    return _SymNS("sparse", table)
+
+
+linalg = _sym_linalg_ns()
+random = _sym_random_ns()
+sparse = _sym_sparse_ns()
+__all__ += ["linalg", "random", "sparse"]
