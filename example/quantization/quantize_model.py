@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Post-training INT8 quantization — the reference's example/quantization
+workflow (imagenet_gen_qsym_mkldnn.py) on the TPU-native int8 path.
+
+Train (or load) an FP32 model, calibrate on sample batches (minmax or KL
+entropy), convert Dense/Conv blocks to int8 with `quantize_net`, and
+report agreement between the fp32 and int8 predictions.
+
+Run: python quantize_model.py [--calib-mode entropy] [--samples 256]
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--calib-mode", default="minmax",
+                    choices=["minmax", "entropy"])
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd, gluon
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    from incubator_mxnet_tpu.gluon.data.vision import MNIST
+
+    # 1. a small trained fp32 classifier
+    ds = MNIST(train=True)
+    data = ds._data.asnumpy().astype("float32")[:4096] / 255.0
+    label = onp.asarray(ds._label[:4096], dtype="float32")
+    x = nd.array(data.transpose(0, 3, 1, 2))
+    y = nd.array(label)
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 5, activation="relu", in_channels=1),
+            gluon.nn.MaxPool2D(2, 2), gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for ep in range(args.epochs):
+        perm = onp.random.RandomState(ep).permutation(len(label))
+        tot = 0.0
+        for i in range(0, len(label), 256):
+            xb, yb = x[perm[i:i + 256]], y[perm[i:i + 256]]
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            tot += float(loss.sum().asscalar())
+        logging.info("epoch %d loss %.4f", ep, tot / len(label))
+
+    # 2. fp32 reference BEFORE conversion (quantize_net swaps in place)
+    test = x[-1024:]
+    ref = net(test).asnumpy().argmax(axis=1)
+
+    # 3. calibrate + convert (ref quantize_graph_pass.cc flow)
+    calib = x[: args.samples]
+    qnet = quantize_net(net, calib_data=[calib], calib_mode=args.calib_mode)
+    qed = qnet(test).asnumpy().argmax(axis=1)
+    agree = float((ref == qed).mean())
+    acc = float((qed == label[-1024:]).mean())
+    logging.info("int8 top-1 agreement with fp32: %.3f; int8 accuracy: %.3f",
+                 agree, acc)
+    assert agree > 0.95, "int8 conversion diverged from fp32"
+
+
+if __name__ == "__main__":
+    main()

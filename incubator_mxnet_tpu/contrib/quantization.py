@@ -76,23 +76,33 @@ def _entropy_threshold(hist, edges, num_quantized_bins=255):
     best_kl, best_t = onp.inf, amax
     for i in range(num_quantized_bins, num_bins, num_bins // 64 or 1):
         t = edges[i]
-        p = hist[:i].astype(onp.float64).copy()
-        p[-1] += hist[i:].sum()  # clip outliers into the last bin
+        clipped = hist[:i].astype(onp.float64)
+        p = clipped.copy()
+        p[-1] += hist[i:].sum()  # reference dist: outliers clip into last bin
         if p.sum() == 0:
             continue
-        # quantize p into num_quantized_bins then expand back
-        factor = len(p) / num_quantized_bins
-        q = onp.zeros_like(p)
+        # candidate Q: quantize the histogram WITHOUT the outlier lump into
+        # num_quantized_bins and expand back. Building Q from p instead
+        # makes Q == P exactly at i == num_quantized_bins (KL=0), which
+        # always wins and collapses the threshold — the bug the canonical
+        # TensorRT/calibrate.cc split of P and Q exists to avoid.
+        factor = len(clipped) / num_quantized_bins
+        q = onp.zeros_like(clipped)
         for j in range(num_quantized_bins):
             lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
-            mass = p[lo:hi].sum()
-            nz = (p[lo:hi] > 0).sum()
+            mass = clipped[lo:hi].sum()
+            nz = (clipped[lo:hi] > 0).sum()
             if nz:
-                q[lo:hi] = onp.where(p[lo:hi] > 0, mass / nz, 0)
+                q[lo:hi] = onp.where(clipped[lo:hi] > 0, mass / nz, 0)
         p_n = p / p.sum()
         q_n = q / q.sum() if q.sum() else q
-        mask = (p_n > 0) & (q_n > 0)
-        kl = float((p_n[mask] * onp.log(p_n[mask] / q_n[mask])).sum())
+        # smoothed KL: positions where P>0 but Q=0 would be infinite —
+        # penalize with a floor rather than masking them away (masking
+        # hides exactly the clipping error the search must see)
+        eps = 1e-12
+        mask = p_n > 0
+        kl = float((p_n[mask] *
+                    onp.log(p_n[mask] / onp.maximum(q_n[mask], eps))).sum())
         if kl < best_kl:
             best_kl, best_t = kl, t
     return best_t
