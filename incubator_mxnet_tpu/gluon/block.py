@@ -221,9 +221,11 @@ class Block:
         for hook in self._forward_hooks:
             hook(self, args, out)
         if args and all(isinstance(a, NDArray) for a in args):
-            # remember the input signature so export() can emit the serving
-            # artifact without an explicit example (see HybridBlock.export)
-            self._last_inputs = list(args)
+            # remember the input SIGNATURE (shape/dtype only — keeping the
+            # live arrays would pin the batch's device buffers in HBM) so
+            # export() can emit the serving artifact without an explicit
+            # example (see HybridBlock.export)
+            self._last_input_avals = [(a.shape, a.dtype) for a in args]
         return out
 
     def forward(self, *args):
@@ -382,8 +384,12 @@ class HybridBlock(Block):
         nd.save("%s-%04d.params" % (path, epoch),
                 {("arg:" + k): v.data() for k, v in params.items()})
         artifact = None
-        inputs = example_inputs if example_inputs is not None \
-            else getattr(self, "_last_inputs", None)
+        inputs = example_inputs
+        if inputs is None:
+            avals = getattr(self, "_last_input_avals", None)
+            if avals is not None:
+                inputs = [nd.zeros(shape, dtype=dtype)
+                          for shape, dtype in avals]
         if inputs is not None:
             from ..contrib import serving
             artifact = "%s.mxtpu" % path

@@ -452,9 +452,8 @@ class ImageRecordIter(DataIter):
                 rem = self._native_pipe.num_records % self.batch_size
                 pad = (self.batch_size - rem) % self.batch_size
             # buffers are reused by the pipeline; nd.array copies to device
-            if self._out_dtype == "uint8":
-                data = onp.clip(data, 0, 255).astype(onp.uint8)
-            return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
+            return DataBatch([nd.array(self._cast_out(data))],
+                             [nd.array(labels)], pad=pad)
         if self._native is not None:
             payloads = self._native.next()
             if payloads is None:
@@ -469,7 +468,8 @@ class ImageRecordIter(DataIter):
             labels = onp.asarray(
                 [onp.ravel(r[1])[: self._label_width] if onp.ndim(r[1])
                  else r[1] for r in results], dtype="float32")
-            return DataBatch([nd.array(data)], [nd.array(labels)], pad=pad)
+            return DataBatch([nd.array(self._cast_out(data))],
+                             [nd.array(labels)], pad=pad)
         n = self._hi - self._lo
         if self._cursor >= n:
             raise StopIteration
@@ -483,8 +483,16 @@ class ImageRecordIter(DataIter):
         self._cursor += self.batch_size
         results = list(self._pool.map(self._process, idxs))
         data = onp.stack([r[0] for r in results])
-        return DataBatch([nd.array(data)], [self._stack_labels(results)],
-                         pad=pad)
+        return DataBatch([nd.array(self._cast_out(data))],
+                         [self._stack_labels(results)], pad=pad)
+
+    def _cast_out(self, data):
+        """Honor dtype='uint8' on EVERY decode path (native pipe, native
+        reader + PIL, pure Python) — the 4x-smaller transfer is the whole
+        point of the option."""
+        if self._out_dtype == "uint8":
+            return onp.clip(data, 0, 255).astype(onp.uint8)
+        return data
 
     def _stack_labels(self, results):
         labels = onp.asarray([onp.ravel(r[1])[:self._label_width] if

@@ -1807,37 +1807,55 @@ __all__ += ["add_n", "batch_take", "depth_to_space", "space_to_depth",
 
 def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                 stride2=1, pad_size=0, is_multiply=True):
-    """Cost volume between two feature maps (ref src/operator/correlation.cc,
-    FlowNet). Output (N, D*D, H, W), D = 2*(max_displacement//stride2)+1:
-    channel k is the per-pixel correlation of data1 with data2 displaced by
-    the k-th (dy, dx) offset — a static displacement loop XLA unrolls, each
-    tap an elementwise product + channel mean (kernel_size=1 form; larger
-    kernels average over the window)."""
+    """Cost volume between two feature maps (ref src/operator/correlation.cc
+    CorrelationForward + correlation-inl.h shape inference; FlowNet).
+
+    Output (N, D*D, top_h, top_w) with D = 2*(max_displacement//stride2)+1,
+    top_h = ceil((H + 2*pad_size - 2*border)/stride1),
+    border = max_displacement + (kernel_size-1)//2.  Channel
+    tc = dy_idx*D + dx_idx holds, per output pixel, the sum over the
+    kernel_size x kernel_size window and input channels of
+    x1*x2_displaced (is_multiply) or |x1 - x2_displaced|, divided by
+    kernel_size^2 * C — exactly the reference's sumelems normalization.
+    The displacement/kernel loops are static and XLA-unrolled into fused
+    strided-slice multiplies."""
     if kernel_size % 2 != 1:
         raise ValueError("kernel_size must be odd")
-    d = max_displacement // stride2
-    offs = [(dy * stride2, dx * stride2)
-            for dy in range(-d, d + 1) for dx in range(-d, d + 1)]
-    kh = kernel_size // 2
+    gr = max_displacement // stride2
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    md = max_displacement
 
     def fn(x1, x2):
         N, C, H, W = x1.shape
-        p = pad_size + d * stride2 + kh
+        p = pad_size
+        ph, pw = H + 2 * p, W + 2 * p
+        top_h = -((2 * border - ph) // stride1)
+        top_w = -((2 * border - pw) // stride1)
+        if top_h < 1 or top_w < 1:
+            raise ValueError("Correlation: input too small for "
+                             "max_displacement/kernel_size")
+        x1p = jnp.pad(x1, ((0, 0), (0, 0), (p, p), (p, p)))
         x2p = jnp.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+
+        def tap(src, y0, x0):
+            return src[:, :, y0: y0 + (top_h - 1) * stride1 + 1: stride1,
+                       x0: x0 + (top_w - 1) * stride1 + 1: stride1]
+
         outs = []
-        for dy, dx in offs:
-            sh = x2p[:, :, p + dy - kh: p + dy + kh + H - 2 * kh + kh,
-                     p + dx - kh: p + dx + kh + W - 2 * kh + kh]
-            sh = sh[:, :, :H, :W]
-            if is_multiply:
-                v = (x1 * sh).mean(axis=1)
-            else:
-                v = -jnp.abs(x1 - sh).mean(axis=1)
-            outs.append(v)
-        out = jnp.stack(outs, axis=1)
-        if stride1 > 1:
-            out = out[:, :, ::stride1, ::stride1]
-        return out
+        for dy in range(-gr, gr + 1):
+            for dx in range(-gr, gr + 1):
+                s2p, s2o = dy * stride2, dx * stride2
+                acc = None
+                for h in range(kernel_size):
+                    for w in range(kernel_size):
+                        a = tap(x1p, md + h, md + w)
+                        b = tap(x2p, md + s2p + h, md + s2o + w)
+                        t = a * b if is_multiply else jnp.abs(a - b)
+                        t = t.sum(axis=1)
+                        acc = t if acc is None else acc + t
+                outs.append(acc / (kernel_size * kernel_size * C))
+        return jnp.stack(outs, axis=1)
     return _apply(fn, data1, data2)
 
 

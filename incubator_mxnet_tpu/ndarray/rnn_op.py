@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import autograd as _ag
 from .ndarray import NDArray, _apply, _to_nd
 
 __all__ = ["RNN", "rnn_param_size"]
@@ -74,6 +75,15 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
     shapes = _dims(mode, I, state_size, num_layers, bidirectional)
     act = "relu" if mode == "rnn_relu" else "tanh"
     has_cell = mode == "lstm"
+    # Inter-layer dropout (ref rnn-inl.h: applied between stacked layers,
+    # never after the last).  Training state and PRNG keys are resolved
+    # EAGERLY here — fn below is replayed by autograd's vjp, so anything
+    # read inside it must be a closure constant or gradients would be
+    # computed for a different function than the forward pass.
+    drop_keys = None
+    if p > 0 and num_layers > 1 and _ag.is_training():
+        from . import random as _rnd
+        drop_keys = [_rnd._next_key() for _ in range(num_layers - 1)]
 
     def fn(x, params, h0, *maybe_c):
         from ..gluon.rnn.rnn_layer import _lstm_step, _gru_step, _rnn_step
@@ -110,6 +120,11 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
                     ys = jnp.flip(ys, 0)
                 dir_outs.append(ys)
             out = dir_outs[0] if D == 1 else jnp.concatenate(dir_outs, -1)
+            if drop_keys is not None and layer != num_layers - 1:
+                keep = 1.0 - p
+                mask = jax.random.bernoulli(
+                    drop_keys[layer], keep, out.shape).astype(out.dtype)
+                out = out * mask / keep
         hy = jnp.stack(h_out, 0)
         if has_cell:
             return out, hy, jnp.stack(c_out, 0)

@@ -1199,16 +1199,71 @@ def _ndarray_array_function(self, func, types, args, kwargs):
 def _ndarray_array_ufunc(self, ufunc, method, *inputs, **kwargs):
     if method != "__call__":
         return NotImplemented
+    out = kwargs.pop("out", None)
     ours = _np_dispatch_lookup(ufunc.__name__)
-    if ours is not None:
+    if ours is not None and not kwargs:
         try:
-            return ours(*inputs)
+            res = ours(*inputs)
+            return _ufunc_apply_out(res, out)
         except TypeError:
             pass
-    res = getattr(onp, ufunc.__name__)(*_to_host(inputs))
+    # Host fallback: forward remaining kwargs (where=, casting=, ...) to
+    # official numpy instead of silently dropping them.  out= must ride
+    # along as real host buffers seeded with the targets' current values so
+    # where=False positions keep their prior contents (numpy's contract),
+    # and so numpy itself enforces its output-casting rules.
+    host_kwargs = {k: _to_host(v) for k, v in kwargs.items()}
+    host_out = None
+    if out is not None:
+        targets = out if isinstance(out, tuple) else (out,)
+        host_out = tuple(
+            t.asnumpy().copy() if isinstance(t, ndarray) else t
+            for t in targets)
+        host_kwargs["out"] = host_out if isinstance(out, tuple) \
+            else host_out[0]
+    res = getattr(onp, ufunc.__name__)(*_to_host(inputs), **host_kwargs)
     if isinstance(res, onp.ndarray):
-        return ndarray(jnp.asarray(res))
-    return res
+        res = ndarray(jnp.asarray(res))
+    elif isinstance(res, tuple):
+        res = tuple(ndarray(jnp.asarray(r)) if isinstance(r, onp.ndarray)
+                    else r for r in res)
+    return _ufunc_apply_out(res, out, checked=host_out is not None)
+
+
+def _ufunc_apply_out(res, out, checked=False):
+    """Honor ufunc out= by writing into the target mx ndarray(s) in place
+    (functional update underneath) and returning the target, matching
+    numpy's aliasing contract as closely as an immutable backend can.
+    ``checked`` means official numpy already ran with out= host buffers
+    and enforced its casting rules; otherwise the same_kind output-casting
+    rule is enforced here (numpy raises on e.g. float->int out)."""
+    if out is None:
+        return res
+    targets = out if isinstance(out, tuple) else (out,)
+    results = res if isinstance(res, tuple) else (res,)
+    if len(targets) != len(results):
+        raise ValueError("out= arity mismatch")
+    written = []
+    for t, r in zip(targets, results):
+        if t is None:  # numpy allows None = "allocate this output"
+            written.append(r)
+            continue
+        if not isinstance(t, ndarray):
+            raise TypeError("out= target must be an mx np ndarray, got %r"
+                            % type(t))
+        r_j = r._data if isinstance(r, ndarray) else jnp.asarray(r)
+        if r_j.dtype != t.dtype:
+            if not checked and not onp.can_cast(r_j.dtype, t.dtype,
+                                                casting="same_kind"):
+                raise TypeError(
+                    "Cannot cast ufunc output from %s to %s with casting "
+                    "rule 'same_kind'" % (r_j.dtype, t.dtype))
+            r_j = r_j.astype(t.dtype)
+        t._data = r_j
+        written.append(t)
+    # numpy normalizes out= to a tuple; hand back a bare array for the
+    # single-output case (what nout==1 ufuncs expect)
+    return written[0] if len(written) == 1 else tuple(written)
 
 
 def _ndarray_array(self, dtype=None, copy=None):

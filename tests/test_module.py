@@ -297,3 +297,11 @@ def test_image_record_iter_uint8_dtype(tmp_path):
     with pytest.raises(AssertionError):
         mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 16, 16),
                               batch_size=2, dtype="uint8", mean_r=123.0)
+    # ADVICE r2: dtype='uint8' must hold on the pure-Python fallback too
+    itp = mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 16, 16),
+                                batch_size=6, dtype="uint8",
+                                force_python=True)
+    bp = itp.next()
+    assert str(bp.data[0].dtype) == "uint8"
+    onp.testing.assert_allclose(bp.data[0].asnumpy().astype("float32"),
+                                bf.data[0].asnumpy(), atol=1.0)

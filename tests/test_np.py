@@ -346,3 +346,31 @@ def test_numpy_dispatch_protocol():
                         onp.unwrap(onp.array([0.0, 3.0, 6.0, 9.0])), rtol=1e-6)
     # __array__ conversion
     assert onp.asarray(a).shape == (2, 2)
+
+
+def test_numpy_ufunc_kwargs():
+    """ADVICE r2: out=/where= must be honored, not silently dropped."""
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([10.0, 20.0, 30.0])
+    c = np.zeros(3)
+    r = onp.add(a, b, out=c)
+    assert r is c
+    assert_almost_equal(c.asnumpy(), [11.0, 22.0, 33.0], rtol=1e-6)
+    # where= rides the host fallback instead of being ignored
+    w = onp.add(a, b, where=onp.array([True, False, True]))
+    got = onp.asarray(w)
+    assert got[0] == 11.0 and got[2] == 33.0
+    # where= + out=: masked positions must keep out's prior contents
+    c2 = np.array([7.0, 8.0, 9.0])
+    onp.add(a, b, out=c2, where=onp.array([True, False, True]))
+    assert_almost_equal(c2.asnumpy(), [11.0, 8.0, 33.0], rtol=1e-6)
+    # float result into int out violates same_kind casting -> error
+    ci = np.zeros(3, dtype="int32")
+    with pytest.raises(TypeError):
+        onp.divide(a, b, out=ci)
+    # multi-output ufunc with None slots in the out tuple is legal
+    q = np.zeros(3)
+    r1, r2 = onp.divmod(a, np.array([2.0, 2.0, 2.0]), out=(q, None))
+    assert r1 is q
+    assert_almost_equal(q.asnumpy(), [0.0, 1.0, 1.0], rtol=1e-6)
+    assert_almost_equal(onp.asarray(r2), [1.0, 0.0, 1.0], rtol=1e-6)

@@ -146,12 +146,61 @@ def test_tensor_op_batch():
     assert_almost_equal(nd.argmax_channel(z), [1.0, 0.0])
 
 
+def _corr_ref(x1, x2, kernel_size=1, max_displacement=1, stride1=1,
+              stride2=1, pad_size=0, is_multiply=True):
+    """Direct loop mirror of reference CorrelationForward (correlation.cc)."""
+    N, C, H, W = x1.shape
+    p = pad_size
+    x1p = onp.pad(x1, ((0, 0), (0, 0), (p, p), (p, p)))
+    x2p = onp.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    ph, pw = H + 2 * p, W + 2 * p
+    th = int(onp.ceil((ph - 2 * border) / stride1))
+    tw = int(onp.ceil((pw - 2 * border) / stride1))
+    gr = max_displacement // stride2
+    gw = 2 * gr + 1
+    out = onp.zeros((N, gw * gw, th, tw), x1.dtype)
+    sumelems = kernel_size * kernel_size * C
+    for i in range(th):
+        for j in range(tw):
+            y1, x1c = i * stride1 + max_displacement, j * stride1 + max_displacement
+            for tc in range(gw * gw):
+                s2o = (tc % gw - gr) * stride2
+                s2p = (tc // gw - gr) * stride2
+                for n in range(N):
+                    acc = 0.0
+                    for h in range(kernel_size):
+                        for w in range(kernel_size):
+                            a = x1p[n, :, y1 + h, x1c + w]
+                            b = x2p[n, :, y1 + s2p + h, x1c + s2o + w]
+                            acc += (a * b).sum() if is_multiply \
+                                else onp.abs(a - b).sum()
+                    out[n, tc, i, j] = acc / sumelems
+    return out
+
+
 def test_correlation_and_crop():
     x = nd.array(onp.random.RandomState(0).rand(1, 4, 6, 6).astype("float32"))
     out = nd.Correlation(x, x, max_displacement=1)
-    assert out.shape == (1, 9, 6, 6)
+    assert out.shape == (1, 9, 4, 4)  # border=1 shrinks 6 -> 4 (ref shape rule)
     mid = out.asnumpy()[0, 4]  # zero displacement = mean over C of x*x
-    assert_almost_equal(mid, (x.asnumpy()[0] ** 2).mean(axis=0), rtol=1e-5)
+    assert_almost_equal(mid, (x.asnumpy()[0] ** 2).mean(axis=0)[1:5, 1:5],
+                        rtol=1e-5)
+    # non-default params vs the direct reference mirror (both branches)
+    rs = onp.random.RandomState(1)
+    a = rs.rand(2, 3, 9, 9).astype("float32")
+    b = rs.rand(2, 3, 9, 9).astype("float32")
+    for kw in ({"kernel_size": 3, "max_displacement": 2, "stride1": 2,
+                "stride2": 2, "pad_size": 2, "is_multiply": True},
+               {"kernel_size": 3, "max_displacement": 2, "stride1": 1,
+                "stride2": 1, "pad_size": 1, "is_multiply": False},
+               {"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                "stride2": 1, "pad_size": 0, "is_multiply": False}):
+        got = nd.Correlation(nd.array(a), nd.array(b), **kw).asnumpy()
+        want = _corr_ref(a, b, **kw)
+        assert got.shape == want.shape, (kw, got.shape, want.shape)
+        assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
     c = nd.Crop(x, offset=(1, 2), h_w=(3, 3))
     assert_almost_equal(c, x.asnumpy()[:, :, 1:4, 2:5])
     like = nd.zeros((1, 4, 2, 2))

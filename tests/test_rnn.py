@@ -167,3 +167,36 @@ def test_monolithic_rnn_op_gru_bidirectional():
     assert out.shape == (T, N, 2 * H)
     assert hy.shape == (2, N, H)
     assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_monolithic_rnn_op_dropout():
+    """ADVICE r2: nd.RNN must apply inter-layer dropout when p>0 in
+    training mode (and be deterministic / dropout-free outside it)."""
+    import numpy as onp
+    from incubator_mxnet_tpu import nd, autograd
+    from incubator_mxnet_tpu.ndarray.rnn_op import rnn_param_size
+
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    n = rnn_param_size("lstm", I, H, num_layers=L)
+    params = nd.array(onp.random.RandomState(0).randn(n).astype("float32") * 0.1)
+    x = nd.random.normal(shape=(T, N, I))
+    h0, c0 = nd.zeros((L, N, H)), nd.zeros((L, N, H))
+
+    base = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L,
+                  mode="lstm", p=0.9).asnumpy()
+    # inference: p ignored
+    infer = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L,
+                   mode="lstm", p=0.9).asnumpy()
+    assert_almost_equal(base, infer, rtol=1e-6)
+    # training: p=0.9 must change the output (mask hits layer-0 output),
+    # and backward must replay the SAME mask (keys are closure constants,
+    # not re-drawn inside the taped fn)
+    x.attach_grad()
+    with autograd.record():
+        dropped = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L,
+                         mode="lstm", p=0.9)
+        loss = (dropped ** 2).sum()
+    loss.backward()
+    assert onp.abs(dropped.asnumpy() - base).max() > 1e-4
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
