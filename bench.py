@@ -192,10 +192,14 @@ def main():
         },
         "long_context": {
             "metric": "gpt_8k_train_tok_per_sec_per_chip",
-            "value": round(lc_tok_s, 0), "unit": "tok/s",
-            "note": "causal GPT (U=1024,L=4,H=8) at S=8192, b1 — the "
-                    "flash-kernel long-context path; throughput stays "
-                    "within ~3% of S=4096 (no quadratic collapse)",
+            "value": round(lc_tok_s[8192], 0), "unit": "tok/s",
+            "tok_s_32k": round(lc_tok_s[32768], 0),
+            "note": "causal GPT (U=1024,L=4,H=8) at b1 — flash kernels "
+                    "with grid-streamed K/V (S bounded by HBM, not VMEM). "
+                    "Attention FLOPs/token grow linearly with S, so the "
+                    "8k->32k ratio bounds overhead: quadratic collapse "
+                    "would be ~4x; attention-linear scaling predicts the "
+                    "observed ratio",
         },
     }))
 
@@ -236,30 +240,38 @@ def bench_transformer(peak):
 
 
 def bench_long_context():
-    """Causal GPT train step at S=8192 on one chip (flash attention
-    backward included) — the long-context capability the reference lacks
-    (SURVEY §5)."""
+    """Causal GPT train step at S=8192 AND S=32768 on one chip (flash
+    attention fwd+bwd, K/V streamed by the kernel grid so VMEM never holds
+    whole-S K/V) — the long-context capability the reference lacks
+    (SURVEY §5).  Returns {S: tok_s}."""
+    import gc
     import numpy as onp
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, jit, models
 
-    S = 8192
-    mx.random.seed(0)
-    net = models.GPTModel(vocab_size=32768, units=1024, num_layers=4,
-                          num_heads=8, max_length=S, attention="flash")
-    net.initialize(mx.init.Xavier())
-    net.cast("bfloat16")
-    tokens = nd.array(onp.random.randint(0, 32768, (1, S)).astype("int32"))
-    trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 1e-4, "multi_precision": True})
-    step = jit.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
-    for _ in range(2):
-        float(step(tokens, tokens).mean().asscalar())
-    t0 = time.perf_counter()
-    for _ in range(4):
-        loss = step(tokens, tokens)
-    float(loss.mean().asscalar())
-    return 4 * S / (time.perf_counter() - t0)
+    out = {}
+    for S in (8192, 32768):
+        mx.random.seed(0)
+        net = models.GPTModel(vocab_size=32768, units=1024, num_layers=4,
+                              num_heads=8, max_length=S, attention="flash")
+        net.initialize(mx.init.Xavier())
+        net.cast("bfloat16")
+        tokens = nd.array(onp.random.randint(0, 32768, (1, S)).astype("int32"))
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-4,
+                                 "multi_precision": True})
+        step = jit.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             trainer)
+        for _ in range(2):
+            float(step(tokens, tokens).mean().asscalar())
+        t0 = time.perf_counter()
+        for _ in range(4):
+            loss = step(tokens, tokens)
+        float(loss.mean().asscalar())
+        out[S] = 4 * S / (time.perf_counter() - t0)
+        del step, trainer, net, tokens, loss
+        gc.collect()
+    return out
 
 
 if __name__ == "__main__":

@@ -30,6 +30,15 @@ ENV_VARS = {
         "Use the flash-attention kernels for every LEGAL shape, overriding "
         "the narrow-head (D<128) short-S profitability heuristic — opt in "
         "when the composite's B*H*S^2 score memory nears OOM."),
+    "MXTPU_FLASH_BLOCK_Q": (
+        int, 0,
+        "Override the flash-attention q-block size (ops/attention.py). "
+        "0 = auto (largest of 1024/512/256/128 dividing S; 1024 measured "
+        "fastest on v5e at S>=8k for fwd+bwd). Must divide S."),
+    "MXTPU_FLASH_BLOCK_K": (
+        int, 0,
+        "Override the flash-attention k-block size. 0 = auto. Must "
+        "divide S."),
     "MXTPU_NO_NATIVE": (
         bool, False,
         "Disable the native C++ library even if it builds (forces the "
