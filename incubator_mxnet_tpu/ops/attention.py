@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["flash_attention", "flash_attention_supported",
-           "flash_attention_legal"]
+           "flash_attention_legal", "flash_attention_lse",
+           "attention_with_lse"]
 
 
 def _interpret():
@@ -267,7 +268,8 @@ def _fa_bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, :, :] += (ds @ k_blk).astype(dq_ref.dtype)
 
 
-def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k):
+def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                 g_lse=None):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
@@ -279,6 +281,11 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k):
     delta = jnp.sum(dof.astype(jnp.float32) *
                     o.reshape(B * H, S, D).astype(jnp.float32),
                     axis=-1)[:, None, :]                 # (B*H, 1, S)
+    if g_lse is not None:
+        # When LSE is a second primal output (flash_attention_lse), its
+        # cotangent enters ds exactly as -delta does: d lse_i/d s_ij = p_ij,
+        # so ds_ij = p_ij*(dp_ij - delta_i + g_lse_i)*scale — fold it in.
+        delta = delta - g_lse.astype(jnp.float32)
 
     if causal:
         # dkv grid streams q-blocks (j) per kv-block (i): q-blocks strictly
@@ -387,3 +394,67 @@ def _fa_bwd(causal, scale, block_q, block_k, res, do):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ------------------------------------------------- out + LSE (for SP paths)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(q, k, v, causal=False, scale=None, block_q=None,
+                        block_k=None):
+    """Like flash_attention but ALSO returns the per-row log-sum-exp
+    (B, H, S) fp32 — the sufficient statistic ring attention's online
+    combine needs. Both outputs are differentiable: the LSE cotangent
+    folds into the existing backward kernels as a delta shift (see
+    _fa_bwd_call). Requires flash_attention_supported(q.shape)."""
+    return _fa_lse_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _fa_lse_fwd(q, k, v, causal, scale, block_q, block_k):
+    B, H, S, D = q.shape
+    block_q, block_k = _resolve_blocks(S, block_q, block_k)
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    out, lse = _fa_call(q, k, v, causal, scale, block_q, block_k)
+    return (out, lse.reshape(B, H, S)), (q, k, v, out, lse)
+
+
+def _fa_lse_bwd(causal, scale, block_q, block_k, res, cts):
+    do, dlse = cts
+    q, k, v, o, lse = res
+    B, H, S, D = q.shape
+    block_q, block_k = _resolve_blocks(S, block_q, block_k)
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    return _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                        g_lse=dlse.reshape(B * H, 1, S))
+
+
+flash_attention_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
+
+
+def _dense_with_lse(q, k, v, causal, scale):
+    """Differentiable XLA fallback returning (out, lse) — same contract as
+    flash_attention_lse for shapes/platforms the kernels can't take."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-37)
+    out = jnp.einsum("bhqk,bhkd->bhqd", (p / l).astype(v.dtype), v)
+    lse = (m_safe + jnp.log(l))[..., 0]
+    return out.astype(q.dtype), lse
+
+
+def attention_with_lse(q, k, v, causal=False, scale=None):
+    """(out, lse) via the Pallas kernels when supported, dense otherwise.
+    The local step of ring/Ulysses sequence parallelism — per-shard memory
+    is O(block^2), not O((S/n)^2), when the kernel engages."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if flash_attention_supported(q.shape):
+        return flash_attention_lse(q, k, v, causal, scale)
+    return _dense_with_lse(q, k, v, causal, scale)

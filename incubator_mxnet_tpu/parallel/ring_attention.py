@@ -54,14 +54,35 @@ def _combine(o1, m1, l1, o2, m2, l2):
     return o, m, l
 
 
+def _local_partials(q, k, v, scale, causal):
+    """One local attention step as an online-softmax partial triple
+    (o, m, l). Rides the Pallas flash kernel when the local shard shape
+    supports it — (out, lse) from the kernel is the equivalent partial
+    (out, lse, 1): out*1*e^lse == numerator, 1*e^lse == denominator —
+    so per-shard memory is O(block^2), not O((S/n)^2). Dense fallback
+    otherwise (small shards / non-TPU)."""
+    from ..ops.attention import attention_with_lse, flash_attention_supported
+    if flash_attention_supported(q.shape):
+        out, lse = attention_with_lse(q, k, v, causal=causal, scale=scale)
+        return (out.astype(jnp.float32), lse[..., None],
+                jnp.ones(lse.shape + (1,), jnp.float32))
+    return local_attention(q, k, v, scale=scale, causal=causal)
+
+
 def _ring_attention_sharded(q, k, v, axis_name, causal, scale):
-    """Runs inside shard_map: local blocks + ring exchange of K/V."""
+    """Runs inside shard_map: local blocks + ring exchange of K/V.
+
+    Causal masking is decomposed at BLOCK granularity (no in-kernel offset
+    support needed): the shard's own K/V block uses the plain causal mask,
+    earlier shards (src < idx) are fully visible (dense step), later shards
+    contribute nothing (skipped partial) — the standard ring-attention
+    causal decomposition."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    sq = q.shape[2]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
 
-    o0, m0, l0 = local_attention(q, k, v, scale=scale, causal=causal,
-                                 q_offset=idx * sq, kv_offset=idx * sq)
+    o0, m0, l0 = _local_partials(q, k, v, scale, causal)
 
     def body(i, carry):
         o, m, l, kk, vv = carry
@@ -70,8 +91,16 @@ def _ring_attention_sharded(q, k, v, axis_name, causal, scale):
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
         src = (idx - i - 1) % n  # which shard we now hold
-        oi, mi, li = local_attention(q, kk, vv, scale=scale, causal=causal,
-                                     q_offset=idx * sq, kv_offset=src * sq)
+        if causal:
+            oi, mi, li = lax.cond(
+                src < idx,
+                lambda kk, vv: _local_partials(q, kk, vv, scale, False),
+                lambda kk, vv: (jnp.zeros_like(o),
+                                jnp.full_like(m, -jnp.inf),
+                                jnp.zeros_like(l)),
+                kk, vv)
+        else:
+            oi, mi, li = _local_partials(q, kk, vv, scale, False)
         o, m, l = _combine(o, m, l, oi, mi, li)
         return o, m, l, kk, vv
 
@@ -90,5 +119,7 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     fn = functools.partial(_ring_attention_sharded, axis_name=axis,
                            causal=causal, scale=scale)
     spec = P(None, None, axis, None)
+    # check_vma=False: pallas_call out_shapes carry no vma annotation, and
+    # the local flash kernel runs inside this shard_map
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -43,8 +43,15 @@ def _ulysses_sharded(q, k, v, axis_name, causal, scale):
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    o, m, l = local_attention(qh, kh, vh, scale=scale, causal=causal)
-    out = (o / jnp.maximum(l, 1e-37)).astype(q.dtype)
+    # full-sequence local attention on H/n heads: the Pallas flash kernel
+    # when the (B, H/n, S, D) shape supports it — O(block^2) VMEM instead
+    # of the dense path's O(S^2) HBM score block
+    from ..ops.attention import flash_attention, flash_attention_supported
+    if flash_attention_supported(qh.shape):
+        out = flash_attention(qh, kh, vh, causal, scale)
+    else:
+        o, m, l = local_attention(qh, kh, vh, scale=scale, causal=causal)
+        out = (o / jnp.maximum(l, 1e-37)).astype(q.dtype)
     return heads_to_seq(out)
 
 
@@ -63,5 +70,6 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     fn = functools.partial(_ulysses_sharded, axis_name=axis, causal=causal,
                            scale=scale)
     spec = P(None, None, axis, None)
+    # check_vma=False: the local flash pallas_call carries no vma annotation
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         out_specs=spec, check_vma=False)(q, k, v)
