@@ -1,0 +1,39 @@
+"""Capture a device trace of the ResNet-50 train step on the real chip.
+
+Usage: python benchmark/profile_resnet.py [batch] [outdir]
+Writes an xplane/trace.json.gz profile under outdir (default /tmp/rn50_prof);
+feed the trace.json.gz to benchmark/roofline.py for the per-fusion table in
+docs/PERF_RESNET.md.
+"""
+import sys
+import numpy as onp
+import jax
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, jit
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    outdir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/rn50_prof"
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    x = nd.random.normal(shape=(batch, 3, 224, 224)).astype("bfloat16")
+    y = nd.array(onp.random.randint(0, 1000, batch).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "multi_precision": True})
+    step = jit.TrainStep(net, loss_fn, trainer)
+    for _ in range(3):
+        float(step(x, y).mean().asscalar())
+    with jax.profiler.trace(outdir):
+        for _ in range(5):
+            loss = step(x, y)
+        float(loss.mean().asscalar())
+    print("profile written to", outdir)
+
+
+if __name__ == "__main__":
+    main()
