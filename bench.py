@@ -172,6 +172,7 @@ def main():
     gc.collect()
     tok_s, bert_mfu = bench_transformer(peak)
     lc_tok_s = bench_long_context()
+    int8_res = bench_int8()
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(img_s, 2),
@@ -201,6 +202,7 @@ def main():
                     "would be ~4x; attention-linear scaling predicts the "
                     "observed ratio",
         },
+        "int8": int8_res,
     }))
 
 
@@ -272,6 +274,70 @@ def bench_long_context():
         del step, trainer, net, tokens, loss
         gc.collect()
     return out
+
+
+def bench_int8():
+    """Native int8 (int32-accumulated) MXU matmul vs bf16 — the kernel the
+    quantized_* op family lowers to (ndarray/contrib.py; numerics covered
+    by tests/test_contrib_ops.py). 40 chained 4096^3 matmuls inside one
+    program amortize the remote-dispatch overhead."""
+    import numpy as onp
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    N, ITERS = 4096, 40
+    key = jax.random.PRNGKey(0)
+    xb = jax.random.normal(key, (N, N), jnp.bfloat16)
+    wb = jax.random.normal(key, (N, N), jnp.bfloat16)
+    xi = jax.random.randint(key, (N, N), -127, 127, jnp.int8)
+    wi = jax.random.randint(key, (N, N), -127, 127, jnp.int8)
+
+    # each loop carries the dependency through ONE row of the lhs (defeats
+    # CSE/hoisting) so per-iter contamination is a 4 KB row update, equal
+    # for both dtypes
+    @jax.jit
+    def loop_b(a, b):
+        def body(i, a):
+            p = lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+            row = (p[0:1] * 1e-6).astype(jnp.bfloat16)
+            return lax.dynamic_update_slice(a, row, (0, 0))
+        return lax.fori_loop(0, ITERS, body, a)[0, 0]
+
+    @jax.jit
+    def loop_i(a, b):
+        def body(i, a):
+            p = lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+            row = (p[0:1] >> 20).astype(jnp.int8)
+            return lax.dynamic_update_slice(a, row, (0, 0))
+        return lax.fori_loop(0, ITERS, body, a)[0, 0]
+
+    def once(f, a, b):
+        t0 = time.perf_counter()
+        onp.asarray(f(a, b))
+        return (time.perf_counter() - t0) / ITERS
+
+    # chip load through the shared tunnel drifts minute-to-minute: run the
+    # two dtypes back-to-back in pairs and take the median ratio (paired
+    # alternation cancels the drift); absolutes report the fastest pair
+    once(loop_b, xb, wb); once(loop_i, xi, wi)  # warm both programs
+    pairs = [(once(loop_b, xb, wb), once(loop_i, xi, wi)) for _ in range(5)]
+    ratios = sorted(b / i for b, i in pairs)
+    db = min(b for b, _ in pairs)
+    di = min(i for _, i in pairs)
+    ratio = ratios[len(ratios) // 2]
+    fl = 2 * N ** 3
+    return {"metric": "int8_matmul_vs_bf16_speedup",
+            "value": round(ratio, 2),
+            "best_pair": round(ratios[-1], 2),
+            "bf16_tflops": round(fl / db / 1e12, 1),
+            "int8_tops": round(fl / di / 1e12, 1),
+            "note": "4096^3 dot_general int8/int32-accum vs bf16, both as "
+                    "40-deep chained loops in one program; the shared chip's "
+                    "co-tenant load deflates the ratio toward 1.0 (wait time "
+                    "is dtype-blind) — 1.77x measured in a quiet window "
+                    "(docs/PERF_RESNET.md sibling artifact)"}
 
 
 if __name__ == "__main__":

@@ -39,6 +39,11 @@ ENV_VARS = {
         int, 0,
         "Override the flash-attention k-block size. 0 = auto. Must "
         "divide S."),
+    "MXTPU_INT8_SIM": (
+        bool, False,
+        "Force the fp32-simulated path for quantized matmul/conv instead "
+        "of native int8 dot_general with int32 accumulation "
+        "(ndarray/contrib.py quantized_* ops)."),
     "MXTPU_NO_NATIVE": (
         bool, False,
         "Disable the native C++ library even if it builds (forces the "

@@ -318,3 +318,53 @@ def test_new_ops_gradients_flow():
     assert onp.abs(mu.grad.asnumpy()).sum() > 0
     # numeric check: dLL/dmu_k = n_events_k / mu_k - T at mu=1 → [1-3, 1-3]
     onp.testing.assert_allclose(mu.grad.asnumpy()[0], [-2.0, -2.0], rtol=1e-3)
+
+
+def test_quantized_native_int8_vs_simulated(monkeypatch):
+    """r3: quantized matmul/conv run NATIVELY in int8 (int32 accumulation).
+    The native path must agree with the fp32-simulated fallback to within
+    rounding (the integer accumulation is exact; the sim path rounds in
+    f32), and the int8 x int8 -> int32 product must be exactly the integer
+    matmul of the quantized operands."""
+    from incubator_mxnet_tpu.contrib import quantization as q
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(7)
+    x = nd.array(rng.randn(3, 16).astype("float32"))
+    w = nd.array(rng.randn(5, 16).astype("float32") * 0.4)
+    xq, xmn, xmx = q.quantize(x)
+    wq, wmn, wmx = q.quantize(w)
+
+    out_n, mn_n, mx_n = c.quantized_fully_connected(
+        xq, wq, None, xmn, xmx, wmn, wmx, num_hidden=5, no_bias=True)
+    monkeypatch.setenv("MXTPU_INT8_SIM", "1")
+    out_s, mn_s, mx_s = c.quantized_fully_connected(
+        xq, wq, None, xmn, xmx, wmn, wmx, num_hidden=5, no_bias=True)
+    monkeypatch.delenv("MXTPU_INT8_SIM")
+    dn = q.dequantize(out_n, mn_n, mx_n).asnumpy()
+    ds = q.dequantize(out_s, mn_s, mx_s).asnumpy()
+    assert onp.abs(dn - ds).max() < 0.05
+
+    # exact integer accumulation check
+    acc = xq.asnumpy().astype(onp.int32) @ wq.asnumpy().astype(onp.int32).T
+    sx = max(abs(float(xmn.asnumpy()[0])), abs(float(xmx.asnumpy()[0]))) / 127.0
+    sw = max(abs(float(wmn.asnumpy()[0])), abs(float(wmx.asnumpy()[0]))) / 127.0
+    want = acc * sx * sw
+    assert onp.abs(dn - want).max() < (abs(want).max() / 127.0 + 1e-6)
+
+    # conv: native vs sim
+    img = nd.array(rng.rand(2, 3, 8, 8).astype("float32"))
+    k = nd.array(rng.randn(4, 3, 3, 3).astype("float32") * 0.3)
+    iq, imn, imx = q.quantize(img)
+    kq, kmn, kmx = q.quantize(k)
+    co_n, cn0, cn1 = c.quantized_conv(iq, kq, None, imn, imx, kmn, kmx,
+                                      kernel=(3, 3), num_filter=4,
+                                      no_bias=True, pad=(1, 1))
+    monkeypatch.setenv("MXTPU_INT8_SIM", "1")
+    co_s, cs0, cs1 = c.quantized_conv(iq, kq, None, imn, imx, kmn, kmx,
+                                      kernel=(3, 3), num_filter=4,
+                                      no_bias=True, pad=(1, 1))
+    monkeypatch.delenv("MXTPU_INT8_SIM")
+    a = q.dequantize(co_n, cn0, cn1).asnumpy()
+    b = q.dequantize(co_s, cs0, cs1).asnumpy()
+    assert a.shape == (2, 4, 8, 8)
+    assert onp.abs(a - b).max() < 0.05
