@@ -39,6 +39,11 @@ ENV_VARS = {
         int, 0,
         "Override the flash-attention k-block size. 0 = auto. Must "
         "divide S."),
+    "MXTPU_ASYNC_STALENESS": (
+        int, 4,
+        "dist_async staleness bound: pushes per key between cross-process "
+        "parameter averages (kvstore.DistAsyncKVStore — the local-SGD "
+        "analog of the reference's async parameter server)."),
     "MXTPU_INT8_SIM": (
         bool, False,
         "Force the fp32-simulated path for quantized matmul/conv instead "

@@ -69,6 +69,37 @@ def main():
     assert onp.allclose(outc.asnumpy(), want), (outc.asnumpy(), want)
     print("RESULT compress %d ok" % rank, flush=True)
 
+    # -- 2c. dist_async: bounded-staleness local-SGD ---------------------
+    # staleness=2: push #1 leaves workers DIVERGED (local apply only);
+    # push #2 triggers the cross-process average -> RECONVERGED
+    kva = mx.kv.create("dist_async")
+    kva._staleness = 2
+    kva.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    rng4 = onp.random.RandomState(11)
+    kva.init(0, nd.array(rng4.randn(4, 2).astype("float32")))
+    wa = nd.zeros((4, 2))
+    kva.push(0, nd.full((4, 2), float(rank + 1)))     # local only
+    kva.pull(0, out=wa)
+    d1 = hashlib.sha1(onp.ascontiguousarray(wa.asnumpy())).hexdigest()
+    print("RESULT async_diverged %d %s" % (rank, d1), flush=True)
+    kva.push(0, nd.full((4, 2), float(rank + 1)))     # triggers average
+    kva.pull(0, out=wa)
+    d2 = hashlib.sha1(onp.ascontiguousarray(wa.asnumpy())).hexdigest()
+    print("RESULT async_synced %d %s" % (rank, d2), flush=True)
+    # the average equals init - 0.1*(g1+g2)/nworkers summed over workers:
+    # verify against the closed form so "synced" isn't just "both zero"
+    w_init = onp.random.RandomState(11).randn(4, 2).astype("float32")
+    per_rank = [w_init - 0.2 * (r + 1) for r in range(nworkers)]
+    want = sum(per_rank) / nworkers
+    assert onp.allclose(wa.asnumpy(), want, atol=1e-5), \
+        (wa.asnumpy(), want)
+    # sync() forces a full average even mid-window
+    kva.push(0, nd.full((4, 2), float(rank + 1)))
+    kva.sync()
+    kva.pull(0, out=wa)
+    d3 = hashlib.sha1(onp.ascontiguousarray(wa.asnumpy())).hexdigest()
+    print("RESULT async_forced %d %s" % (rank, d3), flush=True)
+
     # -- 3. global-mesh SPMD collective across processes ----------------
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

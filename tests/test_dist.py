@@ -49,3 +49,18 @@ def test_dist_sync_two_processes():
     assert len(digests) == NWORKERS, out
     assert len(set(digests.values())) == 1, \
         "params diverged across workers: %s" % digests
+
+    # dist_async (bounded staleness): diverged after 1 local push,
+    # reconverged after the staleness-triggered average, and again after
+    # the forced sync()
+    div = {r: v for c, r, v in results if c == "async_diverged"}
+    syn = {r: v for c, r, v in results if c == "async_synced"}
+    frc = {r: v for c, r, v in results if c == "async_forced"}
+    assert len(div) == NWORKERS and len(syn) == NWORKERS \
+        and len(frc) == NWORKERS, out
+    assert len(set(div.values())) == NWORKERS, \
+        "dist_async should diverge between averages: %s" % div
+    assert len(set(syn.values())) == 1, \
+        "dist_async diverged after averaging: %s" % syn
+    assert len(set(frc.values())) == 1, \
+        "dist_async diverged after forced sync: %s" % frc

@@ -465,3 +465,33 @@ def test_ulysses_attention_flash_local_step(monkeypatch):
         for a, b in zip(g, gr):
             rel = float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)))
             assert rel < 1e-3, causal
+
+
+def test_dist_async_kvstore_priority_and_staleness():
+    """r3: DistAsyncKVStore (the dist_async/P3 analog) — staleness window
+    counts per key, sync resets counters, and the averaging propagates
+    priority classes in DESCENDING order (P3's overlap idea). Single
+    process: the collective itself degenerates, so we observe the batching
+    order via a recording stub."""
+    from incubator_mxnet_tpu.kvstore.kvstore import DistAsyncKVStore
+    kv = DistAsyncKVStore(staleness=3)
+    assert kv.type.startswith("dist_async")
+    kv.init("low", nd.zeros((2,)))
+    kv.init("hi", nd.zeros((2,)))
+    order = []
+    kv._num_workers = 2  # force the sync path; record instead of allgather
+    kv._average_batch = lambda keys: order.append(tuple(keys))
+    kv._key_priority["hi"] = 5   # P3: later layers pushed at higher prio
+    for step in range(3):
+        kv.push(["low", "hi"], [nd.ones((2,)), nd.ones((2,))])
+    # both keys hit the staleness bound in the same push -> hi first
+    assert order == [("hi",), ("low",)], order
+    assert kv._push_count["low"] == 0 and kv._push_count["hi"] == 0
+    order.clear()
+    kv.push("low", nd.ones((2,)), priority=0)
+    kv.sync()   # forced full sync mid-window, still priority-ordered
+    assert order == [("hi",), ("low",)], order
+    # mx.kv.create routes the reference's store names
+    import incubator_mxnet_tpu as mx
+    assert type(mx.kv.create("dist_async")).__name__ == "DistAsyncKVStore"
+    assert type(mx.kv.create("dist_device_sync")).__name__ == "DistKVStore"
