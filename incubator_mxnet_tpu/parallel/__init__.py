@@ -14,7 +14,7 @@ from .data_parallel import DataParallelTrainStep  # noqa
 from .tensor_parallel import ColParallelDense, RowParallelDense, shard_params  # noqa
 from .ring_attention import ring_attention, local_attention  # noqa
 from .ulysses import ulysses_attention  # noqa
-from .pipeline import PipelineParallel, pipeline_spmd  # noqa
+from .pipeline import PipelineParallel, pipeline_spmd, pipeline_1f1b_grads  # noqa
 from .gluon_pipeline import PipelineStack  # noqa
 from .moe import MoELayer, load_balancing_loss  # noqa
 from .compression import GradientCompression  # noqa

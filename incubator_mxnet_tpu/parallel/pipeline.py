@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["PipelineParallel", "pipeline_spmd"]
+__all__ = ["PipelineParallel", "pipeline_spmd", "pipeline_1f1b_grads"]
 
 
 def _pipeline_sharded(x_mb, stacked_params, key, stage_fn, axis_name,
@@ -147,3 +147,163 @@ class PipelineParallel:
     def __call__(self, stacked_params, x):
         return pipeline_spmd(self.stage_fn, stacked_params, x, self.mesh,
                              self.n_microbatches, self.axis)
+
+
+# ----------------------------------------------------------------- 1F1B
+def _pipeline_1f1b_sharded(x_mb, y_mb, stacked_params, stage_fn, loss_fn,
+                           axis_name):
+    """Hand-scheduled 1F1B (PipeDream-flush) inside shard_map.
+
+    Non-interleaved 1F1B timing on the ring: stage s runs F_i at global
+    tick t = s + 2i and B_i at t = 2(p+i) - s - 1 — per stage the two
+    predicates have opposite tick parity, so each tick is one F, one B, or
+    idle. Activations shift +1 on the ring every tick, gradients shift -1;
+    a value produced at tick t is consumed by its neighbour at exactly
+    t+1 in both directions (ticks on other parities carry garbage that no
+    predicate ever reads). Total ticks 2(m+p-1): the SAME bubble fraction
+    as GPipe-by-autodiff — 1F1B's win is the activation stash, which is
+    bounded by p slots per stage instead of GPipe's m (in-flight
+    microbatches at stage s: ceil((2(p-s)-1)/2) <= p).
+
+    The backward recomputes each stage under jax.vjp from the stashed
+    INPUT at its B tick (activation recompute, the standard memory/compute
+    trade); the last stage folds loss_fn into its vjp so the loss gradient
+    needs no self-handoff on the ring.
+
+    Returns (mean loss over microbatches, param grads summed over
+    microbatches (each stage holds its own slice), dx per microbatch for
+    composing with an upstream embedding).
+    """
+    p = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda q: q[0], stacked_params)
+    m = x_mb.shape[0]
+    mb_shape = x_mb.shape[1:]
+    K = p  # stash slots: the 1F1B in-flight bound
+    total_ticks = 2 * (m + p - 1)
+    fwd_perm = [(j, (j + 1) % p) for j in range(p)]
+    bwd_perm = [(j, (j - 1) % p) for j in range(p)]
+
+    def tick(t, carry):
+        a_reg, g_reg, stash, pgrads, dx_buf, loss_acc = carry
+        iF = (t - s) // 2
+        is_F = ((t - s) % 2 == 0) & (t >= s) & (iF < m)
+        iF = jnp.clip(iF, 0, m - 1)
+        iB = (t + s + 1 - 2 * p) // 2
+        is_B = ((t + s + 1 - 2 * p) % 2 == 0) & (iB >= 0) & (iB < m)
+        iB = jnp.clip(iB, 0, m - 1)
+
+        finp = jnp.where(s == 0, x_mb[iF], a_reg)
+
+        def do_F(stash):
+            out = stage_fn(params, finp)
+            return out, stash.at[iF % K].set(finp)
+
+        def no_F(stash):
+            return jnp.zeros(mb_shape, x_mb.dtype), stash
+
+        a_out, stash = lax.cond(is_F, do_F, no_F, stash)
+
+        def do_B(pgrads, dx_buf, loss_acc):
+            binp = stash[iB % K]
+
+            def last_branch(binp):
+                # fold the loss into the stage vjp: the loss gradient needs
+                # no self-handoff on the ring
+                lv, vjp = jax.vjp(
+                    lambda q, x: loss_fn(stage_fn(q, x), y_mb[iB]),
+                    params, binp)
+                dpar, dx = vjp(jnp.ones_like(lv))
+                return lv.astype(jnp.float32), dpar, dx
+
+            def mid_branch(binp):
+                # vjp at cotangent g_reg, phrased as a scalar vdot so both
+                # branches share the (loss, dpar, dx) structure
+                lv, vjp = jax.vjp(
+                    lambda q, x: jnp.vdot(
+                        stage_fn(q, x).astype(jnp.float32),
+                        lax.stop_gradient(g_reg).astype(jnp.float32)),
+                    params, binp)
+                dpar, dx = vjp(jnp.float32(1.0))
+                return jnp.float32(0.0), dpar, dx
+
+            lv, dpar, dx = lax.cond(s == p - 1, last_branch, mid_branch,
+                                    binp)
+            pgrads = jax.tree_util.tree_map(lambda g, d: g + d, pgrads,
+                                            dpar)
+            dx_buf = jnp.where(s == 0, dx_buf.at[iB].set(dx), dx_buf)
+            return dx, pgrads, dx_buf, loss_acc + lv
+
+        def no_B(pgrads, dx_buf, loss_acc):
+            return (jnp.zeros(mb_shape, x_mb.dtype), pgrads, dx_buf,
+                    loss_acc)
+
+        g_out, pgrads, dx_buf, loss_acc = lax.cond(
+            is_B, do_B, no_B, pgrads, dx_buf, loss_acc)
+
+        a_reg = lax.ppermute(a_out, axis_name, fwd_perm)
+        g_reg = lax.ppermute(g_out.astype(x_mb.dtype), axis_name, bwd_perm)
+        return a_reg, g_reg, stash, pgrads, dx_buf, loss_acc
+
+    zeros_mb = jnp.zeros(mb_shape, x_mb.dtype)
+    carry0 = (
+        lax.pcast(zeros_mb, (axis_name,), to="varying"),
+        lax.pcast(zeros_mb, (axis_name,), to="varying"),
+        lax.pcast(jnp.zeros((K,) + mb_shape, x_mb.dtype), (axis_name,),
+                  to="varying"),
+        jax.tree_util.tree_map(
+            lambda q: lax.pcast(jnp.zeros_like(q, jnp.float32),
+                                (axis_name,), to="varying"), params),
+        lax.pcast(jnp.zeros((m,) + mb_shape, x_mb.dtype), (axis_name,),
+                  to="varying"),
+        lax.pcast(jnp.float32(0.0), (axis_name,), to="varying"),
+    )
+    _, _, _, pgrads, dx_buf, loss_acc = lax.fori_loop(
+        0, total_ticks, tick, carry0)
+    # loss lives on the last stage; dx on stage 0 — broadcast both
+    loss = lax.psum(jnp.where(s == p - 1, loss_acc, 0.0), axis_name) / m
+    dx_buf = lax.psum(jnp.where(s == 0, dx_buf, jnp.zeros_like(dx_buf)),
+                      axis_name)
+    # re-stack param grads: each stage contributes its own slice
+    pgrads = jax.tree_util.tree_map(lambda g: g[None], pgrads)
+    return loss, pgrads, dx_buf
+
+
+def pipeline_1f1b_grads(stage_fn, loss_fn, stacked_params, x, y, mesh,
+                        n_microbatches, axis="pp"):
+    """1F1B pipeline train-step core: returns (loss, stage param grads,
+    input grads). Same bubble as the GPipe/autodiff path (2(m+p-1) ticks);
+    activation stash bounded by n_stages slots per stage instead of
+    n_microbatches — the 1F1B memory win (see _pipeline_1f1b_sharded).
+
+    stage_fn(params, x)->y shape-preserving; loss_fn(out, y_mb)->scalar
+    (applied on the last stage); stacked_params leading dim = pp axis size;
+    x/y: (batch, ...) split into n_microbatches on dim 0.
+    """
+    from jax.sharding import NamedSharding
+
+    p = int(mesh.shape[axis])
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != p:
+        raise ValueError("stacked_params leading dim must equal the %r "
+                         "axis size %d" % (axis, p))
+    if x.shape[0] % n_microbatches:
+        raise ValueError("batch %d not divisible by n_microbatches %d"
+                         % (x.shape[0], n_microbatches))
+    mb = x.shape[0] // n_microbatches
+    x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
+    y_mb = y.reshape((n_microbatches, mb) + y.shape[1:])
+    param_specs = jax.tree_util.tree_map(
+        lambda q: P(axis, *([None] * (q.ndim - 1))), stacked_params)
+    x_mb = jax.device_put(x_mb, NamedSharding(mesh, P()))
+    y_mb = jax.device_put(y_mb, NamedSharding(mesh, P()))
+    stacked_params = jax.tree_util.tree_map(
+        lambda q, sp: jax.device_put(q, NamedSharding(mesh, sp)),
+        stacked_params, param_specs)
+    fn = functools.partial(_pipeline_1f1b_sharded, stage_fn=stage_fn,
+                           loss_fn=loss_fn, axis_name=axis)
+    loss, pgrads, dx = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(), P(), param_specs),
+        out_specs=(P(), param_specs, P()), check_vma=False)(
+            x_mb, y_mb, stacked_params)
+    return loss, pgrads, dx.reshape((x.shape[0],) + dx.shape[2:])
