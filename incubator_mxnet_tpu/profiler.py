@@ -12,18 +12,24 @@ import threading
 import time
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
-           "scope", "Marker", "record_event", "device_memory"]
+           "scope", "Marker", "record_event", "device_memory",
+           "memory_summary", "set_memory_source"]
 
 _CONFIG = {"filename": "profile.json", "aggregate_stats": True,
            # profile_imperative: instrument EVERY eager op at the _apply
            # choke point (ref per-op engine profiling, profiler.h:251).
            # Each op is synced to time real device work — turn off to
            # profile async pipelining instead.
-           "profile_imperative": True}
-_STATE = {"running": False, "jax_trace_dir": None}
+           "profile_imperative": True,
+           # profile_memory: sample PJRT device memory after each profiled
+           # op (≙ storage_profiler.h GpuDeviceStorageProfiler) — emits
+           # chrome-trace counter events and a Mem column in the aggregate
+           "profile_memory": True}
+_STATE = {"running": False, "jax_trace_dir": None, "peak_bytes": 0}
 _EVENTS = []
 _LOCK = threading.Lock()
 _AGG = {}
+_MEM_SOURCE = None  # injectable for tests / non-PJRT backends
 
 
 def set_config(**kwargs):
@@ -127,13 +133,66 @@ def record_op(name, t0_us, outs):
     except Exception:
         pass
     prefix = getattr(scope._current, "value", "")
-    record_event("op:" + prefix + name, "operator", t0_us,
-                 time.time() * 1e6 - t0_us)
+    full = "op:" + prefix + name
+    record_event(full, "operator", t0_us, time.time() * 1e6 - t0_us)
+    if _CONFIG.get("profile_memory", True):
+        _sample_memory(full)
+
+
+def set_memory_source(fn):
+    """Override where memory samples come from (fn() -> bytes_in_use int,
+    or -> {'bytes_in_use': int, 'peak_bytes_in_use': int}). Used by tests
+    and by backends whose PJRT client reports no memory_stats (CPU)."""
+    global _MEM_SOURCE
+    _MEM_SOURCE = fn
+
+
+def _mem_now():
+    """(bytes_in_use, peak_bytes_in_use) summed over local devices, or None."""
+    if _MEM_SOURCE is not None:
+        s = _MEM_SOURCE()
+        if isinstance(s, dict):
+            return (int(s.get("bytes_in_use", 0)),
+                    int(s.get("peak_bytes_in_use",
+                              s.get("bytes_in_use", 0))))
+        return int(s), int(s)
+    import jax
+    live = peak = 0
+    seen = False
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats() or {}
+        except Exception:
+            s = {}
+        if "bytes_in_use" in s:
+            seen = True
+            live += s["bytes_in_use"]
+            peak += s.get("peak_bytes_in_use", s["bytes_in_use"])
+    return (live, peak) if seen else None
+
+
+def _sample_memory(op_name):
+    """Attach a live-memory sample to the op's aggregate row and emit a
+    chrome-trace counter event (the storage-profiler view)."""
+    mem = _mem_now()
+    if mem is None:
+        return
+    live, peak = mem
+    with _LOCK:
+        _STATE["peak_bytes"] = max(_STATE["peak_bytes"], peak, live)
+        agg = _AGG.get(op_name)
+        if agg is not None:
+            agg["mem_bytes"] = live
+            agg["peak_mem_bytes"] = max(agg.get("peak_mem_bytes", 0), live)
+        if len(_EVENTS) < _CONFIG.get("max_events", 500_000):
+            _EVENTS.append({"name": "device_memory", "ph": "C",
+                            "ts": time.time() * 1e6, "pid": 0,
+                            "args": {"bytes_in_use": live}})
 
 
 def device_memory():
     """Per-device memory stats (bytes_in_use/peak) via PJRT
-    (≙ the reference's memory profiler counters, profiler.h MemoryProfiler)."""
+    (≙ the reference's storage profiler, src/profiler/storage_profiler.h)."""
     import jax
     out = {}
     for d in jax.local_devices():
@@ -147,6 +206,22 @@ def device_memory():
     return out
 
 
+def memory_summary():
+    """Formatted per-device memory table + the profiled-run peak (the
+    reference's storage-profiler dump)."""
+    lines = ["%-24s %14s %14s %14s"
+             % ("Device", "Live(MB)", "Peak(MB)", "Limit(MB)")]
+    mb = 1.0 / (1024 * 1024)
+    for dev, s in device_memory().items():
+        lines.append("%-24s %14.1f %14.1f %14.1f"
+                     % (dev, s.get("bytes_in_use", 0) * mb,
+                        s.get("peak_bytes_in_use", 0) * mb,
+                        s.get("bytes_limit", 0) * mb))
+    lines.append("profiled-run peak: %.1f MB"
+                 % (_STATE["peak_bytes"] * mb))
+    return "\n".join(lines)
+
+
 def pause(profile_process="worker"):
     _STATE["running"] = False
 
@@ -156,25 +231,36 @@ def resume(profile_process="worker"):
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate stats table (ref aggregate_stats.cc), busiest first."""
-    lines = ["%-48s %8s %12s %10s %10s"
-             % ("Name", "Calls", "Total(us)", "Avg(us)", "Max(us)")]
+    """Aggregate stats table (ref aggregate_stats.cc), busiest first.
+    The Mem column is the device bytes_in_use sampled after the op's most
+    recent execution (storage-profiler view; '-' when the backend reports
+    no memory stats and no source was injected)."""
+    lines = ["%-48s %8s %12s %10s %10s %10s"
+             % ("Name", "Calls", "Total(us)", "Avg(us)", "Max(us)",
+                "Mem(MB)")]
+    mb = 1.0 / (1024 * 1024)
     with _LOCK:
         order = sorted(_AGG.items(), key=lambda kv: -kv[1]["total_us"])
         for name, agg in order:
-            lines.append("%-48s %8d %12.1f %10.1f %10.1f"
+            mem = ("%10.1f" % (agg["mem_bytes"] * mb)) \
+                if "mem_bytes" in agg else "%10s" % "-"
+            lines.append("%-48s %8d %12.1f %10.1f %10.1f %s"
                          % (name[:48], agg["count"], agg["total_us"],
                             agg["total_us"] / max(agg["count"], 1),
-                            agg["max_us"]))
+                            agg["max_us"], mem))
         if reset:
             _AGG.clear()
     return "\n".join(lines)
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write chrome://tracing JSON (ref profiler.h EmitEvents)."""
+    """Write chrome://tracing JSON (ref profiler.h EmitEvents). Includes
+    device_memory counter events recorded per op and a final per-device
+    snapshot under 'deviceMemory' (storage_profiler.h analog)."""
     with _LOCK:
-        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms",
+                   "deviceMemory": device_memory(),
+                   "profiledPeakBytes": _STATE["peak_bytes"]}
         with open(_CONFIG["filename"], "w") as f:
             json.dump(payload, f)
         if finished:

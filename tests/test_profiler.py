@@ -62,3 +62,50 @@ def test_env_registry():
     import pytest
     with pytest.raises(KeyError):
         config.get_env("NOT_A_VAR")
+
+
+def test_memory_profiler_tracks_peak(tmp_path):
+    """r3 (storage_profiler.h analog): per-op memory samples ride the
+    aggregate table (Mem column), chrome-trace counter events land in the
+    dump, and the profiled-run peak tracks a known allocation. The CPU
+    PJRT client reports no memory stats, so the test injects a source that
+    mimics a growing live set."""
+    import json
+    sizes = iter([100 << 20, 300 << 20, 200 << 20, 200 << 20, 200 << 20,
+                  200 << 20, 200 << 20, 200 << 20])
+    last = [0]
+
+    def fake_stats():
+        last[0] = next(sizes, last[0])
+        return {"bytes_in_use": last[0], "peak_bytes_in_use": last[0]}
+
+    profiler.dumps(reset=True)
+    profiler._STATE["peak_bytes"] = 0
+    profiler.set_memory_source(fake_stats)
+    profiler.set_state("run")
+    try:
+        a = nd.random.normal(shape=(8, 8))
+        b = (a * 2.0).sum()
+        b.wait_to_read()
+    finally:
+        profiler.set_state("stop")
+        profiler.set_memory_source(None)
+    table = profiler.dumps()
+    mem_lines = [l for l in table.splitlines()
+                 if l.startswith("op:") and "-" not in l.split()[-1]]
+    assert mem_lines, table        # Mem column populated
+    assert "Mem(MB)" in table
+    # peak tracked the 300MB spike even though live fell back to 200MB
+    summary = profiler.memory_summary()
+    assert "profiled-run peak: 300.0 MB" in summary, summary
+    # dump embeds counter events + the snapshot
+    profiler.set_config(filename=str(tmp_path / "prof.json"))
+    profiler.dump()
+    payload = json.load(open(tmp_path / "prof.json"))
+    counters = [e for e in payload["traceEvents"]
+                if e.get("ph") == "C" and e["name"] == "device_memory"]
+    assert counters and counters[0]["args"]["bytes_in_use"] > 0
+    assert payload["profiledPeakBytes"] == 300 << 20
+    assert isinstance(payload["deviceMemory"], dict)
+    profiler.set_config(filename="profile.json")
+    profiler.dumps(reset=True)
