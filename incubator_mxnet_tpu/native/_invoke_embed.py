@@ -1,0 +1,79 @@
+"""Helper module for the embedded-interpreter imperative-invoke C API
+(native/src/c_predict_api.cc MXTPUImperativeInvoke et al. — ref
+include/mxnet/c_api.h MXImperativeInvokeEx + MXNDArrayCreateEx).
+
+The C side holds each array as an opaque PyObject (an incubator_mxnet_tpu
+NDArray) and calls the module-level functions below through the CPython C
+API. This is the slice that lets non-Python frontends run EAGER ops by
+name — the reference's imperative invoke — on top of the same embedded
+interpreter the predict ABI already boots; op dispatch goes through the
+same nd/nd.contrib registry the Python frontend uses, so every registered
+operator is reachable from C (and from the Julia binding riding this ABI).
+
+Array traffic crosses the ABI as raw C-contiguous bytes + (dtype, shape);
+op attributes cross as a JSON object string (the reference passes
+stringified attrs the same way)."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_bytes", "invoke"]
+
+
+def _nd_mod():
+    from incubator_mxnet_tpu import nd
+    return nd
+
+
+def nd_create(dtype, shape, view):
+    """Host bytes -> NDArray (≙ MXNDArrayCreateEx + SyncCopyFromCPU)."""
+    dt = np.dtype(dtype)
+    shape = tuple(int(d) for d in shape)
+    want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    if view.nbytes != want:
+        raise ValueError("got %d bytes, want %d (shape %s dtype %s)"
+                         % (view.nbytes, want, shape, dt.name))
+    arr = np.frombuffer(view, dtype=dt).reshape(shape).copy()
+    return _nd_mod().array(arr, dtype=dt.name)
+
+
+def nd_shape(h):
+    return tuple(int(d) for d in h.shape)
+
+
+def nd_dtype(h):
+    return np.dtype(h.dtype).name
+
+
+def nd_bytes(h):
+    """≙ MXNDArraySyncCopyToCPU."""
+    return np.ascontiguousarray(h.asnumpy()).tobytes()
+
+
+def invoke(op_name, inputs, kwargs_json):
+    """Name-dispatched eager op call (≙ MXImperativeInvokeEx).
+
+    Resolves ``op_name`` on nd, then nd.contrib (dotted names like
+    "contrib.ROIAlign" or "linalg.gemm2" also work); returns a tuple of
+    NDArray outputs."""
+    nd = _nd_mod()
+    target = nd
+    name = op_name
+    if "." in name:
+        prefix, name = name.rsplit(".", 1)
+        for part in prefix.split("."):
+            target = getattr(target, part)
+    fn = getattr(target, name, None)
+    if fn is None and target is nd:
+        fn = getattr(nd.contrib, name, None)
+    if fn is None:
+        raise ValueError("unknown operator %r" % op_name)
+    kwargs = json.loads(kwargs_json) if kwargs_json else {}
+    kwargs = {k: (tuple(v) if isinstance(v, list) else v)
+              for k, v in kwargs.items()}
+    out = fn(*inputs, **kwargs)
+    if isinstance(out, (list, tuple)):
+        return tuple(out)
+    return (out,)

@@ -339,6 +339,185 @@ int MXTPUPredFree(void* handle) {
   return 0;
 }
 
-int mxtpu_predict_abi_version() { return 1; }
+int mxtpu_predict_abi_version() { return 2; }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Imperative invoke slice (ref include/mxnet/c_api.h MXImperativeInvokeEx,
+// MXNDArrayCreateEx, MXNDArraySyncCopyToCPU): name-dispatched EAGER op
+// calls on opaque NDArray handles, so non-Python frontends (cpp_package,
+// julia_package) can run any registered operator — not just exported
+// predict artifacts. Dispatch goes through native/_invoke_embed.py into the
+// same nd/nd.contrib op registry the Python frontend uses.
+// ---------------------------------------------------------------------------
+namespace {
+
+PyObject* invoke_module() {
+  static PyObject* mod = nullptr;
+  if (!mod)
+    mod = PyImport_ImportModule("incubator_mxnet_tpu.native._invoke_embed");
+  return mod;
+}
+
+struct NDHandle {
+  PyObject* arr;  // strong ref to an incubator_mxnet_tpu NDArray
+};
+
+PyObject* call_invoke(const char* fn, PyObject* args) {
+  PyObject* mod = invoke_module();
+  if (!mod) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create an NDArray from host bytes (C-contiguous). ≙ MXNDArrayCreateEx.
+int MXTPUNDCreate(const char* dtype, const int64_t* shape, int ndim,
+                  const void* data, int64_t nbytes, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* shp = PyTuple_New(ndim);
+  if (!shp) return fail_py("MXTPUNDCreate");
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* view = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+  if (!view) {
+    Py_DECREF(shp);
+    return fail_py("MXTPUNDCreate");
+  }
+  PyObject* args = Py_BuildValue("(sNN)", dtype, shp, view);
+  if (!args) return fail_py("MXTPUNDCreate");
+  PyObject* r = call_invoke("nd_create", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUNDCreate");
+  *out = new NDHandle{r};
+  return 0;
+}
+
+int MXTPUNDGetShape(void* handle, int64_t* shape, int cap, int* out_ndim) {
+  auto* h = static_cast<NDHandle*>(handle);
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(O)", h->arr);
+  PyObject* r = call_invoke("nd_shape", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUNDGetShape");
+  Py_ssize_t n = PyTuple_Size(r);
+  *out_ndim = (int)n;
+  if (shape) {
+    if (n > cap) {
+      Py_DECREF(r);
+      return fail("shape buffer too small");
+    }
+    for (Py_ssize_t i = 0; i < n; ++i)
+      shape[i] = PyLong_AsLongLong(PyTuple_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDGetDType(void* handle, char* buf, int cap) {
+  auto* h = static_cast<NDHandle*>(handle);
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(O)", h->arr);
+  PyObject* r = call_invoke("nd_dtype", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUNDGetDType");
+  const char* s = PyUnicode_AsUTF8(r);
+  if (!s || (int)strlen(s) + 1 > cap) {
+    Py_DECREF(r);
+    return fail("dtype buffer too small");
+  }
+  snprintf(buf, cap, "%s", s);
+  Py_DECREF(r);
+  return 0;
+}
+
+// Copy the array out as contiguous bytes; pass data=null to query size.
+// ≙ MXNDArraySyncCopyToCPU.
+int MXTPUNDGetData(void* handle, void* data, int64_t cap,
+                   int64_t* out_nbytes) {
+  auto* h = static_cast<NDHandle*>(handle);
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* args = Py_BuildValue("(O)", h->arr);
+  PyObject* r = call_invoke("nd_bytes", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUNDGetData");
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return fail_py("MXTPUNDGetData");
+  }
+  if (out_nbytes) *out_nbytes = (int64_t)len;
+  if (data) {
+    if (len > cap) {
+      Py_DECREF(r);
+      return fail("data buffer too small: need " + std::to_string(len));
+    }
+    memcpy(data, buf, len);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDFree(void* handle) {
+  auto* h = static_cast<NDHandle*>(handle);
+  if (Py_IsInitialized()) {
+    PyGILState_STATE s = PyGILState_Ensure();
+    Py_XDECREF(h->arr);
+    PyGILState_Release(s);
+  }
+  delete h;
+  return 0;
+}
+
+// Name-dispatched eager op call. kwargs_json: JSON object of op attributes
+// (numbers/strings/lists), may be null/empty. Outputs land in out_handles
+// (capacity cap); *n_out reports how many. ≙ MXImperativeInvokeEx.
+int MXTPUImperativeInvoke(const char* op_name, void** inputs, int n_inputs,
+                          const char* kwargs_json, void** out_handles,
+                          int cap, int* n_out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* ins = PyList_New(n_inputs);
+  if (!ins) return fail_py("MXTPUImperativeInvoke");
+  for (int i = 0; i < n_inputs; ++i) {
+    PyObject* a = static_cast<NDHandle*>(inputs[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(ins, i, a);
+  }
+  PyObject* args = Py_BuildValue(
+      "(sNs)", op_name, ins, kwargs_json ? kwargs_json : "");
+  if (!args) return fail_py("MXTPUImperativeInvoke");
+  PyObject* r = call_invoke("invoke", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUImperativeInvoke");
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > cap) {
+    Py_DECREF(r);
+    return fail("output handle array too small: need " + std::to_string(n));
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyTuple_GetItem(r, i);
+    Py_INCREF(o);
+    out_handles[i] = new NDHandle{o};
+  }
+  *n_out = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+const char* MXTPUNDGetLastError() { return g_err.c_str(); }
 
 }  // extern "C"

@@ -1,0 +1,186 @@
+# Julia binding for incubator_mxnet_tpu (ref julia/ in upstream MXNet).
+#
+# Rides the flat C ABI in libmxtpu_predict.so via ccall — no build step, no
+# binary dependency beyond the shared library the Python side compiles
+# (incubator_mxnet_tpu.native.lib.build_predict()):
+#   * Predictor: load an exported .mxtpu serving artifact and run inference
+#     (MXTPUPred* — ref MXPredCreate family), and
+#   * NDArray + invoke: name-dispatched EAGER operator calls
+#     (MXTPUNDCreate/MXTPUImperativeInvoke — ref MXImperativeInvokeEx), so
+#     any operator registered in the nd/nd.contrib table is callable from
+#     Julia by name.
+#
+# Point MXTPU_PREDICT_LIB at the .so, or place this package next to the
+# repo so the default relative path resolves. Julia arrays are column-major;
+# the ABI is row-major — conversions below transpose so that the LOGICAL
+# shapes match the Python frontend exactly.
+module MXNetTPU
+
+export NDArray, invoke, Predictor, set_input!, forward!, get_output
+
+const _default_lib = normpath(joinpath(@__DIR__, "..", "..",
+    "incubator_mxnet_tpu", "native", "libmxtpu_predict.so"))
+const _lib = Ref{String}(_default_lib)
+
+function __init__()
+    _lib[] = get(ENV, "MXTPU_PREDICT_LIB", _default_lib)
+end
+
+_lasterr() = unsafe_string(ccall((:MXTPUPredGetLastError, _lib[]), Cstring, ()))
+_check(rc::Integer) = rc == 0 || error("MXNetTPU: " * _lasterr())
+
+# ------------------------------------------------------------------ dtypes
+const _JL2NP = Dict{DataType,String}(
+    Float32 => "float32", Float64 => "float64", Int32 => "int32",
+    Int64 => "int64", Int8 => "int8", UInt8 => "uint8", Int16 => "int16",
+    Bool => "bool")
+const _NP2JL = Dict{String,DataType}(v => k for (k, v) in _JL2NP)
+
+# ------------------------------------------------------------- tiny JSON
+# (op attributes only: numbers, strings, booleans, tuples/vectors thereof)
+_json(x::Real) = x isa Bool ? string(x) : string(x)
+_json(x::AbstractString) = "\"" * x * "\""
+_json(x::Union{Tuple,AbstractVector}) =
+    "[" * join([_json(v) for v in x], ",") * "]"
+_json(d::AbstractDict) =
+    "{" * join(["\"" * string(k) * "\":" * _json(v) for (k, v) in d], ",") *
+    "}"
+
+# ------------------------------------------------------------- NDArray
+mutable struct NDArray
+    handle::Ptr{Cvoid}
+    function NDArray(h::Ptr{Cvoid})
+        x = new(h)
+        finalizer(x) do y
+            ccall((:MXTPUNDFree, _lib[]), Cint, (Ptr{Cvoid},), y.handle)
+        end
+        x
+    end
+end
+
+"""NDArray(a::Array) — upload a Julia array. The logical shape seen by the
+framework equals `size(a)` (the row-major transpose happens here)."""
+function NDArray(a::AbstractArray{T}) where {T}
+    haskey(_JL2NP, T) || error("unsupported element type $T")
+    arr = Array(a)
+    c_order = ndims(arr) <= 1 ? arr :
+        permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+    shape = Int64[size(arr)...]
+    h = Ref{Ptr{Cvoid}}(C_NULL)
+    _check(ccall((:MXTPUNDCreate, _lib[]), Cint,
+                 (Cstring, Ptr{Int64}, Cint, Ptr{Cvoid}, Int64,
+                  Ptr{Ptr{Cvoid}}),
+                 _JL2NP[T], shape, ndims(arr), c_order,
+                 Int64(sizeof(c_order)), h))
+    NDArray(h[])
+end
+
+function Base.size(x::NDArray)
+    nd = Ref{Cint}(0)
+    _check(ccall((:MXTPUNDGetShape, _lib[]), Cint,
+                 (Ptr{Cvoid}, Ptr{Int64}, Cint, Ptr{Cint}),
+                 x.handle, C_NULL, 0, nd))
+    shape = Vector{Int64}(undef, nd[])
+    _check(ccall((:MXTPUNDGetShape, _lib[]), Cint,
+                 (Ptr{Cvoid}, Ptr{Int64}, Cint, Ptr{Cint}),
+                 x.handle, shape, nd[], nd))
+    Tuple(shape)
+end
+
+function _dtype(x::NDArray)
+    buf = Vector{UInt8}(undef, 32)
+    _check(ccall((:MXTPUNDGetDType, _lib[]), Cint,
+                 (Ptr{Cvoid}, Ptr{UInt8}, Cint), x.handle, buf, 32))
+    _NP2JL[unsafe_string(pointer(buf))]
+end
+
+"""Array(x::NDArray) — download to a Julia array (logical shape/order
+matching the Python frontend)."""
+function Base.Array(x::NDArray)
+    T = _dtype(x)
+    shape = size(x)
+    nb = Ref{Int64}(0)
+    _check(ccall((:MXTPUNDGetData, _lib[]), Cint,
+                 (Ptr{Cvoid}, Ptr{Cvoid}, Int64, Ptr{Int64}),
+                 x.handle, C_NULL, 0, nb))
+    raw = Vector{UInt8}(undef, nb[])
+    _check(ccall((:MXTPUNDGetData, _lib[]), Cint,
+                 (Ptr{Cvoid}, Ptr{Cvoid}, Int64, Ptr{Int64}),
+                 x.handle, raw, nb[], C_NULL))
+    vals = reinterpret(T, raw)
+    isempty(shape) && return collect(vals)[1]
+    length(shape) == 1 && return collect(vals)
+    a = reshape(collect(vals), reverse(shape))         # C bytes, rev dims
+    permutedims(a, reverse(ntuple(identity, length(shape))))
+end
+
+"""invoke(op, inputs...; kwargs...) — name-dispatched eager operator call
+(≙ MXImperativeInvokeEx). `invoke("dot", a, b)`,
+`invoke("sum", a; axis=1)`, `invoke("linalg.gemm2", a, b)`. Returns a
+Vector{NDArray} (most ops have one output)."""
+function invoke(op::AbstractString, inputs::NDArray...; cap::Integer = 8,
+                kwargs...)
+    ins = Ptr{Cvoid}[x.handle for x in inputs]
+    outs = fill(C_NULL, cap)
+    n = Ref{Cint}(0)
+    kw = isempty(kwargs) ? "" :
+        _json(Dict(string(k) => v for (k, v) in kwargs))
+    _check(ccall((:MXTPUImperativeInvoke, _lib[]), Cint,
+                 (Cstring, Ptr{Ptr{Cvoid}}, Cint, Cstring, Ptr{Ptr{Cvoid}},
+                  Cint, Ptr{Cint}),
+                 op, ins, length(ins), kw, outs, cap, n))
+    [NDArray(Ptr{Cvoid}(outs[i])) for i in 1:n[]]
+end
+
+# ------------------------------------------------------------- Predictor
+mutable struct Predictor
+    handle::Ptr{Cvoid}
+    function Predictor(path::AbstractString)
+        h = Ref{Ptr{Cvoid}}(C_NULL)
+        _check(ccall((:MXTPUPredCreate, _lib[]), Cint,
+                     (Cstring, Ptr{Ptr{Cvoid}}), path, h))
+        p = new(h[])
+        finalizer(p) do q
+            ccall((:MXTPUPredFree, _lib[]), Cint, (Ptr{Cvoid},), q.handle)
+        end
+        p
+    end
+end
+
+"""set_input!(p, index, a) — stage input `index` (0-based, matching the C
+ABI) from a Julia array."""
+function set_input!(p::Predictor, index::Integer, a::AbstractArray{T}) where {T}
+    arr = Array(a)
+    c_order = ndims(arr) <= 1 ? arr :
+        permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+    _check(ccall((:MXTPUPredSetInput, _lib[]), Cint,
+                 (Ptr{Cvoid}, Cint, Ptr{Cvoid}, Int64),
+                 p.handle, index, c_order, Int64(sizeof(c_order))))
+end
+
+forward!(p::Predictor) =
+    _check(ccall((:MXTPUPredForward, _lib[]), Cint, (Ptr{Cvoid},), p.handle))
+
+function _out_shape(p::Predictor, index::Integer)
+    nd = Ref{Cint}(0)
+    shape = Vector{Int64}(undef, 16)
+    _check(ccall((:MXTPUPredGetOutputShape, _lib[]), Cint,
+                 (Ptr{Cvoid}, Cint, Ptr{Int64}, Cint, Ptr{Cint}),
+                 p.handle, index, shape, 16, nd))
+    Tuple(shape[1:nd[]])
+end
+
+"""get_output(p, index) — fetch output `index` (0-based) as Float32 array."""
+function get_output(p::Predictor, index::Integer)
+    shape = _out_shape(p, index)
+    n = prod(shape)
+    buf = Vector{Float32}(undef, n)
+    _check(ccall((:MXTPUPredGetOutput, _lib[]), Cint,
+                 (Ptr{Cvoid}, Cint, Ptr{Cvoid}, Int64),
+                 p.handle, index, buf, Int64(4n)))
+    length(shape) <= 1 && return buf
+    a = reshape(buf, reverse(shape))
+    permutedims(a, reverse(ntuple(identity, length(shape))))
+end
+
+end # module

@@ -1,0 +1,174 @@
+/* C mirror of the exact ccall sequence julia_package/src/MXNetTPU.jl makes
+ * against libmxtpu_predict.so — the CI stand-in for a Julia interpreter
+ * (absent from this image). Every call below corresponds 1:1 to a ccall in
+ * the module: same symbols, same argument types, same order.
+ *
+ * Usage: ccall_harness <libmxtpu_predict.so> [model.mxtpu input.bin]
+ * Prints op results one float per line, section-tagged, parsed by
+ * tests/test_julia_package.py.
+ *
+ * Build: gcc -O2 ccall_harness.c -ldl -o ccall_harness
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef int (*nd_create_t)(const char*, const int64_t*, int, const void*,
+                           int64_t, void**);
+typedef int (*nd_shape_t)(void*, int64_t*, int, int*);
+typedef int (*nd_dtype_t)(void*, char*, int);
+typedef int (*nd_data_t)(void*, void*, int64_t, int64_t*);
+typedef int (*nd_free_t)(void*);
+typedef int (*invoke_t)(const char*, void**, int, const char*, void**, int,
+                        int*);
+typedef const char* (*lasterr_t)(void);
+typedef int (*pred_create_t)(const char*, void**);
+typedef int (*pred_setin_t)(void*, int, const void*, int64_t);
+typedef int (*pred_fwd_t)(void*);
+typedef int (*pred_oshape_t)(void*, int, int64_t*, int, int*);
+typedef int (*pred_out_t)(void*, int, void*, int64_t);
+typedef int (*pred_free_t)(void*);
+
+static lasterr_t g_err;
+
+#define CHECK(rc)                                                     \
+  do {                                                                \
+    if ((rc) != 0) {                                                  \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,         \
+              g_err ? g_err() : "?");                                 \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+static void print_nd(const char* tag, void* h, nd_shape_t nd_shape,
+                     nd_data_t nd_data) {
+  int64_t shape[16];
+  int ndim = 0;
+  nd_shape(h, shape, 16, &ndim);
+  int64_t nb = 0;
+  nd_data(h, NULL, 0, &nb);
+  float* buf = (float*)malloc((size_t)nb);
+  nd_data(h, buf, nb, NULL);
+  printf("%s", tag);
+  for (int i = 0; i < ndim; ++i) printf(" %lld", (long long)shape[i]);
+  printf("\n");
+  for (int64_t i = 0; i < nb / 4; ++i) printf("%.6e\n", buf[i]);
+  free(buf);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <libmxtpu_predict.so> [model input.bin]\n",
+            argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 1;
+  }
+  nd_create_t nd_create = (nd_create_t)dlsym(lib, "MXTPUNDCreate");
+  nd_shape_t nd_shape = (nd_shape_t)dlsym(lib, "MXTPUNDGetShape");
+  nd_dtype_t nd_dtype = (nd_dtype_t)dlsym(lib, "MXTPUNDGetDType");
+  nd_data_t nd_data = (nd_data_t)dlsym(lib, "MXTPUNDGetData");
+  nd_free_t nd_free = (nd_free_t)dlsym(lib, "MXTPUNDFree");
+  invoke_t invoke = (invoke_t)dlsym(lib, "MXTPUImperativeInvoke");
+  g_err = (lasterr_t)dlsym(lib, "MXTPUNDGetLastError");
+  if (!nd_create || !nd_shape || !nd_dtype || !nd_data || !nd_free ||
+      !invoke || !g_err) {
+    fprintf(stderr, "missing symbols\n");
+    return 1;
+  }
+
+  /* --- NDArray(Float32[1 2 3; 4 5 6]) and ones(2,3): row-major bytes --- */
+  float a_data[6] = {1, 2, 3, 4, 5, 6};
+  float b_data[6] = {1, 1, 1, 1, 1, 1};
+  int64_t shape23[2] = {2, 3};
+  void *a = NULL, *b = NULL;
+  CHECK(nd_create("float32", shape23, 2, a_data, sizeof(a_data), &a));
+  CHECK(nd_create("float32", shape23, 2, b_data, sizeof(b_data), &b));
+
+  char dt[32];
+  CHECK(nd_dtype(a, dt, 32));
+  printf("DTYPE %s\n", dt);
+
+  /* --- invoke("broadcast_add", a, b) --- */
+  void* outs[8];
+  int n_out = 0;
+  void* ins[2] = {a, b};
+  CHECK(invoke("broadcast_add", ins, 2, "", outs, 8, &n_out));
+  if (n_out != 1) return 1;
+  print_nd("ADD", outs[0], nd_shape, nd_data);
+  CHECK(nd_free(outs[0]));
+
+  /* --- invoke("sum", a; axis=1): kwargs as the same JSON Julia emits --- */
+  void* ins1[1] = {a};
+  CHECK(invoke("sum", ins1, 1, "{\"axis\":1}", outs, 8, &n_out));
+  print_nd("SUM", outs[0], nd_shape, nd_data);
+  CHECK(nd_free(outs[0]));
+
+  /* --- invoke("linalg.gemm2", a, aT): dotted sub-namespace dispatch --- */
+  float at_data[6] = {1, 4, 2, 5, 3, 6};
+  int64_t shape32[2] = {3, 2};
+  void* at = NULL;
+  CHECK(nd_create("float32", shape32, 2, at_data, sizeof(at_data), &at));
+  void* ins2[2] = {a, at};
+  CHECK(invoke("linalg.gemm2", ins2, 2, "", outs, 8, &n_out));
+  print_nd("GEMM", outs[0], nd_shape, nd_data);
+  CHECK(nd_free(outs[0]));
+
+  /* --- error path: unknown op reports through the error string --- */
+  if (invoke("not_a_real_op", ins1, 1, "", outs, 8, &n_out) == 0) {
+    fprintf(stderr, "unknown op unexpectedly succeeded\n");
+    return 1;
+  }
+  if (!strstr(g_err(), "not_a_real_op")) {
+    fprintf(stderr, "error string missing op name: %s\n", g_err());
+    return 1;
+  }
+  printf("ERRPATH ok\n");
+
+  CHECK(nd_free(a));
+  CHECK(nd_free(b));
+  CHECK(nd_free(at));
+
+  /* --- Predictor path (same sequence as Predictor/set_input!/forward!) */
+  if (argc >= 4) {
+    pred_create_t pc = (pred_create_t)dlsym(lib, "MXTPUPredCreate");
+    pred_setin_t psi = (pred_setin_t)dlsym(lib, "MXTPUPredSetInput");
+    pred_fwd_t pf = (pred_fwd_t)dlsym(lib, "MXTPUPredForward");
+    pred_oshape_t pos = (pred_oshape_t)dlsym(lib, "MXTPUPredGetOutputShape");
+    pred_out_t po = (pred_out_t)dlsym(lib, "MXTPUPredGetOutput");
+    pred_free_t pfr = (pred_free_t)dlsym(lib, "MXTPUPredFree");
+    void* p = NULL;
+    CHECK(pc(argv[2], &p));
+    FILE* f = fopen(argv[3], "rb");
+    if (!f) return 1;
+    fseek(f, 0, SEEK_END);
+    long n = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* buf = (char*)malloc((size_t)n);
+    if (fread(buf, 1, (size_t)n, f) != (size_t)n) return 1;
+    fclose(f);
+    CHECK(psi(p, 0, buf, n));
+    free(buf);
+    CHECK(pf(p));
+    int64_t oshape[16];
+    int ondim = 0;
+    CHECK(pos(p, 0, oshape, 16, &ondim));
+    int64_t total = 1;
+    for (int i = 0; i < ondim; ++i) total *= oshape[i];
+    float* obuf = (float*)malloc((size_t)(4 * total));
+    CHECK(po(p, 0, obuf, 4 * total));
+    printf("PRED");
+    for (int i = 0; i < ondim; ++i) printf(" %lld", (long long)oshape[i]);
+    printf("\n");
+    for (int64_t i = 0; i < total; ++i) printf("%.6e\n", obuf[i]);
+    free(obuf);
+    CHECK(pfr(p));
+  }
+  printf("DONE\n");
+  return 0;
+}

@@ -1,0 +1,136 @@
+"""Julia binding + imperative-invoke C ABI (ref julia/ package +
+include/mxnet/c_api.h MXImperativeInvokeEx).
+
+The image ships no Julia interpreter, so the binding's exact ccall
+sequence (julia_package/src/MXNetTPU.jl) is exercised through a compiled C
+harness (julia_package/test/ccall_harness.c — same symbols, same argument
+types, same order), running as a real standalone process against
+libmxtpu_predict.so with its embedded interpreter. When a `julia` binary
+exists, the module itself runs too.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.contrib import serving
+from incubator_mxnet_tpu.native import lib as native_lib
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _predict_lib():
+    try:
+        return native_lib.build_predict()
+    except Exception as e:
+        pytest.skip("cannot build libmxtpu_predict.so: %s" % e)
+
+
+def _parse_sections(out):
+    """TAG [shape...] then one float per line until the next tag."""
+    sections = {}
+    cur, shape, vals = None, None, None
+    for line in out.splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0].isupper() and parts[0][0] not in "-+.0123456789":
+            if cur is not None:
+                sections[cur] = (shape, onp.array(vals, onp.float32))
+            if parts[0] in ("DTYPE", "ERRPATH", "DONE"):
+                cur, shape, vals = None, None, None
+                continue
+            cur = parts[0]
+            shape = tuple(int(x) for x in parts[1:])
+            vals = []
+        else:
+            vals.append(float(parts[0]))
+    if cur is not None:
+        sections[cur] = (shape, onp.array(vals, onp.float32))
+    return sections
+
+
+def test_julia_ccall_sequence_standalone(tmp_path):
+    if shutil.which("gcc") is None and shutil.which("g++") is None:
+        pytest.skip("no C compiler")
+    so_path = _predict_lib()
+
+    # export a model for the Predictor leg
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+            gluon.nn.Dense(4, in_units=16))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 8))
+    model = str(tmp_path / "model.mxtpu")
+    serving.export_model(net, x, model)
+    expected_pred = serving.load(model).predict(x).asnumpy()
+    inp = str(tmp_path / "input.bin")
+    with open(inp, "wb") as f:
+        f.write(x.asnumpy().astype(onp.float32).tobytes())
+
+    cc = shutil.which("gcc") or shutil.which("g++")
+    exe = str(tmp_path / "harness")
+    src = os.path.join(ROOT, "julia_package", "test", "ccall_harness.c")
+    subprocess.run([cc, "-O2", src, "-ldl", "-o", exe], check=True,
+                   capture_output=True)
+
+    env = dict(os.environ)
+    env["MXTPU_PREDICT_LIB"] = so_path
+    env["MXTPU_PYTHON"] = sys.executable
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([exe, so_path, model, inp], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "ERRPATH ok" in r.stdout and "DONE" in r.stdout
+
+    s = _parse_sections(r.stdout)
+    a = onp.arange(1, 7, dtype=onp.float32).reshape(2, 3)
+    shape, vals = s["ADD"]
+    assert shape == (2, 3)
+    onp.testing.assert_allclose(vals.reshape(shape), a + 1, rtol=1e-6)
+    shape, vals = s["SUM"]
+    assert shape == (2,)
+    onp.testing.assert_allclose(vals, a.sum(axis=1), rtol=1e-6)
+    shape, vals = s["GEMM"]
+    assert shape == (2, 2)
+    onp.testing.assert_allclose(vals.reshape(shape), a @ a.T, rtol=1e-5)
+    shape, vals = s["PRED"]
+    assert shape == expected_pred.shape
+    onp.testing.assert_allclose(vals.reshape(shape), expected_pred,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_julia_module_e2e(tmp_path):
+    """Run MXNetTPU.jl itself when a Julia interpreter is available."""
+    julia = shutil.which("julia")
+    if julia is None:
+        pytest.skip("no julia interpreter in this image")
+    so_path = _predict_lib()
+    script = tmp_path / "run.jl"
+    script.write_text("""
+push!(LOAD_PATH, joinpath(%r, "julia_package", "src"))
+using MXNetTPU
+a = NDArray(Float32[1 2 3; 4 5 6])
+b = NDArray(ones(Float32, 2, 3))
+s = Array(invoke("broadcast_add", a, b)[1])
+@assert s == Float32[2 3 4; 5 6 7]
+r = Array(invoke("sum", a; axis=1)[1])
+@assert r == Float32[6, 15]
+println("JULIA OK")
+""" % ROOT)
+    env = dict(os.environ)
+    env["MXTPU_PREDICT_LIB"] = so_path
+    env["MXTPU_PYTHON"] = sys.executable
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([julia, str(script)], capture_output=True, text=True,
+                       env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "JULIA OK" in r.stdout
