@@ -29,6 +29,11 @@ if _config.get_env("MXTPU_NUM_PROC") > 1 and \
                                     _config.get_env("MXTPU_NUM_PROC"),
                                     _config.get_env("MXTPU_PROC_ID"))
 
+if _config.get_env("MXTPU_MATMUL_PRECISION"):
+    import jax as _jax
+    _jax.config.update("jax_default_matmul_precision",
+                       _config.get_env("MXTPU_MATMUL_PRECISION"))
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus

@@ -49,6 +49,13 @@ ENV_VARS = {
         "Force the fp32-simulated path for quantized matmul/conv instead "
         "of native int8 dot_general with int32 accumulation "
         "(ndarray/contrib.py quantized_* ops)."),
+    "MXTPU_MATMUL_PRECISION": (
+        str, None,
+        "Matmul/conv precision on the MXU: 'default' (bf16 multiplies, "
+        "fp32 accumulate — fastest), 'high' (3-pass), 'highest' (fp32). "
+        "Applied at package import via jax_default_matmul_precision; the "
+        "numerics sweep (test_utils.op_consistency_sweep) verifies "
+        "CPU<->TPU agreement of matmul-class ops under 'highest'."),
     "MXTPU_NO_NATIVE": (
         bool, False,
         "Disable the native C++ library even if it builds (forces the "

@@ -16,7 +16,8 @@ from .ndarray import NDArray
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
            "rand_shape_nd", "check_numeric_gradient", "check_consistency",
-           "numeric_grad", "simple_forward", "same", "random_seed"]
+           "numeric_grad", "simple_forward", "same", "random_seed",
+           "op_consistency_sweep", "SWEEP_TOLS"]
 
 _default_ctx = [None]
 
@@ -149,6 +150,192 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",), rtol=1e-3,
     base = results[0]
     for r in results[1:]:
         assert_almost_equal(r, base, rtol=rtol, atol=atol)
+
+
+# ----------------------------------------------------------------- sweep
+def _sweep_table():
+    """Op table for the cross-backend numerics sweep (the reference's
+    test_operator_gpu.py re-run-everything-on-device trick, distilled to an
+    op walk). Each entry: (name, fn(*nd arrays) -> NDArray, input specs)
+    where a spec is (shape, kind) and kind is 'f' (float, cast to the sweep
+    dtype), 'pos' (positive float), or 'i' (int32 indices, never cast)."""
+    from .ndarray import linalg  # noqa: F401  (namespace touch)
+
+    def f(*shape):
+        return (shape, "f")
+
+    def pos(*shape):
+        return (shape, "pos")
+
+    def idx(*shape):
+        return (shape, "i")
+
+    t = [
+        # elemwise unary
+        ("exp@trans", lambda a: nd.exp(a), [f(4, 16)]),
+        ("log@trans", lambda a: nd.log(a), [pos(4, 16)]),
+        ("sqrt@trans", lambda a: nd.sqrt(a), [pos(4, 16)]),
+        ("rsqrt@trans", lambda a: nd.rsqrt(a), [pos(4, 16)]),
+        ("sigmoid@trans", lambda a: nd.sigmoid(a), [f(4, 16)]),
+        ("tanh@trans", lambda a: nd.tanh(a), [f(4, 16)]),
+        ("erf@trans", lambda a: nd.erf(a), [f(4, 16)]),
+        ("abs", lambda a: nd.abs(a), [f(4, 16)]),
+        ("square", lambda a: nd.square(a), [f(4, 16)]),
+        ("cbrt@trans", lambda a: nd.cbrt(a), [pos(4, 16)]),
+        ("round", lambda a: nd.round(a), [f(4, 16)]),
+        ("floor", lambda a: nd.floor(a), [f(4, 16)]),
+        ("sin@trans", lambda a: nd.sin(a), [f(4, 16)]),
+        ("cos@trans", lambda a: nd.cos(a), [f(4, 16)]),
+        ("log1p@trans", lambda a: nd.log1p(a), [pos(4, 16)]),
+        ("expm1@trans", lambda a: nd.expm1(a), [f(4, 16)]),
+        ("relu", lambda a: nd.relu(a), [f(4, 16)]),
+        ("softsign@trans", lambda a: nd.softsign(a), [f(4, 16)]),
+        ("clip", lambda a: nd.clip(a, -1.0, 1.0), [f(4, 16)]),
+        # binary / broadcast
+        ("broadcast_add", lambda a, b: nd.broadcast_add(a, b),
+         [f(4, 16), f(1, 16)]),
+        ("broadcast_sub", lambda a, b: nd.broadcast_sub(a, b),
+         [f(4, 16), f(1, 16)]),
+        ("broadcast_mul", lambda a, b: nd.broadcast_mul(a, b),
+         [f(4, 16), f(1, 16)]),
+        ("broadcast_div", lambda a, b: nd.broadcast_div(a, b),
+         [f(4, 16), pos(1, 16)]),
+        ("maximum", lambda a, b: nd.maximum(a, b), [f(4, 16), f(4, 16)]),
+        ("minimum", lambda a, b: nd.minimum(a, b), [f(4, 16), f(4, 16)]),
+        ("power@trans", lambda a, b: nd.power(a, b), [pos(4, 16), f(4, 16)]),
+        # reductions
+        ("sum", lambda a: nd.sum(a, axis=1), [f(8, 64)]),
+        ("mean", lambda a: nd.mean(a, axis=1), [f(8, 64)]),
+        ("max", lambda a: nd.max(a, axis=1), [f(8, 64)]),
+        ("min", lambda a: nd.min(a, axis=1), [f(8, 64)]),
+        ("prod", lambda a: nd.prod(a, axis=1), [f(8, 8)]),
+        ("norm@trans", lambda a: nd.norm(a, axis=1), [f(8, 64)]),
+        ("argmax", lambda a: nd.argmax(a, axis=1), [f(8, 64)]),
+        ("argmin", lambda a: nd.argmin(a, axis=1), [f(8, 64)]),
+        # linalg / nn
+        ("dot@mm", lambda a, b: nd.dot(a, b), [f(8, 32), f(32, 8)]),
+        ("linalg.gemm2@mm", lambda a, b: nd.linalg.gemm2(a, b),
+         [f(8, 32), f(32, 8)]),
+        ("FullyConnected@mm",
+         lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=8),
+         [f(4, 32), f(8, 32), f(8)]),
+        ("Convolution@mm",
+         lambda x, w: nd.Convolution(x, w, None, kernel=(3, 3),
+                                     num_filter=8, pad=(1, 1), no_bias=True),
+         [f(2, 4, 8, 8), f(8, 4, 3, 3)]),
+        ("Pooling_max",
+         lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="max",
+                              stride=(2, 2)),
+         [f(2, 4, 8, 8)]),
+        ("Pooling_avg",
+         lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="avg",
+                              stride=(2, 2)),
+         [f(2, 4, 8, 8)]),
+        ("softmax@trans", lambda a: nd.softmax(a, axis=-1), [f(4, 16)]),
+        ("log_softmax@trans", lambda a: nd.log_softmax(a, axis=-1), [f(4, 16)]),
+        ("LayerNorm",
+         lambda x, g, b: nd.LayerNorm(x, g, b, axis=-1),
+         [f(4, 16), f(16), f(16)]),
+        ("LeakyReLU", lambda a: nd.LeakyReLU(a, slope=0.1), [f(4, 16)]),
+        ("Activation@trans",
+         lambda a: nd.Activation(a, act_type="softrelu"), [f(4, 16)]),
+        # indexing / shape
+        ("take", lambda a, i: nd.take(a, i), [f(16, 8), idx(6)]),
+        ("Embedding",
+         lambda i, w: nd.Embedding(i, w, input_dim=16, output_dim=8),
+         [idx(6), f(16, 8)]),
+        ("one_hot", lambda i: nd.one_hot(i, 16), [idx(6)]),
+        ("topk", lambda a: nd.topk(a, k=3, ret_typ="value"), [f(4, 16)]),
+        ("sort", lambda a: nd.sort(a, axis=-1), [f(4, 16)]),
+        ("transpose", lambda a: nd.transpose(a, axes=(1, 0, 2)),
+         [f(3, 4, 5)]),
+        ("where", lambda c, a, b: nd.where(c, a, b),
+         [idx(4, 16), f(4, 16), f(4, 16)]),
+    ]
+    return t
+
+
+#: per-dtype (rtol, atol) for the sweep; bf16 has 8 mantissa bits, fp16 10.
+#: 'trans'-tagged ops (transcendentals) get the looser fp32 row — XLA
+#: backends use different polynomial approximations, parity is ~1e-3 not
+#: ULP. 'mm'-tagged ops run under jax.default_matmul_precision('highest')
+#: so the sweep checks ARITHMETIC parity; the MXU's default bf16-multiply
+#: fp32-accumulate mode is a documented perf trade (MXTPU_MATMUL_PRECISION).
+SWEEP_TOLS = {"float32": (1e-4, 1e-5), "bfloat16": (4e-2, 2e-2),
+              "float16": (1e-2, 2e-3)}
+SWEEP_TOLS_TRANS = {"float32": (2e-3, 1e-4), "bfloat16": (4e-2, 2e-2),
+                    "float16": (1e-2, 2e-3)}
+
+
+def op_consistency_sweep(dtypes=("float32", "bfloat16", "float16"),
+                         ctx_list=None, quick=False, seed=0):
+    """Walk the op table across contexts x dtypes; returns rows of
+    (op, dtype, max_rel_err, status) where status is 'ok', 'MISMATCH', or
+    'ERROR: ...'. ctx_list defaults to [cpu, default_context] — on TPU
+    hosts that is the real CPU<->TPU cross-backend walk (the reference's
+    GPU-suite re-run); on CPU-only hosts both legs are CPU and the sweep
+    still catches dtype-lowering breaks."""
+    table = _sweep_table()
+    if quick:
+        table = table[::3]
+    if ctx_list is None:
+        ctx_list = [cpu(0), default_context()]
+    rows = []
+    rng = onp.random.RandomState(seed)
+    inputs_cache = {}
+    import contextlib
+    import jax
+    for entry_name, fn, specs in table:
+        name, _, tag = entry_name.partition("@")
+        key = name
+        if key not in inputs_cache:
+            gen = []
+            for shape, kind in specs:
+                if kind == "i":
+                    gen.append(rng.randint(0, 2, size=shape).astype("int32")
+                               if name == "where"
+                               else rng.randint(0, min(shape) if shape
+                                                else 4, size=shape)
+                               .astype("int32"))
+                else:
+                    a = rng.uniform(-2.0, 2.0, size=shape).astype("float32")
+                    if kind == "pos":
+                        a = onp.abs(a) + 0.5
+                    gen.append(a)
+            inputs_cache[key] = gen
+        for dt in dtypes:
+            rtol, atol = (SWEEP_TOLS_TRANS if tag == "trans"
+                          else SWEEP_TOLS)[dt]
+            prec = jax.default_matmul_precision("highest") if tag == "mm" \
+                else contextlib.nullcontext()
+            try:
+                outs = []
+                with prec:
+                    for ctx in ctx_list:
+                        arrs = []
+                        for (shape, kind), x in zip(specs,
+                                                    inputs_cache[key]):
+                            a = nd.array(x, ctx=ctx)
+                            if kind != "i" and dt != "float32":
+                                a = a.astype(dt)
+                            arrs.append(a)
+                        with ctx:
+                            o = fn(*arrs)
+                        outs.append(o.asnumpy().astype("float32"))
+                ref = outs[0]
+                err = 0.0
+                ok = True
+                for r in outs[1:]:
+                    diff = onp.abs(r - ref)
+                    denom = onp.abs(ref) + atol
+                    err = max(err, float((diff / denom).max())
+                              if diff.size else 0.0)
+                    ok = ok and onp.allclose(r, ref, rtol=rtol, atol=atol)
+                rows.append((name, dt, err, "ok" if ok else "MISMATCH"))
+            except Exception as e:  # record, keep walking
+                rows.append((name, dt, None,
+                             "ERROR: %s" % str(e).splitlines()[0][:120]))
+    return rows
 
 
 class random_seed:

@@ -1,0 +1,23 @@
+"""Cross-backend numerics sweep (ref tests/python/gpu/test_operator_gpu.py
+strategy — the CPU suite re-run on device, distilled to an op-table walk).
+
+On this CPU-only CI host both legs run on CPU (catching dtype-lowering
+breaks); the committed docs/NUMERICS_SWEEP.md is the full CPU<->TPU run
+from the real chip. 'mm'-tagged ops run under matmul precision 'highest'
+(the MXU's default bf16-multiply mode is a documented perf trade,
+MXTPU_MATMUL_PRECISION); 'trans' ops use the transcendental tolerance row.
+"""
+import os
+
+import pytest
+
+from incubator_mxnet_tpu.test_utils import op_consistency_sweep
+
+
+def test_op_consistency_sweep():
+    quick = bool(os.environ.get("MXTPU_TEST_QUICK"))
+    rows = op_consistency_sweep(quick=quick)
+    bad = [(n, dt, err, st) for n, dt, err, st in rows if st != "ok"]
+    assert not bad, "sweep failures: %s" % bad
+    # the walk actually covered the table x dtypes
+    assert len(rows) >= (15 if quick else 150)
