@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# CI entry point (ref ci/docker/runtime_functions.sh + Jenkinsfile stages).
+# One command green from a clean checkout:
+#
+#   ci/run.sh                 # all stages
+#   ci/run.sh lint native     # selected stages
+#
+# Stages:
+#   lint    - syntax walk over every python file (compileall)
+#   native  - rebuild libmxtpu.so + libmxtpu_predict.so from src, then a
+#             TSAN (-fsanitize=thread) compile of the native layer (the
+#             race-detection build the TSAN test also uses; ref ASAN job)
+#   suite   - quick test suite on the 8-device virtual CPU mesh
+#   smoke   - driver contract: entry() jit-compiles on CPU and
+#             dryrun_multichip(8) runs a full sharded train step
+#   wheel   - sdist + wheel build including fresh native libs (ref
+#             tools/pip staticbuild)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STAGES=("$@")
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite smoke wheel)
+
+has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
+
+if has_stage lint; then
+  echo "=== lint: syntax walk ==="
+  python -m compileall -q incubator_mxnet_tpu tests tools benchmark bench.py __graft_entry__.py
+fi
+
+if has_stage native; then
+  echo "=== native: rebuild + TSAN compile ==="
+  python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+from incubator_mxnet_tpu.native import lib
+print(lib.build(force=True))
+print(lib.build_predict(force=True))
+EOF
+  TSAN_OUT=$(mktemp -d)/libmxtpu_tsan.so
+  g++ -O1 -g -std=c++17 -shared -fPIC -pthread -fsanitize=thread \
+      incubator_mxnet_tpu/native/src/recordio.cc \
+      incubator_mxnet_tpu/native/src/image.cc \
+      incubator_mxnet_tpu/native/src/c_api.cc \
+      -o "$TSAN_OUT" -ljpeg
+  echo "tsan build ok: $TSAN_OUT"
+fi
+
+if has_stage suite; then
+  echo "=== suite: quick tests on the 8-device virtual CPU mesh ==="
+  MXTPU_TEST_QUICK=1 python -m pytest tests/ -q -x
+fi
+
+if has_stage smoke; then
+  echo "=== smoke: driver contract ==="
+  python -c "
+import jax; jax.config.update('jax_platforms','cpu')
+import __graft_entry__ as g
+fn, a = g.entry()
+jax.jit(fn)(*a).block_until_ready()
+print('entry() ok')"
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+fi
+
+if has_stage wheel; then
+  echo "=== wheel: sdist + bdist incl. native libs ==="
+  rm -rf build dist *.egg-info
+  python setup.py -q sdist bdist_wheel
+  ls -la dist/
+fi
+
+echo "CI GREEN (${STAGES[*]})"
