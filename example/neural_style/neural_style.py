@@ -1,0 +1,94 @@
+"""Neural style transfer (ref example/neural-style/): Gatys-style image
+OPTIMIZATION — gradients flow to the INPUT pixels, not the weights.
+
+TPU-native notes: each L-BFGS-free Adam step is autograd through a VGG
+feature stack (content loss on deep features, style loss on Gram matrices
+of shallow ones); the whole backward-to-pixels pass is one XLA program.
+Synthetic content/style images by default so it runs anywhere:
+
+    python example/neural_style/neural_style.py --iters 40
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import model_zoo
+
+
+def synthetic_images(hw=64):
+    ys, xs = onp.mgrid[0:hw, 0:hw].astype("float32") / hw
+    content = onp.stack([onp.exp(-((xs - .5) ** 2 + (ys - .5) ** 2) * 8)] * 3)
+    style = onp.stack([onp.sin(xs * 20) * onp.cos(ys * 20)] * 3) * .5 + .5
+    return content[None], style[None]
+
+
+def gram(feat):
+    b, c = feat.shape[0], feat.shape[1]
+    f = feat.reshape((b, c, -1))
+    return nd.batch_dot(f, nd.transpose(f, axes=(0, 2, 1))) / f.shape[2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--style-weight", type=float, default=50.0)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    vgg = model_zoo.vision.vgg11()
+    vgg.initialize(mx.init.Xavier())
+    # taps: shallow layers for style (texture), deeper for content
+    layers = list(vgg.features)
+    style_ids, content_id = [0, 3], 6
+
+    def features(x):
+        feats = []
+        for i, layer in enumerate(layers[:content_id + 1]):
+            x = layer(x)
+            if i in style_ids:
+                feats.append(x)
+        return feats, x
+
+    content_np, style_np = synthetic_images(args.size)
+    c_img, s_img = nd.array(content_np), nd.array(style_np)
+    _, c_target = features(c_img)
+    s_feats, _ = features(s_img)
+    s_targets = [gram(f) for f in s_feats]
+
+    img = nd.array(content_np + onp.random.RandomState(1)
+                   .randn(*content_np.shape).astype("float32") * 0.1)
+    img.attach_grad()
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+    m = nd.zeros(img.shape)
+    v = nd.zeros(img.shape)
+    last = None
+    for it in range(1, args.iters + 1):
+        with autograd.record():
+            feats, content = features(img)
+            loss = nd.sum(nd.square(content - c_target))
+            for f, t in zip(feats, s_targets):
+                loss = loss + args.style_weight * nd.sum(nd.square(gram(f) - t))
+        loss.backward()
+        g = img.grad
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * nd.square(g)
+        mh = m / (1 - b1 ** it)
+        vh = v / (1 - b2 ** it)
+        img = nd.clip(img - lr * mh / (nd.sqrt(vh) + eps), -1.5, 1.5)
+        img.attach_grad()
+        last = float(loss.asnumpy())
+        if it % 10 == 0 or it == 1:
+            print("iter %3d  loss %.3f" % (it, last))
+    print("final loss %.3f" % last)
+    assert onp.isfinite(last)
+
+
+if __name__ == "__main__":
+    main()

@@ -210,15 +210,41 @@ class NDArray:
         raise ValueError("unknown stype %r" % stype)
 
     # ------------------------------------------------------------- indexing
+    @staticmethod
+    def _key_past_int32(key):
+        """Integer indices beyond int32 range need a scoped x64 enable —
+        jax passes dynamic index scalars as int32 by default, which
+        overflows on >2^31-element axes (the int64-tensor-size story)."""
+        lim = 2 ** 31 - 1
+        # NOTE: module-level `abs` is the nd operator — plain comparisons
+        def big(v):
+            return isinstance(v, int) and (v > lim or v < -lim)
+
+        for k in key if isinstance(key, tuple) else (key,):
+            if big(k):
+                return True
+            if isinstance(k, slice) and any(
+                    big(v) for v in (k.start, k.stop, k.step)
+                    if v is not None):
+                return True
+        return False
+
     def __getitem__(self, key):
         key = _index_fixup(key)
+        if self._key_past_int32(key):
+            with jax.enable_x64(True):
+                return _apply(lambda x: x[key], self)
         return _apply(lambda x: x[key], self)
 
     def __setitem__(self, key, value):
         key = _index_fixup(key)
         if isinstance(value, NDArray):
             value = value._data
-        self._data = self._data.at[key].set(value)
+        if self._key_past_int32(key):
+            with jax.enable_x64(True):
+                self._data = self._data.at[key].set(value)
+        else:
+            self._data = self._data.at[key].set(value)
 
     def take(self, indices, axis=0, mode="clip"):
         from . import op as _op  # noqa
@@ -350,11 +376,25 @@ class NDArray:
     def min(self, axis=None, keepdims=False, **kw): return self._reduce(jnp.min, axis, keepdims)
     def prod(self, axis=None, keepdims=False, **kw): return self._reduce(jnp.prod, axis, keepdims)
 
+    def _argreduce(self, jfn, axis, keepdims):
+        # MXNet convention: float indices. Past 2^24 the float32 mantissa
+        # can no longer hold exact indices (and jax's default int32 index
+        # dtype wraps past 2^31) — large extents compute under a scoped
+        # x64 enable and return float64 (the int64-tensor-size story,
+        # ref USE_INT64_TENSOR_SIZE / tests/nightly/test_large_vector.py)
+        extent = self.size if axis is None else self.shape[axis]
+        if extent > (1 << 24):
+            with jax.enable_x64(True):
+                return _apply(lambda x: jfn(x, axis=axis, keepdims=keepdims)
+                              .astype(onp.float64), self)
+        return _apply(lambda x: jfn(x, axis=axis, keepdims=keepdims)
+                      .astype(onp.float32), self)
+
     def argmax(self, axis=None, keepdims=False):
-        return _apply(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims).astype(onp.float32), self)
+        return self._argreduce(jnp.argmax, axis, keepdims)
 
     def argmin(self, axis=None, keepdims=False):
-        return _apply(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims).astype(onp.float32), self)
+        return self._argreduce(jnp.argmin, axis, keepdims)
 
     def norm(self, ord=2, axis=None, keepdims=False):
         return norm(self, ord, axis, keepdims)
@@ -1772,13 +1812,18 @@ def space_to_depth(data, block_size):
 
 
 def shape_array(data):
-    """Shape as an int64 array (ref tensor/matrix_op.cc shape_array)."""
-    return NDArray(jnp.asarray(data.shape, jnp.int64))
+    """Shape as a TRUE int64 array (ref tensor/matrix_op.cc shape_array) —
+    created under a scoped x64 enable so dims past 2^31 don't truncate to
+    int32 (jax's default without jax_enable_x64)."""
+    with jax.enable_x64(True):
+        return NDArray(jnp.asarray(data.shape, jnp.int64))
 
 
 def size_array(data):
-    """Element count as a (1,) int64 array (ref size_array)."""
-    return NDArray(jnp.asarray([data.size], jnp.int64))
+    """Element count as a (1,) TRUE int64 array (ref size_array; see
+    shape_array for the x64 scoping)."""
+    with jax.enable_x64(True):
+        return NDArray(jnp.asarray([data.size], jnp.int64))
 
 
 def argmax_channel(data):

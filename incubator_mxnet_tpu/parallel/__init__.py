@@ -16,6 +16,6 @@ from .ring_attention import ring_attention, local_attention  # noqa
 from .ulysses import ulysses_attention  # noqa
 from .pipeline import PipelineParallel, pipeline_spmd, pipeline_1f1b_grads  # noqa
 from .gluon_pipeline import PipelineStack  # noqa
-from .moe import MoELayer, load_balancing_loss  # noqa
+from .moe import MoELayer, load_balancing_loss, router_z_loss  # noqa
 from .compression import GradientCompression  # noqa
 from .dist import init_distributed, rank, num_workers  # noqa

@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 from ..gluon.block import HybridBlock
 from ..ndarray import _apply
 
-__all__ = ["MoELayer", "load_balancing_loss"]
+__all__ = ["MoELayer", "load_balancing_loss", "router_z_loss"]
 
 
 def load_balancing_loss(gates, top_idx, num_experts):
@@ -36,6 +36,14 @@ def load_balancing_loss(gates, top_idx, num_experts):
     f = jnp.mean(jax.nn.one_hot(top_idx[:, 0], num_experts,
                                 dtype=gates.dtype), axis=0)       # (E,)
     return num_experts * jnp.sum(f * p)
+
+
+def router_z_loss(logits):
+    """ST-MoE router z-loss: mean(logsumexp(logits)^2) — keeps router
+    logits small so the softmax stays out of its saturated/overflow-prone
+    region (bf16 routers drift without it)."""
+    z = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(jnp.square(z))
 
 
 def _route_dense(tokens, gates, top_vals, top_idx, num_experts, w1, w2, act):
@@ -85,11 +93,20 @@ class MoELayer(HybridBlock):
 
     def __init__(self, num_experts, hidden_size, ffn_hidden, top_k=2,
                  ep_axis="ep", activation="relu", capacity_factor=None,
-                 **kwargs):
+                 z_loss_coef=1e-3, **kwargs):
         super().__init__(**kwargs)
+        if capacity_factor is None and num_experts >= 8:
+            import warnings
+            warnings.warn(
+                "MoELayer(num_experts=%d, capacity_factor=None): the dense "
+                "capacity-free dispatch is O(T*E) compute and defeats "
+                "expert parallelism at scale — pass capacity_factor "
+                "(GShard default 1.25) for real workloads" % num_experts,
+                stacklevel=2)
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
+        self.z_loss_coef = z_loss_coef
         self._act = activation
         with self.name_scope():
             self.gate_weight = self.params.get(
@@ -121,7 +138,9 @@ class MoELayer(HybridBlock):
                                   capacity, w1, w2, act)
         out = out.reshape(shape)
         if compute_aux:
-            return out, load_balancing_loss(gates, top_idx, num_experts)
+            aux = load_balancing_loss(gates, top_idx, num_experts) \
+                + self.z_loss_coef * router_z_loss(logits)
+            return out, aux
         return out
 
     def forward(self, x):
@@ -130,6 +149,7 @@ class MoELayer(HybridBlock):
                       self.gate_weight.data(), self.w1.data(), self.w2.data())
 
     def forward_with_aux(self, x):
-        """Returns (y, aux_load_balancing_loss)."""
+        """Returns (y, aux) where aux = Switch load-balancing loss +
+        z_loss_coef * ST-MoE router z-loss (add to the task loss)."""
         return _apply(lambda *a: self._fn(*a, compute_aux=True), x,
                       self.gate_weight.data(), self.w1.data(), self.w2.data())

@@ -232,12 +232,44 @@ def test_pipeline_grad_through_ring():
 def test_moe_layer():
     _need_devices(8)
     mesh = parallel.make_mesh({"ep": 8})
-    layer = parallel.MoELayer(num_experts=8, hidden_size=16, ffn_hidden=32, top_k=2)
+    layer = parallel.MoELayer(num_experts=8, hidden_size=16, ffn_hidden=32,
+                              top_k=2, capacity_factor=1.25)
     layer.initialize()
     x = nd.random.normal(shape=(4, 6, 16))
     out = layer(x)
     assert out.shape == (4, 6, 16)
     assert bool(onp.isfinite(out.asnumpy()).all())
+    # the dense default at scale is a documented footgun -> warns
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        parallel.MoELayer(num_experts=8, hidden_size=4, ffn_hidden=8)
+    assert any("capacity_factor" in str(w.message) for w in rec)
+
+
+def test_moe_router_z_loss():
+    """r3 (weak #8): aux includes the ST-MoE router z-loss — scaled-up
+    router logits must RAISE the aux loss even with identical softmax."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel.moe import router_z_loss
+    logits = jnp.asarray(onp.random.RandomState(0).randn(16, 4)
+                         .astype("float32"))
+    z1 = float(router_z_loss(logits))
+    z2 = float(router_z_loss(logits + 10.0))  # same softmax, bigger logits
+    assert z2 > z1 >= 0.0
+    # and the layer folds it into aux: same weights, z_loss_coef on vs off
+    onp.random.seed(1)
+    mkw = dict(num_experts=4, hidden_size=8, ffn_hidden=16, top_k=2)
+    mx.random.seed(2)
+    l1 = parallel.MoELayer(z_loss_coef=0.0, **mkw)
+    l1.initialize()
+    mx.random.seed(2)
+    l2 = parallel.MoELayer(z_loss_coef=1.0, **mkw)
+    l2.initialize()
+    x = nd.random.normal(shape=(3, 5, 8))
+    _, a1 = l1.forward_with_aux(x)
+    _, a2 = l2.forward_with_aux(x)
+    assert float(a2.asnumpy()) > float(a1.asnumpy())
 
 
 def test_moe_capacity_and_aux_loss():
