@@ -274,13 +274,6 @@ class DistKVStore(KVStore):
                     agg = agg.tostype("default")
                 self._data[k] = agg
 
-    def _aggregate(self, v, key):
-        # single-key compatibility path (pushpull etc. reuse base push)
-        agg = super()._aggregate(v, key)
-        if self._num_workers > 1:
-            agg = self._cross_sum_single(agg)
-        return agg
-
     def _cross_sum_single(self, agg):
         from ..ndarray.sparse import BaseSparseNDArray
         if isinstance(agg, BaseSparseNDArray):
@@ -288,8 +281,9 @@ class DistKVStore(KVStore):
         return self._cross_sum_batch([agg])[0]
 
     def _cross_sum_batch(self, args):
-        """ONE host allgather per dtype for a list of dense NDArrays —
-        the batched replacement for per-key round trips."""
+        """ONE host allgather per dtype for a list of dense values —
+        the batched replacement for per-key round trips. Accepts NDArrays
+        or raw jax/numpy arrays; each output keeps its input's type."""
         if not args or self._num_workers <= 1:
             return list(args)
         import numpy as onp
@@ -300,7 +294,8 @@ class DistKVStore(KVStore):
         for i, a in enumerate(args):
             by_dtype.setdefault(onp.dtype(a.dtype).name, []).append(i)
         for dt, idxs in sorted(by_dtype.items()):
-            flats = [onp.asarray(args[i]._data).ravel() for i in idxs]
+            flats = [onp.asarray(args[i]._data if isinstance(args[i], NDArray)
+                                 else args[i]).ravel() for i in idxs]
             sizes = [f.size for f in flats]
             cat = onp.concatenate(flats) if len(flats) > 1 else flats[0]
             # allgather lands on host; reduce there, upload once
@@ -309,7 +304,8 @@ class DistKVStore(KVStore):
             for i, sz in zip(idxs, sizes):
                 seg = summed[off: off + sz].reshape(args[i].shape)
                 off += sz
-                out[i] = NDArray(jnp.asarray(seg.astype(dt)))
+                arr = jnp.asarray(seg.astype(dt))
+                out[i] = NDArray(arr) if isinstance(args[i], NDArray) else arr
         return out
 
     def barrier(self):

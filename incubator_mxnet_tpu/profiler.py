@@ -41,6 +41,7 @@ def set_state(state_="stop", profile_process="worker"):
     """ref profiler.py set_state('run'|'stop')."""
     if state_ == "run" and not _STATE["running"]:
         _STATE["running"] = True
+        _STATE["peak_bytes"] = 0  # fresh session, fresh peak
         try:
             import jax
             trace_dir = _CONFIG.get("jax_trace_dir")

@@ -16,7 +16,7 @@
 # shapes match the Python frontend exactly.
 module MXNetTPU
 
-export NDArray, invoke, Predictor, set_input!, forward!, get_output
+export NDArray, invoke_op, Predictor, set_input!, forward!, get_output
 
 const _default_lib = normpath(joinpath(@__DIR__, "..", "..",
     "incubator_mxnet_tpu", "native", "libmxtpu_predict.so"))
@@ -114,12 +114,13 @@ function Base.Array(x::NDArray)
     permutedims(a, reverse(ntuple(identity, length(shape))))
 end
 
-"""invoke(op, inputs...; kwargs...) — name-dispatched eager operator call
-(≙ MXImperativeInvokeEx). `invoke("dot", a, b)`,
-`invoke("sum", a; axis=1)`, `invoke("linalg.gemm2", a, b)`. Returns a
-Vector{NDArray} (most ops have one output)."""
-function invoke(op::AbstractString, inputs::NDArray...; cap::Integer = 8,
-                kwargs...)
+"""invoke_op(op, inputs...; kwargs...) — name-dispatched eager operator
+call (≙ MXImperativeInvokeEx; named to avoid colliding with
+`Base.invoke`). `invoke_op("dot", a, b)`, `invoke_op("sum", a; axis=1)`,
+`invoke_op("linalg.gemm2", a, b)`. Returns a Vector{NDArray} (most ops
+have one output)."""
+function invoke_op(op::AbstractString, inputs::NDArray...; cap::Integer = 8,
+                   kwargs...)
     ins = Ptr{Cvoid}[x.handle for x in inputs]
     outs = fill(C_NULL, cap)
     n = Ref{Cint}(0)

@@ -13,6 +13,7 @@ import sys
 
 from setuptools import setup, find_packages
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
 
 
 class BuildWithNative(build_py):
@@ -23,6 +24,14 @@ class BuildWithNative(build_py):
             native_lib.build(force=True)
             native_lib.build_predict(force=True)
         super().run()
+
+
+class BinaryDistribution(Distribution):
+    """The bundled .so files are platform/arch-specific: force a platform
+    wheel tag (a py3-none-any wheel would install-but-break elsewhere)."""
+
+    def has_ext_modules(self):
+        return not os.environ.get("MXTPU_SKIP_NATIVE_BUILD")
 
 
 setup(
@@ -37,4 +46,5 @@ setup(
     python_requires=">=3.10",
     install_requires=["jax", "numpy"],
     cmdclass={"build_py": BuildWithNative},
+    distclass=BinaryDistribution,
 )

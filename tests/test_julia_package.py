@@ -119,9 +119,9 @@ push!(LOAD_PATH, joinpath(%r, "julia_package", "src"))
 using MXNetTPU
 a = NDArray(Float32[1 2 3; 4 5 6])
 b = NDArray(ones(Float32, 2, 3))
-s = Array(invoke("broadcast_add", a, b)[1])
+s = Array(invoke_op("broadcast_add", a, b)[1])
 @assert s == Float32[2 3 4; 5 6 7]
-r = Array(invoke("sum", a; axis=1)[1])
+r = Array(invoke_op("sum", a; axis=1)[1])
 @assert r == Float32[6, 15]
 println("JULIA OK")
 """ % ROOT)
