@@ -19,7 +19,9 @@ import json
 
 import numpy as np
 
-__all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_bytes", "invoke"]
+__all__ = ["nd_create", "nd_shape", "nd_dtype", "nd_bytes", "invoke",
+           "attach_grad", "record_begin", "record_end", "backward",
+           "grad_of", "set_data"]
 
 
 def _nd_mod():
@@ -50,6 +52,58 @@ def nd_dtype(h):
 def nd_bytes(h):
     """≙ MXNDArraySyncCopyToCPU."""
     return np.ascontiguousarray(h.asnumpy()).tobytes()
+
+
+# -- autograd slice (≙ MXAutogradSetIsRecording / MXAutogradBackwardEx /
+# MXNDArrayGetGrad): with invoke() above, non-Python frontends can TRAIN —
+# attach grads, record a tape scope, run ops, backward, read gradients,
+# and write updated parameter values back (set_data).
+_RECORD_SCOPES = []
+
+
+def attach_grad(h):
+    h.attach_grad()
+    return True
+
+
+def record_begin():
+    from incubator_mxnet_tpu import autograd
+    scope = autograd.record()
+    scope.__enter__()
+    _RECORD_SCOPES.append(scope)
+    return True
+
+
+def record_end():
+    if not _RECORD_SCOPES:
+        raise RuntimeError("record_end without record_begin")
+    _RECORD_SCOPES.pop().__exit__(None, None, None)
+    return True
+
+
+def backward(h):
+    h.backward()
+    return True
+
+
+def grad_of(h):
+    g = h.grad
+    if g is None:
+        raise ValueError("no gradient: attach_grad not called or backward "
+                         "not run")
+    return g
+
+
+def set_data(h, view, dtype):
+    """Overwrite h's buffer from host bytes (the optimizer-update writeback
+    path for C-side training loops)."""
+    dt = np.dtype(dtype)
+    want = int(np.prod(h.shape, dtype=np.int64)) * dt.itemsize
+    if view.nbytes != want:
+        raise ValueError("got %d bytes, want %d" % (view.nbytes, want))
+    arr = np.frombuffer(view, dtype=dt).reshape(h.shape)
+    h._data = __import__("jax").numpy.asarray(arr)
+    return True
 
 
 def invoke(op_name, inputs, kwargs_json):

@@ -521,3 +521,73 @@ int MXTPUImperativeInvoke(const char* op_name, void** inputs, int n_inputs,
 const char* MXTPUNDGetLastError() { return g_err.c_str(); }
 
 }  // extern "C"
+
+// ---- autograd slice (ref c_api.h MXAutogradSetIsRecording /
+// MXAutogradBackwardEx / MXNDArrayGetGrad): with MXTPUImperativeInvoke,
+// non-Python frontends can TRAIN from C — tape scope, backward, gradient
+// readout, and parameter writeback. -----------------------------------
+
+namespace {
+
+int call_bool(const char* fn, PyObject* args) {
+  PyObject* r = call_invoke(fn, args);
+  Py_DECREF(args);
+  if (!r) return fail_py(fn);
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" int MXTPUNDAttachGrad(void* handle) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<NDHandle*>(handle);
+  return call_bool("attach_grad", Py_BuildValue("(O)", h->arr));
+}
+
+extern "C" int MXTPUAutogradRecordBegin() {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_bool("record_begin", PyTuple_New(0));
+}
+
+extern "C" int MXTPUAutogradRecordEnd() {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_bool("record_end", PyTuple_New(0));
+}
+
+extern "C" int MXTPUNDBackward(void* handle) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<NDHandle*>(handle);
+  return call_bool("backward", Py_BuildValue("(O)", h->arr));
+}
+
+// Returns a NEW NDArray handle holding the gradient of `handle`.
+extern "C" int MXTPUNDGetGrad(void* handle, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<NDHandle*>(handle);
+  PyObject* args = Py_BuildValue("(O)", h->arr);
+  PyObject* r = call_invoke("grad_of", args);
+  Py_DECREF(args);
+  if (!r) return fail_py("MXTPUNDGetGrad");
+  *out = new NDHandle{r};
+  return 0;
+}
+
+// Overwrite the array's buffer from host bytes (optimizer writeback).
+extern "C" int MXTPUNDSetData(void* handle, const char* dtype,
+                              const void* data, int64_t nbytes) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<NDHandle*>(handle);
+  PyObject* view = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+  if (!view) return fail_py("MXTPUNDSetData");
+  PyObject* args = Py_BuildValue("(ONs)", h->arr, view, dtype);
+  if (!args) return fail_py("MXTPUNDSetData");
+  return call_bool("set_data", args);
+}

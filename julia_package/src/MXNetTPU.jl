@@ -16,7 +16,8 @@
 # shapes match the Python frontend exactly.
 module MXNetTPU
 
-export NDArray, invoke_op, Predictor, set_input!, forward!, get_output
+export NDArray, invoke_op, Predictor, set_input!, forward!, get_output,
+       attach_grad!, recording, backward!, grad, set_data!
 
 const _default_lib = normpath(joinpath(@__DIR__, "..", "..",
     "incubator_mxnet_tpu", "native", "libmxtpu_predict.so"))
@@ -131,6 +132,50 @@ function invoke_op(op::AbstractString, inputs::NDArray...; cap::Integer = 8,
                   Cint, Ptr{Cint}),
                  op, ins, length(ins), kw, outs, cap, n))
     [NDArray(Ptr{Cvoid}(outs[i])) for i in 1:n[]]
+end
+
+# ------------------------------------------------------------- autograd
+# (≙ MXAutogradSetIsRecording / MXAutogradBackwardEx / MXNDArrayGetGrad —
+# the slice that lets Julia TRAIN, not just run inference)
+
+"""attach_grad!(x) — mark x as a differentiable leaf."""
+attach_grad!(x::NDArray) =
+    _check(ccall((:MXTPUNDAttachGrad, _lib[]), Cint, (Ptr{Cvoid},),
+                 x.handle))
+
+"""recording(f) — run f() inside an autograd tape scope:
+`loss = recording(() -> invoke_op("sum", invoke_op("dot", x, w)[1])[1])`."""
+function recording(f)
+    _check(ccall((:MXTPUAutogradRecordBegin, _lib[]), Cint, ()))
+    try
+        return f()
+    finally
+        _check(ccall((:MXTPUAutogradRecordEnd, _lib[]), Cint, ()))
+    end
+end
+
+"""backward!(loss) — reverse pass from a (scalar) recorded output."""
+backward!(loss::NDArray) =
+    _check(ccall((:MXTPUNDBackward, _lib[]), Cint, (Ptr{Cvoid},),
+                 loss.handle))
+
+"""grad(x) — the gradient accumulated on leaf x (a new NDArray)."""
+function grad(x::NDArray)
+    h = Ref{Ptr{Cvoid}}(C_NULL)
+    _check(ccall((:MXTPUNDGetGrad, _lib[]), Cint,
+                 (Ptr{Cvoid}, Ptr{Ptr{Cvoid}}), x.handle, h))
+    NDArray(h[])
+end
+
+"""set_data!(x, a) — overwrite x's buffer from a Julia array (the
+optimizer-update writeback for Julia-side training loops)."""
+function set_data!(x::NDArray, a::AbstractArray{T}) where {T}
+    arr = Array(a)
+    c_order = ndims(arr) <= 1 ? arr :
+        permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+    _check(ccall((:MXTPUNDSetData, _lib[]), Cint,
+                 (Ptr{Cvoid}, Cstring, Ptr{Cvoid}, Int64),
+                 x.handle, _JL2NP[T], c_order, Int64(sizeof(c_order))))
 end
 
 # ------------------------------------------------------------- Predictor

@@ -134,6 +134,75 @@ int main(int argc, char** argv) {
   CHECK(nd_free(b));
   CHECK(nd_free(at));
 
+  /* --- TRAIN: the autograd slice (attach_grad!/recording/backward!/grad)
+   * One SGD step on w for loss = sum((x*w - y)^2); the gradient is checked
+   * against the closed form 2*x^T*(x*w - y) computed right here in C. --- */
+  {
+    typedef int (*v_t)(void*);
+    typedef int (*v0_t)(void);
+    typedef int (*gg_t)(void*, void**);
+    v_t nd_attach = (v_t)dlsym(lib, "MXTPUNDAttachGrad");
+    v0_t rec_begin = (v0_t)dlsym(lib, "MXTPUAutogradRecordBegin");
+    v0_t rec_end = (v0_t)dlsym(lib, "MXTPUAutogradRecordEnd");
+    v_t nd_backward = (v_t)dlsym(lib, "MXTPUNDBackward");
+    gg_t nd_grad = (gg_t)dlsym(lib, "MXTPUNDGetGrad");
+    if (!nd_attach || !rec_begin || !rec_end || !nd_backward || !nd_grad) {
+      fprintf(stderr, "missing autograd symbols\n");
+      return 1;
+    }
+    float x_d[6] = {1, -1, 2, 0.5f, 3, -2};   /* (2,3) */
+    float w_d[3] = {0.5f, -1, 2};             /* (3,)->(3,1) */
+    float y_d[2] = {1, -1};                   /* (2,1) */
+    int64_t s23[2] = {2, 3}, s31[2] = {3, 1}, s21[2] = {2, 1};
+    void *xh = NULL, *wh = NULL, *yh = NULL;
+    CHECK(nd_create("float32", s23, 2, x_d, sizeof(x_d), &xh));
+    CHECK(nd_create("float32", s31, 2, w_d, sizeof(w_d), &wh));
+    CHECK(nd_create("float32", s21, 2, y_d, sizeof(y_d), &yh));
+    CHECK(nd_attach(wh));
+    CHECK(rec_begin());
+    void* t[2] = {xh, wh};
+    CHECK(invoke("dot", t, 2, "", outs, 8, &n_out));
+    void* pred = outs[0];
+    void* t2[2] = {pred, yh};
+    CHECK(invoke("broadcast_sub", t2, 2, "", outs, 8, &n_out));
+    void* dif = outs[0];
+    CHECK(invoke("square", &dif, 1, "", outs, 8, &n_out));
+    void* sq = outs[0];
+    CHECK(invoke("sum", &sq, 1, "", outs, 8, &n_out));
+    void* loss = outs[0];
+    CHECK(rec_end());
+    CHECK(nd_backward(loss));
+    void* gw = NULL;
+    CHECK(nd_grad(wh, &gw));
+    int64_t nb = 0;
+    CHECK(nd_data(gw, NULL, 0, &nb));
+    float gbuf[3];
+    if (nb != sizeof(gbuf)) return 1;
+    CHECK(nd_data(gw, gbuf, nb, NULL));
+    /* closed form */
+    float pred_d[2], want[3] = {0, 0, 0};
+    for (int i = 0; i < 2; ++i) {
+      pred_d[i] = 0;
+      for (int j = 0; j < 3; ++j) pred_d[i] += x_d[i * 3 + j] * w_d[j];
+    }
+    for (int j = 0; j < 3; ++j)
+      for (int i = 0; i < 2; ++i)
+        want[j] += 2.0f * x_d[i * 3 + j] * (pred_d[i] - y_d[i]);
+    for (int j = 0; j < 3; ++j) {
+      float d = gbuf[j] - want[j];
+      if (d < 0) d = -d;
+      if (d > 1e-4f * (want[j] < 0 ? -want[j] : want[j]) + 1e-5f) {
+        fprintf(stderr, "grad mismatch [%d]: %f vs %f\n", j, gbuf[j],
+                want[j]);
+        return 1;
+      }
+    }
+    printf("TRAINOK\n");
+    CHECK(nd_free(pred)); CHECK(nd_free(dif)); CHECK(nd_free(sq));
+    CHECK(nd_free(loss)); CHECK(nd_free(gw));
+    CHECK(nd_free(xh)); CHECK(nd_free(wh)); CHECK(nd_free(yh));
+  }
+
   /* --- Predictor path (same sequence as Predictor/set_input!/forward!) */
   if (argc >= 4) {
     pred_create_t pc = (pred_create_t)dlsym(lib, "MXTPUPredCreate");

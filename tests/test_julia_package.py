@@ -89,6 +89,9 @@ def test_julia_ccall_sequence_standalone(tmp_path):
                        text=True, env=env, timeout=300)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "ERRPATH ok" in r.stdout and "DONE" in r.stdout
+    # the autograd slice: a full C-side train step with the gradient
+    # checked against the closed form inside the harness
+    assert "TRAINOK" in r.stdout
 
     s = _parse_sections(r.stdout)
     a = onp.arange(1, 7, dtype=onp.float32).reshape(2, 3)
@@ -123,6 +126,19 @@ s = Array(invoke_op("broadcast_add", a, b)[1])
 @assert s == Float32[2 3 4; 5 6 7]
 r = Array(invoke_op("sum", a; axis=1)[1])
 @assert r == Float32[6, 15]
+w = NDArray(reshape(Float32[0.5, -1, 2], 3, 1))
+x = NDArray(Float32[1 -1 2; 0.5 3 -2])
+y = NDArray(reshape(Float32[1, -1], 2, 1))
+attach_grad!(w)
+loss = recording() do
+    p = invoke_op("dot", x, w)[1]
+    d = invoke_op("broadcast_sub", p, y)[1]
+    invoke_op("sum", invoke_op("square", d)[1])[1]
+end
+backward!(loss)
+g = Array(grad(w))
+@assert size(g) == (3, 1)
+set_data!(w, Array(w) .- 0.1f0 .* g)
 println("JULIA OK")
 """ % ROOT)
     env = dict(os.environ)
