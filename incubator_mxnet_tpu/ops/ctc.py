@@ -1,0 +1,102 @@
+"""CTC loss — log-space alpha recursion over a lax.scan
+(ref src/operator/nn/ctc_loss.cc / 3rdparty warp-ctc semantics).
+
+TPU-native: the whole forward DP is one scan over time with static shapes
+(the extended blank-interleaved label sequence is padded to 2L+1); the
+backward pass is jax autodiff through the scan — no hand-written beta
+recursion needed, and the (T, N, 2L+1) alpha lattice never materializes in
+HBM beyond the scan carry.
+
+Contract (matching the reference op):
+- x: (T, N, C) UNNORMALIZED activations (softmax applied internally)
+- labels: (N, L) float/int; entries < 0 are padding when label lengths are
+  not given explicitly
+- blank is class 0 ("first", the reference default) or C-1 ("last")
+- returns per-sample NEGATIVE log likelihood (N,)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ctc_loss"]
+
+_NEG = -1e30  # -inf stand-in that stays NaN-free through logsumexp
+
+
+def _lse(*xs):
+    m = xs[0]
+    for x in xs[1:]:
+        m = jnp.maximum(m, x)
+    s = sum(jnp.exp(x - m) for x in xs)
+    return m + jnp.log(jnp.maximum(s, 1e-37))
+
+
+def ctc_loss(x, labels, data_lengths=None, label_lengths=None,
+             blank_label="first"):
+    T, N, C = x.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)  # (T,N,C)
+    labels = labels.astype(jnp.int32)
+
+    if label_lengths is None:
+        ll = jnp.sum((labels >= 0).astype(jnp.int32), axis=1)   # (N,)
+    else:
+        ll = label_lengths.astype(jnp.int32)
+    if data_lengths is None:
+        dl = jnp.full((N,), T, jnp.int32)
+    else:
+        dl = data_lengths.astype(jnp.int32)
+
+    blank = 0 if blank_label == "first" else C - 1
+    safe_labels = jnp.where(labels >= 0, labels, blank)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank  (N, S)
+    pos = jnp.arange(S)
+    is_lab = (pos % 2 == 1)
+    lab_idx = jnp.minimum(pos // 2, L - 1)
+    ext = jnp.where(is_lab[None, :], safe_labels[:, lab_idx], blank)  # (N,S)
+    # valid extended positions: s < 2*ll+1
+    valid = pos[None, :] < (2 * ll + 1)[:, None]                      # (N,S)
+
+    # skip-transition allowed at s when ext[s] != blank and ext[s]!=ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((N, 2), -1, jnp.int32),
+                              ext[:, :-2]], axis=1)
+    can_skip = is_lab[None, :] & (ext != ext_m2)                      # (N,S)
+
+    batch = jnp.arange(N)
+
+    def emit(t_logp):  # (N,C) -> (N,S) log prob of each extended symbol
+        return t_logp[batch[:, None], ext]
+
+    alpha0 = jnp.full((N, S), _NEG, jnp.float32)
+    e0 = emit(logp[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(ll > 0, e0[:, 1], _NEG))
+
+    def step(alpha, t_and_logp):
+        t, lp = t_and_logp
+        prev1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG, jnp.float32), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG, jnp.float32), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        a = _lse(alpha, prev1, prev2) + emit(lp)
+        a = jnp.where(valid, a, _NEG)
+        # past this sample's data length the lattice freezes
+        live = (t < dl)[:, None]
+        a = jnp.where(live, a, alpha)
+        return a, None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha0, (ts, logp[1:]))
+
+    # NLL = -logsumexp(alpha[2*ll], alpha[2*ll-1]) at each sample's end
+    end = 2 * ll
+    a_end = alpha[batch, jnp.clip(end, 0, S - 1)]
+    a_end1 = jnp.where(ll > 0,
+                       alpha[batch, jnp.clip(end - 1, 0, S - 1)], _NEG)
+    # (ll == 0 degenerates correctly: end = 0 is the all-blank path)
+    return (-_lse(a_end, a_end1)).astype(jnp.float32)

@@ -1,0 +1,126 @@
+"""CTC loss (ref src/operator/nn/ctc_loss.cc + tests/python/unittest
+test_operator.py ctc cases). The r2 tree shipped nd.CTCLoss pointing at a
+module that did not exist — these tests pin the r3 implementation against
+a direct numpy forward DP and finite differences."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _ctc_ref(x, label, blank):
+    """Direct O(T*S) numpy forward DP, one sample. x: (T, C) logits."""
+    T, C = x.shape
+    p = x - x.max(-1, keepdims=True)
+    p = p - onp.log(onp.exp(p).sum(-1, keepdims=True))   # log softmax
+    lab = [int(v) for v in label if v >= 0]
+    ext = [blank]
+    for s in lab:
+        ext += [s, blank]
+    S = len(ext)
+    NEG = -1e30
+    a = onp.full(S, NEG)
+    a[0] = p[0, ext[0]]
+    if S > 1:
+        a[1] = p[0, ext[1]]
+    for t in range(1, T):
+        na = onp.full(S, NEG)
+        for s in range(S):
+            c = [a[s]]
+            if s >= 1:
+                c.append(a[s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                c.append(a[s - 2])
+            m = max(c)
+            na[s] = m + onp.log(sum(onp.exp(v - m) for v in c)) + p[t, ext[s]]
+        a = na
+    ends = [a[S - 1]] + ([a[S - 2]] if S > 1 else [])
+    m = max(ends)
+    return -(m + onp.log(sum(onp.exp(v - m) for v in ends)))
+
+
+@pytest.mark.parametrize("blank_label", ["first", "last"])
+def test_ctc_matches_reference_dp(blank_label):
+    rng = onp.random.RandomState(0)
+    T, N, C, L = 12, 4, 6, 3
+    x = rng.randn(T, N, C).astype("float32")
+    blank = 0 if blank_label == "first" else C - 1
+    # labels avoid the blank class; rows have different lengths via -1 pad
+    lens = [3, 2, 1, 3]
+    labels = -onp.ones((N, L), "float32")
+    for i, l in enumerate(lens):
+        choices = [c for c in range(C) if c != blank]
+        labels[i, :l] = rng.choice(choices, l)
+    out = nd.CTCLoss(nd.array(x), nd.array(labels),
+                     blank_label=blank_label).asnumpy()
+    for i in range(N):
+        want = _ctc_ref(x[:, i], labels[i], blank)
+        assert abs(out[i] - want) < 1e-3, (i, out[i], want)
+
+
+def test_ctc_variable_data_lengths():
+    rng = onp.random.RandomState(1)
+    T, N, C = 10, 3, 5
+    x = rng.randn(T, N, C).astype("float32")
+    labels = onp.array([[1, 2, -1], [3, -1, -1], [1, 1, -1]], "float32")
+    dl = onp.array([6, 10, 8], "float32")
+    out = nd.CTCLoss(nd.array(x), nd.array(labels), nd.array(dl), None,
+                     use_data_lengths=True).asnumpy()
+    for i in range(N):
+        want = _ctc_ref(x[: int(dl[i]), i], labels[i], 0)
+        assert abs(out[i] - want) < 1e-3, (i, out[i], want)
+
+
+def test_ctc_gradient_finite_differences():
+    rng = onp.random.RandomState(2)
+    T, N, C = 6, 2, 4
+    x = rng.randn(T, N, C).astype("float64")
+    labels = onp.array([[1, 2], [3, -1]], "float64")
+
+    a = nd.array(x.astype("float32"))
+    a.attach_grad()
+    with autograd.record():
+        loss = nd.CTCLoss(a, nd.array(labels)).sum()
+    loss.backward()
+    g = a.grad.asnumpy()
+
+    eps = 1e-3
+    for _ in range(8):
+        t, n, c = rng.randint(T), rng.randint(N), rng.randint(C)
+        xp, xm = x.copy(), x.copy()
+        xp[t, n, c] += eps
+        xm[t, n, c] -= eps
+        fp = nd.CTCLoss(nd.array(xp.astype("float32")),
+                        nd.array(labels)).sum().asnumpy()
+        fm = nd.CTCLoss(nd.array(xm.astype("float32")),
+                        nd.array(labels)).sum().asnumpy()
+        want = (float(fp) - float(fm)) / (2 * eps)
+        assert abs(g[t, n, c] - want) < 5e-2 * max(1.0, abs(want)), \
+            ((t, n, c), g[t, n, c], want)
+
+
+def test_ctc_gluon_loss_layout():
+    """gluon CTCLoss NTC layout wraps the op (the DeepSpeech-style usage —
+    example/speech_recognition/train_ctc.py)."""
+    from incubator_mxnet_tpu import gluon
+    rng = onp.random.RandomState(3)
+    N, T, C = 2, 8, 5
+    pred = nd.array(rng.randn(N, T, C).astype("float32"))
+    label = nd.array(onp.array([[1, 2, -1], [3, 1, 2]], "float32"))
+    loss = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")(pred, label)
+    assert loss.shape == (N,)
+    assert bool(onp.isfinite(loss.asnumpy()).all())
+    # training through it moves the loss down
+    w = nd.array(rng.randn(C, C).astype("float32") * 0.1)
+    w.attach_grad()
+    vals = []
+    for _ in range(30):
+        with autograd.record():
+            out = nd.dot(pred.reshape((-1, C)), w).reshape((N, T, C))
+            l = gluon.loss.CTCLoss(layout="NTC")(out, label).mean()
+        l.backward()
+        w -= 0.5 * w.grad
+        vals.append(float(l.asnumpy()))
+    assert vals[-1] < vals[0]
