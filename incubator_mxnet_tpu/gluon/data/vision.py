@@ -40,10 +40,15 @@ class _DownloadedDataset(Dataset):
 
 
 def _synthetic(n, shape, num_classes, seed):
-    rng = onp.random.RandomState(seed)
+    # Class means come from a DEDICATED stream: train and test splits draw
+    # different n, which used to shift the rng state before the means were
+    # sampled — giving each split different class prototypes and making
+    # held-out accuracy chance-level. Means are split-invariant now;
+    # labels/noise still differ per split (keyed by n).
+    base = onp.random.RandomState(seed).rand(num_classes, *shape) \
+        .astype("float32")
+    rng = onp.random.RandomState(seed + 100003 * n)
     label = rng.randint(0, num_classes, size=(n,)).astype("int32")
-    # class-dependent means make the synthetic task learnable
-    base = rng.rand(num_classes, *shape).astype("float32")
     data = base[label] * 0.8 + rng.rand(n, *shape).astype("float32") * 0.2
     return data, label
 

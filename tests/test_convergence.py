@@ -60,3 +60,12 @@ def test_gluon_trainer_converges_on_mnist():
             step(x[perm[i:i + 256]], y[perm[i:i + 256]])
     pred = net(x).asnumpy().argmax(-1)
     assert (pred == label).mean() > 0.95
+    # GENERALIZATION, not memorization: the held-out split shares the
+    # class prototypes but has fresh labels/noise (r3: the fallback used
+    # to draw different prototypes per split, making this chance-level)
+    ev = MNIST(train=False)
+    xe = nd.array(ev._data.asnumpy().astype("float32")
+                  .transpose(0, 3, 1, 2) / 255.0)
+    ye = onp.asarray(ev._label, dtype="float32")
+    eacc = (net(xe).asnumpy().argmax(-1) == ye).mean()
+    assert eacc > 0.95, eacc
