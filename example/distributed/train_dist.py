@@ -29,6 +29,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=32, help="per worker")
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--kv-store", default="dist_sync",
+                    choices=["dist_sync", "dist_async"],
+                    help="dist_async = bounded-staleness local SGD "
+                         "(periodic averaging, MXTPU_ASYNC_STALENESS)")
     args = ap.parse_args()
 
     # initialize the process group BEFORE touching devices
@@ -41,7 +45,7 @@ def main():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, autograd, gluon, models
 
-    kv = mx.kv.create("dist_sync")
+    kv = mx.kv.create(args.kv_store)
     rank, nworkers = kv.rank, kv.num_workers
     logging.info("worker %d/%d up", rank, nworkers)
 
@@ -78,7 +82,10 @@ def main():
         logging.info("worker %d epoch %d: loss=%.4f acc=%.3f",
                      rank, epoch, total / n, metric.get()[1])
 
-    # the dist_sync invariant: identical params everywhere
+    if args.kv_store == "dist_async":
+        kv.sync()  # epoch/end-of-training boundary: force a full average
+    # the invariant: identical params everywhere (dist_sync after every
+    # step; dist_async after the explicit sync())
     import hashlib
     digest = hashlib.sha1()
     for name in sorted(net.collect_params()):
