@@ -1,0 +1,161 @@
+"""Faster-R-CNN-style two-stage detector (ref example/rcnn/ — reduced to
+the load-bearing pipeline: backbone -> RPN -> Proposal -> ROIAlign ->
+per-ROI heads).
+
+TPU-native notes: the RPN trains with a dense anchor objectness/bbox loss
+(static shapes); `nd.contrib.MultiProposal` turns RPN outputs into ROIs
+(top-k NMS, eager — data-dependent), and `nd.contrib.ROIAlign` crops
+per-ROI features for the second-stage classifier. Synthetic scenes (one
+bright square per image, class = square size) keep it runnable anywhere:
+
+    python example/rcnn/train_frcnn.py --epochs 8
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+IM = 64          # image size
+STRIDE = 8       # backbone downsampling
+ANCHOR = 16.0    # single square anchor per cell
+N_CLS = 2        # small / large square
+
+
+def synth_scene(rng):
+    img = rng.rand(3, IM, IM).astype("float32") * 0.1
+    big = rng.randint(0, 2)
+    side = 24 if big else 12
+    y0 = rng.randint(0, IM - side)
+    x0 = rng.randint(0, IM - side)
+    img[:, y0:y0 + side, x0:x0 + side] += 0.8
+    return img, onp.array([x0, y0, x0 + side, y0 + side], "float32"), big
+
+
+def rpn_targets(box):
+    """Objectness (1 at the cell containing the box center) + bbox deltas
+    for each feature cell's anchor."""
+    G = IM // STRIDE
+    obj = onp.zeros((G, G), "float32")
+    deltas = onp.zeros((4, G, G), "float32")
+    cx, cy = (box[0] + box[2]) / 2, (box[1] + box[3]) / 2
+    gx, gy = int(cx // STRIDE), int(cy // STRIDE)
+    obj[gy, gx] = 1.0
+    ax, ay = gx * STRIDE + STRIDE / 2, gy * STRIDE + STRIDE / 2
+    w, h = box[2] - box[0], box[3] - box[1]
+    deltas[:, gy, gx] = [(cx - ax) / ANCHOR, (cy - ay) / ANCHOR,
+                         onp.log(w / ANCHOR), onp.log(h / ANCHOR)]
+    return obj, deltas
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rng = onp.random.RandomState(0)
+
+    backbone = nn.HybridSequential()
+    backbone.add(nn.Conv2D(16, 3, 2, 1, activation="relu"),
+                 nn.Conv2D(32, 3, 2, 1, activation="relu"),
+                 nn.Conv2D(32, 3, 2, 1, activation="relu"))   # /8
+    rpn_head = nn.Conv2D(1 + 4, 1)        # objectness logit + 4 deltas
+    roi_head = nn.HybridSequential()
+    roi_head.add(nn.Dense(64, activation="relu"), nn.Dense(N_CLS))
+    for blk in (backbone, rpn_head, roi_head):
+        blk.initialize(mx.init.Xavier())
+    params = (list(backbone.collect_params().values())
+              + list(rpn_head.collect_params().values())
+              + list(roi_head.collect_params().values()))
+    all_params = {}
+    for blk in (backbone, rpn_head, roi_head):
+        all_params.update(blk.collect_params())
+    trainer = gluon.Trainer(all_params, "adam", {"learning_rate": 2e-3})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    l1 = gluon.loss.HuberLoss()
+
+    data = [synth_scene(rng) for _ in range(256)]
+    n_batches = len(data) // args.batch
+    for epoch in range(args.epochs):
+        order = rng.permutation(len(data))
+        tot = cls_hits = n_roi = 0
+        for b in range(n_batches):
+            batch = [data[i] for i in order[b * args.batch:(b + 1) * args.batch]]
+            imgs = nd.array(onp.stack([d[0] for d in batch]))
+            objs = nd.array(onp.stack([rpn_targets(d[1])[0] for d in batch]))
+            dels = nd.array(onp.stack([rpn_targets(d[1])[1] for d in batch]))
+            labels = nd.array(onp.array([d[2] for d in batch], "float32"))
+            with autograd.record():
+                feat = backbone(imgs)
+                rpn = rpn_head(feat)
+                obj_logit = rpn[:, 0]
+                deltas = rpn[:, 1:]
+                # RPN losses (dense, static)
+                l_obj = bce(obj_logit, objs).mean()
+                mask = objs.expand_dims(1)
+                l_box = (l1(deltas * mask, dels * mask)).mean() * 10.0
+                # second stage: ROIs from the ground-truth cell (teacher
+                # forcing keeps the graph static; Proposal used at eval)
+                rois = []
+                for i, d in enumerate(batch):
+                    x0, y0, x1, y1 = d[1]
+                    rois.append([i, x0, y0, x1, y1])
+                rois = nd.array(onp.array(rois, "float32"))
+                crops = nd.contrib.ROIAlign(feat, rois, (4, 4), 1.0 / STRIDE)
+                logits = roi_head(crops.reshape((len(batch), -1)))
+                l_cls = sce(logits, labels).mean()
+                loss = l_obj + l_box + l_cls
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+            cls_hits += int((logits.asnumpy().argmax(-1) ==
+                             labels.asnumpy()).sum())
+            n_roi += len(batch)
+        print("epoch %d: loss %.3f  roi-cls acc %.3f"
+              % (epoch, tot / n_batches, cls_hits / n_roi))
+
+    # ---- eval: full two-stage inference with MultiProposal + NMS -------
+    hits = iou_sum = 0.0
+    for i in range(16):
+        img, box, big = synth_scene(onp.random.RandomState(1000 + i))
+        feat = backbone(nd.array(img[None]))
+        rpn = rpn_head(feat)
+        obj = nd.sigmoid(rpn[:, 0:1])
+        cls_prob = nd.concat(1 - obj, obj, dim=1)     # (1,2,G,G)
+        # RPN deltas are already in the standard (dx,dy,dw,dh) anchor
+        # parameterization MultiProposal applies (anchor side == ANCHOR)
+        bbox_pred = rpn[:, 1:]
+        im_info = nd.array([[IM, IM, 1.0]])
+        rois = nd.contrib.MultiProposal(
+            cls_prob, bbox_pred, im_info, feature_stride=STRIDE,
+            scales=(2.0,), ratios=(1.0,), rpn_pre_nms_top_n=16,
+            rpn_post_nms_top_n=1, threshold=0.7, rpn_min_size=4)
+        r = rois.asnumpy()[0]                         # [batch, x0,y0,x1,y1]
+        ix0, iy0, ix1, iy1 = r[1], r[2], r[3], r[4]
+        inter = max(0, min(ix1, box[2]) - max(ix0, box[0])) * \
+            max(0, min(iy1, box[3]) - max(iy0, box[1]))
+        union = (ix1 - ix0) * (iy1 - iy0) + \
+            (box[2] - box[0]) * (box[3] - box[1]) - inter
+        iou_sum += inter / max(union, 1e-6)
+        crop = nd.contrib.ROIAlign(feat, rois, (4, 4), 1.0 / STRIDE)
+        pred = roi_head(crop.reshape((1, -1))).asnumpy().argmax(-1)[0]
+        hits += int(pred == big)
+    print("eval: proposal mean IoU %.2f, roi-cls acc %.2f"
+          % (iou_sum / 16, hits / 16))
+    # the single-anchor toy setup bounds IoU; the pipeline working at all
+    # (proposals overlapping the object + ROI heads classifying) is the
+    # point of the example
+    assert hits / 16 >= 0.5
+
+
+if __name__ == "__main__":
+    main()
