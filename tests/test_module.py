@@ -225,6 +225,15 @@ def test_image_record_pipeline(tmp_path):
                                 num_parts=2, part_index=1)
     b2 = it2.next()
     assert b2.data[0].shape == (2, 3, 12, 12)
+    # PNG payloads take the tier-2 path (native reader + PIL decode);
+    # dtype='uint8' must hold there too (ADVICE r2)
+    it8 = mx.io.ImageRecordIter(path_imgrec=uri, path_imgidx=idx,
+                                data_shape=(3, 12, 12), batch_size=4,
+                                dtype="uint8")
+    b8 = it8.next()
+    assert str(b8.data[0].dtype) == "uint8"
+    onp.testing.assert_allclose(b8.data[0].asnumpy().astype("float32"),
+                                batch.data[0].asnumpy(), atol=1.0)
 
 
 def test_module_multi_context_data_parallel():

@@ -5,7 +5,12 @@ TPU-native: multi-host SPMD uses jax.distributed — one process per host over
 DCN. This launcher starts N local worker processes with the coordinator env
 (COORD_ADDR/NUM_PROC/PROC_ID), the analog of DMLC_ROLE/DMLC_PS_ROOT_URI for
 the parameter-server design. Remote hosts: run the same command per host with
-PROC_ID set (ssh orchestration mirrors dmlc-tracker's ssh mode).
+PROC_ID set (ssh orchestration mirrors dmlc-tracker's ssh mode; exercised
+only manually — CI images ship no sshd). The reference's mpi/yarn/sge
+launchers are a documented cut: TPU pods are provisioned by the platform
+(GKE/queued resources), which owns the role dmlc-tracker's cluster
+schedulers played — jax.distributed only needs the coordinator address
+this launcher already provides.
 """
 import argparse
 import os
