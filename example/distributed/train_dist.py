@@ -84,6 +84,10 @@ def main():
 
     if args.kv_store == "dist_async":
         kv.sync()  # epoch/end-of-training boundary: force a full average
+        # sync() rebinds the STORE's copies; re-pull so the live params
+        # reflect the average (pull aliases the post-average buffers)
+        for i, p in enumerate(net.collect_params().values()):
+            kv.pull(i, p.data())
     # the invariant: identical params everywhere (dist_sync after every
     # step; dist_async after the explicit sync())
     import hashlib

@@ -72,9 +72,6 @@ def main():
     roi_head.add(nn.Dense(64, activation="relu"), nn.Dense(N_CLS))
     for blk in (backbone, rpn_head, roi_head):
         blk.initialize(mx.init.Xavier())
-    params = (list(backbone.collect_params().values())
-              + list(rpn_head.collect_params().values())
-              + list(roi_head.collect_params().values()))
     all_params = {}
     for blk in (backbone, rpn_head, roi_head):
         all_params.update(blk.collect_params())
@@ -84,15 +81,17 @@ def main():
     l1 = gluon.loss.HuberLoss()
 
     data = [synth_scene(rng) for _ in range(256)]
+    targets = [rpn_targets(d[1]) for d in data]   # once per sample
     n_batches = len(data) // args.batch
     for epoch in range(args.epochs):
         order = rng.permutation(len(data))
         tot = cls_hits = n_roi = 0
         for b in range(n_batches):
-            batch = [data[i] for i in order[b * args.batch:(b + 1) * args.batch]]
+            sel = order[b * args.batch:(b + 1) * args.batch]
+            batch = [data[i] for i in sel]
             imgs = nd.array(onp.stack([d[0] for d in batch]))
-            objs = nd.array(onp.stack([rpn_targets(d[1])[0] for d in batch]))
-            dels = nd.array(onp.stack([rpn_targets(d[1])[1] for d in batch]))
+            objs = nd.array(onp.stack([targets[i][0] for i in sel]))
+            dels = nd.array(onp.stack([targets[i][1] for i in sel]))
             labels = nd.array(onp.array([d[2] for d in batch], "float32"))
             with autograd.record():
                 feat = backbone(imgs)

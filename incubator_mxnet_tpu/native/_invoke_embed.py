@@ -101,7 +101,11 @@ def set_data(h, view, dtype):
     want = int(np.prod(h.shape, dtype=np.int64)) * dt.itemsize
     if view.nbytes != want:
         raise ValueError("got %d bytes, want %d" % (view.nbytes, want))
-    arr = np.frombuffer(view, dtype=dt).reshape(h.shape)
+    # .copy(): the view is a NON-OWNING window over the C caller's buffer
+    # (freed right after the call returns); jax.device_put may take a
+    # zero-copy path for aligned host arrays, so aliasing it would be a
+    # use-after-free — same reason nd_create copies
+    arr = np.frombuffer(view, dtype=dt).reshape(h.shape).copy()
     h._data = __import__("jax").numpy.asarray(arr)
     return True
 

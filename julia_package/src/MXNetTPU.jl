@@ -47,6 +47,11 @@ _json(d::AbstractDict) =
     "{" * join(["\"" * string(k) * "\":" * _json(v) for (k, v) in d], ",") *
     "}"
 
+# row-major (C) <-> column-major (Julia) conversion, shared by every
+# upload path
+_c_order(arr::AbstractArray) = ndims(arr) <= 1 ? arr :
+    permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+
 # ------------------------------------------------------------- NDArray
 mutable struct NDArray
     handle::Ptr{Cvoid}
@@ -64,8 +69,7 @@ framework equals `size(a)` (the row-major transpose happens here)."""
 function NDArray(a::AbstractArray{T}) where {T}
     haskey(_JL2NP, T) || error("unsupported element type $T")
     arr = Array(a)
-    c_order = ndims(arr) <= 1 ? arr :
-        permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+    c_order = _c_order(arr)
     shape = Int64[size(arr)...]
     h = Ref{Ptr{Cvoid}}(C_NULL)
     _check(ccall((:MXTPUNDCreate, _lib[]), Cint,
@@ -171,8 +175,7 @@ end
 optimizer-update writeback for Julia-side training loops)."""
 function set_data!(x::NDArray, a::AbstractArray{T}) where {T}
     arr = Array(a)
-    c_order = ndims(arr) <= 1 ? arr :
-        permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+    c_order = _c_order(arr)
     _check(ccall((:MXTPUNDSetData, _lib[]), Cint,
                  (Ptr{Cvoid}, Cstring, Ptr{Cvoid}, Int64),
                  x.handle, _JL2NP[T], c_order, Int64(sizeof(c_order))))
@@ -197,8 +200,7 @@ end
 ABI) from a Julia array."""
 function set_input!(p::Predictor, index::Integer, a::AbstractArray{T}) where {T}
     arr = Array(a)
-    c_order = ndims(arr) <= 1 ? arr :
-        permutedims(arr, reverse(ntuple(identity, ndims(arr))))
+    c_order = _c_order(arr)
     _check(ccall((:MXTPUPredSetInput, _lib[]), Cint,
                  (Ptr{Cvoid}, Cint, Ptr{Cvoid}, Int64),
                  p.handle, index, c_order, Int64(sizeof(c_order))))
