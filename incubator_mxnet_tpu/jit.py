@@ -51,7 +51,7 @@ class TrainStep:
     """Compile net forward + loss + backward + optimizer update into one program."""
 
     def __init__(self, net, loss_fn, trainer, batch_axis=0, grad_postprocess=None,
-                 mesh=None, data_axis="dp", remat=False):
+                 mesh=None, data_axis="dp", remat=False, zero=False):
         self.net = net
         self.loss_fn = loss_fn
         self.trainer = trainer
@@ -65,6 +65,14 @@ class TrainStep:
         # — trades ~1 extra forward of FLOPs for O(layer) activation memory,
         # the long-sequence HBM lever (SURVEY §7 guidance)
         self.remat = remat
+        # zero: ZeRO-1 / automatic cross-replica sharding of the weight
+        # update (arXiv:2004.13336, the GSPMD-annotation form): optimizer
+        # states (incl. fp32 masters) are SHARDED over the dp axis on dim 0,
+        # so state memory and update FLOPs divide by |dp|; the sharding
+        # mismatch makes XLA lower the grad all-reduce to reduce-scatter and
+        # all-gather the updated weights — no hand-written collectives.
+        # Params themselves stay replicated (ZeRO-1, not 2/3).
+        self.zero = zero
 
     # ------------------------------------------------------------------
     def _split_params(self):
@@ -140,6 +148,7 @@ class TrainStep:
                         w.astype(jnp.float32), gf, state_nd, lrs[i], wds[i], t)
                     new_t.append(new_w.astype(w.dtype))
                     new_opt.append(_tree_to_data(new_state_nd))
+            new_t, new_opt = self._constrain_update(new_t, new_opt, trainable)
             return loss_full, new_t, new_opt, aux_vals
 
         if self.mesh is not None:
@@ -147,6 +156,48 @@ class TrainStep:
         else:
             jitted = jax.jit(step_fn, donate_argnums=(0, 2))
         return jitted, trainable, frozen, t_arrs, f_arrs, aux_box
+
+    def _zero_leaf_sharding(self, p):
+        """Per-leaf optimizer-state sharding rule under zero=True: shard
+        dim 0 over the dp axis when divisible (masters/momenta share the
+        param shape); scalars and indivisible leaves replicate; params a
+        tensor/expert-parallel layer already sharded keep their spec."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        if not self.zero or self.mesh is None \
+                or self.mesh.shape.get(self.data_axis, 1) <= 1 \
+                or getattr(p, "sharding", None) is not None:
+            base = self._param_sharding(p)
+            return lambda leaf: base
+        n = self.mesh.shape[self.data_axis]
+        dp = self.data_axis
+
+        def rule(leaf):
+            shape = getattr(leaf, "shape", ())
+            if len(shape) >= 1 and shape[0] and shape[0] % n == 0:
+                return NamedSharding(
+                    self.mesh,
+                    PartitionSpec(dp, *([None] * (len(shape) - 1))))
+            return repl
+
+        return rule
+
+    def _constrain_update(self, new_t, new_opt, trainable):
+        """Pin the update outputs' shardings (zero mode): new states stay
+        dp-sharded, new weights return to their (replicated/TP) param
+        sharding — the mismatch is what GSPMD lowers to
+        reduce-scatter + sharded update + all-gather."""
+        if not self.zero or self.mesh is None:
+            return new_t, new_opt
+        out_t, out_opt = [], []
+        for w, s, p in zip(new_t, new_opt, trainable):
+            rule = self._zero_leaf_sharding(p)
+            out_t.append(jax.lax.with_sharding_constraint(
+                w, self._param_sharding(p)))
+            out_opt.append(jax.tree_util.tree_map(
+                lambda leaf: jax.lax.with_sharding_constraint(
+                    leaf, rule(leaf)), s))
+        return out_t, out_opt
 
     def _param_sharding(self, p):
         """Per-parameter sharding: p.sharding (a PartitionSpec) if set by a
@@ -172,6 +223,8 @@ class TrainStep:
         data_sh = NamedSharding(self.mesh, PartitionSpec(self.data_axis))
         jitted = jax.jit(step_fn, donate_argnums=(0, 2))
 
+        state_rules = [self._zero_leaf_sharding(p) for p in trainable]
+
         def wrapper(t_datas, f_datas, opt_states, input_datas, *rest):
             # lay out operands on the mesh; no-op once steady-state shardings
             # are established (outputs inherit them), so the reshard cost is
@@ -179,8 +232,8 @@ class TrainStep:
             t_datas = [jax.device_put(d, s) for d, s in zip(t_datas, t_sh)]
             f_datas = [jax.device_put(d, s) for d, s in zip(f_datas, f_sh)]
             opt_states = [jax.tree_util.tree_map(
-                lambda x, _s=s: jax.device_put(x, _s), st)
-                for st, s in zip(opt_states, t_sh)]
+                lambda x, _r=r: jax.device_put(x, _r(x)), st)
+                for st, r in zip(opt_states, state_rules)]
             input_datas = [jax.device_put(d, data_sh) for d in input_datas]
             rest = [jax.device_put(r, repl) for r in rest]
             return jitted(t_datas, f_datas, opt_states, input_datas, *rest)
