@@ -338,6 +338,65 @@ def op_consistency_sweep(dtypes=("float32", "bfloat16", "float16"),
     return rows
 
 
+def grad_consistency_sweep(ctx_list=None, quick=False, seed=0):
+    """Backward-pass companion to op_consistency_sweep: for every
+    differentiable float op in the table, compare d(sum(op))/d(inputs)
+    across contexts at float32 (matmul-class under 'highest' precision).
+    Returns (op, max_rel_err, status) rows."""
+    import contextlib
+    import jax
+    from . import autograd as _ag
+
+    table = [e for e in _sweep_table()
+             if all(kind != "i" for _, kind in e[2])]
+    # non-differentiable / piecewise-constant outputs excluded
+    skip = {"round", "floor", "argmax", "argmin", "one_hot"}
+    table = [e for e in table if e[0].partition("@")[0] not in skip]
+    if quick:
+        table = table[::3]
+    if ctx_list is None:
+        ctx_list = [cpu(0), default_context()]
+    rows = []
+    rng = onp.random.RandomState(seed)
+    for entry_name, fn, specs in table:
+        name, _, tag = entry_name.partition("@")
+        inputs = []
+        for shape, kind in specs:
+            a = rng.uniform(-2.0, 2.0, size=shape).astype("float32")
+            if kind == "pos":
+                a = onp.abs(a) + 0.5
+            inputs.append(a)
+        rtol, atol = (2e-3, 1e-4) if tag == "trans" else (1e-4, 1e-5)
+        prec = jax.default_matmul_precision("highest") if tag == "mm" \
+            else contextlib.nullcontext()
+        try:
+            grads = []
+            with prec:
+                for ctx in ctx_list:
+                    arrs = [nd.array(x, ctx=ctx) for x in inputs]
+                    for a in arrs:
+                        a.attach_grad()
+                    with ctx:
+                        with _ag.record():
+                            out = fn(*arrs)
+                            s = out.sum()
+                        s.backward()
+                    grads.append([a.grad.asnumpy() for a in arrs])
+            err = 0.0
+            ok = True
+            for g in grads[1:]:
+                for a, b in zip(g, grads[0]):
+                    diff = onp.abs(a - b)
+                    err = max(err, float((diff / (onp.abs(b) + atol)).max())
+                              if diff.size else 0.0)
+                    ok = ok and onp.allclose(a, b, rtol=rtol, atol=atol)
+            rows.append((name, err, "ok" if ok else "MISMATCH"))
+        except Exception as e:
+            rows.append((name, None,
+                         "ERROR: %s" % str(e).splitlines()[0][:120]))
+    return rows
+
+
 class random_seed:
     """Context manager fixing framework + numpy seeds (ref common.py with_seed)."""
 

@@ -21,3 +21,15 @@ def test_op_consistency_sweep():
     assert not bad, "sweep failures: %s" % bad
     # the walk actually covered the table x dtypes
     assert len(rows) >= (15 if quick else 150)
+
+
+def test_grad_consistency_sweep():
+    """Backward-pass cross-context walk (forward parity alone can hide
+    VJP-rule divergence). Full CPU<->TPU run: 44/44 clean on hardware
+    (docs/NUMERICS_SWEEP.md)."""
+    from incubator_mxnet_tpu.test_utils import grad_consistency_sweep
+    quick = bool(os.environ.get("MXTPU_TEST_QUICK"))
+    rows = grad_consistency_sweep(quick=quick)
+    bad = [r for r in rows if r[2] != "ok"]
+    assert not bad, "grad sweep failures: %s" % bad
+    assert len(rows) >= (10 if quick else 40)
