@@ -1,0 +1,40 @@
+"""Capture a device trace of the BERT-large-512 train step (the bench's
+secondary phase) for benchmark/roofline.py — the transformer counterpart
+of profile_resnet.py.
+
+Usage: python benchmark/profile_bert.py [outdir]
+"""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as onp
+import jax
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, jit, models
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/bert_prof"
+    B, S, V, U, L, H = 64, 512, 32768, 1024, 12, 8
+    mx.random.seed(0)
+    net = models.BERTModel(vocab_size=V, units=U, hidden_size=4 * U,
+                           num_layers=L, num_heads=H, max_length=S,
+                           dropout=0.0, attention="flash")
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    tokens = nd.array(onp.random.randint(0, V, (B, S)).astype("int32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-4, "multi_precision": True})
+    step = jit.TrainStep(net, loss_fn, trainer)
+    for _ in range(2):
+        float(step(tokens, tokens).mean().asscalar())
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            loss = step(tokens, tokens)
+        float(loss.mean().asscalar())
+    print("profile written to", outdir)
+
+
+if __name__ == "__main__":
+    main()

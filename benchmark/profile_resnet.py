@@ -5,7 +5,10 @@ Writes an xplane/trace.json.gz profile under outdir (default /tmp/rn50_prof);
 feed the trace.json.gz to benchmark/roofline.py for the per-fusion table in
 docs/PERF_RESNET.md.
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as onp
 import jax
 import incubator_mxnet_tpu as mx
