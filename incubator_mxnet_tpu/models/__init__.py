@@ -8,7 +8,8 @@
 """
 from .lenet import LeNet  # noqa
 from .bert import BERTEncoder, BERTModel, TransformerEncoderLayer, MultiHeadAttention  # noqa
-from .gpt import GPTModel, TransformerDecoderLayer  # noqa
+from .gpt import (GPTModel, TransformerDecoderLayer, ChunkedLMLoss,  # noqa
+                  FeaturesView)
 from .lstm_lm import LSTMLanguageModel  # noqa
 from .ssd import SSD  # noqa
 from ..gluon.model_zoo.vision import get_model  # noqa

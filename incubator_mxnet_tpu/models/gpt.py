@@ -73,7 +73,9 @@ class GPTModel(HybridBlock):
                     tp_axis=tp_axis, sp_axis=sp_axis))
             self.ln_f = nn.LayerNorm(in_channels=units)
 
-    def forward(self, token_ids):
+    def features(self, token_ids):
+        """Trunk output (B, S, U) — the pre-head activations (pair with
+        ChunkedLMLoss to avoid materializing (B*S, V) logits)."""
         B, S = token_ids.shape
         if S > self._max_length:
             raise ValueError(
@@ -83,7 +85,55 @@ class GPTModel(HybridBlock):
         pos = nd.arange(S, dtype="int32").reshape((1, S))
         h = self.tok_embed(token_ids) + self.pos_embed(pos)
         h = self.layers(h)
-        h = self.ln_f(h)
+        return self.ln_f(h)
+
+    def forward(self, token_ids):
+        h = self.features(token_ids)
         # weight-tied head: logits = h @ E^T
         return _apply(lambda hd, e: hd @ e.T.astype(hd.dtype), h,
                       self.tok_embed.weight.data())
+
+
+class ChunkedLMLoss:
+    """Loss head that fuses the (weight-tied) LM projection with a CHUNKED
+    softmax-CE (ops/lm_ce.py): the full (T, V) logits never materialize —
+    the vocab-CE HBM lever identified in docs/PERF_BERT.md. Use with the
+    model's ``features`` output:
+
+        gpt = GPTModel(...)
+        loss_fn = ChunkedLMLoss(gpt, chunk=512)
+        step = jit.TrainStep(FeaturesView(gpt), loss_fn, trainer)
+
+    Gradients flow into the tied embedding through ``weight.data()`` the
+    same way they do for any parameter the traced step reads."""
+
+    def __init__(self, model, chunk=512):
+        self._model = model
+        self._chunk = chunk
+
+    def forward(self, hidden, labels):
+        from ..ops.lm_ce import chunked_lm_cross_entropy
+
+        def fn(h, w, y):
+            losses = chunked_lm_cross_entropy(h, w, y, self._chunk)
+            # gluon loss contract: per-sample mean over non-batch axes
+            return losses.reshape(losses.shape[0], -1).mean(axis=1)
+
+        return _apply(fn, hidden, self._model.tok_embed.weight.data(),
+                      labels)
+
+    __call__ = forward
+
+
+class FeaturesView(HybridBlock):
+    """Expose a model's ``features`` as its forward (so TrainStep's
+    net(x) -> loss_fn(out, y) contract pairs the trunk with a fused
+    loss head like ChunkedLMLoss). Shares the wrapped model's params."""
+
+    def __init__(self, model, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.model = model
+
+    def forward(self, token_ids):
+        return self.model.features(token_ids)

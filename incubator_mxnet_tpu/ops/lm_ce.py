@@ -1,0 +1,51 @@
+"""Chunked LM cross-entropy — the vocab-softmax HBM lever
+(docs/PERF_BERT.md: the fp32 (T, V) logits block is ~4 GB at 32k x 32k and
+its reduce fusions run at pure HBM bandwidth, ~15% of the BERT step).
+
+``chunked_lm_cross_entropy(hidden, head_w, labels, chunk)`` computes
+per-token CE WITHOUT materializing the full (T, V) logits: a lax.map over
+token chunks does (chunk, U) @ (U, V) -> LSE + label-logit gather per
+chunk, so at most (chunk, V) logits exist at a time — small enough for
+XLA to keep the matmul output in VMEM feeding the reduction. Backward is
+jax autodiff through the map (the chunk logits are recomputed, the
+classic memory/compute trade).
+
+Numerics: LSE in fp32 with max subtraction; identical to dense softmax-CE
+within bf16 matmul tolerance (tests/test_lm_ce.py pins parity and grads).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["chunked_lm_cross_entropy"]
+
+
+def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=512):
+    """hidden: (..., U) activations; head_w: (V, U) (embedding-tied head);
+    labels: (...,) int. Returns per-token CE losses shaped like labels.
+    Token dims are flattened, chunked, and restored; T % chunk != 0 falls
+    back to a single chunk."""
+    shape = labels.shape
+    U = hidden.shape[-1]
+    h = hidden.reshape(-1, U)
+    y = labels.reshape(-1).astype(jnp.int32)
+    T = h.shape[0]
+    if T % chunk:
+        chunk = T
+    n = T // chunk
+    hc = h.reshape(n, chunk, U)
+    yc = y.reshape(n, chunk)
+
+    def one(args):
+        hb, yb = args
+        logits = (hb @ head_w.T.astype(hb.dtype)).astype(jnp.float32)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        lse = (m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1,
+                                   keepdims=True)))[:, 0]
+        lab = jnp.take_along_axis(logits, yb[:, None], axis=-1)[:, 0]
+        return lse - lab
+
+    losses = lax.map(one, (hc, yc))
+    return losses.reshape(shape)

@@ -1,0 +1,91 @@
+"""Chunked LM cross-entropy (ops/lm_ce.py + models.ChunkedLMLoss) — the
+vocab-softmax HBM lever from docs/PERF_BERT.md: parity with the dense
+logits+softmax path, gradient flow into the tied embedding, and the
+structural guarantee that the full (T, V) logits never materialize."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, jit, models
+from incubator_mxnet_tpu.ops.lm_ce import chunked_lm_cross_entropy
+
+
+def test_chunked_ce_matches_dense():
+    rng = onp.random.RandomState(0)
+    T, U, V = 64, 16, 40
+    h = jnp.asarray(rng.randn(T, U).astype("float32"))
+    w = jnp.asarray(rng.randn(V, U).astype("float32") * 0.2)
+    y = jnp.asarray(rng.randint(0, V, T).astype("int32"))
+
+    def dense(h, w, y):
+        logits = (h @ w.T).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        lab = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+        return lse - lab
+
+    for chunk in (16, 64, 7):  # 7: non-dividing -> single-chunk fallback
+        got = chunked_lm_cross_entropy(h, w, y, chunk=chunk)
+        onp.testing.assert_allclose(onp.asarray(got),
+                                    onp.asarray(dense(h, w, y)),
+                                    rtol=1e-5, atol=1e-6)
+    # gradients match too (autodiff through lax.map)
+    g1 = jax.grad(lambda h, w: chunked_lm_cross_entropy(h, w, y, 16).sum(),
+                  argnums=(0, 1))(h, w)
+    g2 = jax.grad(lambda h, w: dense(h, w, y).sum(), argnums=(0, 1))(h, w)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_ce_never_materializes_full_logits():
+    T, U, V, chunk = 256, 8, 64, 32
+    h = jnp.zeros((T, U))
+    w = jnp.zeros((V, U))
+    y = jnp.zeros((T,), jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda h, w, y: chunked_lm_cross_entropy(h, w, y, chunk))(h, w, y)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                assert not (len(shape) >= 2 and shape[-2] == T
+                            and shape[-1] == V), \
+                    "(T,V) logits materialized: %s" % (shape,)
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+    walk(jaxpr.jaxpr)
+
+
+def test_gpt_chunked_loss_trains_and_ties_embedding():
+    """FeaturesView(gpt) + ChunkedLMLoss == dense GPT forward + softmax CE:
+    same per-token losses, and training through the fused TrainStep moves
+    the TIED embedding (grads flow through weight.data())."""
+    mx.random.seed(0)
+    V, U, S, B = 64, 16, 32, 2
+    gpt = models.GPTModel(vocab_size=V, units=U, num_layers=1, num_heads=2,
+                          max_length=S, attention="dense")
+    gpt.initialize(mx.init.Xavier())
+    tokens = nd.array(onp.random.RandomState(1).randint(0, V, (B, S))
+                      .astype("int32"))
+
+    dense_logits = gpt(tokens)
+    dense_loss = gluon.loss.SoftmaxCrossEntropyLoss()(dense_logits, tokens)
+    loss_fn = models.ChunkedLMLoss(gpt, chunk=16)
+    chunked = loss_fn(gpt.features(tokens), tokens)
+    onp.testing.assert_allclose(chunked.asnumpy(),
+                                dense_loss.asnumpy(), rtol=1e-4, atol=1e-5)
+
+    view = models.FeaturesView(gpt)
+    before = gpt.tok_embed.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(view.collect_params(), "sgd", {"learning_rate": 0.5})
+    step = jit.TrainStep(view, loss_fn, tr)
+    l0 = float(step(tokens, tokens).mean().asnumpy())
+    l1 = float(step(tokens, tokens).mean().asnumpy())
+    assert l1 < l0
+    after = gpt.tok_embed.weight.data().asnumpy()
+    assert onp.abs(after - before).max() > 1e-5  # tied head got gradients
