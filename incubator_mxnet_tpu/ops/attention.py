@@ -360,7 +360,10 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     block_q, block_k = _resolve_blocks(q.shape[2], block_q, block_k)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if flash_attention_supported(q.shape, block_q, block_k):
+    # the kernels assume self-attention shapes (Sq == Sk); cross-attention
+    # with mismatched lengths takes the composite (which handles it)
+    if k.shape == q.shape and v.shape == q.shape \
+            and flash_attention_supported(q.shape, block_q, block_k):
         out, lse = _fa_call(q, k, v, causal, scale, block_q, block_k)
     else:
         out, lse = _blocked_reference(q, k, v, causal, scale), None
@@ -455,6 +458,7 @@ def attention_with_lse(q, k, v, causal=False, scale=None):
     is O(block^2), not O((S/n)^2), when the kernel engages."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if flash_attention_supported(q.shape):
+    if k.shape == q.shape and v.shape == q.shape \
+            and flash_attention_supported(q.shape):
         return flash_attention_lse(q, k, v, causal, scale)
     return _dense_with_lse(q, k, v, causal, scale)

@@ -137,3 +137,23 @@ def test_flash_streamed_kv_long_chain(causal):
     for a, b in zip(gf, gr):
         rel = float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)))
         assert rel < 1e-3
+
+
+def test_flash_cross_attention_shape_guard():
+    """Sq != Sk (cross-attention) must take the composite path, not feed
+    the self-attention-shaped kernels garbage."""
+    from incubator_mxnet_tpu.ops import attention as A
+    rng = onp.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 2, 256, 128).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(1, 2, 384, 128).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(1, 2, 384, 128).astype("float32") * 0.3)
+    out = A.flash_attention(q, k, v, False)
+    ref = A._blocked_reference(q, k, v, False, 1.0 / onp.sqrt(128))
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-4
+    o2, lse = A.attention_with_lse(q, k, v)
+    assert o2.shape == (1, 2, 256, 128) and lse.shape == (1, 2, 256)
+    assert float(jnp.max(jnp.abs(o2 - ref))) < 2e-4
+    # grads flow through the fallback too
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        A.flash_attention(q, k, v, False) ** 2), (0, 1, 2))(q, k, v)
+    assert all(bool(jnp.isfinite(x).all()) for x in g)
