@@ -25,19 +25,25 @@ __all__ = ["chunked_lm_cross_entropy"]
 def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=512):
     """hidden: (..., U) activations; head_w: (V, U) (embedding-tied head);
     labels: (...,) int. Returns per-token CE losses shaped like labels.
-    Token dims are flattened, chunked, and restored; T % chunk != 0 falls
-    back to a single chunk."""
+    Token dims are flattened, chunked, and restored; when chunk does not
+    divide T, the largest divisor of T that is <= chunk is used (never a
+    silent full-T fallback — the op exists to bound the logits block)."""
     shape = labels.shape
     U = hidden.shape[-1]
     h = hidden.reshape(-1, U)
     y = labels.reshape(-1).astype(jnp.int32)
     T = h.shape[0]
     if T % chunk:
-        chunk = T
+        chunk = next(c for c in range(min(chunk, T), 0, -1) if T % c == 0)
     n = T // chunk
     hc = h.reshape(n, chunk, U)
     yc = y.reshape(n, chunk)
 
+    # checkpoint: WITHOUT it, grad-of-map stacks each chunk's softmax
+    # residuals into an (n, chunk, V) buffer — full-logits-sized, exactly
+    # what this op exists to avoid. With it, the backward recomputes the
+    # chunk logits from the (chunk, U) inputs.
+    @jax.checkpoint
     def one(args):
         hb, yb = args
         logits = (hb @ head_w.T.astype(hb.dtype)).astype(jnp.float32)

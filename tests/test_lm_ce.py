@@ -26,7 +26,7 @@ def test_chunked_ce_matches_dense():
         lab = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
         return lse - lab
 
-    for chunk in (16, 64, 7):  # 7: non-dividing -> single-chunk fallback
+    for chunk in (16, 64, 7):  # 7: non-dividing -> divisor fallback (4)
         got = chunked_lm_cross_entropy(h, w, y, chunk=chunk)
         onp.testing.assert_allclose(onp.asarray(got),
                                     onp.asarray(dense(h, w, y)),
@@ -41,24 +41,64 @@ def test_chunked_ce_matches_dense():
 
 
 def test_chunked_ce_never_materializes_full_logits():
+    """Structural guarantee on the TRAINING path: no intermediate of total
+    size >= T*V exists in the jaxpr of grad(loss) — this is what catches
+    the grad-of-map residual stacking ((n, chunk, V) == full logits) that
+    a forward-only, exact-shape check would miss."""
     T, U, V, chunk = 256, 8, 64, 32
     h = jnp.zeros((T, U))
     w = jnp.zeros((V, U))
     y = jnp.zeros((T,), jnp.int32)
     jaxpr = jax.make_jaxpr(
-        lambda h, w, y: chunked_lm_cross_entropy(h, w, y, chunk))(h, w, y)
+        jax.grad(lambda h, w: chunked_lm_cross_entropy(h, w, y, chunk)
+                 .sum(), argnums=(0, 1)))(h, w)
+
+    import math
 
     def walk(jx):
         for eqn in jx.eqns:
             for var in eqn.outvars:
                 shape = getattr(var.aval, "shape", ())
-                assert not (len(shape) >= 2 and shape[-2] == T
-                            and shape[-1] == V), \
-                    "(T,V) logits materialized: %s" % (shape,)
+                size = math.prod(shape) if shape else 0
+                assert size < T * V, \
+                    "full-logits-sized intermediate: %s" % (shape,)
             for sub in eqn.params.values():
                 if hasattr(sub, "jaxpr"):
                     walk(sub.jaxpr)
+
     walk(jaxpr.jaxpr)
+
+
+def test_chunked_ce_non_dividing_picks_divisor():
+    """T % chunk != 0 must NOT silently fall back to one full-T chunk."""
+    T, U, V = 96, 8, 32
+    rng = onp.random.RandomState(3)
+    h = jnp.asarray(rng.randn(T, U).astype("float32"))
+    w = jnp.asarray(rng.randn(V, U).astype("float32") * 0.2)
+    y = jnp.asarray(rng.randint(0, V, T).astype("int32"))
+    # chunk=40 -> largest divisor of 96 <= 40 is 32 (not 96)
+    jaxpr = jax.make_jaxpr(
+        lambda h, w: chunked_lm_cross_entropy(h, w, y, 40).sum())(h, w)
+    import math
+
+    def max_size(jx, best=0):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                best = max(best, math.prod(shape) if shape else 0)
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    best = max(best, max_size(sub.jaxpr, best))
+        return best
+
+    assert max_size(jaxpr.jaxpr) < T * V  # never the dense block
+    # and the values still match the dense computation
+    dense_logits = h @ w.T
+    lse = jax.nn.logsumexp(dense_logits, axis=-1)
+    lab = jnp.take_along_axis(dense_logits, y[:, None], axis=-1)[:, 0]
+    onp.testing.assert_allclose(
+        onp.asarray(chunked_lm_cross_entropy(h, w, y, 40)),
+        onp.asarray(lse - lab), rtol=1e-5, atol=1e-6)
 
 
 def test_gpt_chunked_loss_trains_and_ties_embedding():
