@@ -198,6 +198,27 @@ int main(int argc, char** argv) {
       }
     }
     printf("TRAINOK\n");
+    /* --- the SGD update itself: set_data!(w, w - 0.1*g) --- */
+    typedef int (*sd_t)(void*, const char*, const void*, int64_t);
+    sd_t nd_setdata = (sd_t)dlsym(lib, "MXTPUNDSetData");
+    if (!nd_setdata) {
+      fprintf(stderr, "missing MXTPUNDSetData\n");
+      return 1;
+    }
+    float w_new[3];
+    for (int j = 0; j < 3; ++j) w_new[j] = w_d[j] - 0.1f * gbuf[j];
+    CHECK(nd_setdata(wh, "float32", w_new, sizeof(w_new)));
+    float w_back[3];
+    CHECK(nd_data(wh, w_back, sizeof(w_back), NULL));
+    for (int j = 0; j < 3; ++j) {
+      float d = w_back[j] - w_new[j];
+      if (d < 0) d = -d;
+      if (d > 1e-6f) {
+        fprintf(stderr, "set_data round-trip mismatch [%d]\n", j);
+        return 1;
+      }
+    }
+    printf("SETDATAOK\n");
     CHECK(nd_free(pred)); CHECK(nd_free(dif)); CHECK(nd_free(sq));
     CHECK(nd_free(loss)); CHECK(nd_free(gw));
     CHECK(nd_free(xh)); CHECK(nd_free(wh)); CHECK(nd_free(yh));
