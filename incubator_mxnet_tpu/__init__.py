@@ -91,3 +91,15 @@ from . import numpy as np
 from . import numpy_extension
 from . import numpy_extension as npx
 from . import contrib
+
+# ---- env-driven startup behaviors (config.ENV_VARS documents each) ----
+if config.get_env("MXTPU_SEED") is not None:
+    random.seed(config.get_env("MXTPU_SEED"))
+
+if config.get_env("MXTPU_PROFILER_AUTOSTART"):
+    # MXNET_PROFILER_AUTOSTART analog: record from import, dump at exit
+    import atexit as _atexit
+
+    profiler.set_config(filename=config.get_env("MXTPU_PROFILER_FILENAME"))
+    profiler.set_state("run")
+    _atexit.register(profiler.dump)

@@ -72,6 +72,60 @@ ENV_VARS = {
         int, 0,
         "Verbose logging in the kvstore server-role facade "
         "(kvstore_server.py)."),
+    "MXTPU_EXEC_CACHE_SIZE": (
+        int, 16,
+        "Bound on each compiled-executable cache (TrainStep/EvalStep/"
+        "hybridize shape-keyed caches — the CachedOp analog). Oldest entry "
+        "is evicted past the bound; raise for bucketed variable-shape "
+        "workloads (ref MXNET_EXEC_... executor caching)."),
+    "MXTPU_NO_DONATE": (
+        bool, False,
+        "Disable input-buffer donation in the fused train/eval steps "
+        "(jit.py). Donation updates parameters in place (kWriteInplace); "
+        "turn off when debugging needs pre-step values alive."),
+    "MXTPU_REMAT": (
+        bool, False,
+        "Default jax.checkpoint (rematerialisation) for TrainStep when the "
+        "caller does not pass remat= explicitly — trades FLOPs for "
+        "activation memory (MXNET_BACKWARD_DO_MIRROR analog)."),
+    "MXTPU_ENGINE_BULK_SIZE": (
+        int, 15,
+        "Initial engine bulk size (MXNET_ENGINE_BULK_SIZE analog). "
+        "Informational on TPU: XLA already compiles the whole step as one "
+        "program; kept for API parity with engine.set_bulk_size."),
+    "MXTPU_PROFILER_AUTOSTART": (
+        bool, False,
+        "Start the profiler at package import and dump on interpreter exit "
+        "(MXNET_PROFILER_AUTOSTART analog)."),
+    "MXTPU_PROFILER_FILENAME": (
+        str, "profile.json",
+        "Chrome-trace output path used by the autostarted profiler dump "
+        "(MXNET_PROFILE_FILENAME analog; profiler.set_config overrides)."),
+    "MXTPU_KVSTORE_BIGARRAY_BOUND": (
+        int, 1000000,
+        "Element-count bound above which a dense value gets its OWN host "
+        "allgather instead of riding the per-dtype batched concat "
+        "(MXNET_KVSTORE_BIGARRAY_BOUND analog — bounds peak host memory of "
+        "the batch buffer)."),
+    "MXTPU_SEED": (
+        int, None,
+        "Global RNG seed applied at package import (MXNET_SEED analog): "
+        "seeds nd.random, np.random and the functional key stream."),
+    "MXTPU_CONV_BWD_PALLAS": (
+        bool, True,
+        "Gate for the fused Pallas conv-backward kernel (dgrad+wgrad in "
+        "one HBM pass): ops.conv_bwd.conv3x3_s1 routes its backward "
+        "through it when the shape is legal on TPU. Model-zoo convs keep "
+        "XLA's lowering (see docs/PERF_RESNET.md pilot disposition)."),
+    "MXTPU_CPU_WORKER_NTHREADS": (
+        int, 4,
+        "Default decode/augment thread count for the native "
+        "ImageRecordIter when preprocess_threads is not given "
+        "(MXNET_CPU_WORKER_NTHREADS analog)."),
+    "MXTPU_TEST_LARGE_TENSOR": (
+        bool, False,
+        "Opt into the >2^31-element int64 large-tensor test tier "
+        "(tests/test_large_tensor.py; ~2-6 GB of host RAM)."),
     "JAX_PLATFORMS": (
         str, None,
         "Backend selection (jax): 'cpu' forces the virtual-device CPU path "
@@ -95,6 +149,14 @@ def get_env(name):
     if typ is bool:
         return raw.strip().lower() not in ("0", "", "false", "no", "off")
     return typ(raw)
+
+
+def evict_to_bound(cache):
+    """Drop oldest entries of an insertion-ordered executable cache until it
+    fits MXTPU_EXEC_CACHE_SIZE (call after inserting)."""
+    bound = max(1, get_env("MXTPU_EXEC_CACHE_SIZE"))
+    while len(cache) > bound:
+        cache.pop(next(iter(cache)))
 
 
 def describe():

@@ -28,7 +28,9 @@ import contextlib
 
 __all__ = ["bulk", "set_bulk_size"]
 
-_BULK_SIZE = [15]
+from .config import get_env as _get_env
+
+_BULK_SIZE = [_get_env("MXTPU_ENGINE_BULK_SIZE")]
 
 
 def set_bulk_size(size):

@@ -346,6 +346,8 @@ class HybridBlock(Block):
                 self, train_mode)
             jitted = jax.jit(lambda pd, xd, key: pure_fn(pd, xd, key))
             self._cached_fn[meta] = (jitted, param_arrs, aux_box)
+            from ..config import evict_to_bound
+            evict_to_bound(self._cached_fn)
         jitted, param_arrs, aux_box = self._cached_fn[meta]
 
         key = jax.random.PRNGKey(0) if not train_mode else _split_global_key()

@@ -284,12 +284,15 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
                  label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
-                 num_parts=1, part_index=0, preprocess_threads=4, round_batch=True,
+                 num_parts=1, part_index=0, preprocess_threads=None, round_batch=True,
                  seed=0, path_imgidx=None, prefetch_buffer=2, resize=0,
                  force_python=False, dtype="float32", **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         from concurrent.futures import ThreadPoolExecutor
+        if preprocess_threads is None:
+            from ..config import get_env
+            preprocess_threads = get_env("MXTPU_CPU_WORKER_NTHREADS")
 
         # Fast path tier 1: FULL native pipeline — JPEG decode + augment +
         # NCHW batch assembly in C++ worker threads, zero Python in the

@@ -20,9 +20,15 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import config
 from .gluon import _functional
 from .ndarray import NDArray
 from .ndarray import random as _rnd
+
+
+def _donate(argnums):
+    """Buffer donation unless MXTPU_NO_DONATE (debugging) is set."""
+    return () if config.get_env("MXTPU_NO_DONATE") else argnums
 
 __all__ = ["TrainStep", "EvalStep"]
 
@@ -51,7 +57,7 @@ class TrainStep:
     """Compile net forward + loss + backward + optimizer update into one program."""
 
     def __init__(self, net, loss_fn, trainer, batch_axis=0, grad_postprocess=None,
-                 mesh=None, data_axis="dp", remat=False, zero=False):
+                 mesh=None, data_axis="dp", remat=None, zero=False):
         self.net = net
         self.loss_fn = loss_fn
         self.trainer = trainer
@@ -64,7 +70,8 @@ class TrainStep:
         # remat: rematerialize the forward during backward (jax.checkpoint)
         # — trades ~1 extra forward of FLOPs for O(layer) activation memory,
         # the long-sequence HBM lever (SURVEY §7 guidance)
-        self.remat = remat
+        from .config import get_env
+        self.remat = get_env("MXTPU_REMAT") if remat is None else remat
         # zero: ZeRO-1 / automatic cross-replica sharding of the weight
         # update (arXiv:2004.13336, the GSPMD-annotation form): optimizer
         # states (incl. fp32 masters) are SHARDED over the dp axis on dim 0,
@@ -154,7 +161,7 @@ class TrainStep:
         if self.mesh is not None:
             jitted = self._jit_sharded(step_fn, trainable, frozen)
         else:
-            jitted = jax.jit(step_fn, donate_argnums=(0, 2))
+            jitted = jax.jit(step_fn, donate_argnums=_donate((0, 2)))
         return jitted, trainable, frozen, t_arrs, f_arrs, aux_box
 
     def _zero_leaf_sharding(self, p):
@@ -221,7 +228,7 @@ class TrainStep:
         t_sh = [self._param_sharding(p) for p in trainable]
         f_sh = [self._param_sharding(p) for p in frozen]
         data_sh = NamedSharding(self.mesh, PartitionSpec(self.data_axis))
-        jitted = jax.jit(step_fn, donate_argnums=(0, 2))
+        jitted = jax.jit(step_fn, donate_argnums=_donate((0, 2)))
 
         state_rules = [self._zero_leaf_sharding(p) for p in trainable]
 
@@ -259,6 +266,7 @@ class TrainStep:
         meta = (n_net_inputs, tuple((a.shape, str(a.dtype)) for a in arrs))
         if meta not in self._cache:
             self._cache[meta] = self._build(meta, n_net_inputs)
+            config.evict_to_bound(self._cache)
         jitted, trainable, frozen, t_arrs, f_arrs, aux_box = self._cache[meta]
 
         optimizer = trainer._optimizer
@@ -322,6 +330,7 @@ class EvalStep:
                 self.net, train_mode=False)
             jitted = jax.jit(pure_fn)
             self._cache[meta] = (jitted, param_arrs)
+            config.evict_to_bound(self._cache)
         jitted, param_arrs = self._cache[meta]
         key = jax.random.PRNGKey(0)
         out_datas, _aux = jitted([a._data for a in param_arrs],

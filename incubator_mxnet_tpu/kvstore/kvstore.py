@@ -290,10 +290,17 @@ class DistKVStore(KVStore):
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
         out = list(args)
+        from ..config import get_env
+        bigarray = get_env("MXTPU_KVSTORE_BIGARRAY_BOUND")
         by_dtype = {}
         for i, a in enumerate(args):
-            by_dtype.setdefault(onp.dtype(a.dtype).name, []).append(i)
-        for dt, idxs in sorted(by_dtype.items()):
+            # big values get their own allgather so the batched concat
+            # buffer's peak host memory stays bounded
+            # (MXNET_KVSTORE_BIGARRAY_BOUND analog)
+            key = (onp.dtype(a.dtype).name,
+                   i if getattr(a, "size", 0) >= bigarray else -1)
+            by_dtype.setdefault(key, []).append(i)
+        for (dt, _big), idxs in sorted(by_dtype.items()):
             flats = [onp.asarray(args[i]._data if isinstance(args[i], NDArray)
                                  else args[i]).ravel() for i in idxs]
             sizes = [f.size for f in flats]
@@ -343,10 +350,21 @@ class DistAsyncKVStore(DistKVStore):
     slices and schedules high-priority — later-layer — tensors first), one
     batched allgather per priority class.
 
-    The averaging collective requires workers to reach the same push count
-    per key (true for the standard identical-loop training pattern; the
-    same requirement any collective imposes). ``sync()`` forces a full
-    average of every key — call at epoch/checkpoint boundaries.
+    The averaging collective requires every worker to REACH it.  Two
+    contracts make that deadlock-free:
+
+    * **Lockstep (default)**: workers run identical push loops (the
+      standard data-parallel pattern) — every worker hits the same
+      staleness boundaries.
+    * **Uneven shards**: call ``begin_epoch(local_steps)`` at each epoch
+      start (all workers present — a matched point).  It allgathers the
+      workers' PLANNED step counts and caps this epoch's staleness rounds
+      at ``min_steps // staleness`` — a schedule every worker can honor
+      even with k fewer local steps, because min_steps bounds them all.
+      Pushes past the cap apply locally with no collective.  ``sync()`` at
+      the epoch end (again all-present) folds the stragglers' tails back
+      in.  ``Module.fit`` wires both calls automatically when the iterator
+      advertises its length.
     """
 
     def __init__(self, name="dist_async", staleness=None):
@@ -357,6 +375,26 @@ class DistAsyncKVStore(DistKVStore):
         self._staleness = max(1, int(staleness))
         self._push_count = {}
         self._key_priority = {}
+        self._round_budget = None   # per-key staleness rounds this epoch
+        self._rounds_done = {}
+
+    def begin_epoch(self, local_steps):
+        """Agree on this epoch's collective schedule (call on ALL workers
+        at the epoch start, with each worker's own planned push-step
+        count). Returns the agreed number of staleness rounds per key."""
+        local_steps = int(local_steps)
+        if self._num_workers > 1:
+            from jax.experimental import multihost_utils
+            import numpy as onp
+            counts = multihost_utils.process_allgather(
+                onp.array([local_steps], dtype=onp.int64))
+            min_steps = int(counts.min())
+        else:
+            min_steps = local_steps
+        self._round_budget = min_steps // self._staleness
+        self._rounds_done = {}
+        self._push_count = {}
+        return self._round_budget
 
     def _aggregate(self, v, key):
         # local-only aggregation: the cross-process traffic happens solely
@@ -373,12 +411,21 @@ class DistAsyncKVStore(DistKVStore):
             c = self._push_count.get(k, 0) + 1
             self._push_count[k] = c
             if c >= self._staleness:
+                # under an epoch schedule, only rounds every worker can
+                # reach run the collective; the tail stays local
+                if self._round_budget is not None and \
+                        self._rounds_done.get(k, 0) >= self._round_budget:
+                    continue
+                self._rounds_done[k] = self._rounds_done.get(k, 0) + 1
                 due.append(k)
         if due:
             self._sync_keys(due)
 
     def sync(self):
-        """Force a full parameter average (epoch/checkpoint boundary)."""
+        """Force a full parameter average (epoch/checkpoint boundary —
+        a matched point on every worker). Resets the epoch schedule."""
+        self._round_budget = None
+        self._rounds_done = {}
         self._sync_keys(list(self._data))
 
     def _sync_keys(self, keys):

@@ -1,0 +1,143 @@
+"""Wiring tests for the env/config registry additions (ref env_var.md —
+each MXTPU_* knob must be READ somewhere real, not just documented)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import config, engine, nd
+
+
+def test_registry_size_and_render():
+    assert len(config.ENV_VARS) >= 25
+    text = config.describe()
+    for name in config.ENV_VARS:
+        assert name in text
+    # the committed doc is the rendered registry (regenerated, not drifted)
+    doc = open(os.path.join(os.path.dirname(__file__), os.pardir, "docs",
+                            "ENV_VARS.md")).read()
+    missing = [n for n in config.ENV_VARS if n not in doc]
+    assert not missing, "docs/ENV_VARS.md stale — regenerate: %s" % missing
+
+
+def test_exec_cache_bound(monkeypatch):
+    monkeypatch.setenv("MXTPU_EXEC_CACHE_SIZE", "2")
+    cache = {}
+    for i in range(5):
+        cache[i] = i
+        config.evict_to_bound(cache)
+    assert list(cache) == [3, 4]
+
+
+def test_eval_step_cache_evicts(monkeypatch):
+    from incubator_mxnet_tpu import gluon, jit
+    monkeypatch.setenv("MXTPU_EXEC_CACHE_SIZE", "2")
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    step = jit.EvalStep(net)
+    for n in (2, 3, 4, 5):
+        step(nd.ones((n, 4)))
+    assert len(step._cache) == 2
+
+
+def test_no_donate_env(monkeypatch):
+    from incubator_mxnet_tpu.jit import _donate
+    monkeypatch.delenv("MXTPU_NO_DONATE", raising=False)
+    assert _donate((0, 2)) == (0, 2)
+    monkeypatch.setenv("MXTPU_NO_DONATE", "1")
+    assert _donate((0, 2)) == ()
+
+
+def test_remat_default_env(monkeypatch):
+    from incubator_mxnet_tpu import gluon, jit
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd")
+    loss = gluon.loss.L2Loss()
+    monkeypatch.setenv("MXTPU_REMAT", "1")
+    assert jit.TrainStep(net, loss, trainer).remat is True
+    monkeypatch.delenv("MXTPU_REMAT")
+    assert jit.TrainStep(net, loss, trainer).remat is False
+    # explicit arg wins over env
+    monkeypatch.setenv("MXTPU_REMAT", "1")
+    assert jit.TrainStep(net, loss, trainer, remat=False).remat is False
+
+
+def test_engine_bulk_size_env():
+    # import-time default is the registered default when env unset
+    assert engine.set_bulk_size(engine.set_bulk_size(31)) == 31
+
+
+def test_bigarray_bound_splits_batch(monkeypatch):
+    """The (dtype, big-index) partitioning must route values past the bound
+    into their OWN allgather and reassemble every segment onto the right
+    key. Stub the collective (2 identical 'workers') so the grouping,
+    concat, and offset-reassembly logic actually runs single-process."""
+    from incubator_mxnet_tpu.kvstore.kvstore import DistKVStore
+    from jax.experimental import multihost_utils
+    monkeypatch.setenv("MXTPU_KVSTORE_BIGARRAY_BOUND", "10")
+
+    calls = []
+
+    def fake_allgather(cat):
+        calls.append(onp.asarray(cat).size)
+        return onp.stack([onp.asarray(cat)] * 2)   # 2 workers, same data
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    kv = DistKVStore.__new__(DistKVStore)
+    kv._num_workers = 2
+    small_a = nd.arange(3)
+    small_b = nd.arange(4) * 10
+    big = nd.arange(100)
+    out = kv._cross_sum_batch([small_a, big, small_b])
+    # each value summed over the 2 stub workers = 2x
+    assert onp.allclose(out[0].asnumpy(), 2 * small_a.asnumpy())
+    assert onp.allclose(out[1].asnumpy(), 2 * big.asnumpy())
+    assert onp.allclose(out[2].asnumpy(), 2 * small_b.asnumpy())
+    # partitioning: smalls batched into one allgather (3+4), big on its own
+    assert sorted(calls) == [7, 100], calls
+
+
+def test_seed_env_subprocess():
+    code = (
+        "import os; os.environ['MXTPU_SEED']='1234';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import incubator_mxnet_tpu as mx;"
+        "a = mx.nd.random.uniform(shape=(4,));"
+        "mx.random.seed(1234);"
+        "b = mx.nd.random.uniform(shape=(4,));"
+        "import numpy as np;"
+        "assert np.allclose(a.asnumpy(), b.asnumpy());"
+        "print('SEED OK')")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0 and "SEED OK" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_profiler_autostart_subprocess(tmp_path):
+    out = tmp_path / "auto.json"
+    code = (
+        "import os;"
+        "os.environ['MXTPU_PROFILER_AUTOSTART']='1';"
+        "os.environ['MXTPU_PROFILER_FILENAME']=%r;"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import incubator_mxnet_tpu as mx;"
+        "assert mx.profiler.state() == 'run';"
+        "mx.nd.ones((2, 2)).asnumpy();"
+        "print('AUTO OK')" % str(out))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0 and "AUTO OK" in r.stdout, (r.stdout, r.stderr)
+    assert out.exists() and out.stat().st_size > 0
+
+
+def test_cpu_worker_nthreads_default(monkeypatch):
+    monkeypatch.setenv("MXTPU_CPU_WORKER_NTHREADS", "3")
+    assert config.get_env("MXTPU_CPU_WORKER_NTHREADS") == 3

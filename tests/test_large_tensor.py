@@ -18,7 +18,8 @@ N = 2 ** 31 + 4096  # past the int32 element-count boundary
 
 
 def _enabled():
-    if not os.environ.get("MXTPU_TEST_LARGE_TENSOR"):
+    from incubator_mxnet_tpu.config import get_env
+    if not get_env("MXTPU_TEST_LARGE_TENSOR"):
         return False
     try:
         avail = int(next(l for l in open("/proc/meminfo")
