@@ -13,13 +13,15 @@
 #   suite   - quick test suite on the 8-device virtual CPU mesh
 #   smoke   - driver contract: entry() jit-compiles on CPU and
 #             dryrun_multichip(8) runs a full sharded train step
+#   large   - int64 large-tensor tier (>2^31 elements; int8/uint8 dtypes
+#             keep it ~2.2 GB — ref tests/nightly/test_large_array.py)
 #   wheel   - sdist + wheel build including fresh native libs (ref
 #             tools/pip staticbuild)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite smoke wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -61,6 +63,12 @@ jax.jit(fn)(*a).block_until_ready()
 print('entry() ok')"
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+fi
+
+if has_stage large; then
+  echo "=== large: int64 large-tensor tier ==="
+  MXTPU_TEST_LARGE_TENSOR=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_large_tensor.py -q
 fi
 
 if has_stage wheel; then

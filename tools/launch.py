@@ -45,15 +45,28 @@ def main():
             code |= p.wait()
         sys.exit(code)
     else:
+        # dmlc-tracker ssh mode: one worker per rank, hosts assigned
+        # round-robin from the hostfile; env rides the remote command line
+        # (ssh joins argv into one remote shell string). Exit codes
+        # propagate like the local mode. Tested via a PATH-shimmed fake
+        # ssh (tests/test_launcher_ssh.py); real-cluster use only needs
+        # sshd + shared filesystem, as upstream.
         hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
-        for rank, host in enumerate(hosts[: args.num_workers]):
+        if not hosts:
+            sys.exit("empty hostfile")
+        for rank in range(args.num_workers):
+            host = hosts[rank % len(hosts)]
             cmd = ["ssh", host,
                    "MXTPU_COORD_ADDR=%s" % args.coord_addr,
                    "MXTPU_NUM_PROC=%d" % args.num_workers,
-                   "MXTPU_PROC_ID=%d" % rank] + args.command
+                   "MXTPU_PROC_ID=%d" % rank,
+                   "DMLC_NUM_WORKER=%d" % args.num_workers,
+                   "DMLC_RANK=%d" % rank] + args.command
             procs.append(subprocess.Popen(cmd))
+        code = 0
         for p in procs:
-            p.wait()
+            code |= p.wait()
+        sys.exit(code)
 
 
 if __name__ == "__main__":
