@@ -133,11 +133,26 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # dist_async epoch contract (uneven shards stay deadlock-free):
+        # agree on the staleness-round schedule at each epoch start, force
+        # a full average at each epoch end (kvstore.DistAsyncKVStore)
+        kv = getattr(self, "_kvstore", None)
+        kv_async = kv is not None and hasattr(kv, "begin_epoch")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
+            if kv_async:
+                try:
+                    planned = len(train_data)
+                except TypeError:
+                    planned = 0
+                # unconditional: begin_epoch is a COLLECTIVE — a worker
+                # with an empty shard (planned=0) must still join it or
+                # the other workers' allgather deadlocks
+                kv.begin_epoch(planned)
             for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
@@ -151,6 +166,8 @@ class BaseModule:
                     for cb in _as_list(batch_end_callback):
                         cb(param)
                 nbatch += 1
+            if kv_async:
+                kv.sync()
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)

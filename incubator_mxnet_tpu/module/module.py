@@ -183,9 +183,20 @@ class Module(BaseModule):
             optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
                                        **optimizer_params)
         self._optimizer = optimizer
+        self._update_on_kvstore = False
         if kvstore:
             kv = kvs_mod.create(kvstore) if isinstance(kvstore, str) else kvstore
             self._kvstore = kv
+            # ref module.py:474 + model.py _update_params_on_kvstore: dist
+            # stores own the update — push grads (the store aggregates
+            # across workers), pull back the updated weight
+            self._update_on_kvstore = kv.type.startswith("dist")
+            if self._update_on_kvstore:
+                kv.set_optimizer(optimizer)
+                for i, name in enumerate(self.param_names):
+                    if name not in self._fixed_param_names and \
+                            self._exec.grad_dict.get(name) is not None:
+                        kv.init(i, self._exec.arg_dict[name])
         self._updater_states = {}
         self.optimizer_initialized = True
 
@@ -229,8 +240,21 @@ class Module(BaseModule):
         self._exec.backward(out_grads)
 
     def update(self):
-        """ref module.py:646 — optimizer step on accumulated grads."""
+        """ref module.py:646 — optimizer step on accumulated grads.
+
+        With a dist kvstore the step is update-on-kvstore (model.py:151):
+        grads are PUSHED (the store aggregates across workers and applies
+        the optimizer to its copy) and the weight PULLED back."""
         assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore is not None:
+            for i, name in enumerate(self.param_names):
+                w = self._exec.arg_dict[name]
+                g = self._exec.grad_dict.get(name)
+                if g is None or name in self._fixed_param_names:
+                    continue
+                self._kvstore.push(i, g, priority=-i)
+                self._kvstore.pull(i, out=w, priority=-i)
+            return
         for i, name in enumerate(self.param_names):
             w = self._exec.arg_dict[name]
             g = self._exec.grad_dict.get(name)

@@ -100,6 +100,50 @@ def main():
     d3 = hashlib.sha1(onp.ascontiguousarray(wa.asnumpy())).hexdigest()
     print("RESULT async_forced %d %s" % (rank, d3), flush=True)
 
+    # -- 2d. dist_async UNEVEN shards: worker 1 runs 2 fewer steps --------
+    # begin_epoch agrees on min_steps//staleness collective rounds (here
+    # min(5,3)//2 = 1), so the straggler reaches every collective; the
+    # faster worker's tail pushes stay local; epoch-end sync() reconverges.
+    planned = 5 if rank == 0 else 3
+    budget = kva.begin_epoch(planned)
+    assert budget == 1, budget
+    for _ in range(planned):
+        kva.push(0, nd.full((4, 2), float(rank + 1)))
+    kva.sync()                       # epoch boundary: all workers present
+    kva.pull(0, out=wa)
+    d4 = hashlib.sha1(onp.ascontiguousarray(wa.asnumpy())).hexdigest()
+    print("RESULT async_uneven %d %s" % (rank, d4), flush=True)
+
+    # -- 2e. Module update-on-kvstore (ref model.py _update_params_on_
+    # kvstore): each worker feeds DIFFERENT data; the dist store aggregates
+    # the pushed grads, so updated params must be bitwise identical --------
+    from incubator_mxnet_tpu import sym as sym_mod, mod as mod_mod, io as io_mod
+    net = sym_mod.FullyConnected(sym_mod.Variable("data"), num_hidden=1,
+                                 name="fc")
+    net = sym_mod.LinearRegressionOutput(net, sym_mod.Variable(
+        "softmax_label"), name="lro")
+    m = mod_mod.Module(net, context=mx.cpu())
+    m.bind(data_shapes=[("data", (4, 3))], label_shapes=[
+        ("softmax_label", (4, 1))])
+    rngm = onp.random.RandomState(5)
+    m.init_params(arg_params={
+        "fc_weight": nd.array(rngm.randn(1, 3).astype("float32")),
+        "fc_bias": nd.zeros((1,))})
+    m.init_optimizer(kvstore=mx.kv.create("dist_sync"),
+                     optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    assert m._update_on_kvstore
+    x_loc = onp.full((4, 3), float(rank + 1), dtype="float32")
+    y_loc = onp.full((4, 1), float(-rank), dtype="float32")
+    batch = io_mod.DataBatch(data=[nd.array(x_loc)],
+                             label=[nd.array(y_loc)])
+    for _ in range(2):
+        m.forward_backward(batch)
+        m.update()
+    argp, _ = m.get_params()
+    dm = hashlib.sha1(onp.ascontiguousarray(
+        argp["fc_weight"].asnumpy())).hexdigest()
+    print("RESULT module_kv %d %s" % (rank, dm), flush=True)
+
     # -- 3. global-mesh SPMD collective across processes ----------------
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -64,3 +64,17 @@ def test_dist_sync_two_processes():
         "dist_async diverged after averaging: %s" % syn
     assert len(set(frc.values())) == 1, \
         "dist_async diverged after forced sync: %s" % frc
+
+    # uneven shards (worker 1 ran 2 fewer pushes): completed without
+    # deadlock AND reconverged bitwise at the epoch-end sync
+    unev = {r: v for c, r, v in results if c == "async_uneven"}
+    assert len(unev) == NWORKERS, out
+    assert len(set(unev.values())) == 1, \
+        "dist_async diverged after uneven epoch: %s" % unev
+
+    # Module update-on-kvstore: different per-worker data, identical
+    # updated params (grads aggregated through the dist store)
+    mkv = {r: v for c, r, v in results if c == "module_kv"}
+    assert len(mkv) == NWORKERS, out
+    assert len(set(mkv.values())) == 1, \
+        "Module update-on-kvstore diverged: %s" % mkv

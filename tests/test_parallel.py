@@ -529,6 +529,33 @@ def test_dist_async_kvstore_priority_and_staleness():
     assert type(mx.kv.create("dist_device_sync")).__name__ == "DistKVStore"
 
 
+def test_dist_async_epoch_budget_caps_collectives():
+    """Uneven-shard contract: begin_epoch caps staleness rounds at
+    min_steps//staleness so a straggler worker reaches every collective;
+    pushes past the cap stay local; sync() lifts the cap."""
+    from incubator_mxnet_tpu.kvstore.kvstore import DistAsyncKVStore
+    kv = DistAsyncKVStore(staleness=2)
+    kv.init("w", nd.zeros((2,)))
+    rounds = []
+    kv._num_workers = 2
+    kv._average_batch = lambda keys: rounds.append(tuple(keys))
+    # single-process: the step-count allgather degenerates to local min
+    orig_workers = kv._num_workers
+    kv._num_workers = 1
+    budget = kv.begin_epoch(5)      # min_steps=5, staleness=2 -> 2 rounds
+    kv._num_workers = orig_workers
+    assert budget == 2
+    for _ in range(8):              # run PAST the agreed schedule
+        kv.push("w", nd.ones((2,)))
+    assert len(rounds) == 2, rounds  # capped: pushes 5..8 stayed local
+    kv.sync()                        # epoch boundary forces the average
+    assert len(rounds) == 3, rounds
+    # after sync the schedule is lifted: staleness windows fire again
+    kv.push("w", nd.ones((2,)))
+    kv.push("w", nd.ones((2,)))
+    assert len(rounds) == 4, rounds
+
+
 def test_pipeline_1f1b_matches_gpipe_and_sequential():
     """r3: hand-scheduled 1F1B (pipeline_1f1b_grads) produces the same loss
     and gradients as running the stage stack sequentially under autodiff
