@@ -1,0 +1,143 @@
+"""Pipeline-schedule measurements: GPipe vs 1F1B vs interleaved 1F1B.
+
+Produces the numbers committed in docs/PERF_PIPELINE.md:
+
+* schedule-analytic bubble fractions (exact, from the tick tables — both
+  equal-cost and backward=2x-forward weighting),
+* compiled peak TEMP memory per device (XLA memory_analysis — the
+  activation-stash story: GPipe-by-autodiff stashes all m microbatch
+  activations, 1F1B recomputes from a bounded stash),
+* wall-clock per train step on the virtual device mesh (4 of the 8 CPU
+  devices; relative, not absolute, numbers are the point).
+
+Same total model everywhere: 8 tanh-matmul layers. GPipe/1F1B run p=4
+stages of 2 layers; interleaved runs p=4, v=2 with 8 single-layer chunks.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python benchmark/pipeline_bench.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as onp
+from jax.sharding import Mesh
+
+from incubator_mxnet_tpu.parallel.pipeline import (
+    pipeline_spmd, pipeline_1f1b_grads)
+from incubator_mxnet_tpu.parallel.pipeline_interleaved import (
+    interleaved_schedule, schedule_gpipe, schedule_stats,
+    pipeline_interleaved_grads)
+
+P_, V_, D, MB = 4, 2, 512, 16
+
+
+def bench(fn, *args, reps=7):
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    p, v = P_, V_
+    V = p * v
+    mesh = Mesh(onp.array(jax.devices()[:p]), ("pp",))
+    rng = onp.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(V, D, D).astype("float32") * 0.05)
+    bs = jnp.asarray(rng.randn(V, D).astype("float32") * 0.05)
+    x = jnp.asarray(rng.randn(m * MB, D).astype("float32"))
+    y = jnp.asarray(rng.randn(m * MB, D).astype("float32"))
+
+    def layer(par, h):
+        W, b = par
+        return jnp.tanh(h @ W + b)
+
+    def stage2(par, h):      # 2 layers per stage (non-interleaved)
+        W, b = par
+        return layer((W[1], b[1]), layer((W[0], b[0]), h))
+
+    def loss_fn(out, yb):
+        return jnp.sum((out - yb) ** 2)
+
+    # ---- schedule-analytic bubbles
+    rows = {}
+    for name, ticks in [
+            ("gpipe", schedule_gpipe(m, p)),
+            ("1f1b", interleaved_schedule(m, p, 1)),
+            ("interleaved_v2", interleaved_schedule(m, p, v))]:
+        scale = v if name == "interleaved_v2" else 1
+        eq = schedule_stats(ticks, p, f_cost=1 / scale, b_cost=1 / scale)
+        wt = schedule_stats(ticks, p, f_cost=1 / scale, b_cost=2 / scale)
+        rows[name] = {"ticks": eq["ticks"],
+                      "bubble_eq": round(eq["bubble_fraction"], 3),
+                      "bubble_b2f": round(wt["bubble_fraction"], 3),
+                      "step_cost_b2f": round(wt["step_cost"], 1)}
+
+    # ---- GPipe: autodiff over the forward ring
+    W2 = Ws.reshape(p, 2, D, D)
+    b2 = bs.reshape(p, 2, D)
+
+    def gpipe_loss(params, x):
+        out = pipeline_spmd(stage2, params, x, mesh, m)
+        return jnp.sum((out - y) ** 2) / m
+
+    gpipe_fn = jax.jit(jax.value_and_grad(gpipe_loss), static_argnums=())
+    gpipe_t = bench(gpipe_fn, (W2, b2), x)
+    gpipe_mem = gpipe_fn.lower((W2, b2), x).compile() \
+        .memory_analysis().temp_size_in_bytes
+
+    # ---- 1F1B (p stages of 2 layers)
+    def f1b(params, x, y):
+        return pipeline_1f1b_grads(stage2, loss_fn, params, x, y, mesh, m)
+
+    f1b_fn = jax.jit(f1b)
+    f1b_t = bench(f1b_fn, (W2, b2), x, y)
+    f1b_mem = f1b_fn.lower((W2, b2), x, y).compile() \
+        .memory_analysis().temp_size_in_bytes
+
+    # ---- interleaved 1F1B (v chunks of 1 layer per device)
+    Wc = Ws.reshape(v, p, D, D)
+    bc = bs.reshape(v, p, D)
+
+    def ilv(params, x, y):
+        return pipeline_interleaved_grads(layer, loss_fn, params, x, y,
+                                          mesh, m, v)
+
+    ilv_fn = jax.jit(ilv)
+    ilv_t = bench(ilv_fn, (Wc, bc), x, y)
+    ilv_mem = ilv_fn.lower((Wc, bc), x, y).compile() \
+        .memory_analysis().temp_size_in_bytes
+
+    # parity spot-check while we're here
+    l1 = float(f1b_fn((W2, b2), x, y)[0])
+    l2 = float(ilv_fn((Wc, bc), x, y)[0])
+    assert abs(l1 - l2) / abs(l1) < 1e-4, (l1, l2)
+
+    out = {
+        "m": m, "p": p, "v": v, "D": D, "mb": MB,
+        "schedules": rows,
+        "wallclock_ms": {"gpipe": round(gpipe_t * 1e3, 1),
+                         "1f1b": round(f1b_t * 1e3, 1),
+                         "interleaved_v2": round(ilv_t * 1e3, 1)},
+        "temp_bytes_mb": {"gpipe": round(gpipe_mem / 2 ** 20, 1),
+                          "1f1b": round(f1b_mem / 2 ** 20, 1),
+                          "interleaved_v2": round(ilv_mem / 2 ** 20, 1)},
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,8 @@ from .tensor_parallel import ColParallelDense, RowParallelDense, shard_params  #
 from .ring_attention import ring_attention, local_attention  # noqa
 from .ulysses import ulysses_attention  # noqa
 from .pipeline import PipelineParallel, pipeline_spmd, pipeline_1f1b_grads  # noqa
+from .pipeline_interleaved import (  # noqa
+    pipeline_interleaved_grads, interleaved_schedule, schedule_stats)
 from .gluon_pipeline import PipelineStack  # noqa
 from .moe import MoELayer, load_balancing_loss, router_z_loss  # noqa
 from .compression import GradientCompression  # noqa

@@ -1,0 +1,79 @@
+"""Fused Pallas conv-backward kernel (ops/conv_bwd.py) — numerics vs
+jax.vjp of the XLA conv, in interpret mode on CPU (the same gate the
+flash-attention kernels use; the real-chip timing artifact is
+benchmark/conv_bwd_pilot.py -> docs/PERF_RESNET.md)."""
+import os
+
+import numpy as onp
+import pytest
+
+os.environ.setdefault("MXTPU_FLASH_INTERPRET", "1")
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.ops import conv_bwd
+
+
+def _ref(x, w, dy):
+    _, vjp = jax.vjp(conv_bwd._conv_fwd_ref, x, w)
+    return vjp(dy)
+
+
+@pytest.mark.parametrize("shape", [
+    (4, 8, 8, 16, 24),     # C != K
+    (2, 7, 7, 32, 32),     # odd spatial (conv5-like)
+    (3, 5, 9, 8, 16),      # H != W
+])
+def test_conv3x3_bwd_matches_vjp(shape):
+    N, H, W, C, K = shape
+    x = jax.random.normal(jax.random.PRNGKey(0), (N, H, W, C), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, C, K), jnp.float32)
+    dy = jax.random.normal(jax.random.PRNGKey(2), (N, H, W, K), jnp.float32)
+    dx_ref, dw_ref = _ref(x, w, dy)
+    dx, dw = conv_bwd.conv3x3_bwd(x, dy, w, interpret=True)
+    onp.testing.assert_allclose(dx, dx_ref, atol=1e-4, rtol=1e-4)
+    onp.testing.assert_allclose(dw, dw_ref, atol=1e-3, rtol=1e-3)
+
+
+def test_conv3x3_bwd_batch_chunking():
+    """Grid accumulation across batch chunks must match the monolith."""
+    N, H, W, C, K = 8, 6, 6, 8, 8
+    x = jax.random.normal(jax.random.PRNGKey(3), (N, H, W, C), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(4), (3, 3, C, K), jnp.float32)
+    dy = jax.random.normal(jax.random.PRNGKey(5), (N, H, W, K), jnp.float32)
+    a = conv_bwd.conv3x3_bwd(x, dy, w, block_n=8, interpret=True)
+    b = conv_bwd.conv3x3_bwd(x, dy, w, block_n=2, interpret=True)
+    onp.testing.assert_allclose(a[0], b[0], atol=1e-4, rtol=1e-4)
+    onp.testing.assert_allclose(a[1], b[1], atol=1e-3, rtol=1e-3)
+
+
+def test_conv3x3_s1_custom_vjp_grad():
+    """The custom_vjp wrapper differentiates end-to-end (falls back to the
+    XLA rule off-TPU; on TPU it routes to the kernel)."""
+    N, H, W, C, K = 2, 6, 6, 8, 8
+    x = jax.random.normal(jax.random.PRNGKey(6), (N, H, W, C), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(7), (3, 3, C, K), jnp.float32)
+
+    def loss_kernel(x, w):
+        return jnp.sum(conv_bwd.conv3x3_s1(x, w) ** 2)
+
+    def loss_ref(x, w):
+        return jnp.sum(conv_bwd._conv_fwd_ref(x, w) ** 2)
+
+    gx, gw = jax.grad(loss_kernel, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    onp.testing.assert_allclose(gx, rx, atol=1e-4, rtol=1e-4)
+    onp.testing.assert_allclose(gw, rw, atol=1e-3, rtol=1e-3)
+
+
+def test_legality_gate():
+    assert not conv_bwd.conv3x3_bwd_legal((4, 8, 8, 16), (3, 3, 16, 24),
+                                          stride=(2, 2))
+    assert not conv_bwd.conv3x3_bwd_legal((4, 8, 8, 16), (1, 1, 16, 24))
+    assert not conv_bwd.conv3x3_bwd_legal((4, 8, 8, 10), (3, 3, 10, 24))
+    os.environ["MXTPU_CONV_BWD_PALLAS"] = "0"
+    try:
+        assert not conv_bwd.conv3x3_bwd_legal((4, 8, 8, 16), (3, 3, 16, 24))
+    finally:
+        os.environ["MXTPU_CONV_BWD_PALLAS"] = "1"
