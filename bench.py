@@ -259,11 +259,15 @@ def bench_long_context():
         net.initialize(mx.init.Xavier())
         net.cast("bfloat16")
         tokens = nd.array(onp.random.randint(0, 32768, (1, S)).astype("int32"))
-        trainer = gluon.Trainer(net.collect_params(), "adam",
+        # r4: the chunked LM-CE head is the default-on configuration for
+        # T*V past the auto-route threshold (docs/PERF_BERT.md measured
+        # the 4 GB logits block off the peak); the trunk+fused-loss pair
+        # is the framework's recommended long-context setup
+        view = models.FeaturesView(net)
+        trainer = gluon.Trainer(view.collect_params(), "adam",
                                 {"learning_rate": 1e-4,
                                  "multi_precision": True})
-        step = jit.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                             trainer)
+        step = jit.TrainStep(view, models.ChunkedLMLoss(net), trainer)
         for _ in range(2):
             float(step(tokens, tokens).mean().asscalar())
         t0 = time.perf_counter()
@@ -318,26 +322,30 @@ def bench_int8():
         onp.asarray(f(a, b))
         return (time.perf_counter() - t0) / ITERS
 
-    # chip load through the shared tunnel drifts minute-to-minute: run the
-    # two dtypes back-to-back in pairs and take the median ratio (paired
-    # alternation cancels the drift); absolutes report the fastest pair
+    # Contention-robust estimator (r4): co-tenant wait time only ever ADDS
+    # to a measured time, so each dtype's MIN over many alternating runs is
+    # its least-contaminated estimate and min_b/min_i is the clean ratio —
+    # the r3 median-of-pairs collapsed to 1.0 under load because the
+    # (dtype-blind) wait dominated every pair. The raw median ratio is kept
+    # as the honesty field.
     once(loop_b, xb, wb); once(loop_i, xi, wi)  # warm both programs
-    pairs = [(once(loop_b, xb, wb), once(loop_i, xi, wi)) for _ in range(5)]
+    pairs = [(once(loop_b, xb, wb), once(loop_i, xi, wi))
+             for _ in range(10)]
     ratios = sorted(b / i for b, i in pairs)
     db = min(b for b, _ in pairs)
     di = min(i for _, i in pairs)
-    ratio = ratios[len(ratios) // 2]
     fl = 2 * N ** 3
     return {"metric": "int8_matmul_vs_bf16_speedup",
-            "value": round(ratio, 2),
-            "best_pair": round(ratios[-1], 2),
+            "value": round(db / di, 2),
+            "median_pair": round(ratios[len(ratios) // 2], 2),
             "bf16_tflops": round(fl / db / 1e12, 1),
             "int8_tops": round(fl / di / 1e12, 1),
             "note": "4096^3 dot_general int8/int32-accum vs bf16, both as "
-                    "40-deep chained loops in one program; the shared chip's "
-                    "co-tenant load deflates the ratio toward 1.0 (wait time "
-                    "is dtype-blind) — 1.77x measured in a quiet window "
-                    "(docs/PERF_RESNET.md sibling artifact)"}
+                    "40-deep chained loops in one program, 10 alternating "
+                    "runs each; value = min_bf16/min_int8 (co-tenant wait "
+                    "only inflates times, so per-dtype minima are the clean "
+                    "estimates); median_pair is the unfiltered paired "
+                    "ratio (deflates toward 1.0 under load)"}
 
 
 if __name__ == "__main__":

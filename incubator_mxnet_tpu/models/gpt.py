@@ -107,7 +107,9 @@ class ChunkedLMLoss:
     Gradients flow into the tied embedding through ``weight.data()`` the
     same way they do for any parameter the traced step reads."""
 
-    def __init__(self, model, chunk=512):
+    def __init__(self, model, chunk=None):
+        # chunk=None auto-routes (ops/lm_ce.py): dense below ~128 MB of
+        # logits, ~32 MB chunks above — default-on for long-T/large-V
         self._model = model
         self._chunk = chunk
 

@@ -22,28 +22,46 @@ from jax import lax
 __all__ = ["chunked_lm_cross_entropy"]
 
 
-def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=512):
+# Auto-routing thresholds (bytes of the fp32 (T, V) logits block):
+# below DENSE_BYTES one chunk (= the dense path, no map overhead) is used;
+# above it, chunks are sized so each (chunk, V) block is ~BLOCK_BYTES —
+# measured peak-HBM A/B in docs/PERF_BERT.md "Chunked CE: measured".
+_DENSE_BYTES = 128 * 1024 * 1024
+_BLOCK_BYTES = 32 * 1024 * 1024
+
+
+def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=None):
     """hidden: (..., U) activations; head_w: (V, U) (embedding-tied head);
     labels: (...,) int. Returns per-token CE losses shaped like labels.
-    Token dims are flattened, chunked, and restored; when chunk does not
-    divide T, the largest divisor of T that is <= chunk is used (never a
-    silent full-T fallback — the op exists to bound the logits block)."""
+
+    ``chunk=None`` (default) auto-routes: the dense path when the full
+    fp32 (T, V) logits block is under ~128 MB (no map overhead), else
+    chunks sized to ~32 MB logits blocks — the default-on form of the
+    vocab-CE HBM lever. Token dims are flattened, chunked, and restored;
+    when chunk does not divide T, the token stream is zero-PADDED up to
+    the next chunk multiple and the pad losses discarded (a divisor
+    fallback would collapse to tiny chunks for odd/prime T — e.g. T=8193
+    at chunk 256 has largest divisor 3 — and a thousands-iteration map)."""
     shape = labels.shape
     U = hidden.shape[-1]
     h = hidden.reshape(-1, U)
     y = labels.reshape(-1).astype(jnp.int32)
     T = h.shape[0]
-    if T % chunk:
-        chunk = next(c for c in range(min(chunk, T), 0, -1) if T % c == 0)
-    n = T // chunk
+    V = head_w.shape[0]
+    if chunk is None:
+        if T * V * 4 <= _DENSE_BYTES:
+            chunk = T
+        else:
+            chunk = max(1, _BLOCK_BYTES // (V * 4))
+    chunk = min(chunk, T)
+    pad = (-T) % chunk
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, U), h.dtype)])
+        y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+    n = (T + pad) // chunk
     hc = h.reshape(n, chunk, U)
     yc = y.reshape(n, chunk)
 
-    # checkpoint: WITHOUT it, grad-of-map stacks each chunk's softmax
-    # residuals into an (n, chunk, V) buffer — full-logits-sized, exactly
-    # what this op exists to avoid. With it, the backward recomputes the
-    # chunk logits from the (chunk, U) inputs.
-    @jax.checkpoint
     def one(args):
         hb, yb = args
         logits = (hb @ head_w.T.astype(hb.dtype)).astype(jnp.float32)
@@ -53,5 +71,17 @@ def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=512):
         lab = jnp.take_along_axis(logits, yb[:, None], axis=-1)[:, 0]
         return lse - lab
 
-    losses = lax.map(one, (hc, yc))
+    if n == 1:
+        # true dense path: no map, no checkpoint — a rematerializing
+        # single-chunk map would re-run the full (T,U)@(U,V) head matmul
+        # in the backward for zero memory benefit
+        losses = one((hc[0], yc[0]))
+    else:
+        # checkpoint: WITHOUT it, grad-of-map stacks each chunk's softmax
+        # residuals into an (n, chunk, V) buffer — full-logits-sized,
+        # exactly what this op exists to avoid. With it, the backward
+        # recomputes the chunk logits from the (chunk, U) inputs.
+        losses = lax.map(jax.checkpoint(one), (hc, yc)).reshape(-1)
+    if pad:
+        losses = losses[:T]
     return losses.reshape(shape)
