@@ -1,0 +1,320 @@
+// mxnet_tpu_cpp — header-only C++ GRAPH API over the flat C ABI
+// (ref cpp-package/include/mxnet-cpp Symbol/Executor over c_api.h
+// MXSymbolCreateAtomicSymbol/MXSymbolCompose/MXExecutorSimpleBindEx).
+//
+// With predictor.hpp a C++ program can run exported artifacts; with this
+// header it can BUILD a graph, bind an executor, and TRAIN:
+//
+//   using namespace mxnet_tpu_cpp;
+//   Symbol data = Symbol::Variable("data");
+//   Symbol fc = Symbol::Op("FullyConnected", R"({"num_hidden": 8})")
+//                   .Compose("fc1", {{"data", data}});
+//   Executor ex = fc.SimpleBind(R"({"data": [4, 3]})", "write");
+//   ex.Forward(true, {{"data", batch}});
+//   ex.Backward();
+//   NDArray g = ex.ArgGrad("fc1_weight");
+//
+// Zero build-time dependencies: dlopen (MXTPU_PREDICT_LIB or
+// "libmxtpu_predict.so" on the loader path); compile with `g++ app.cc -ldl`.
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+namespace graph_detail {
+
+struct Api {
+  void* so;
+  const char* (*GetLastError)();
+  int (*NDCreate)(const char*, const int64_t*, int, const void*, int64_t,
+                  void**);
+  int (*NDGetShape)(void*, int64_t*, int, int*);
+  int (*NDGetData)(void*, void*, int64_t, int64_t*);
+  int (*NDSetData)(void*, const char*, const void*, int64_t);
+  int (*NDFree)(void*);
+  int (*SymVariable)(const char*, void**);
+  int (*SymAtomic)(const char*, const char*, void**);
+  int (*SymCompose)(void*, const char*, int, const char**, void**);
+  int (*SymListArguments)(void*, char*, int, int64_t*);
+  int (*SymListOutputs)(void*, char*, int, int64_t*);
+  int (*SymToJSON)(void*, char*, int, int64_t*);
+  int (*SymFree)(void*);
+  int (*ExSimpleBind)(void*, const char*, const char*, void**);
+  int (*ExForward)(void*, int, int, const char**, void**);
+  int (*ExNumOutputs)(void*, int*);
+  int (*ExOutput)(void*, int, void**);
+  int (*ExBackward)(void*, int, void**);
+  int (*ExArg)(void*, const char*, void**);
+  int (*ExArgGrad)(void*, const char*, void**);
+  int (*ExFree)(void*);
+
+  template <typename T>
+  void Sym(T& fn, const char* name) {
+    fn = reinterpret_cast<T>(dlsym(so, name));
+    if (!fn)
+      throw std::runtime_error(std::string("missing symbol ") + name);
+  }
+
+  static Api& Get() {
+    static Api api = Load();
+    return api;
+  }
+
+  static Api Load() {
+    Api a;
+    const char* path = std::getenv("MXTPU_PREDICT_LIB");
+    a.so = dlopen(path ? path : "libmxtpu_predict.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!a.so)
+      throw std::runtime_error(std::string("dlopen failed: ") + dlerror());
+    a.Sym(a.GetLastError, "MXTPUNDGetLastError");
+    a.Sym(a.NDCreate, "MXTPUNDCreate");
+    a.Sym(a.NDGetShape, "MXTPUNDGetShape");
+    a.Sym(a.NDGetData, "MXTPUNDGetData");
+    a.Sym(a.NDSetData, "MXTPUNDSetData");
+    a.Sym(a.NDFree, "MXTPUNDFree");
+    a.Sym(a.SymVariable, "MXTPUSymbolCreateVariable");
+    a.Sym(a.SymAtomic, "MXTPUSymbolCreateAtomic");
+    a.Sym(a.SymCompose, "MXTPUSymbolCompose");
+    a.Sym(a.SymListArguments, "MXTPUSymbolListArguments");
+    a.Sym(a.SymListOutputs, "MXTPUSymbolListOutputs");
+    a.Sym(a.SymToJSON, "MXTPUSymbolToJSON");
+    a.Sym(a.SymFree, "MXTPUSymbolFree");
+    a.Sym(a.ExSimpleBind, "MXTPUExecutorSimpleBind");
+    a.Sym(a.ExForward, "MXTPUExecutorForward");
+    a.Sym(a.ExNumOutputs, "MXTPUExecutorNumOutputs");
+    a.Sym(a.ExOutput, "MXTPUExecutorOutput");
+    a.Sym(a.ExBackward, "MXTPUExecutorBackward");
+    a.Sym(a.ExArg, "MXTPUExecutorArg");
+    a.Sym(a.ExArgGrad, "MXTPUExecutorArgGrad");
+    a.Sym(a.ExFree, "MXTPUExecutorFree");
+    return a;
+  }
+};
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(what) + ": " +
+                             Api::Get().GetLastError());
+}
+
+}  // namespace graph_detail
+
+// Owning wrapper over an ND ABI handle (float32 host interface).
+class NDArray {
+ public:
+  NDArray() : h_(nullptr) {}
+  NDArray(const std::vector<int64_t>& shape, const std::vector<float>& data) {
+    graph_detail::Check(
+        graph_detail::Api::Get().NDCreate(
+            "float32", shape.data(), (int)shape.size(), data.data(),
+            (int64_t)(data.size() * sizeof(float)), &h_),
+        "NDCreate");
+  }
+  explicit NDArray(void* owned) : h_(owned) {}
+  NDArray(NDArray&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    std::swap(h_, o.h_);
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  ~NDArray() {
+    if (h_) graph_detail::Api::Get().NDFree(h_);
+  }
+
+  void* handle() const { return h_; }
+
+  std::vector<int64_t> Shape() const {
+    int64_t dims[16];
+    int nd = 0;
+    graph_detail::Check(
+        graph_detail::Api::Get().NDGetShape(h_, dims, 16, &nd), "NDGetShape");
+    return std::vector<int64_t>(dims, dims + nd);
+  }
+
+  std::vector<float> Data() const {
+    int64_t nbytes = 0;
+    graph_detail::Check(graph_detail::Api::Get().NDGetData(h_, nullptr, 0,
+                                                           &nbytes),
+                        "NDGetData");
+    std::vector<float> out(nbytes / sizeof(float));
+    graph_detail::Check(
+        graph_detail::Api::Get().NDGetData(h_, out.data(), nbytes, nullptr),
+        "NDGetData");
+    return out;
+  }
+
+  void SetData(const std::vector<float>& v) {
+    graph_detail::Check(
+        graph_detail::Api::Get().NDSetData(
+            h_, "float32", v.data(), (int64_t)(v.size() * sizeof(float))),
+        "NDSetData");
+  }
+
+ private:
+  void* h_;
+};
+
+class Executor;
+
+class Symbol {
+ public:
+  static Symbol Variable(const std::string& name) {
+    void* h = nullptr;
+    graph_detail::Check(graph_detail::Api::Get().SymVariable(name.c_str(), &h),
+                        "SymbolCreateVariable");
+    return Symbol(h);
+  }
+
+  // ≙ MXSymbolCreateAtomicSymbol; attrs is a JSON object string
+  static Symbol Op(const std::string& op, const std::string& attrs_json) {
+    void* h = nullptr;
+    graph_detail::Check(
+        graph_detail::Api::Get().SymAtomic(op.c_str(), attrs_json.c_str(), &h),
+        "SymbolCreateAtomic");
+    return Symbol(h);
+  }
+
+  // ≙ MXSymbolCompose (named operator inputs); rvalue-qualified: legal
+  // only in the `Symbol fc = Symbol::Op(...).Compose(...)` chain — calling
+  // it on a NAMED symbol would move its handle out and is a compile error
+  Symbol&& Compose(
+      const std::string& name,
+      const std::vector<std::pair<std::string, const Symbol*>>& args) && {
+    std::vector<const char*> keys;
+    std::vector<void*> handles;
+    for (auto& kv : args) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second->h_);
+    }
+    graph_detail::Check(
+        graph_detail::Api::Get().SymCompose(h_, name.c_str(),
+                                            (int)args.size(), keys.data(),
+                                            handles.data()),
+        "SymbolCompose");
+    return std::move(*this);
+  }
+
+  std::string ListArguments() const { return Str_(graph_detail::Api::Get()
+                                                      .SymListArguments); }
+  std::string ListOutputs() const { return Str_(graph_detail::Api::Get()
+                                                    .SymListOutputs); }
+  std::string ToJSON() const { return Str_(graph_detail::Api::Get()
+                                               .SymToJSON); }
+
+  Executor SimpleBind(const std::string& shapes_json,
+                      const std::string& grad_req) const;
+
+  Symbol(Symbol&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol& operator=(Symbol&& o) noexcept {
+    std::swap(h_, o.h_);
+    return *this;
+  }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+  ~Symbol() {
+    if (h_) graph_detail::Api::Get().SymFree(h_);
+  }
+
+ private:
+  explicit Symbol(void* h) : h_(h) {}
+  std::string Str_(int (*fn)(void*, char*, int, int64_t*)) const {
+    // size-probe then fetch: no fixed cap, works for any graph size
+    int64_t needed = 0;
+    graph_detail::Check(fn(h_, nullptr, 0, &needed), "SymbolStr(probe)");
+    std::vector<char> buf((size_t)needed);
+    graph_detail::Check(fn(h_, buf.data(), (int)buf.size(), nullptr),
+                        "SymbolStr");
+    return std::string(buf.data());
+  }
+  void* h_;
+  friend class Executor;
+};
+
+class Executor {
+ public:
+  void Forward(bool is_train,
+               const std::vector<std::pair<std::string, const NDArray*>>&
+                   feed) {
+    std::vector<const char*> names;
+    std::vector<void*> handles;
+    for (auto& kv : feed) {
+      names.push_back(kv.first.c_str());
+      handles.push_back(kv.second->handle());
+    }
+    graph_detail::Check(
+        graph_detail::Api::Get().ExForward(h_, is_train ? 1 : 0,
+                                           (int)feed.size(), names.data(),
+                                           handles.data()),
+        "ExecutorForward");
+  }
+
+  int NumOutputs() const {
+    int n = 0;
+    graph_detail::Check(graph_detail::Api::Get().ExNumOutputs(h_, &n),
+                        "ExecutorNumOutputs");
+    return n;
+  }
+
+  NDArray Output(int i) const {
+    void* h = nullptr;
+    graph_detail::Check(graph_detail::Api::Get().ExOutput(h_, i, &h),
+                        "ExecutorOutput");
+    return NDArray(h);
+  }
+
+  void Backward() {
+    graph_detail::Check(graph_detail::Api::Get().ExBackward(h_, 0, nullptr),
+                        "ExecutorBackward");
+  }
+
+  NDArray Arg(const std::string& name) const {
+    void* h = nullptr;
+    graph_detail::Check(graph_detail::Api::Get().ExArg(h_, name.c_str(), &h),
+                        "ExecutorArg");
+    return NDArray(h);
+  }
+
+  NDArray ArgGrad(const std::string& name) const {
+    void* h = nullptr;
+    graph_detail::Check(
+        graph_detail::Api::Get().ExArgGrad(h_, name.c_str(), &h),
+        "ExecutorArgGrad");
+    return NDArray(h);
+  }
+
+  Executor(Executor&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Executor& operator=(Executor&& o) noexcept {
+    std::swap(h_, o.h_);
+    return *this;
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  ~Executor() {
+    if (h_) graph_detail::Api::Get().ExFree(h_);
+  }
+
+ private:
+  explicit Executor(void* h) : h_(h) {}
+  void* h_;
+  friend class Symbol;
+};
+
+inline Executor Symbol::SimpleBind(const std::string& shapes_json,
+                                   const std::string& grad_req) const {
+  void* h = nullptr;
+  graph_detail::Check(
+      graph_detail::Api::Get().ExSimpleBind(h_, shapes_json.c_str(),
+                                            grad_req.c_str(), &h),
+      "ExecutorSimpleBind");
+  return Executor(h);
+}
+
+}  // namespace mxnet_tpu_cpp

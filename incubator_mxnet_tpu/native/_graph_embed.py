@@ -1,0 +1,119 @@
+"""Helper module for the embedded-interpreter GRAPH C API
+(native/src/c_predict_api.cc MXTPUSymbol*/MXTPUExecutor* — ref
+include/mxnet/c_api.h MXSymbolCreateAtomicSymbol/MXSymbolCompose and
+MXExecutorSimpleBindEx/c_api_executor.cc:860).
+
+The imperative-invoke slice lets C frontends run EAGER ops; this slice
+lets them build and run a GRAPH — compose symbols, simple_bind an
+executor, forward/backward, and read/update the bound arrays — which is
+what cpp_package-style deployment and training actually want.
+
+Handles crossing the ABI are opaque PyObjects: composed ``Symbol``s, an
+uncomposed atomic-op token (``_Atomic``), ``Executor``s, and the NDArrays
+the existing ND ABI already moves.  Reference parity notes: like
+``MXSymbolCompose``, composing fills un-supplied operator inputs with
+auto-named variables (fc1 -> fc1_weight/fc1_bias) via the symbol
+frontend's own machinery; like ``MXExecutorSimpleBindEx``, simple_bind
+allocates argument arrays from shape hints and grad buffers per grad_req.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["sym_variable", "sym_atomic", "sym_compose", "sym_list_arguments",
+           "sym_list_outputs", "sym_tojson", "executor_simple_bind",
+           "executor_forward", "executor_num_outputs", "executor_output",
+           "executor_backward", "executor_arg", "executor_arg_grad"]
+
+
+class _Atomic:
+    """An op + attrs awaiting composition (MXSymbolCreateAtomicSymbol)."""
+
+    def __init__(self, op_name, attrs):
+        self.op_name = op_name
+        self.attrs = attrs
+
+
+def _sym_mod():
+    from incubator_mxnet_tpu import sym
+    return sym
+
+
+def sym_variable(name):
+    return _sym_mod().Variable(name)
+
+
+def sym_atomic(op_name, attrs_json):
+    sym = _sym_mod()
+    if not hasattr(sym, op_name):
+        raise ValueError("unknown symbol op %r" % op_name)
+    attrs = json.loads(attrs_json) if attrs_json else {}
+    return _Atomic(op_name, attrs)
+
+
+def sym_compose(atomic, name, keys, args):
+    """MXSymbolCompose: bind named symbol inputs + attrs into a node."""
+    if not isinstance(atomic, _Atomic):
+        raise TypeError("compose target must be an uncomposed atomic "
+                        "symbol (got %r)" % type(atomic).__name__)
+    sym = _sym_mod()
+    fn = getattr(sym, atomic.op_name)
+    kwargs = dict(atomic.attrs)
+    if name:
+        kwargs["name"] = name
+    positional = []
+    for k, a in zip(keys, args):
+        if k:
+            kwargs[k] = a
+        else:
+            positional.append(a)
+    return fn(*positional, **kwargs)
+
+
+def sym_list_arguments(s):
+    return json.dumps(list(s.list_arguments()))
+
+
+def sym_list_outputs(s):
+    return json.dumps(list(s.list_outputs()))
+
+
+def sym_tojson(s):
+    return s.tojson()
+
+
+def executor_simple_bind(s, shapes_json, grad_req):
+    shapes = {k: tuple(int(d) for d in v)
+              for k, v in json.loads(shapes_json).items()}
+    return s.simple_bind(grad_req=grad_req, **shapes)
+
+
+def executor_forward(ex, is_train, names, arrays):
+    feed = dict(zip(names, arrays))
+    ex.forward(is_train=bool(is_train), **feed)
+
+
+def executor_num_outputs(ex):
+    return len(ex.outputs)
+
+
+def executor_output(ex, i):
+    return ex.outputs[i]
+
+
+def executor_backward(ex, head_grads):
+    ex.backward(head_grads if head_grads else None)
+
+
+def executor_arg(ex, name):
+    return ex.arg_dict[name]
+
+
+def executor_arg_grad(ex, name):
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise KeyError("no grad buffer for %r (grad_req/null or not an "
+                       "argument)" % name)
+    return g

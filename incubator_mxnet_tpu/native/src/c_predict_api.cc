@@ -591,3 +591,267 @@ extern "C" int MXTPUNDSetData(void* handle, const char* dtype,
   if (!args) return fail_py("MXTPUNDSetData");
   return call_bool("set_data", args);
 }
+
+// ---------------------------------------------------------------------------
+// Graph slice (ref include/mxnet/c_api.h MXSymbolCreateAtomicSymbol /
+// MXSymbolCompose / MXSymbolListArguments / MXExecutorSimpleBindEx
+// (src/c_api/c_api_executor.cc:860) / MXExecutorForward / MXExecutorBackward
+// / MXExecutorOutputs): C frontends can BUILD and RUN a graph — compose
+// symbols, simple_bind, forward/backward, and read/update bound arrays —
+// not just predict or run eager ops. Dispatch goes through
+// native/_graph_embed.py into the same symbol/executor stack the Python
+// frontend uses; array traffic rides the existing ND ABI handles.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+PyObject* graph_module() {
+  static PyObject* mod = nullptr;
+  if (!mod)
+    mod = PyImport_ImportModule("incubator_mxnet_tpu.native._graph_embed");
+  return mod;
+}
+
+// STEALS the args reference (every call site passes a fresh
+// Py_BuildValue tuple; decref here keeps the 13 call sites leak-free —
+// same contract as call_bool above).
+PyObject* call_graph(const char* fn, PyObject* args) {
+  if (!args) return nullptr;
+  PyObject* mod = graph_module();
+  if (!mod) {
+    Py_DECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (!f) {
+    Py_DECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  return r;
+}
+
+struct SymHandle {
+  PyObject* obj;  // Symbol, atomic token, or Executor (opaque to C)
+};
+
+// buf == nullptr: size-probe handshake (required length incl. NUL via
+// *needed) — the MXTPUNDGetData convention, so callers can retry with a
+// right-sized buffer instead of dead-ending on big graphs.
+int str_out(PyObject* r, char* buf, int cap, int64_t* needed,
+            const char* where) {
+  const char* c = PyUnicode_AsUTF8(r);
+  if (!c) {
+    Py_DECREF(r);
+    return fail_py(where);
+  }
+  std::string s(c);
+  Py_DECREF(r);
+  if (needed) *needed = (int64_t)s.size() + 1;
+  if (!buf) return 0;
+  if ((int)s.size() + 1 > cap) return fail("buffer too small");
+  std::snprintf(buf, cap, "%s", s.c_str());
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ≙ MXSymbolCreateVariable
+int MXTPUSymbolCreateVariable(const char* name, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_graph("sym_variable", Py_BuildValue("(s)", name));
+  if (!r) return fail_py("MXTPUSymbolCreateVariable");
+  *out = new SymHandle{r};
+  return 0;
+}
+
+// ≙ MXSymbolCreateAtomicSymbol (attrs as a JSON object string)
+int MXTPUSymbolCreateAtomic(const char* op_name, const char* attrs_json,
+                            void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_graph("sym_atomic",
+                           Py_BuildValue("(ss)", op_name, attrs_json));
+  if (!r) return fail_py("MXTPUSymbolCreateAtomic");
+  *out = new SymHandle{r};
+  return 0;
+}
+
+// ≙ MXSymbolCompose: mutates `handle` from atomic token to composed node.
+// keys[i] names the operator input args[i] binds to (NULL/"" = positional).
+int MXTPUSymbolCompose(void* handle, const char* name, int n,
+                       const char** keys, void** args) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(handle);
+  PyObject* kl = PyList_New(n);
+  PyObject* al = PyList_New(n);
+  if (!kl || !al) return fail_py("MXTPUSymbolCompose");
+  for (int i = 0; i < n; ++i) {
+    PyList_SET_ITEM(kl, i, PyUnicode_FromString(keys && keys[i] ? keys[i]
+                                                                : ""));
+    PyObject* a = static_cast<SymHandle*>(args[i])->obj;
+    Py_INCREF(a);
+    PyList_SET_ITEM(al, i, a);
+  }
+  PyObject* r = call_graph("sym_compose",
+                           Py_BuildValue("(OsNN)", h->obj, name ? name : "",
+                                         kl, al));
+  if (!r) return fail_py("MXTPUSymbolCompose");
+  Py_DECREF(h->obj);
+  h->obj = r;
+  return 0;
+}
+
+int MXTPUSymbolListArguments(void* handle, char* buf, int cap,
+        int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(handle);
+  PyObject* r = call_graph("sym_list_arguments",
+                           Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUSymbolListArguments");
+  return str_out(r, buf, cap, needed, "MXTPUSymbolListArguments");
+}
+
+int MXTPUSymbolListOutputs(void* handle, char* buf, int cap,
+        int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(handle);
+  PyObject* r = call_graph("sym_list_outputs", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUSymbolListOutputs");
+  return str_out(r, buf, cap, needed, "MXTPUSymbolListOutputs");
+}
+
+// ≙ MXSymbolSaveToJSON
+int MXTPUSymbolToJSON(void* handle, char* buf, int cap,
+        int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(handle);
+  PyObject* r = call_graph("sym_tojson", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUSymbolToJSON");
+  return str_out(r, buf, cap, needed, "MXTPUSymbolToJSON");
+}
+
+int MXTPUSymbolFree(void* handle) {
+  Gil gil;
+  auto* h = static_cast<SymHandle*>(handle);
+  if (gil.ok) Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+// ≙ MXExecutorSimpleBindEx: shapes as a JSON object {"name": [dims...]}
+int MXTPUExecutorSimpleBind(void* sym, const char* shapes_json,
+                            const char* grad_req, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(sym);
+  PyObject* r = call_graph("executor_simple_bind",
+                           Py_BuildValue("(Oss)", h->obj, shapes_json,
+                                         grad_req));
+  if (!r) return fail_py("MXTPUExecutorSimpleBind");
+  *out = new SymHandle{r};
+  return 0;
+}
+
+// ≙ MXExecutorForward (+ the feed: names/arrays pairs bind data vars)
+int MXTPUExecutorForward(void* ex, int is_train, int n, const char** names,
+                         void** nd_handles) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(ex);
+  PyObject* kl = PyList_New(n);
+  PyObject* al = PyList_New(n);
+  if (!kl || !al) return fail_py("MXTPUExecutorForward");
+  for (int i = 0; i < n; ++i) {
+    PyList_SET_ITEM(kl, i, PyUnicode_FromString(names[i]));
+    PyObject* a = static_cast<NDHandle*>(nd_handles[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(al, i, a);
+  }
+  PyObject* r = call_graph("executor_forward",
+                           Py_BuildValue("(OiNN)", h->obj, is_train, kl, al));
+  if (!r) return fail_py("MXTPUExecutorForward");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUExecutorNumOutputs(void* ex, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(ex);
+  PyObject* r = call_graph("executor_num_outputs",
+                           Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUExecutorNumOutputs");
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// ≙ MXExecutorOutputs — returns a new ND handle usable with the ND ABI
+int MXTPUExecutorOutput(void* ex, int index, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(ex);
+  PyObject* r = call_graph("executor_output",
+                           Py_BuildValue("(Oi)", h->obj, index));
+  if (!r) return fail_py("MXTPUExecutorOutput");
+  *out = new NDHandle{r};
+  return 0;
+}
+
+// ≙ MXExecutorBackwardEx (head_grads NULL/0 = ones like the reference)
+int MXTPUExecutorBackward(void* ex, int n, void** head_grads) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(ex);
+  PyObject* hl = PyList_New(n);
+  if (!hl) return fail_py("MXTPUExecutorBackward");
+  for (int i = 0; i < n; ++i) {
+    PyObject* a = static_cast<NDHandle*>(head_grads[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(hl, i, a);
+  }
+  PyObject* r = call_graph("executor_backward",
+                           Py_BuildValue("(ON)", h->obj, hl));
+  if (!r) return fail_py("MXTPUExecutorBackward");
+  Py_DECREF(r);
+  return 0;
+}
+
+// Bound argument array by name (read/update via the ND ABI; updates are
+// seen by the next forward — the executor reads args at call time).
+int MXTPUExecutorArg(void* ex, const char* name, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(ex);
+  PyObject* r = call_graph("executor_arg",
+                           Py_BuildValue("(Os)", h->obj, name));
+  if (!r) return fail_py("MXTPUExecutorArg");
+  *out = new NDHandle{r};
+  return 0;
+}
+
+// ≙ the grad arrays MXExecutorSimpleBindEx returns
+int MXTPUExecutorArgGrad(void* ex, const char* name, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(ex);
+  PyObject* r = call_graph("executor_arg_grad",
+                           Py_BuildValue("(Os)", h->obj, name));
+  if (!r) return fail_py("MXTPUExecutorArgGrad");
+  *out = new NDHandle{r};
+  return 0;
+}
+
+int MXTPUExecutorFree(void* handle) { return MXTPUSymbolFree(handle); }
+
+}  // extern "C"

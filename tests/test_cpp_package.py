@@ -118,3 +118,29 @@ def test_cpp_package_standalone_binary(tmp_path):
     got = onp.array([float(line) for line in r.stdout.split()],
                     onp.float32).reshape(2, 4)
     onp.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_cpp_graph_train_mlp(tmp_path):
+    """r4 graph slice (ref c_api.h MXSymbolCompose/MXExecutorSimpleBindEx):
+    a standalone C++ binary builds a 2-layer MLP SYMBOLICALLY, simple_binds
+    an executor, and trains it end-to-end — forward, backward, grad
+    readout, parameter writeback — through the flat C ABI."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    so_path = _predict_lib()
+    exe = str(tmp_path / "train_mlp")
+    src = os.path.join(ROOT, "cpp_package", "example", "train_mlp.cc")
+    inc = os.path.join(ROOT, "cpp_package", "include")
+    subprocess.run(["g++", "-O2", "-std=c++17", src, "-I", inc, "-ldl",
+                    "-o", exe], check=True, capture_output=True)
+    env = dict(os.environ)
+    env["MXTPU_PREDICT_LIB"] = so_path
+    env["MXTPU_PYTHON"] = sys.executable
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "CPP GRAPH TRAIN OK" in r.stdout, r.stdout
+    # the composed symbol auto-created the layer weights (compose parity)
+    assert "fc1_weight" in r.stdout and "fc2_bias" in r.stdout
