@@ -1,0 +1,133 @@
+# R binding for incubator_mxnet_tpu (ref R-package/ in upstream MXNet).
+#
+# Rides the .C-convention shim in src/rmxtpu.c over the flat C ABI
+# (libmxtpu_predict.so, embedded-interpreter backend):
+#   * mx.nd.array / as.array: NDArray transfer (R is column-major, the
+#     ABI row-major — conversions below keep LOGICAL shapes identical to
+#     the Python frontend, like the Julia binding);
+#   * mx.invoke: name-dispatched EAGER ops from the nd/nd.contrib
+#     registry (MXImperativeInvokeEx analog);
+#   * mx.attach.grad / mx.recording / mx.backward / mx.grad /
+#     mx.set.data: the autograd slice — TRAINING from R.
+#
+# Usage (with an R install; the CI image has none, so the shim layer is
+# exercised by the compiled harness in tests/harness.c instead):
+#   dyn.load("rmxtpu.so")  # after: gcc -O2 -shared -fPIC rmxtpu.c -ldl
+#   source("mxnet_tpu.R")
+#   a <- mx.nd.array(matrix(1:6, 2, 3))
+#   b <- mx.invoke("broadcast_add", list(a, a))[[1]]
+#   as.array(b)
+
+.rmxtpu.err <- function() {
+  r <- .C("rmxtpu_last_error", out = character(1))
+  r$out
+}
+
+.rmxtpu.check <- function(rc) {
+  if (rc != 0) stop(paste("mxnet_tpu:", .rmxtpu.err()))
+}
+
+# row-major (ABI) <-> column-major (R)
+.to.rowmajor <- function(x) {
+  if (is.null(dim(x)) || length(dim(x)) <= 1) as.double(x)
+  else as.double(aperm(x, rev(seq_along(dim(x)))))
+}
+
+.from.rowmajor <- function(vals, shape) {
+  if (length(shape) <= 1) return(vals)
+  aperm(array(vals, dim = rev(shape)), rev(seq_along(shape)))
+}
+
+mx.nd.array <- function(x, as.double.dtype = FALSE) {
+  shape <- if (is.null(dim(x))) length(x) else dim(x)
+  vals <- .to.rowmajor(x)
+  r <- .C("rmxtpu_nd_create", shape = as.integer(shape),
+          ndim = as.integer(length(shape)), data = vals,
+          n = as.integer(length(vals)),
+          as_double = as.integer(as.double.dtype),
+          out_id = integer(1), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  structure(list(id = r$out_id), class = "MXNDArray")
+}
+
+mx.nd.shape <- function(nd) {
+  r <- .C("rmxtpu_nd_shape", id = as.integer(nd$id), shape = integer(32),
+          cap = as.integer(32), ndim = integer(1), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  r$shape[seq_len(r$ndim)]
+}
+
+as.array.MXNDArray <- function(x, ...) {
+  shape <- mx.nd.shape(x)
+  n <- prod(shape)
+  r <- .C("rmxtpu_nd_data", id = as.integer(x$id), out = double(n),
+          cap = as.integer(n), n = integer(1), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  .from.rowmajor(r$out[seq_len(r$n)], shape)
+}
+
+mx.set.data <- function(nd, x) {
+  vals <- .to.rowmajor(x)
+  r <- .C("rmxtpu_nd_set_data", id = as.integer(nd$id), data = vals,
+          n = as.integer(length(vals)), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  invisible(nd)
+}
+
+mx.nd.free <- function(nd) {
+  .C("rmxtpu_nd_free", id = as.integer(nd$id), rc = integer(1))
+  invisible(NULL)
+}
+
+# attrs: named list of scalars/strings -> JSON object string
+.attrs.json <- function(attrs) {
+  if (length(attrs) == 0) return("")
+  parts <- vapply(names(attrs), function(k) {
+    v <- attrs[[k]]
+    vs <- if (is.character(v)) paste0('"', v, '"')
+          else if (is.logical(v)) tolower(as.character(v))
+          else as.character(v)
+    paste0('"', k, '":', vs)
+  }, character(1))
+  paste0("{", paste(parts, collapse = ","), "}")
+}
+
+mx.invoke <- function(op_name, inputs, attrs = list()) {
+  ids <- vapply(inputs, function(a) as.integer(a$id), integer(1))
+  r <- .C("rmxtpu_invoke", op_name = as.character(op_name),
+          in_ids = ids, nin = as.integer(length(ids)),
+          attrs_json = .attrs.json(attrs), out_ids = integer(16),
+          cap = as.integer(16), nout = integer(1), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  lapply(seq_len(r$nout), function(i)
+    structure(list(id = r$out_ids[i]), class = "MXNDArray"))
+}
+
+mx.attach.grad <- function(nd) {
+  r <- .C("rmxtpu_attach_grad", id = as.integer(nd$id), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  invisible(nd)
+}
+
+mx.recording <- function(expr) {
+  r <- .C("rmxtpu_record", begin = as.integer(1), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  on.exit({
+    r2 <- .C("rmxtpu_record", begin = as.integer(0), rc = integer(1))
+    .rmxtpu.check(r2$rc)
+  })
+  force(expr)
+}
+
+mx.backward <- function(loss) {
+  r <- .C("rmxtpu_backward", id = as.integer(loss$id), rc = integer(1))
+  .rmxtpu.check(r$rc)
+  invisible(NULL)
+}
+
+mx.grad <- function(nd) {
+  r <- .C("rmxtpu_grad_of", id = as.integer(nd$id), out_id = integer(1),
+          rc = integer(1))
+  .rmxtpu.check(r$rc)
+  structure(list(id = r$out_id), class = "MXNDArray")
+}

@@ -1,0 +1,316 @@
+/* R binding shim for incubator_mxnet_tpu (ref R-package/src over c_api.h).
+ *
+ * Every function below uses the .C calling convention — plain C functions
+ * taking pointers to R atomic vectors (int*, double*, char**) and writing
+ * results through them. That buys two things:
+ *   1. with a real R install, `dyn.load("rmxtpu.so")` + `.C(...)` works
+ *      directly — no Rinternals.h/SEXP shim to compile against R;
+ *   2. without one (this CI image ships no R), the EXACT functions R
+ *      would call are driven by a compiled C harness
+ *      (tests/harness.c), so the binding's FFI layer is fully exercised.
+ *
+ * Opaque ABI handles (NDArrays, predictors) are kept in a process-global
+ * table and crossed to R as integer ids (R's .C cannot carry pointers).
+ * Array payloads cross as double* (R numeric) and are converted to the
+ * requested dtype here. The flat ABI itself (MXTPU*) is resolved with
+ * dlopen from MXTPU_PREDICT_LIB or the loader path.
+ *
+ * Build: gcc -O2 -shared -fPIC rmxtpu.c -ldl -o rmxtpu.so
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ ABI */
+typedef int (*nd_create_t)(const char*, const int64_t*, int, const void*,
+                           int64_t, void**);
+typedef int (*nd_shape_t)(void*, int64_t*, int, int*);
+typedef int (*nd_dtype_t)(void*, char*, int);
+typedef int (*nd_data_t)(void*, void*, int64_t, int64_t*);
+typedef int (*nd_setdata_t)(void*, const char*, const void*, int64_t);
+typedef int (*nd_free_t)(void*);
+typedef int (*invoke_t)(const char*, void**, int, const char*, void**, int,
+                        int*);
+typedef int (*v_t)(void*);
+typedef int (*v0_t)(void);
+typedef int (*gg_t)(void*, void**);
+typedef const char* (*err_t)(void);
+
+static struct {
+  void* so;
+  nd_create_t nd_create;
+  nd_shape_t nd_shape;
+  nd_dtype_t nd_dtype;
+  nd_data_t nd_data;
+  nd_setdata_t nd_setdata;
+  nd_free_t nd_free;
+  invoke_t invoke;
+  v_t attach_grad, backward;
+  v0_t rec_begin, rec_end;
+  gg_t grad_of;
+  err_t last_err;
+} g_api;
+
+static char g_err[4096];
+
+static int api_init(void) {
+  if (g_api.so) return 0;
+  const char* path = getenv("MXTPU_PREDICT_LIB");
+  g_api.so = dlopen(path ? path : "libmxtpu_predict.so",
+                    RTLD_NOW | RTLD_GLOBAL);
+  if (!g_api.so) {
+    snprintf(g_err, sizeof(g_err), "dlopen: %s", dlerror());
+    return -1;
+  }
+#define SYM(field, name)                                    \
+  do {                                                      \
+    g_api.field = (typeof(g_api.field))dlsym(g_api.so, name); \
+    if (!g_api.field) {                                     \
+      snprintf(g_err, sizeof(g_err), "missing %s", name);   \
+      return -1;                                            \
+    }                                                       \
+  } while (0)
+  SYM(nd_create, "MXTPUNDCreate");
+  SYM(nd_shape, "MXTPUNDGetShape");
+  SYM(nd_dtype, "MXTPUNDGetDType");
+  SYM(nd_data, "MXTPUNDGetData");
+  SYM(nd_setdata, "MXTPUNDSetData");
+  SYM(nd_free, "MXTPUNDFree");
+  SYM(invoke, "MXTPUImperativeInvoke");
+  SYM(attach_grad, "MXTPUNDAttachGrad");
+  SYM(backward, "MXTPUNDBackward");
+  SYM(rec_begin, "MXTPUAutogradRecordBegin");
+  SYM(rec_end, "MXTPUAutogradRecordEnd");
+  SYM(grad_of, "MXTPUNDGetGrad");
+  SYM(last_err, "MXTPUNDGetLastError");
+#undef SYM
+  return 0;
+}
+
+static void set_err(const char* where) {
+  const char* e = g_api.last_err ? g_api.last_err() : "";
+  snprintf(g_err, sizeof(g_err), "%s: %s", where, e && *e ? e : "error");
+}
+
+/* ------------------------------------------------- handle table (ids) */
+#define MAX_HANDLES 4096
+static void* g_handles[MAX_HANDLES];
+
+static int put_handle(void* h) {
+  for (int i = 1; i < MAX_HANDLES; ++i)
+    if (!g_handles[i]) {
+      g_handles[i] = h;
+      return i;
+    }
+  snprintf(g_err, sizeof(g_err), "handle table full");
+  return -1;
+}
+
+static void* get_handle(int id) {
+  return (id > 0 && id < MAX_HANDLES) ? g_handles[id] : NULL;
+}
+
+/* ----------------------------------------------------- .C entry points
+ * All outputs through pointers; *rc = 0 on success. */
+
+void rmxtpu_last_error(char** out) { *out = g_err; }
+
+/* doubles -> float32 NDArray (R numeric is double; float32 is the TPU
+ * default dtype — float64 passthrough when *as_double). */
+void rmxtpu_nd_create(int* shape, int* ndim, double* data, int* n,
+                      int* as_double, int* out_id, int* rc) {
+  *rc = -1;
+  if (api_init()) return;
+  int64_t shp[32];
+  for (int i = 0; i < *ndim && i < 32; ++i) shp[i] = shape[i];
+  void* h = NULL;
+  int r;
+  if (*as_double) {
+    r = g_api.nd_create("float64", shp, *ndim, data,
+                        (int64_t)(*n) * 8, &h);
+  } else {
+    float* buf = (float*)malloc((size_t)(*n) * 4);
+    if (!buf) return;
+    for (int i = 0; i < *n; ++i) buf[i] = (float)data[i];
+    r = g_api.nd_create("float32", shp, *ndim, buf, (int64_t)(*n) * 4, &h);
+    free(buf);
+  }
+  if (r) {
+    set_err("nd_create");
+    return;
+  }
+  int id = put_handle(h);
+  if (id < 0) return;
+  *out_id = id;
+  *rc = 0;
+}
+
+void rmxtpu_nd_shape(int* id, int* shape, int* cap, int* ndim, int* rc) {
+  *rc = -1;
+  void* h = get_handle(*id);
+  if (!h || api_init()) return;
+  int64_t shp[32];
+  int nd = 0;
+  if (g_api.nd_shape(h, shp, 32, &nd)) {
+    set_err("nd_shape");
+    return;
+  }
+  if (nd > *cap) {
+    snprintf(g_err, sizeof(g_err), "shape cap too small");
+    return;
+  }
+  for (int i = 0; i < nd; ++i) shape[i] = (int)shp[i];
+  *ndim = nd;
+  *rc = 0;
+}
+
+/* payload out as doubles (converted from the array's dtype) */
+void rmxtpu_nd_data(int* id, double* out, int* cap, int* n, int* rc) {
+  *rc = -1;
+  void* h = get_handle(*id);
+  if (!h || api_init()) return;
+  char dt[16] = {0};
+  if (g_api.nd_dtype(h, dt, sizeof(dt))) {
+    set_err("nd_dtype");
+    return;
+  }
+  int64_t nbytes = 0;
+  if (g_api.nd_data(h, NULL, 0, &nbytes)) {
+    set_err("nd_data");
+    return;
+  }
+  int item = strcmp(dt, "float64") == 0 ? 8 :
+             strcmp(dt, "float32") == 0 ? 4 :
+             strcmp(dt, "int32") == 0 ? 4 : 0;
+  if (!item) {
+    snprintf(g_err, sizeof(g_err), "unsupported dtype %s for R", dt);
+    return;
+  }
+  int64_t count = nbytes / item;
+  if (count > *cap) {
+    snprintf(g_err, sizeof(g_err), "data cap too small");
+    return;
+  }
+  void* buf = malloc((size_t)nbytes);
+  if (!buf) return;
+  if (g_api.nd_data(h, buf, nbytes, NULL)) {
+    free(buf);
+    set_err("nd_data");
+    return;
+  }
+  for (int64_t i = 0; i < count; ++i) {
+    out[i] = strcmp(dt, "float64") == 0 ? ((double*)buf)[i] :
+             strcmp(dt, "float32") == 0 ? (double)((float*)buf)[i]
+                                        : (double)((int32_t*)buf)[i];
+  }
+  free(buf);
+  *n = (int)count;
+  *rc = 0;
+}
+
+void rmxtpu_nd_set_data(int* id, double* data, int* n, int* rc) {
+  *rc = -1;
+  void* h = get_handle(*id);
+  if (!h || api_init()) return;
+  float* buf = (float*)malloc((size_t)(*n) * 4);
+  if (!buf) return;
+  for (int i = 0; i < *n; ++i) buf[i] = (float)data[i];
+  int r = g_api.nd_setdata(h, "float32", buf, (int64_t)(*n) * 4);
+  free(buf);
+  if (r) {
+    set_err("nd_set_data");
+    return;
+  }
+  *rc = 0;
+}
+
+void rmxtpu_nd_free(int* id, int* rc) {
+  void* h = get_handle(*id);
+  if (h) {
+    g_api.nd_free(h);
+    g_handles[*id] = NULL;
+  }
+  *rc = 0;
+}
+
+/* name-dispatched eager op (≙ MXImperativeInvokeEx); attrs JSON string */
+void rmxtpu_invoke(char** op_name, int* in_ids, int* nin, char** attrs_json,
+                   int* out_ids, int* cap, int* nout, int* rc) {
+  *rc = -1;
+  if (api_init()) return;
+  void* ins[64];
+  for (int i = 0; i < *nin && i < 64; ++i) {
+    ins[i] = get_handle(in_ids[i]);
+    if (!ins[i]) {
+      snprintf(g_err, sizeof(g_err), "bad input handle id %d", in_ids[i]);
+      return;
+    }
+  }
+  void* outs[64];
+  int n_out = 0;
+  if (g_api.invoke(*op_name, ins, *nin, *attrs_json, outs, 64, &n_out)) {
+    set_err("invoke");
+    return;
+  }
+  if (n_out > *cap) {
+    snprintf(g_err, sizeof(g_err), "output cap too small");
+    return;
+  }
+  for (int i = 0; i < n_out; ++i) {
+    int id = put_handle(outs[i]);
+    if (id < 0) return;
+    out_ids[i] = id;
+  }
+  *nout = n_out;
+  *rc = 0;
+}
+
+/* autograd slice: attach/record/backward/grad — train from R */
+void rmxtpu_attach_grad(int* id, int* rc) {
+  *rc = -1;
+  void* h = get_handle(*id);
+  if (!h || api_init()) return;
+  if (g_api.attach_grad(h)) {
+    set_err("attach_grad");
+    return;
+  }
+  *rc = 0;
+}
+
+void rmxtpu_record(int* begin, int* rc) {
+  *rc = -1;
+  if (api_init()) return;
+  if ((*begin ? g_api.rec_begin() : g_api.rec_end())) {
+    set_err("record");
+    return;
+  }
+  *rc = 0;
+}
+
+void rmxtpu_backward(int* id, int* rc) {
+  *rc = -1;
+  void* h = get_handle(*id);
+  if (!h || api_init()) return;
+  if (g_api.backward(h)) {
+    set_err("backward");
+    return;
+  }
+  *rc = 0;
+}
+
+void rmxtpu_grad_of(int* id, int* out_id, int* rc) {
+  *rc = -1;
+  void* h = get_handle(*id);
+  if (!h || api_init()) return;
+  void* g = NULL;
+  if (g_api.grad_of(h, &g)) {
+    set_err("grad_of");
+    return;
+  }
+  int gid = put_handle(g);
+  if (gid < 0) return;
+  *out_id = gid;
+  *rc = 0;
+}

@@ -1,0 +1,179 @@
+/* Drives r_package/src/rmxtpu.c's .C-convention entry points with the
+ * exact call sequence R/mxnet_tpu.R makes (same functions, same argument
+ * shapes, same order: create -> invoke -> autograd train step -> grads
+ * vs closed form -> set_data round trip). The image ships no R binary,
+ * so this compiled harness IS the CI execution of the binding's FFI
+ * layer; with a real R install the .R file drives the identical symbols
+ * through dyn.load/.C.
+ *
+ * Build: gcc -O2 harness.c -ldl -o harness  (dlopens rmxtpu.so)
+ */
+#include <dlfcn.h>
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void (*err_t)(char**);
+typedef void (*create_t)(int*, int*, double*, int*, int*, int*, int*);
+typedef void (*shape_t)(int*, int*, int*, int*, int*);
+typedef void (*data_t)(int*, double*, int*, int*, int*);
+typedef void (*setdata_t)(int*, double*, int*, int*);
+typedef void (*freend_t)(int*, int*);
+typedef void (*invoke_t)(char**, int*, int*, char**, int*, int*, int*, int*);
+typedef void (*attach_t)(int*, int*);
+typedef void (*record_t)(int*, int*);
+typedef void (*backward_t)(int*, int*);
+typedef void (*gradof_t)(int*, int*, int*);
+
+static void* shim;
+static err_t f_err;
+
+#define LOAD(var, name)                              \
+  var = (typeof(var))dlsym(shim, name);              \
+  if (!var) {                                        \
+    fprintf(stderr, "missing %s\n", name);           \
+    return 1;                                        \
+  }
+
+#define CHECK(rc)                                    \
+  if (rc != 0) {                                     \
+    char* e = NULL;                                  \
+    f_err(&e);                                       \
+    fprintf(stderr, "rc=%d err=%s (line %d)\n", rc, e ? e : "?", __LINE__); \
+    return 1;                                        \
+  }
+
+int main(void) {
+  const char* path = getenv("RMXTPU_SHIM");
+  shim = dlopen(path ? path : "./rmxtpu.so", RTLD_NOW);
+  if (!shim) {
+    fprintf(stderr, "dlopen shim: %s\n", dlerror());
+    return 2;
+  }
+  create_t f_create; shape_t f_shape; data_t f_data; setdata_t f_setdata;
+  freend_t f_free; invoke_t f_invoke; attach_t f_attach; record_t f_record;
+  backward_t f_backward; gradof_t f_gradof;
+  LOAD(f_err, "rmxtpu_last_error");
+  LOAD(f_create, "rmxtpu_nd_create");
+  LOAD(f_shape, "rmxtpu_nd_shape");
+  LOAD(f_data, "rmxtpu_nd_data");
+  LOAD(f_setdata, "rmxtpu_nd_set_data");
+  LOAD(f_free, "rmxtpu_nd_free");
+  LOAD(f_invoke, "rmxtpu_invoke");
+  LOAD(f_attach, "rmxtpu_attach_grad");
+  LOAD(f_record, "rmxtpu_record");
+  LOAD(f_backward, "rmxtpu_backward");
+  LOAD(f_gradof, "rmxtpu_grad_of");
+
+  int rc = -1, one = 1, zero = 0;
+
+  /* --- create + invoke broadcast_add, row-major payloads as the .R
+   * converts them --- */
+  int s23[2] = {2, 3}, nd2 = 2, n6 = 6;
+  double a_d[6] = {1, 2, 3, 4, 5, 6};
+  double b_d[6] = {1, 1, 1, 1, 1, 1};
+  int a_id = 0, b_id = 0;
+  f_create(s23, &nd2, a_d, &n6, &zero, &a_id, &rc); CHECK(rc);
+  f_create(s23, &nd2, b_d, &n6, &zero, &b_id, &rc); CHECK(rc);
+  char* op = "broadcast_add";
+  char* noattrs = "";
+  int ins[2] = {a_id, b_id}, nin = 2, outs[16], cap = 16, nout = 0;
+  f_invoke(&op, ins, &nin, &noattrs, outs, &cap, &nout, &rc); CHECK(rc);
+  if (nout != 1) return 1;
+  double got[6]; int ngot = 0, cap6 = 6;
+  f_data(&outs[0], got, &cap6, &ngot, &rc); CHECK(rc);
+  for (int i = 0; i < 6; ++i)
+    if (fabs(got[i] - (a_d[i] + 1.0)) > 1e-6) {
+      fprintf(stderr, "add mismatch [%d]\n", i);
+      return 1;
+    }
+  printf("INVOKE ok\n");
+  f_free(&outs[0], &rc);
+
+  /* --- attrs path: sum(axis=1) --- */
+  char* sum_op = "sum";
+  char* axis_attr = "{\"axis\": 1}";
+  int in1[1] = {a_id}; nin = 1;
+  f_invoke(&sum_op, in1, &nin, &axis_attr, outs, &cap, &nout, &rc); CHECK(rc);
+  int shp[32], snd = 0, cap32 = 32;
+  f_shape(&outs[0], shp, &cap32, &snd, &rc); CHECK(rc);
+  if (snd != 1 || shp[0] != 2) {
+    fprintf(stderr, "sum shape wrong\n");
+    return 1;
+  }
+  f_data(&outs[0], got, &cap6, &ngot, &rc); CHECK(rc);
+  if (fabs(got[0] - 6) > 1e-6 || fabs(got[1] - 15) > 1e-6) return 1;
+  printf("ATTRS ok\n");
+  f_free(&outs[0], &rc);
+
+  /* --- TRAIN: loss = sum((x.w - y)^2), grad vs closed form, SGD step
+   * via set_data — the mx.recording/mx.backward/mx.grad sequence --- */
+  double x_d[6] = {1, -1, 2, 0.5, 3, -2};   /* (2,3) row-major */
+  double w_d[3] = {0.5, -1, 2};             /* (3,1) */
+  double y_d[2] = {1, -1};                  /* (2,1) */
+  int s31[2] = {3, 1}, s21[2] = {2, 1};
+  int x_id = 0, w_id = 0, y_id = 0, n3 = 3, n2 = 2;
+  f_create(s23, &nd2, x_d, &n6, &zero, &x_id, &rc); CHECK(rc);
+  f_create(s31, &nd2, w_d, &n3, &zero, &w_id, &rc); CHECK(rc);
+  f_create(s21, &nd2, y_d, &n2, &zero, &y_id, &rc); CHECK(rc);
+  f_attach(&w_id, &rc); CHECK(rc);
+  f_record(&one, &rc); CHECK(rc);
+  char* dot = "dot"; char* sub = "broadcast_sub"; char* sq = "square";
+  char* sum2 = "sum";
+  int t2[2]; t2[0] = x_id; t2[1] = w_id; nin = 2;
+  f_invoke(&dot, t2, &nin, &noattrs, outs, &cap, &nout, &rc); CHECK(rc);
+  int pred = outs[0];
+  t2[0] = pred; t2[1] = y_id;
+  f_invoke(&sub, t2, &nin, &noattrs, outs, &cap, &nout, &rc); CHECK(rc);
+  int dif = outs[0]; nin = 1;
+  f_invoke(&sq, &dif, &nin, &noattrs, outs, &cap, &nout, &rc); CHECK(rc);
+  int sqv = outs[0];
+  f_invoke(&sum2, &sqv, &nin, &noattrs, outs, &cap, &nout, &rc); CHECK(rc);
+  int loss = outs[0];
+  f_record(&zero, &rc); CHECK(rc);
+  f_backward(&loss, &rc); CHECK(rc);
+  int g_id = 0;
+  f_gradof(&w_id, &g_id, &rc); CHECK(rc);
+  double g[3]; int cap3 = 3;
+  f_data(&g_id, g, &cap3, &ngot, &rc); CHECK(rc);
+  /* closed form 2*x^T*(x.w - y) */
+  double p0 = 0, p1 = 0, want[3];
+  for (int j = 0; j < 3; ++j) p0 += x_d[j] * w_d[j];
+  for (int j = 0; j < 3; ++j) p1 += x_d[3 + j] * w_d[j];
+  for (int j = 0; j < 3; ++j)
+    want[j] = 2 * (x_d[j] * (p0 - y_d[0]) + x_d[3 + j] * (p1 - y_d[1]));
+  for (int j = 0; j < 3; ++j)
+    if (fabs(g[j] - want[j]) > 1e-4 * fabs(want[j]) + 1e-5) {
+      fprintf(stderr, "grad mismatch [%d]: %f vs %f\n", j, g[j], want[j]);
+      return 1;
+    }
+  printf("TRAINOK\n");
+
+  /* SGD update + read-back (mx.set.data path) */
+  double w_new[3];
+  for (int j = 0; j < 3; ++j) w_new[j] = w_d[j] - 0.1 * g[j];
+  f_setdata(&w_id, w_new, &n3, &rc); CHECK(rc);
+  double w_back[3];
+  f_data(&w_id, w_back, &cap3, &ngot, &rc); CHECK(rc);
+  for (int j = 0; j < 3; ++j)
+    if (fabs(w_back[j] - w_new[j]) > 1e-6) return 1;
+  printf("SETDATAOK\n");
+
+  /* error path: unknown op reports through rmxtpu_last_error */
+  char* bad = "not_a_real_op";
+  nin = 1;
+  f_invoke(&bad, &a_id, &nin, &noattrs, outs, &cap, &nout, &rc);
+  if (rc == 0) {
+    fprintf(stderr, "unknown op unexpectedly succeeded\n");
+    return 1;
+  }
+  char* e = NULL;
+  f_err(&e);
+  if (!e || !strstr(e, "not_a_real_op")) {
+    fprintf(stderr, "error string missing op name: %s\n", e ? e : "?");
+    return 1;
+  }
+  printf("ERRPATH ok\n");
+  printf("R HARNESS OK\n");
+  return 0;
+}
