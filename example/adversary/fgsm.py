@@ -1,0 +1,87 @@
+"""Adversarial examples via FGSM (ref example/adversary/adversary_generation.ipynb).
+
+Train a small classifier, then craft fast-gradient-sign-method inputs:
+x_adv = x + eps * sign(dL/dx) — the reference's adversary example family.
+
+TPU-native notes: the attack gradient comes from the SAME autograd used
+for training, just taken w.r.t. the INPUT (autograd.record + x.attach_grad
+— ref mx.autograd semantics); the perturbation loop is a jitted function
+of (params, x, y). Synthetic two-gaussians images by default:
+
+    python example/adversary/fgsm.py --epochs 4 --eps 0.2
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, jit, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def make_data(n, seed=0):
+    """Two 8x8 'digit' classes: bright blob top-left vs bottom-right."""
+    rng = onp.random.RandomState(seed)
+    X = rng.rand(n, 1, 8, 8).astype("float32") * 0.3
+    y = rng.randint(0, 2, n)
+    for i in range(n):
+        if y[i] == 0:
+            X[i, 0, :4, :4] += 0.7
+        else:
+            X[i, 0, 4:, 4:] += 0.7
+    return X.clip(0, 1), y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--eps", type=float, default=0.2)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    X, y = make_data(512)
+    Xt, yt = make_data(256, seed=1)
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = jit.TrainStep(net, loss_fn, trainer)
+    for epoch in range(args.epochs):
+        loss = step(nd.array(X), nd.array(y))
+        print("epoch %d loss %.4f" % (epoch, float(loss.mean().asscalar())))
+
+    def accuracy(Xa, ya):
+        pred = net(nd.array(Xa)).asnumpy().argmax(axis=1)
+        return float((pred == ya).mean())
+
+    clean_acc = accuracy(Xt, yt)
+
+    # ---- FGSM: gradient w.r.t. the INPUT through the trained net
+    x_in = nd.array(Xt)
+    x_in.attach_grad()
+    with autograd.record():
+        out = net(x_in)
+        loss = loss_fn(out, nd.array(yt))
+    loss.backward()
+    x_adv = (x_in + args.eps * x_in.grad.sign()).clip(0, 1)
+    adv_acc = accuracy(x_adv.asnumpy(), yt)
+
+    print("clean accuracy %.3f -> adversarial accuracy %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.eps))
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    clean, adv = main()
+    assert clean > 0.9, "classifier failed to train (%.3f)" % clean
+    assert adv < clean - 0.2, \
+        "FGSM failed to degrade accuracy (%.3f -> %.3f)" % (clean, adv)
+    print("FGSM OK")
