@@ -107,6 +107,13 @@ ENV_VARS = {
         "allgather instead of riding the per-dtype batched concat "
         "(MXNET_KVSTORE_BIGARRAY_BOUND analog — bounds peak host memory of "
         "the batch buffer)."),
+    "MXTPU_P3_SLICE": (
+        int, 1000000,
+        "P3 slice bound in ELEMENTS for dist_async priority averaging "
+        "(kvstore.DistAsyncKVStore._average_batch — ref p3store_dist.h "
+        "slicing): no collective carries more than this many elements, so "
+        "time-to-first-averaged-parameter is bounded by the slice, not "
+        "the largest tensor."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

@@ -440,11 +440,48 @@ class DistAsyncKVStore(DistKVStore):
             self._average_batch(groups[pr])
 
     def _average_batch(self, keys):
-        vals = [self._data[k] for k in keys]
-        summed = self._cross_sum_batch(vals)
+        """Priority-class average with P3 tensor SLICING (ref
+        p3store_dist.h:40): values are cut into slices of at most
+        MXTPU_P3_SLICE elements and averaged in bounded-size collectives,
+        so the time until the first (highest-priority) parameters finish
+        is set by the slice bound — a later-layer update is never stuck
+        behind one giant low-layer tensor in a single monolithic
+        collective. Slices of small tensors batch together up to the same
+        bound (one collective each would be worse, the r2->r3 lesson)."""
+        import numpy as onp
+        from ..config import get_env
+        bound = max(1, get_env("MXTPU_P3_SLICE"))
         inv = 1.0 / self._num_workers
-        for k, s in zip(keys, summed):
-            self._data[k] = s * inv
+
+        flats = {k: onp.asarray(self._data[k]._data
+                                if isinstance(self._data[k], NDArray)
+                                else self._data[k]).ravel() for k in keys}
+        # (key, start, stop) slices, key order preserved within the class
+        slices = []
+        for k in keys:
+            n = flats[k].size
+            for s in range(0, max(n, 1), bound):
+                slices.append((k, s, min(s + bound, n)))
+        # bounded batches of slices, in order
+        batch, batch_n, batches = [], 0, []
+        for item in slices:
+            ln = item[2] - item[1]
+            if batch and batch_n + ln > bound:
+                batches.append(batch)
+                batch, batch_n = [], 0
+            batch.append(item)
+            batch_n += ln
+        if batch:
+            batches.append(batch)
+        out = {k: onp.empty_like(flats[k]) for k in keys}
+        for b in batches:
+            vals = [flats[k][s:e] for k, s, e in b]
+            summed = self._cross_sum_batch(vals)
+            for (k, s, e), v in zip(b, summed):
+                out[k][s:e] = onp.asarray(
+                    v._data if isinstance(v, NDArray) else v) * inv
+        for k in keys:
+            self._data[k] = nd.array(out[k].reshape(self._data[k].shape))
 
 
 def create(name="local"):

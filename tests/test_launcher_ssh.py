@@ -80,13 +80,21 @@ def test_ssh_mode_python_worker_imports_package(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
+    outdir = tmp_path / "wout"
+    outdir.mkdir()
     worker = tmp_path / "worker.py"
+    # per-rank output files: concurrent workers sharing a stdout pipe can
+    # interleave mid-line
     worker.write_text(
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "from incubator_mxnet_tpu import config\n"
-        "print('WORKER', config.get_env('MXTPU_PROC_ID'),\n"
-        "      config.get_env('MXTPU_NUM_PROC'))\n")
+        "rank = config.get_env('MXTPU_PROC_ID')\n"
+        "open(%r + '/rank_%%d' %% rank, 'w').write(\n"
+        "    'WORKER %%d %%d' %% (rank, config.get_env('MXTPU_NUM_PROC')))\n"
+        % str(outdir))
     r = _launch(tmp_path, env, 2,
                 [sys.executable, str(worker)], hosts=("localhost",))
     assert r.returncode == 0, (r.stdout, r.stderr)
-    assert "WORKER 0 2" in r.stdout and "WORKER 1 2" in r.stdout, r.stdout
+    for rank in range(2):
+        got = (outdir / ("rank_%d" % rank)).read_text()
+        assert got == "WORKER %d 2" % rank, got

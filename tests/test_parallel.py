@@ -529,6 +529,40 @@ def test_dist_async_kvstore_priority_and_staleness():
     assert type(mx.kv.create("dist_device_sync")).__name__ == "DistKVStore"
 
 
+def test_dist_async_p3_slicing(monkeypatch):
+    """P3 slicing (ref p3store_dist.h:40): within a priority class, no
+    collective exceeds MXTPU_P3_SLICE elements, big tensors split across
+    several bounded collectives, small ones batch together — and the
+    reassembled averages are exact."""
+    from incubator_mxnet_tpu.kvstore.kvstore import DistAsyncKVStore
+    from jax.experimental import multihost_utils
+    monkeypatch.setenv("MXTPU_P3_SLICE", "100")
+    calls = []
+
+    def fake_allgather(cat):
+        calls.append(onp.asarray(cat).size)
+        return onp.stack([onp.asarray(cat)] * 2)  # 2 identical workers
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    kv = DistAsyncKVStore(staleness=1)
+    kv._num_workers = 2
+    kv.init("big", nd.arange(250).astype("float32"))    # 3 slices
+    kv.init("s1", nd.arange(30).astype("float32"))
+    kv.init("s2", nd.arange(40).astype("float32"))
+    kv._key_priority = {"big": 0, "s1": 5, "s2": 5}
+    kv._sync_keys(["big", "s1", "s2"])
+    # every collective bounded; smalls batched into ONE (30+40<=100); big
+    # split into ceil(250/100)=3; high-priority class runs FIRST
+    assert max(calls) <= 100, calls
+    assert calls[0] == 70, calls          # s1+s2 batch leads (priority 5)
+    assert calls[1:] == [100, 100, 50], calls
+    # values: identical-worker average == original
+    onp.testing.assert_allclose(kv._data["big"].asnumpy(),
+                                onp.arange(250, dtype="float32"))
+    onp.testing.assert_allclose(kv._data["s2"].asnumpy(),
+                                onp.arange(40, dtype="float32"))
+
+
 def test_dist_async_epoch_budget_caps_collectives():
     """Uneven-shard contract: begin_epoch caps staleness rounds at
     min_steps//staleness so a straggler worker reaches every collective;
