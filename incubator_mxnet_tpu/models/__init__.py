@@ -7,7 +7,8 @@
 - lstm_lm:    LSTM language model (config 5)
 """
 from .lenet import LeNet  # noqa
-from .bert import BERTEncoder, BERTModel, TransformerEncoderLayer, MultiHeadAttention  # noqa
+from .bert import (BERTEncoder, BERTModel, TransformerEncoderLayer,  # noqa
+                   MultiHeadAttention, ChunkedMLMLoss)
 from .gpt import (GPTModel, TransformerDecoderLayer, ChunkedLMLoss,  # noqa
                   FeaturesView)
 from .lstm_lm import LSTMLanguageModel  # noqa

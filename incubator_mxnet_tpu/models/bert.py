@@ -18,6 +18,7 @@ from .. import ndarray as nd
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray import NDArray, _apply
+from .lm_head import ChunkedHeadLossBase
 
 
 class MultiHeadAttention(HybridBlock):
@@ -170,6 +171,13 @@ class BERTModel(HybridBlock):
             self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
 
     def forward(self, token_ids, token_types=None, mask=None):
+        mlm = self.mlm_decoder(self.features(token_ids, token_types, mask))
+        return mlm
+
+    def features(self, token_ids, token_types=None, mask=None):
+        """Pre-decoder MLM activations (B, S, U) — pair with
+        ``ChunkedMLMLoss`` so the (B*S, V) logits never materialize (the
+        vocab-CE HBM lever, docs/PERF_BERT.md)."""
         x = self.word_embed(token_ids)
         if token_types is not None:
             x = x + self.token_type_embed(token_types)
@@ -177,5 +185,19 @@ class BERTModel(HybridBlock):
         if self.embed_dropout:
             x = self.embed_dropout(x)
         h = self.encoder(x, mask)
-        mlm = self.mlm_decoder(self.mlm_ln(self.mlm_dense(h)))
-        return mlm
+        return self.mlm_ln(self.mlm_dense(h))
+
+
+class ChunkedMLMLoss(ChunkedHeadLossBase):
+    """BERT counterpart of models.gpt.ChunkedLMLoss — same chunked
+    softmax-CE forward, but the head is the UNTIED, BIASED mlm_decoder.
+    Use with ``FeaturesView(bert)`` (variadic: token_types/mask pass
+    through to ``features``):
+
+        bert = BERTModel(...)
+        step = jit.TrainStep(FeaturesView(bert), ChunkedMLMLoss(bert), tr)
+    """
+
+    def _head_params(self):
+        return (self._model.mlm_decoder.weight.data(),
+                self._model.mlm_decoder.bias.data())

@@ -19,6 +19,7 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray import _apply
 from .bert import MultiHeadAttention
+from .lm_head import ChunkedHeadLossBase
 
 __all__ = ["GPTModel", "TransformerDecoderLayer"]
 
@@ -94,48 +95,34 @@ class GPTModel(HybridBlock):
                       self.tok_embed.weight.data())
 
 
-class ChunkedLMLoss:
+class ChunkedLMLoss(ChunkedHeadLossBase):
     """Loss head that fuses the (weight-tied) LM projection with a CHUNKED
     softmax-CE (ops/lm_ce.py): the full (T, V) logits never materialize —
     the vocab-CE HBM lever identified in docs/PERF_BERT.md. Use with the
     model's ``features`` output:
 
         gpt = GPTModel(...)
-        loss_fn = ChunkedLMLoss(gpt, chunk=512)
+        loss_fn = ChunkedLMLoss(gpt)          # chunk=None auto-routes
         step = jit.TrainStep(FeaturesView(gpt), loss_fn, trainer)
 
     Gradients flow into the tied embedding through ``weight.data()`` the
     same way they do for any parameter the traced step reads."""
 
-    def __init__(self, model, chunk=None):
-        # chunk=None auto-routes (ops/lm_ce.py): dense below ~128 MB of
-        # logits, ~32 MB chunks above — default-on for long-T/large-V
-        self._model = model
-        self._chunk = chunk
-
-    def forward(self, hidden, labels):
-        from ..ops.lm_ce import chunked_lm_cross_entropy
-
-        def fn(h, w, y):
-            losses = chunked_lm_cross_entropy(h, w, y, self._chunk)
-            # gluon loss contract: per-sample mean over non-batch axes
-            return losses.reshape(losses.shape[0], -1).mean(axis=1)
-
-        return _apply(fn, hidden, self._model.tok_embed.weight.data(),
-                      labels)
-
-    __call__ = forward
+    def _head_params(self):
+        return self._model.tok_embed.weight.data(), None
 
 
 class FeaturesView(HybridBlock):
     """Expose a model's ``features`` as its forward (so TrainStep's
-    net(x) -> loss_fn(out, y) contract pairs the trunk with a fused
-    loss head like ChunkedLMLoss). Shares the wrapped model's params."""
+    net(*inputs) -> loss_fn(out, y) contract pairs the trunk with a fused
+    loss head like ChunkedLMLoss). Shares the wrapped model's params;
+    variadic so multi-input features (BERT's token_types/mask) pass
+    through."""
 
     def __init__(self, model, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.model = model
 
-    def forward(self, token_ids):
-        return self.model.features(token_ids)
+    def forward(self, *args):
+        return self.model.features(*args)

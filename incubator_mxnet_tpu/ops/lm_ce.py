@@ -30,8 +30,10 @@ _DENSE_BYTES = 128 * 1024 * 1024
 _BLOCK_BYTES = 32 * 1024 * 1024
 
 
-def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=None):
-    """hidden: (..., U) activations; head_w: (V, U) (embedding-tied head);
+def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=None,
+                             head_b=None):
+    """hidden: (..., U) activations; head_w: (V, U) (embedding-tied or
+    untied head); optional head_b: (V,) bias (BERT-style MLM decoders);
     labels: (...,) int. Returns per-token CE losses shaped like labels.
 
     ``chunk=None`` (default) auto-routes: the dense path when the full
@@ -65,6 +67,8 @@ def chunked_lm_cross_entropy(hidden, head_w, labels, chunk=None):
     def one(args):
         hb, yb = args
         logits = (hb @ head_w.T.astype(hb.dtype)).astype(jnp.float32)
+        if head_b is not None:
+            logits = logits + head_b.astype(jnp.float32)
         m = jnp.max(logits, axis=-1, keepdims=True)
         lse = (m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1,
                                    keepdims=True)))[:, 0]

@@ -26,7 +26,7 @@ def test_chunked_ce_matches_dense():
         lab = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
         return lse - lab
 
-    for chunk in (16, 64, 7):  # 7: non-dividing -> divisor fallback (4)
+    for chunk in (16, 64, 7):  # 7: non-dividing -> pads 64 -> 70
         got = chunked_lm_cross_entropy(h, w, y, chunk=chunk)
         onp.testing.assert_allclose(onp.asarray(got),
                                     onp.asarray(dense(h, w, y)),
@@ -69,14 +69,15 @@ def test_chunked_ce_never_materializes_full_logits():
     walk(jaxpr.jaxpr)
 
 
-def test_chunked_ce_non_dividing_picks_divisor():
-    """T % chunk != 0 must NOT silently fall back to one full-T chunk."""
+def test_chunked_ce_non_dividing_stays_chunked():
+    """T % chunk != 0 must NOT silently fall back to one full-T chunk
+    (r4: the stream pads to the chunk multiple; pad losses discarded)."""
     T, U, V = 96, 8, 32
     rng = onp.random.RandomState(3)
     h = jnp.asarray(rng.randn(T, U).astype("float32"))
     w = jnp.asarray(rng.randn(V, U).astype("float32") * 0.2)
     y = jnp.asarray(rng.randint(0, V, T).astype("int32"))
-    # chunk=40 -> largest divisor of 96 <= 40 is 32 (not 96)
+    # chunk=40, T=96 -> padded to 120, 3 chunks of 40 (never dense)
     jaxpr = jax.make_jaxpr(
         lambda h, w: chunked_lm_cross_entropy(h, w, y, 40).sum())(h, w)
     import math
@@ -201,3 +202,32 @@ def test_chunked_ce_backward_memory_bound():
         .temp_size_in_bytes
     logits_bytes = T * V * 4
     assert mem_d - mem_c > logits_bytes // 2, (mem_d, mem_c, logits_bytes)
+
+
+def test_bert_chunked_mlm_loss_matches_dense_and_trains():
+    """r4: ChunkedMLMLoss (untied, BIASED decoder head) == dense BERT
+    forward + softmax CE; training through TrainStep moves the loss and
+    the decoder params get gradients (bias rides the chunked path)."""
+    mx.random.seed(0)
+    V, U, S, B = 64, 16, 32, 2
+    bert = models.BERTModel(vocab_size=V, units=U, hidden_size=2 * U,
+                            num_layers=1, num_heads=2, max_length=S,
+                            dropout=0.0, attention="dense")
+    bert.initialize(mx.init.Xavier())
+    tokens = nd.array(onp.random.RandomState(1).randint(0, V, (B, S))
+                      .astype("int32"))
+    dense = gluon.loss.SoftmaxCrossEntropyLoss()(bert(tokens), tokens)
+    chunked = models.ChunkedMLMLoss(bert, chunk=16)(
+        bert.features(tokens), tokens)
+    onp.testing.assert_allclose(chunked.asnumpy(), dense.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+    view = models.FeaturesView(bert)
+    before = bert.mlm_decoder.bias.data().asnumpy().copy()
+    tr = gluon.Trainer(view.collect_params(), "sgd",
+                       {"learning_rate": 0.5})
+    step = jit.TrainStep(view, models.ChunkedMLMLoss(bert), tr)
+    l0 = float(step(tokens, tokens).mean().asnumpy())
+    l1 = float(step(tokens, tokens).mean().asnumpy())
+    assert l1 < l0
+    after = bert.mlm_decoder.bias.data().asnumpy()
+    assert onp.abs(after - before).max() > 1e-6  # bias got gradients
