@@ -5,7 +5,10 @@ re-expressed as SPMD sharding + XLA collectives over ICI/DCN).
 - data_parallel:     sharded fused train step (≙ dist_device_sync kvstore)
 - tensor_parallel:   row/col-sharded layers (NEW capability vs reference)
 - ring_attention:    sequence/context parallelism over the ring (NEW)
-- pipeline:          GPipe-style microbatch pipeline parallelism (NEW)
+- pipeline:          GPipe ring + hand-scheduled 1F1B pipeline (NEW)
+- pipeline_interleaved: virtual-stage (interleaved) 1F1B — static greedy
+                     tick tables, schedule-bounded stash; measured
+                     disposition in docs/PERF_PIPELINE.md (NEW)
 - moe:               expert parallel mixture-of-experts (NEW)
 - compression:       2-bit gradient compression analog (ref gradient_compression.h)
 """
