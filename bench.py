@@ -297,14 +297,19 @@ def bench_int8():
     xi = jax.random.randint(key, (N, N), -127, 127, jnp.int8)
     wi = jax.random.randint(key, (N, N), -127, 127, jnp.int8)
 
-    # each loop carries the dependency through ONE row of the lhs (defeats
-    # CSE/hoisting) so per-iter contamination is a 4 KB row update, equal
-    # for both dtypes
+    # the carry must consume the whole product NONLINEARLY: a row-slice
+    # carry (p[0:1]) let XLA slice the dot to one row (caught r4 when
+    # deeper chains ran "faster than peak"), and a plain linear sum could
+    # legally fold to sum(a) @ b — abs() blocks both rewrites. The reduce
+    # reads p at its accumulator width (int32 = 2x the bf16 bytes), which
+    # biases the int8 side LOW by a few percent — conservative for a
+    # speedup claim, noted rather than hidden.
     @jax.jit
     def loop_b(a, b):
         def body(i, a):
             p = lax.dot_general(a, b, (((1,), (0,)), ((), ())))
-            row = (p[0:1] * 1e-6).astype(jnp.bfloat16)
+            row = (jnp.abs(p).sum(axis=0, keepdims=True)
+                   * 1e-9).astype(jnp.bfloat16)
             return lax.dynamic_update_slice(a, row, (0, 0))
         return lax.fori_loop(0, ITERS, body, a)[0, 0]
 
@@ -313,7 +318,8 @@ def bench_int8():
         def body(i, a):
             p = lax.dot_general(a, b, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.int32)
-            row = (p[0:1] >> 20).astype(jnp.int8)
+            row = (jnp.abs(p).sum(axis=0, keepdims=True)
+                   >> 20).astype(jnp.int8)
             return lax.dynamic_update_slice(a, row, (0, 0))
         return lax.fori_loop(0, ITERS, body, a)[0, 0]
 
@@ -335,17 +341,24 @@ def bench_int8():
     db = min(b for b, _ in pairs)
     di = min(i for _, i in pairs)
     fl = 2 * N ** 3
+    # tripwire for the DCE class of bug: implied rates beyond chip peak
+    # (bf16 197 TF/s, int8 394 TOPS on v5e) mean the matmul was NOT
+    # executed as written — flag loudly instead of reporting fiction
+    sane = fl / db / 1e12 < 1.25 * 197 and fl / di / 1e12 < 1.25 * 394
     return {"metric": "int8_matmul_vs_bf16_speedup",
-            "value": round(db / di, 2),
+            "value": round(db / di, 2) if sane else None,
+            "sanity_peak_ok": sane,
             "median_pair": round(ratios[len(ratios) // 2], 2),
             "bf16_tflops": round(fl / db / 1e12, 1),
             "int8_tops": round(fl / di / 1e12, 1),
-            "note": "4096^3 dot_general int8/int32-accum vs bf16, both as "
-                    "40-deep chained loops in one program, 10 alternating "
-                    "runs each; value = min_bf16/min_int8 (co-tenant wait "
-                    "only inflates times, so per-dtype minima are the clean "
-                    "estimates); median_pair is the unfiltered paired "
-                    "ratio (deflates toward 1.0 under load)"}
+            "note": "4096^3 dot_general int8/int32-accum vs bf16, 40-deep "
+                    "chained loops whose carry consumes the FULL product "
+                    "(r4 fix: a row-slice carry let XLA slice the dot to a "
+                    "matvec), 10 alternating runs; value = min_bf16/"
+                    "min_int8 (wait only inflates times, so per-dtype "
+                    "minima are the clean estimates); median_pair is the "
+                    "unfiltered paired ratio (deflates toward 1 under "
+                    "sustained co-tenant load)"}
 
 
 if __name__ == "__main__":
