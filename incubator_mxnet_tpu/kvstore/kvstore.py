@@ -402,12 +402,24 @@ class DistAsyncKVStore(DistKVStore):
         return KVStore._aggregate(self, v, key)
 
     def push(self, key, value, priority=0):
+        """``priority`` may be a scalar or a per-key sequence (batched
+        multi-key pushes keep their per-layer P3 ordering)."""
         KVStore.push(self, key, value, priority)   # local apply ONLY
         keys, _ = self._normalize(key, value)
+        if isinstance(priority, (list, tuple)):
+            if len(priority) != len(keys):
+                raise ValueError("priority list length %d != %d keys"
+                                 % (len(priority), len(keys)))
+            prios = list(priority)
+        else:
+            prios = [priority] * len(keys)
         due = []
-        for k in keys:
-            self._key_priority[k] = max(self._key_priority.get(k, 0),
-                                        priority)
+        for k, pr in zip(keys, prios):
+            # first push SETS the priority (negative per-layer priorities
+            # must register, not be clamped by a default 0); later pushes
+            # keep the highest seen
+            self._key_priority[k] = pr if k not in self._key_priority \
+                else max(self._key_priority[k], pr)
             c = self._push_count.get(k, 0) + 1
             self._push_count[k] = c
             if c >= self._staleness:
@@ -480,8 +492,16 @@ class DistAsyncKVStore(DistKVStore):
             for (k, s, e), v in zip(b, summed):
                 out[k][s:e] = onp.asarray(
                     v._data if isinstance(v, NDArray) else v) * inv
+        import jax as _jax
         for k in keys:
-            self._data[k] = nd.array(out[k].reshape(self._data[k].shape))
+            # pass the dtype explicitly: nd.array() would silently demote
+            # float64 payloads to the float32 default — and 64-bit dtypes
+            # additionally need the x64 scope or jnp truncates them anyway
+            dt = str(self._data[k].dtype) if isinstance(self._data[k], NDArray) \
+                else str(onp.asarray(self._data[k]).dtype)
+            with _jax.enable_x64(dt in ("float64", "int64", "uint64")):
+                self._data[k] = nd.array(
+                    out[k].reshape(self._data[k].shape), dtype=dt)
 
 
 def create(name="local"):

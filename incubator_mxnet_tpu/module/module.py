@@ -247,13 +247,25 @@ class Module(BaseModule):
         the optimizer to its copy) and the weight PULLED back."""
         assert self.optimizer_initialized
         if self._update_on_kvstore and self._kvstore is not None:
+            # one multi-key push + one multi-key pull so the dist store's
+            # dtype-batched allgather path handles all grads in one
+            # collective instead of O(num_params) round-trips
+            keys, grads, weights = [], [], []
             for i, name in enumerate(self.param_names):
                 w = self._exec.arg_dict[name]
                 g = self._exec.grad_dict.get(name)
                 if g is None or name in self._fixed_param_names:
                     continue
-                self._kvstore.push(i, g, priority=-i)
-                self._kvstore.pull(i, out=w, priority=-i)
+                keys.append(i)
+                grads.append(g)
+                weights.append(w)
+            if keys:
+                # priority=-i as in ref module.py: earlier layers sync
+                # first (the next forward needs them first); push accepts
+                # a per-key sequence so P3 ordering survives the batch
+                self._kvstore.push(keys, grads,
+                                   priority=[-i for i in keys])
+                self._kvstore.pull(keys, out=weights)
             return
         for i, name in enumerate(self.param_names):
             w = self._exec.arg_dict[name]

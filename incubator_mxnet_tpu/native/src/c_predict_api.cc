@@ -691,7 +691,11 @@ int MXTPUSymbolCompose(void* handle, const char* name, int n,
   auto* h = static_cast<SymHandle*>(handle);
   PyObject* kl = PyList_New(n);
   PyObject* al = PyList_New(n);
-  if (!kl || !al) return fail_py("MXTPUSymbolCompose");
+  if (!kl || !al) {
+    Py_XDECREF(kl);
+    Py_XDECREF(al);
+    return fail_py("MXTPUSymbolCompose");
+  }
   for (int i = 0; i < n; ++i) {
     PyList_SET_ITEM(kl, i, PyUnicode_FromString(keys && keys[i] ? keys[i]
                                                                 : ""));
@@ -699,9 +703,14 @@ int MXTPUSymbolCompose(void* handle, const char* name, int n,
     Py_INCREF(a);
     PyList_SET_ITEM(al, i, a);
   }
-  PyObject* r = call_graph("sym_compose",
-                           Py_BuildValue("(OsNN)", h->obj, name ? name : "",
-                                         kl, al));
+  // N-format only steals kl/al on SUCCESS; drop them ourselves on failure
+  PyObject* tup = Py_BuildValue("(OsNN)", h->obj, name ? name : "", kl, al);
+  if (!tup) {
+    Py_DECREF(kl);
+    Py_DECREF(al);
+    return fail_py("MXTPUSymbolCompose");
+  }
+  PyObject* r = call_graph("sym_compose", tup);
   if (!r) return fail_py("MXTPUSymbolCompose");
   Py_DECREF(h->obj);
   h->obj = r;
@@ -770,15 +779,25 @@ int MXTPUExecutorForward(void* ex, int is_train, int n, const char** names,
   auto* h = static_cast<SymHandle*>(ex);
   PyObject* kl = PyList_New(n);
   PyObject* al = PyList_New(n);
-  if (!kl || !al) return fail_py("MXTPUExecutorForward");
+  if (!kl || !al) {
+    Py_XDECREF(kl);
+    Py_XDECREF(al);
+    return fail_py("MXTPUExecutorForward");
+  }
   for (int i = 0; i < n; ++i) {
     PyList_SET_ITEM(kl, i, PyUnicode_FromString(names[i]));
     PyObject* a = static_cast<NDHandle*>(nd_handles[i])->arr;
     Py_INCREF(a);
     PyList_SET_ITEM(al, i, a);
   }
-  PyObject* r = call_graph("executor_forward",
-                           Py_BuildValue("(OiNN)", h->obj, is_train, kl, al));
+  // N-format only steals kl/al on SUCCESS; drop them ourselves on failure
+  PyObject* tup = Py_BuildValue("(OiNN)", h->obj, is_train, kl, al);
+  if (!tup) {
+    Py_DECREF(kl);
+    Py_DECREF(al);
+    return fail_py("MXTPUExecutorForward");
+  }
+  PyObject* r = call_graph("executor_forward", tup);
   if (!r) return fail_py("MXTPUExecutorForward");
   Py_DECREF(r);
   return 0;

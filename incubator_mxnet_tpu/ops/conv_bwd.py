@@ -55,16 +55,22 @@ def _on_tpu():
 _VMEM_BUDGET = 6 * 1024 * 1024
 
 
-def _auto_block_n(N, H, W, C, K, itemsize):
-    """Largest batch-chunk dividing N whose resident blocks fit the budget.
+def _per_img_bytes(H, W, C, K, itemsize):
+    """Resident VMEM bytes per image: x/dx blocks (C lanes), dy block
+    (K lanes), the padded copies, the im2col patch buffer (9*max(C,K)
+    lanes — the big one), and the fp32 dx matmul result on the stack.
 
-    Per image: x/dx blocks (C lanes), dy block (K lanes), the padded
-    copies, the im2col patch buffer (9*max(C,K) lanes — the big one), and
-    the fp32 dx matmul result on the stack."""
+    Shared between the block chooser and the legality gate so the two
+    can never disagree about what fits."""
     pad = (H + 2) * (W + 2)
-    per_img = (H * W * (2 * itemsize * C + itemsize * K + 4 * C)
-               + pad * itemsize * (C + K)
-               + H * W * 9 * max(C, K) * itemsize)
+    return (H * W * (2 * itemsize * C + itemsize * K + 4 * C)
+            + pad * itemsize * (C + K)
+            + H * W * 9 * max(C, K) * itemsize)
+
+
+def _auto_block_n(N, H, W, C, K, itemsize):
+    """Largest batch-chunk dividing N whose resident blocks fit the budget."""
+    per_img = _per_img_bytes(H, W, C, K, itemsize)
     bn = max(1, _VMEM_BUDGET // max(per_img, 1))
     while bn > 1 and N % bn:
         bn -= 1
@@ -72,7 +78,7 @@ def _auto_block_n(N, H, W, C, K, itemsize):
 
 
 def conv3x3_bwd_legal(x_shape, w_shape, stride=(1, 1), padding=(1, 1),
-                      dilation=(1, 1), groups=1):
+                      dilation=(1, 1), groups=1, itemsize=4):
     """Capability: 3x3, stride 1, SAME (pad 1), dense, NHWC/HWIO, C and K
     lane-packable (mult of 8); TPU or interpret mode."""
     if len(x_shape) != 4 or len(w_shape) != 4:
@@ -88,7 +94,13 @@ def conv3x3_bwd_legal(x_shape, w_shape, stride=(1, 1), padding=(1, 1),
         return False
     # the (9C, K) fp32 dw accumulator must fit VMEM alongside the patch
     # buffer — C=K=512 (conv5-class) exceeds it in this single-pass design
-    if 9 * C * K * 4 > 6 * 1024 * 1024:
+    if 9 * C * K * 4 > _VMEM_BUDGET:
+        return False
+    # even at block_n=1 the per-image resident footprint (dominated by the
+    # H*W*9*max(C,K) patch buffer) must fit, or the kernel fails scoped-VMEM
+    # allocation at compile time instead of falling back to XLA
+    _, H, W, _ = x_shape
+    if _per_img_bytes(H, W, C, K, itemsize) > _VMEM_BUDGET:
         return False
     from ..config import get_env
     if not get_env("MXTPU_CONV_BWD_PALLAS"):
@@ -238,7 +250,7 @@ def _conv_fwd(x, w):
 
 def _conv_bwd_rule(res, dy):
     x, w = res
-    if conv3x3_bwd_legal(x.shape, w.shape):
+    if conv3x3_bwd_legal(x.shape, w.shape, itemsize=x.dtype.itemsize):
         return conv3x3_bwd(x, dy, w)
     # XLA fallback for off-TPU / odd shapes
     _, vjp = jax.vjp(_conv_fwd_ref, x, w)

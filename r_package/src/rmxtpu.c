@@ -123,8 +123,12 @@ void rmxtpu_nd_create(int* shape, int* ndim, double* data, int* n,
                       int* as_double, int* out_id, int* rc) {
   *rc = -1;
   if (api_init()) return;
+  if (*ndim > 32) {
+    snprintf(g_err, sizeof(g_err), "ndim %d exceeds shim cap 32", *ndim);
+    return;
+  }
   int64_t shp[32];
-  for (int i = 0; i < *ndim && i < 32; ++i) shp[i] = shape[i];
+  for (int i = 0; i < *ndim; ++i) shp[i] = shape[i];
   void* h = NULL;
   int r;
   if (*as_double) {
@@ -240,8 +244,12 @@ void rmxtpu_invoke(char** op_name, int* in_ids, int* nin, char** attrs_json,
                    int* out_ids, int* cap, int* nout, int* rc) {
   *rc = -1;
   if (api_init()) return;
+  if (*nin > 64) {
+    snprintf(g_err, sizeof(g_err), "nin %d exceeds shim cap 64", *nin);
+    return;
+  }
   void* ins[64];
-  for (int i = 0; i < *nin && i < 64; ++i) {
+  for (int i = 0; i < *nin; ++i) {
     ins[i] = get_handle(in_ids[i]);
     if (!ins[i]) {
       snprintf(g_err, sizeof(g_err), "bad input handle id %d", in_ids[i]);
