@@ -3,7 +3,8 @@ from __future__ import annotations
 
 import numpy as onp
 
-__all__ = ["MXNetError", "string_types", "numeric_types", "registry", "Registry"]
+__all__ = ["MXNetError", "string_types", "numeric_types", "registry",
+           "Registry", "public_op_names"]
 
 
 class MXNetError(RuntimeError):
@@ -64,3 +65,24 @@ def registry(name):
     if name not in _registries:
         _registries[name] = Registry(name)
     return _registries[name]
+
+
+def public_op_names(namespace, exclude=()):
+    """Public operator-like callables of a namespace: everything that is
+    not underscored, a module, a class, or in ``exclude``. The ONE
+    eligibility rule shared by the nd→sym auto-registration
+    (symbol/__init__.py), the registry sweep coverage contract
+    (test_utils.sweep_coverage), and the parity tests — so the three can
+    never disagree about what counts as an op."""
+    import inspect
+    import types
+    out = []
+    for n in sorted(dir(namespace)):
+        if n.startswith("_") or n in exclude:
+            continue
+        o = getattr(namespace, n)
+        if isinstance(o, types.ModuleType) or inspect.isclass(o) or \
+                not callable(o):
+            continue
+        out.append(n)
+    return out

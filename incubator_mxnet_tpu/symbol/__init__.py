@@ -45,6 +45,14 @@ def _deferred_rules(op_name, kwargs):
         return {1: c, 2: c}
     if op_name == "Embedding":
         return {1: lambda s: (kwargs.get("input_dim"), kwargs.get("output_dim"))}
+    if op_name == "Deconvolution":
+        nf = kwargs.get("num_filter")
+        kernel = tuple(kwargs.get("kernel"))
+        ng = kwargs.get("num_group", 1)
+        return {1: lambda s: (s[1], nf // ng) + kernel, 2: lambda s: (nf,)}
+    if op_name in ("GroupNorm", "InstanceNorm"):
+        c = lambda s: (s[1],)
+        return {1: c, 2: c}
     return None
 
 
@@ -54,17 +62,45 @@ def _op_lookup(name):
     return getattr(nd, name)
 
 
+def _flat_adapter(fn, spec):
+    """Rebuild list-of-array positional args from the flattened Symbol
+    inputs: spec[i] is None for a plain arg, or the list length. The spec
+    travels in kwargs as ``__arg_spec__`` so graph JSON round-trips."""
+    def call(*vals, **kw):
+        kw.pop("__arg_spec__", None)
+        it = iter(vals)
+        rebuilt = []
+        for s in spec:
+            rebuilt.append(next(it) if s is None
+                           else [next(it) for _ in range(s)])
+        return fn(*rebuilt, **kw)
+    return call
+
+
 def _symbolize(fn, op_name):
-    """Wrap an nd function into a Symbol builder."""
+    """Wrap an nd function into a Symbol builder (≙ the reference's
+    register.py code-gen: ONE registry drives both namespaces —
+    ref python/mxnet/symbol/register.py:1, ndarray/register.py:265)."""
 
     def sym_fn(*args, name=None, **kwargs):
-        inputs = []
+        inputs, spec = [], []
         for a in args:
             if isinstance(a, Symbol):
                 inputs.append(a)
+                spec.append(None)
+            elif isinstance(a, (list, tuple)) and a and \
+                    all(isinstance(x, Symbol) for x in a):
+                inputs.extend(a)
+                spec.append(len(a))
             else:
-                raise TypeError("%s: positional args must be Symbols" % op_name)
-        return Symbol(op=fn, op_name=op_name, inputs=inputs, kwargs=kwargs,
+                raise TypeError("%s: positional args must be Symbols "
+                                "(or lists of Symbols)" % op_name)
+        if any(s is not None for s in spec):
+            kwargs["__arg_spec__"] = tuple(spec)
+            op = _flat_adapter(fn, spec)
+        else:
+            op = fn
+        return Symbol(op=op, op_name=op_name, inputs=inputs, kwargs=kwargs,
                       name=name)
 
     sym_fn.__name__ = op_name
@@ -72,33 +108,46 @@ def _symbolize(fn, op_name):
     return sym_fn
 
 
-# generate the simple-op surface from nd
-_SIMPLE_OPS = [
-    "abs", "sign", "round", "ceil", "floor", "trunc", "square", "sqrt", "rsqrt",
-    "exp", "log", "log10", "log2", "log1p", "expm1", "sin", "cos", "tan",
-    "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "sigmoid", "relu",
-    "softsign", "reciprocal", "negative", "erf", "gamma", "gammaln",
-    "add", "subtract", "multiply", "divide", "power", "maximum", "minimum",
-    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
-    "broadcast_maximum", "broadcast_minimum", "broadcast_power", "broadcast_to",
-    "sum", "mean", "prod", "max", "min", "norm", "argmax", "argmin", "clip",
-    "reshape", "flatten", "transpose", "swapaxes", "expand_dims", "squeeze",
-    "tile", "repeat", "pad", "flip", "concat", "stack", "split", "slice_axis",
-    "take", "pick", "one_hot", "gather_nd", "where", "cast", "zeros_like",
-    "ones_like", "dot", "batch_dot", "softmax", "log_softmax", "softmin",
-    "sequence_mask", "SequenceMask", "SequenceLast", "SequenceReverse",
-    "make_loss", "BlockGrad", "identity", "L2Normalization", "LRN",
-    "UpSampling", "BilinearResize2D", "slice_like", "amp_cast",
-    "smooth_l1", "hard_sigmoid", "softmax_cross_entropy", "digamma",
-    "khatri_rao", "trace", "im2col", "col2im", "add_n", "batch_take", "RNN",
-    "depth_to_space", "space_to_depth", "shape_array", "size_array",
-    "argmax_channel", "Correlation", "Crop",
-]
+# ---------------------------------------------------- registry unification
+# ONE registry drives both namespaces (the reference generates nd and sym
+# from the same op registry — python/mxnet/symbol/register.py:1,
+# ndarray/register.py:265). Every public nd callable that is not in the
+# documented exclusion table below is symbolized automatically, so adding
+# an nd op can never silently widen the nd/sym gap again. Layer ops with
+# auto-created parameter Variables (FullyConnected, Convolution, ...) are
+# re-defined further down and override their plain auto-symbolized forms.
+_SYM_EXCLUDE = {
+    # host-side constructors / serialization / interop — these have no
+    # graph-node semantics (a Symbol is built from var() + operators)
+    "array": "host constructor; use sym.var + bind",
+    "empty": "uninitialized host constructor",
+    "save": "file io (Symbol.save writes graph JSON instead)",
+    "load": "file io (sym.load reads graph JSON instead)",
+    "from_dlpack": "zero-copy interop is eager-only",
+    "from_numpy": "zero-copy interop is eager-only",
+    "to_dlpack_for_read": "zero-copy interop is eager-only",
+    "to_dlpack_for_write": "zero-copy interop is eager-only",
+    "load_frombuffer": "file io",
+    "imdecode": "host-side jpeg decode (io pipeline, not an operator)",
+    "waitall": "engine sync primitive, not an operator",
+    "rnn_param_size": "shape helper returning a python int",
+}
+
 _g = globals()
-for _name in _SIMPLE_OPS:
-    _g[_name] = _symbolize(getattr(nd, _name), _name)
-    __all__.append(_name)
-slice = _symbolize(nd.slice, "slice")
+
+
+def _auto_register_from_nd():
+    from ..base import public_op_names
+    added = []
+    for _n in public_op_names(nd, exclude=_SYM_EXCLUDE):
+        if _n in _g:
+            continue
+        _g[_n] = _symbolize(getattr(nd, _n), _n)
+        added.append(_n)
+    return added
+
+
+__all__ += _auto_register_from_nd()
 
 # operator-sugar node names (Symbol.__add__ etc., symbol.py _binop) so
 # graph JSON containing them reloads; the *_scalar variants resolve through
@@ -240,6 +289,49 @@ def LayerNorm(data=None, gamma=None, beta=None, axis=-1, eps=1e-5, name=None, **
                   name=name)
 
 
+def Deconvolution(data=None, weight=None, bias=None, kernel=None, stride=(1, 1),
+                  dilate=(1, 1), pad=(0, 0), adj=(0, 0), num_filter=None,
+                  num_group=1, no_bias=False, target_shape=None, name=None, **kw):
+    """ref nn/deconvolution-inl.h symbol interface; weight is
+    (in_channels, num_filter/num_group, *kernel)."""
+    name = name or _auto_name("deconvolution")
+
+    def w_shape(in_shape):
+        return (in_shape[1], num_filter // num_group) + tuple(kernel)
+
+    weight = weight if weight is not None else _param_var(name, "weight", w_shape)
+    inputs = [data, weight]
+    if not no_bias:
+        bias = bias if bias is not None else _param_var(
+            name, "bias", lambda s: (num_filter,))
+        inputs.append(bias)
+    kwargs = dict(kernel=kernel, stride=stride, dilate=dilate, pad=pad, adj=adj,
+                  num_filter=num_filter, num_group=num_group, no_bias=no_bias,
+                  target_shape=target_shape)
+    return Symbol(op=nd.Deconvolution, op_name="Deconvolution", inputs=inputs,
+                  kwargs=kwargs, name=name)
+
+
+def GroupNorm(data=None, gamma=None, beta=None, num_groups=1, eps=1e-5,
+              name=None, **kw):
+    name = name or _auto_name("groupnorm")
+    c_shape = lambda s: (s[1],)
+    gamma = gamma if gamma is not None else _param_var(name, "gamma", c_shape)
+    beta = beta if beta is not None else _param_var(name, "beta", c_shape)
+    return Symbol(op=nd.GroupNorm, op_name="GroupNorm",
+                  inputs=[data, gamma, beta],
+                  kwargs=dict(num_groups=num_groups, eps=eps), name=name)
+
+
+def InstanceNorm(data=None, gamma=None, beta=None, eps=1e-3, name=None, **kw):
+    name = name or _auto_name("instancenorm")
+    c_shape = lambda s: (s[1],)
+    gamma = gamma if gamma is not None else _param_var(name, "gamma", c_shape)
+    beta = beta if beta is not None else _param_var(name, "beta", c_shape)
+    return Symbol(op=nd.InstanceNorm, op_name="InstanceNorm",
+                  inputs=[data, gamma, beta], kwargs=dict(eps=eps), name=name)
+
+
 def _make_regression_output(op_name, nd_fn):
     def builder(data=None, label=None, grad_scale=1.0, name=None, **kw):
         name = name or _auto_name(op_name.lower())
@@ -261,17 +353,23 @@ MAERegressionOutput = _make_regression_output(
 
 for _n in ["FullyConnected", "Convolution", "BatchNorm", "Activation", "LeakyReLU",
            "Pooling", "Dropout", "SoftmaxOutput", "Embedding", "LayerNorm",
-           "LinearRegressionOutput"]:
+           "LinearRegressionOutput", "Deconvolution", "GroupNorm",
+           "InstanceNorm"]:
     __all__.append(_n)
     _OP_TABLE[_n] = getattr(nd, _n, None)
+
+# backend-alias layer ops resolve to the param-creating builders, exactly
+# as the reference maps the *_v1 / cudnn names onto the same operators
+BatchNorm_v1 = CuDNNBatchNorm = BatchNorm
+Convolution_v1 = Convolution
+Pooling_v1 = Pooling
 
 from . import contrib  # noqa  (symbolic control flow)
 
 
 # creation/scalar symbol ops the reference exposes at module level
+# (hypot/histogram/slice come from the auto-registration already)
 pow = _g["power"]  # noqa: A001  (ref symbol.py pow)
-hypot = _symbolize(nd.hypot, "hypot") if hasattr(nd, "hypot") else None
-histogram = _symbolize(nd.histogram, "histogram")
 
 
 def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False, name=None):
@@ -309,8 +407,7 @@ def linspace(start, stop, num, endpoint=True, dtype="float32", **kw):
         op_name="linspace", inputs=[])
 
 
-__all__ += ["pow", "hypot", "split_v2", "histogram", "eye", "full", "arange",
-            "linspace"]
+__all__ += ["pow", "split_v2", "eye", "full", "arange", "linspace"]
 
 
 # -------------------------------------------------------- sub-namespaces
@@ -377,4 +474,6 @@ def _sym_sparse_ns():
 linalg = _sym_linalg_ns()
 random = _sym_random_ns()
 sparse = _sym_sparse_ns()
-__all__ += ["linalg", "random", "sparse"]
+__all__ += ["linalg", "random", "sparse", "BatchNorm_v1", "CuDNNBatchNorm",
+            "Convolution_v1", "Pooling_v1"]
+__all__ = list(dict.fromkeys(__all__))  # auto-registered names deduped

@@ -392,6 +392,10 @@ def load_json(json_str):
             fn = _op_lookup(node["op"])
             inputs = [built[i[0]] for i in node["inputs"]]
             kwargs = {k: _parse_attr(v) for k, v in node.get("attrs", {}).items()}
+            if "__arg_spec__" in kwargs:
+                # list-of-arrays op: restore the flat→structured adapter
+                from . import _flat_adapter
+                fn = _flat_adapter(fn, tuple(kwargs["__arg_spec__"]))
             # restore deferred-shape rules on auto-created parameter vars
             rules = _deferred_rules(node["op"], kwargs)
             for idx, shape_fn in (rules or {}).items():

@@ -17,7 +17,8 @@ __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
            "rand_shape_nd", "check_numeric_gradient", "check_consistency",
            "numeric_grad", "simple_forward", "same", "random_seed",
-           "op_consistency_sweep", "SWEEP_TOLS"]
+           "op_consistency_sweep", "grad_consistency_sweep", "SWEEP_TOLS",
+           "SWEEP_SKIP", "sweep_coverage", "sweep_inputs"]
 
 _default_ctx = [None]
 
@@ -153,13 +154,45 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",), rtol=1e-3,
 
 
 # ----------------------------------------------------------------- sweep
+#: ops excluded from the registry sweep, each with the reason — the
+#: coverage test (tests/test_numerics_sweep.py) fails when a public nd
+#: callable is neither in the table nor here, so a new op can't silently
+#: skip the walk (round-4 verdict Next #3).
+def _sweep_skip():
+    # the host-side exclusions are the SAME table the symbolic
+    # auto-registration uses (symbol/__init__.py) — one source of truth —
+    # plus two sweep-only entries
+    from .symbol import _SYM_EXCLUDE
+    skip = dict(_SYM_EXCLUDE)
+    skip["Custom"] = "needs a registered op_type; exercised in test_extension"
+    skip["reset_arrays"] = "in-place void op; exercised in test_optimizer_ops"
+    return skip
+
+
+SWEEP_SKIP = _sweep_skip()
+
+
 def _sweep_table():
     """Op table for the cross-backend numerics sweep (the reference's
-    test_operator_gpu.py re-run-everything-on-device trick, distilled to an
-    op walk). Each entry: (name, fn(*nd arrays) -> NDArray, input specs)
-    where a spec is (shape, kind) and kind is 'f' (float, cast to the sweep
-    dtype), 'pos' (positive float), or 'i' (int32 indices, never cast)."""
+    test_operator_gpu.py re-run-everything-on-device trick, distilled to
+    an op walk over the WHOLE nd registry).
+
+    Each entry: (name[@tag], fn(M, *arrays) -> output, input specs[, opts]).
+    ``M`` is the namespace the op is drawn from — ``nd`` for the numeric
+    sweeps, ``mx.sym`` for the symbolic-parity walk (tests/test_sym_parity.py)
+    — the same table drives both, the way the reference generates both
+    frontends from one registry. A spec is (shape, kind):
+      'f' float in (-2,2)    'pos' |f|+0.5       'unit' (-0.9,0.9)
+      'gt1' |f|+1.5          'perm' distinct floats (sortable)
+      'b' 0/1 floats         'pmf' positive rows summing to 1
+      'len' 1..dim0 lengths  ('i', hi) int32 in [0,hi)
+      ('i1', hi) in [1,hi)   ('const', array) fixed payload
+    opts: {'op': registry name if != entry name, 'nondiff': True to skip
+    the grad walk, 'seed': True to reseed the framework PRNG per leg,
+    'sym': False to skip the symbolic walk (reason string in 'symreason')}.
+    """
     from .ndarray import linalg  # noqa: F401  (namespace touch)
+    from .ndarray import rnn_param_size
 
     def f(*shape):
         return (shape, "f")
@@ -167,91 +200,375 @@ def _sweep_table():
     def pos(*shape):
         return (shape, "pos")
 
-    def idx(*shape):
-        return (shape, "i")
+    def idx(*shape, hi=4):
+        return (shape, ("i", hi))
 
-    t = [
-        # elemwise unary
-        ("exp@trans", lambda a: nd.exp(a), [f(4, 16)]),
-        ("log@trans", lambda a: nd.log(a), [pos(4, 16)]),
-        ("sqrt@trans", lambda a: nd.sqrt(a), [pos(4, 16)]),
-        ("rsqrt@trans", lambda a: nd.rsqrt(a), [pos(4, 16)]),
-        ("sigmoid@trans", lambda a: nd.sigmoid(a), [f(4, 16)]),
-        ("tanh@trans", lambda a: nd.tanh(a), [f(4, 16)]),
-        ("erf@trans", lambda a: nd.erf(a), [f(4, 16)]),
-        ("abs", lambda a: nd.abs(a), [f(4, 16)]),
-        ("square", lambda a: nd.square(a), [f(4, 16)]),
-        ("cbrt@trans", lambda a: nd.cbrt(a), [pos(4, 16)]),
-        ("round", lambda a: nd.round(a), [f(4, 16)]),
-        ("floor", lambda a: nd.floor(a), [f(4, 16)]),
-        ("sin@trans", lambda a: nd.sin(a), [f(4, 16)]),
-        ("cos@trans", lambda a: nd.cos(a), [f(4, 16)]),
-        ("log1p@trans", lambda a: nd.log1p(a), [pos(4, 16)]),
-        ("expm1@trans", lambda a: nd.expm1(a), [f(4, 16)]),
-        ("relu", lambda a: nd.relu(a), [f(4, 16)]),
-        ("softsign@trans", lambda a: nd.softsign(a), [f(4, 16)]),
-        ("clip", lambda a: nd.clip(a, -1.0, 1.0), [f(4, 16)]),
-        # binary / broadcast
-        ("broadcast_add", lambda a, b: nd.broadcast_add(a, b),
-         [f(4, 16), f(1, 16)]),
-        ("broadcast_sub", lambda a, b: nd.broadcast_sub(a, b),
-         [f(4, 16), f(1, 16)]),
-        ("broadcast_mul", lambda a, b: nd.broadcast_mul(a, b),
-         [f(4, 16), f(1, 16)]),
-        ("broadcast_div", lambda a, b: nd.broadcast_div(a, b),
-         [f(4, 16), pos(1, 16)]),
-        ("maximum", lambda a, b: nd.maximum(a, b), [f(4, 16), f(4, 16)]),
-        ("minimum", lambda a, b: nd.minimum(a, b), [f(4, 16), f(4, 16)]),
-        ("power@trans", lambda a, b: nd.power(a, b), [pos(4, 16), f(4, 16)]),
-        # reductions
-        ("sum", lambda a: nd.sum(a, axis=1), [f(8, 64)]),
-        ("mean", lambda a: nd.mean(a, axis=1), [f(8, 64)]),
-        ("max", lambda a: nd.max(a, axis=1), [f(8, 64)]),
-        ("min", lambda a: nd.min(a, axis=1), [f(8, 64)]),
-        ("prod", lambda a: nd.prod(a, axis=1), [f(8, 8)]),
-        ("norm@trans", lambda a: nd.norm(a, axis=1), [f(8, 64)]),
-        ("argmax", lambda a: nd.argmax(a, axis=1), [f(8, 64)]),
-        ("argmin", lambda a: nd.argmin(a, axis=1), [f(8, 64)]),
-        # linalg / nn
-        ("dot@mm", lambda a, b: nd.dot(a, b), [f(8, 32), f(32, 8)]),
-        ("linalg.gemm2@mm", lambda a, b: nd.linalg.gemm2(a, b),
-         [f(8, 32), f(32, 8)]),
-        ("FullyConnected@mm",
-         lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=8),
-         [f(4, 32), f(8, 32), f(8)]),
-        ("Convolution@mm",
-         lambda x, w: nd.Convolution(x, w, None, kernel=(3, 3),
-                                     num_filter=8, pad=(1, 1), no_bias=True),
-         [f(2, 4, 8, 8), f(8, 4, 3, 3)]),
-        ("Pooling_max",
-         lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="max",
-                              stride=(2, 2)),
-         [f(2, 4, 8, 8)]),
-        ("Pooling_avg",
-         lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="avg",
-                              stride=(2, 2)),
-         [f(2, 4, 8, 8)]),
-        ("softmax@trans", lambda a: nd.softmax(a, axis=-1), [f(4, 16)]),
-        ("log_softmax@trans", lambda a: nd.log_softmax(a, axis=-1), [f(4, 16)]),
-        ("LayerNorm",
-         lambda x, g, b: nd.LayerNorm(x, g, b, axis=-1),
-         [f(4, 16), f(16), f(16)]),
-        ("LeakyReLU", lambda a: nd.LeakyReLU(a, slope=0.1), [f(4, 16)]),
-        ("Activation@trans",
-         lambda a: nd.Activation(a, act_type="softrelu"), [f(4, 16)]),
-        # indexing / shape
-        ("take", lambda a, i: nd.take(a, i), [f(16, 8), idx(6)]),
-        ("Embedding",
-         lambda i, w: nd.Embedding(i, w, input_dim=16, output_dim=8),
-         [idx(6), f(16, 8)]),
-        ("one_hot", lambda i: nd.one_hot(i, 16), [idx(6)]),
-        ("topk", lambda a: nd.topk(a, k=3, ret_typ="value"), [f(4, 16)]),
-        ("sort", lambda a: nd.sort(a, axis=-1), [f(4, 16)]),
-        ("transpose", lambda a: nd.transpose(a, axes=(1, 0, 2)),
-         [f(3, 4, 5)]),
-        ("where", lambda c, a, b: nd.where(c, a, b),
-         [idx(4, 16), f(4, 16), f(4, 16)]),
-    ]
+    def mk(name, *specs, call=None, tag=None, **opts):
+        """Entry builder: op looked up on M by name at call time."""
+        entry = name if tag is None else name + "@" + tag
+        if call is None:
+            def call_(M, *a, _n=name):
+                return getattr(M, _n)(*a)
+            call = call_
+        return (entry, call, list(specs), opts)
+
+    def kw(name, kwargs, *specs, tag=None, **opts):
+        def call(M, *a, _n=name, _k=kwargs):
+            return getattr(M, _n)(*a, **_k)
+        return ((name if tag is None else name + "@" + tag), call,
+                list(specs), opts)
+
+    t = []
+
+    # ---- unary elementwise, bulk families
+    UNARY_F = ["abs", "sign", "square", "sin", "cos", "tan", "arctan",
+               "sinh", "cosh", "tanh", "arcsinh", "sigmoid", "relu",
+               "softsign", "erf", "negative", "identity", "zeros_like",
+               "ones_like", "BlockGrad", "stop_gradient", "make_loss",
+               "hard_sigmoid", "degrees", "radians", "exp", "expm1",
+               "logical_not", "flatten", "Flatten"]
+    UNARY_ND = ["round", "rint", "fix", "ceil", "floor", "trunc", "sign",
+                "logical_not"]
+    UNARY_POS = ["sqrt", "rsqrt", "cbrt", "rcbrt", "log", "log10", "log2",
+                 "log1p", "reciprocal", "gamma", "gammaln", "digamma"]
+    UNARY_UNIT = ["arcsin", "arccos", "arctanh", "erfinv"]
+    TRANS = {"exp", "expm1", "log", "log10", "log2", "log1p", "sqrt",
+             "rsqrt", "cbrt", "rcbrt", "sin", "cos", "tan", "arcsin",
+             "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh",
+             "arccosh", "arctanh", "sigmoid", "softsign", "erf", "erfinv",
+             "gamma", "gammaln", "digamma", "reciprocal", "power",
+             "hypot", "arctan2", "norm", "softmax", "log_softmax",
+             "softmin"}
+    for n in UNARY_F:
+        if hasattr(nd, n):
+            nondiff = n in UNARY_ND
+            t.append(mk(n, f(4, 16), tag="trans" if n in TRANS else None,
+                        nondiff=nondiff))
+    for n in UNARY_ND:
+        if hasattr(nd, n) and n not in UNARY_F:
+            t.append(mk(n, f(4, 16), nondiff=True))
+    for n in UNARY_POS:
+        if hasattr(nd, n):
+            t.append(mk(n, pos(4, 16), tag="trans"))
+    for n in UNARY_UNIT:
+        if hasattr(nd, n):
+            t.append(mk(n, ((4, 16), "unit"), tag="trans"))
+    t.append(mk("arccosh", ((4, 16), "gt1"), tag="trans"))
+    t.append(kw("clip", dict(a_min=-1.0, a_max=1.0), f(4, 16)))
+    t.append(kw("smooth_l1", dict(scalar=1.0), f(4, 16)))
+    t.append(kw("IdentityAttachKLSparseReg", dict(sparseness_target=0.1),
+                ((4, 16), "unit")))
+    t.append(kw("cast", dict(dtype="float16"), f(4, 16)))
+    t.append(kw("Cast", dict(dtype="float16"), f(4, 16)))
+    t.append(kw("amp_cast", dict(dtype="float16"), f(4, 16)))
+    t.append(mk("amp_multicast", f(4, 16), f(4, 16),
+                call=lambda M, a, b: M.amp_multicast(a, b, num_outputs=2)))
+
+    # ---- binary elementwise
+    BIN_FF = ["add", "subtract", "multiply", "maximum", "minimum",
+              "hypot", "arctan2", "elemwise_add", "elemwise_sub",
+              "elemwise_mul"]
+    BIN_FPOS = ["divide", "true_divide", "mod", "modulo", "elemwise_div"]
+    BIN_CMP = ["equal", "not_equal", "greater", "greater_equal", "lesser",
+               "lesser_equal"]
+    BIN_LOGIC = ["logical_and", "logical_or", "logical_xor"]
+    for n in BIN_FF:
+        if hasattr(nd, n):
+            t.append(mk(n, f(4, 16), f(4, 16),
+                        tag="trans" if n in TRANS else None))
+    for n in BIN_FPOS:
+        if hasattr(nd, n):
+            t.append(mk(n, f(4, 16), pos(4, 16)))
+    for n in BIN_CMP:
+        t.append(mk(n, f(4, 16), f(4, 16), nondiff=True))
+    for n in BIN_LOGIC:
+        t.append(mk(n, ((4, 16), "b"), ((4, 16), "b"), nondiff=True))
+    t.append(mk("power", pos(4, 16), f(4, 16), tag="trans"))
+
+    # ---- broadcast binary family
+    BCAST_FF = ["broadcast_add", "broadcast_plus", "broadcast_sub",
+                "broadcast_minus", "broadcast_subtract", "broadcast_mul",
+                "broadcast_multiply", "broadcast_maximum",
+                "broadcast_minimum", "broadcast_hypot",
+                "broadcast_arctan2"]
+    BCAST_FPOS = ["broadcast_div", "broadcast_divide", "broadcast_mod",
+                  "broadcast_modulo"]
+    BCAST_CMP = ["broadcast_equal", "broadcast_not_equal",
+                 "broadcast_greater", "broadcast_greater_equal",
+                 "broadcast_lesser", "broadcast_lesser_equal"]
+    BCAST_LOGIC = ["broadcast_logical_and", "broadcast_logical_or",
+                   "broadcast_logical_xor"]
+    for n in BCAST_FF:
+        if hasattr(nd, n):
+            t.append(mk(n, f(4, 16), f(1, 16),
+                        tag="trans" if n.replace("broadcast_", "") in TRANS
+                        else None))
+    for n in BCAST_FPOS:
+        if hasattr(nd, n):
+            t.append(mk(n, f(4, 16), pos(1, 16)))
+    for n in BCAST_CMP:
+        t.append(mk(n, f(4, 16), f(1, 16), nondiff=True))
+    for n in BCAST_LOGIC:
+        t.append(mk(n, ((4, 16), "b"), ((1, 16), "b"), nondiff=True))
+    t.append(mk("broadcast_power", pos(4, 16), f(1, 16), tag="trans"))
+
+    # ---- reductions
+    for n in ["sum", "mean", "max", "min"]:
+        t.append(kw(n, dict(axis=1), f(8, 64)))
+    t.append(kw("prod", dict(axis=1), f(8, 8)))
+    t.append(kw("norm", dict(axis=1), f(8, 64), tag="trans"))
+    t.append(kw("argmax", dict(axis=1), ((8, 64), "perm"), nondiff=True))
+    t.append(kw("argmin", dict(axis=1), ((8, 64), "perm"), nondiff=True))
+    t.append(kw("moments", dict(axes=1), f(8, 16)))
+    t.append(mk("all_finite", f(4, 16), nondiff=True))
+    t.append(mk("multi_all_finite", f(4, 16), f(4, 16), nondiff=True,
+                call=lambda M, a, b: M.multi_all_finite(a, b, num_arrays=2)))
+    t.append(mk("multi_sum_sq", f(4, 16), f(4, 16),
+                call=lambda M, a, b: M.multi_sum_sq(a, b, num_arrays=2)))
+    t.append(mk("multi_lars", pos(4), pos(4), pos(4), pos(4),
+                call=lambda M, lr, w, g, wd: M.multi_lars(
+                    lr, w, g, wd, eta=0.001), nondiff=True))
+
+    # ---- shape / layout
+    t.append(kw("reshape", dict(shape=(8, 8)), f(4, 16)))
+    t.append(mk("reshape_like", f(4, 16), f(8, 8)))
+    t.append(kw("transpose", dict(axes=(1, 0, 2)), f(3, 4, 5)))
+    t.append(kw("swapaxes", dict(dim1=0, dim2=1), f(3, 4, 5)))
+    t.append(kw("SwapAxis", dict(dim1=0, dim2=1), f(3, 4, 5)))
+    t.append(kw("expand_dims", dict(axis=1), f(4, 16)))
+    t.append(kw("squeeze", dict(axis=1), f(4, 1, 16)))
+    t.append(kw("tile", dict(reps=(2, 2)), f(3, 4)))
+    t.append(kw("repeat", dict(repeats=2, axis=1), f(3, 4)))
+    t.append(kw("pad", dict(mode="constant",
+                            pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+                f(2, 3, 4, 4)))
+    t.append(kw("flip", dict(axis=1), f(3, 4)))
+    t.append(kw("reverse", dict(axis=1), f(3, 4)))
+    t.append(kw("depth_to_space", dict(block_size=2), f(1, 8, 3, 3)))
+    t.append(kw("space_to_depth", dict(block_size=2), f(1, 2, 6, 6)))
+    t.append(kw("diag", dict(k=0), f(5, 5)))
+    t.append(kw("moveaxis", dict(source=0, destination=1), f(3, 4, 5)))
+    t.append(kw("broadcast_to", dict(shape=(4, 16)), f(1, 16)))
+    t.append(mk("broadcast_like", f(1, 16), f(4, 16)))
+    t.append(kw("broadcast_axis", dict(axis=0, size=4), f(1, 16)))
+    t.append(kw("slice", dict(begin=(1, 2), end=(3, 10)), f(4, 16)))
+    t.append(kw("slice_axis", dict(axis=1, begin=2, end=10), f(4, 16)))
+    t.append(mk("slice_like", f(8, 16), f(4, 8)))
+    t.append(kw("split", dict(num_outputs=2, axis=1), f(4, 16)))
+    t.append(kw("SliceChannel", dict(num_outputs=2, axis=1), f(4, 16)))
+    t.append(kw("split_v2", dict(indices_or_sections=2, axis=1), f(4, 16)))
+    t.append(kw("stack", dict(axis=0), f(3, 4), f(3, 4)))
+    t.append(kw("concat", dict(dim=1), f(4, 8), f(4, 8)))
+    t.append(kw("Concat", dict(dim=1), f(4, 8), f(4, 8)))
+    t.append(mk("concatenate", f(4, 8), f(4, 8),
+                call=lambda M, a, b: M.concatenate([a, b], axis=0)))
+    t.append(kw("Crop", dict(offset=(1, 1), h_w=(4, 4), num_args=1),
+                f(1, 2, 8, 8)))
+    t.append(mk("meshgrid", f(4), f(5)))
+    t.append(kw("arange_like", dict(start=0.0, step=1.0), f(4, 16),
+                nondiff=True))
+    t.append(mk("shape_array", f(4, 16), nondiff=True))
+    t.append(mk("size_array", f(4, 16), nondiff=True))
+    t.append(mk("add_n", f(4, 16), f(4, 16), f(4, 16)))
+
+    # ---- indexing / ordering
+    t.append(mk("take", f(16, 8), idx(6, hi=16)))
+    t.append(kw("pick", dict(axis=-1), f(4, 16), idx(4, hi=16)))
+    t.append(kw("one_hot", dict(depth=16), idx(6, hi=16), nondiff=True))
+    t.append(mk("gather_nd", f(5, 5), idx(2, 4, hi=5)))
+    t.append(kw("scatter_nd", dict(shape=(5, 5)), f(4), idx(2, 4, hi=5)))
+    t.append(mk("batch_take", f(4, 8), idx(4, hi=8)))
+    t.append(kw("topk", dict(k=3, ret_typ="value"), ((4, 16), "perm")))
+    t.append(kw("sort", dict(axis=-1), ((4, 16), "perm")))
+    t.append(kw("argsort", dict(axis=-1), ((4, 16), "perm"), nondiff=True))
+    t.append(mk("argmax_channel", ((4, 16), "perm"), nondiff=True))
+    t.append(mk("where", ((4, 16), "b"), f(4, 16), f(4, 16)))
+    t.append(kw("unravel_index", dict(shape=(4, 6)), idx(6, hi=24),
+                nondiff=True))
+    t.append(kw("ravel_multi_index", dict(shape=(4, 6)), idx(2, 6, hi=4),
+                nondiff=True))
+    t.append(mk("onehot_encode", idx(6, hi=8), f(6, 8), nondiff=True))
+    t.append(kw("histogram", dict(bins=5, range=(-2.0, 2.0)), f(64),
+                nondiff=True))
+    t.append(mk("shuffle", f(8, 4), seed=True, nondiff=True))
+    t.append(kw("multinomial", dict(shape=3), ((4, 8), "pmf"), seed=True,
+                nondiff=True))
+    # sequence family (float lengths, mask semantics)
+    t.append(kw("sequence_mask", dict(use_sequence_length=True),
+                f(5, 3, 4), ((3,), "len5"), nondiff=True))
+    t.append(kw("SequenceMask", dict(use_sequence_length=True),
+                f(5, 3, 4), ((3,), "len5"), nondiff=True))
+    t.append(kw("SequenceLast", dict(use_sequence_length=True),
+                f(5, 3, 4), ((3,), "len5"), nondiff=True))
+    t.append(kw("SequenceReverse", dict(use_sequence_length=True),
+                f(5, 3, 4), ((3,), "len5"), nondiff=True))
+
+    # ---- matmul-class
+    t.append(mk("dot", f(8, 32), f(32, 8), tag="mm"))
+    t.append(mk("batch_dot", f(2, 8, 16), f(2, 16, 8), tag="mm"))
+    t.append(mk("khatri_rao", f(4, 8), f(3, 8), tag="mm"))
+    t.append(kw("trace", dict(offset=0, axis1=0, axis2=1), f(6, 6)))
+    t.append(mk("linalg.gemm2", f(8, 32), f(32, 8), tag="mm",
+                call=lambda M, a, b: (nd if M is nd else M).linalg.gemm2(a, b),
+                op="linalg.gemm2"))
+
+    # ---- nn layers
+    t.append(mk("FullyConnected", f(4, 32), f(8, 32), f(8), tag="mm",
+                call=lambda M, x, w, b: M.FullyConnected(x, w, b,
+                                                         num_hidden=8)))
+    t.append(mk("Convolution", f(2, 4, 8, 8), f(8, 4, 3, 3), tag="mm",
+                call=lambda M, x, w: M.Convolution(
+                    x, w, None, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                    no_bias=True)))
+    t.append(mk("Convolution_v1", f(2, 4, 8, 8), f(8, 4, 3, 3), tag="mm",
+                call=lambda M, x, w: M.Convolution_v1(
+                    x, w, None, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                    no_bias=True)))
+    t.append(mk("Deconvolution", f(2, 4, 8, 8), f(4, 8, 3, 3), tag="mm",
+                call=lambda M, x, w: M.Deconvolution(
+                    x, w, None, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                    no_bias=True)))
+    t.append(mk("Pooling", f(2, 4, 8, 8),
+                call=lambda M, x: M.Pooling(x, kernel=(2, 2),
+                                            pool_type="max", stride=(2, 2))))
+    t.append(mk("Pooling_avg", f(2, 4, 8, 8), op="Pooling",
+                call=lambda M, x: M.Pooling(x, kernel=(2, 2),
+                                            pool_type="avg", stride=(2, 2))))
+    t.append(mk("Pooling_v1", f(2, 4, 8, 8),
+                call=lambda M, x: M.Pooling_v1(x, kernel=(2, 2),
+                                               pool_type="max",
+                                               stride=(2, 2))))
+    for bn_name in ["BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm"]:
+        t.append(mk(bn_name, f(2, 4, 8, 8), f(4), pos(4), f(4), pos(4),
+                    call=lambda M, x, g, b, mm, mv, _n=bn_name: getattr(M, _n)(
+                        x, g, b, mm, mv, fix_gamma=False,
+                        use_global_stats=True)))
+    t.append(mk("LayerNorm", f(4, 16), f(16), f(16),
+                call=lambda M, x, g, b: M.LayerNorm(x, g, b, axis=-1)))
+    t.append(mk("GroupNorm", f(2, 4, 8, 8), f(4), f(4),
+                call=lambda M, x, g, b: M.GroupNorm(x, g, b, num_groups=2)))
+    t.append(mk("InstanceNorm", f(2, 4, 8, 8), f(4), f(4)))
+    t.append(kw("Dropout", dict(p=0.0), f(4, 16)))
+    t.append(kw("Activation", dict(act_type="softrelu"), f(4, 16),
+                tag="trans"))
+    t.append(kw("LeakyReLU", dict(act_type="leaky", slope=0.1), f(4, 16)))
+    t.append(kw("SoftmaxActivation", dict(mode="instance"), f(4, 16),
+                tag="trans"))
+    for n in ["softmax", "log_softmax", "softmin"]:
+        t.append(kw(n, dict(axis=-1), f(4, 16), tag="trans"))
+    t.append(mk("softmax_cross_entropy", f(4, 16), idx(4, hi=16),
+                tag="trans"))
+    t.append(mk("Embedding", idx(6, hi=16), f(16, 8),
+                call=lambda M, i, w: M.Embedding(i, w, input_dim=16,
+                                                 output_dim=8)))
+    t.append(mk("SoftmaxOutput", f(4, 8), idx(4, hi=8), tag="trans"))
+    t.append(mk("LinearRegressionOutput", f(4, 8), f(4, 8)))
+    t.append(mk("LogisticRegressionOutput", f(4, 8), ((4, 8), "b"),
+                tag="trans"))
+    t.append(mk("MAERegressionOutput", f(4, 8), f(4, 8)))
+    t.append(mk("CTCLoss", f(6, 2, 5), ((2, 3), ("i1", 5)), tag="trans"))
+    t.append(mk("ctc_loss", f(6, 2, 5), ((2, 3), ("i1", 5)), tag="trans"))
+    t.append(kw("L2Normalization", dict(mode="instance"), f(4, 16)))
+    t.append(kw("LRN", dict(nsize=3), f(2, 4, 6, 6)))
+    t.append(kw("UpSampling", dict(scale=2, sample_type="nearest"),
+                f(1, 2, 4, 4)))
+    t.append(kw("BilinearResize2D", dict(height=6, width=6), f(1, 2, 4, 4)))
+    t.append(kw("Correlation", dict(kernel_size=1, max_displacement=2),
+                f(2, 3, 8, 8), f(2, 3, 8, 8)))
+    t.append(kw("im2col", dict(kernel=(3, 3), pad=(1, 1)), f(2, 3, 8, 8)))
+    t.append(kw("col2im", dict(output_size=(8, 8), kernel=(3, 3),
+                               pad=(1, 1)), f(2, 27, 64)))
+    t.append(kw("ROIPooling", dict(pooled_size=(2, 2), spatial_scale=1.0),
+                f(1, 3, 8, 8),
+                ((onp.array([[0, 0, 0, 4, 4], [0, 1, 1, 6, 6]],
+                            dtype="float32"),), "const")))
+    t.append(mk("BilinearSampler", f(1, 2, 6, 6), ((1, 2, 4, 4), "unit")))
+    t.append(kw("GridGenerator", dict(transform_type="affine",
+                                      target_shape=(4, 4)),
+                ((2, 6), "unit")))
+    t.append(kw("SpatialTransformer",
+                dict(target_shape=(4, 4), transform_type="affine",
+                     sampler_type="bilinear"),
+                f(1, 2, 8, 8), ((1, 6), "unit")))
+    _rnn_n = rnn_param_size("rnn_tanh", 4, 8, 1)
+    t.append(mk("RNN", f(3, 2, 4), f(_rnn_n), f(1, 2, 8), tag="trans",
+                call=lambda M, x, p, s: M.RNN(x, p, s, state_size=8,
+                                              num_layers=1,
+                                              mode="rnn_tanh")))
+
+    # ---- optimizer update ops (nondiff: parity of the update rule itself)
+    # spec kinds: variance-class accumulator states must be positive
+    OPT2 = {"sgd_update": "fg", "signsgd_update": "fg",
+            "mp_sgd_update": "fgf", "sgd_mom_update": "fgf",
+            "signum_update": "fgf", "nag_mom_update": "fgf",
+            "mp_sgd_mom_update": "fgff", "mp_nag_mom_update": "fgff",
+            "rmsprop_update": "fgp", "adam_update": "fgfp",
+            "ftrl_update": "fgfp", "ftml_update": "fgfpf"}
+    for n, kinds in OPT2.items():
+        specs = [f(4, 8) if k in "fg" else pos(4, 8) for k in kinds]
+        t.append(mk(n, *specs, nondiff=True,
+                    call=lambda M, *a, _n=n: getattr(M, _n)(*a, lr=0.1)))
+    # centered RMSProp: the n state must dominate g^2 (n - g^2 under the
+    # sqrt), so n starts >1.5 while the g state stays in the unit ball
+    t.append(mk("rmspropalex_update", f(4, 8), f(4, 8), ((4, 8), "gt1"),
+                ((4, 8), "unit"), f(4, 8), nondiff=True,
+                call=lambda M, w, g, n_, gs, d: M.rmspropalex_update(
+                    w, g, n_, gs, d, lr=0.1)))
+    for n in ["lamb_update_phase1", "mp_lamb_update_phase1"]:
+        t.append(mk(n, f(4, 8), f(4, 8), f(4, 8), pos(4, 8), nondiff=True,
+                    call=lambda M, w, g, m, v, _n=n: getattr(M, _n)(
+                        w, g, m, v, t=1)))
+    for n in ["lamb_update_phase2", "mp_lamb_update_phase2"]:
+        t.append(mk(n, f(4, 8), f(4, 8), pos(1), pos(1), nondiff=True,
+                    call=lambda M, w, g, r1, r2, _n=n: getattr(M, _n)(
+                        w, g, r1, r2, lr=0.1)))
+    t.append(mk("multi_sgd_update", f(4, 8), f(4, 8), nondiff=True,
+                call=lambda M, w, g: M.multi_sgd_update(
+                    [w], [g], lrs=[0.1], wds=[0.0])))
+    t.append(mk("multi_sgd_mom_update", f(4, 8), f(4, 8), f(4, 8),
+                nondiff=True,
+                call=lambda M, w, g, m: M.multi_sgd_mom_update(
+                    [w], [g], [m], lrs=[0.1], wds=[0.0])))
+    t.append(mk("multi_mp_sgd_update", f(4, 8), f(4, 8), f(4, 8),
+                nondiff=True,
+                call=lambda M, w, g, w32: M.multi_mp_sgd_update(
+                    [w], [g], [w32], lrs=[0.1], wds=[0.0])))
+    t.append(mk("multi_mp_sgd_mom_update", f(4, 8), f(4, 8), f(4, 8),
+                f(4, 8), nondiff=True,
+                call=lambda M, w, g, m, w32: M.multi_mp_sgd_mom_update(
+                    [w], [g], [m], [w32], lrs=[0.1], wds=[0.0])))
+    t.append(mk("preloaded_multi_sgd_update", f(4, 8), f(4, 8), pos(1),
+                pos(1), nondiff=True,
+                call=lambda M, w, g, lr, wd: M.preloaded_multi_sgd_update(
+                    [w], [g], lr, wd)))
+    t.append(mk("preloaded_multi_sgd_mom_update", f(4, 8), f(4, 8),
+                f(4, 8), pos(1), pos(1), nondiff=True,
+                call=lambda M, w, g, m, lr, wd:
+                M.preloaded_multi_sgd_mom_update([w], [g], [m], lr, wd)))
+    t.append(mk("preloaded_multi_mp_sgd_update", f(4, 8), f(4, 8), f(4, 8),
+                pos(1), pos(1), nondiff=True,
+                call=lambda M, w, g, w32, lr, wd:
+                M.preloaded_multi_mp_sgd_update([w], [g], [w32], lr, wd)))
+    t.append(mk("preloaded_multi_mp_sgd_mom_update", f(4, 8), f(4, 8),
+                f(4, 8), f(4, 8), pos(1), pos(1), nondiff=True,
+                call=lambda M, w, g, m, w32, lr, wd:
+                M.preloaded_multi_mp_sgd_mom_update([w], [g], [m], [w32],
+                                                    lr, wd)))
+
+    # ---- creation ops (nullary; cross-leg determinism)
+    t.append(mk("zeros", call=lambda M: M.zeros((3, 4)), nondiff=True))
+    t.append(mk("ones", call=lambda M: M.ones((3, 4)), nondiff=True))
+    t.append(mk("full", call=lambda M: M.full((3, 4), 2.5), nondiff=True))
+    t.append(mk("eye", call=lambda M: M.eye(4), nondiff=True))
+    t.append(mk("arange", call=lambda M: M.arange(0, 8), nondiff=True))
+    t.append(mk("linspace", call=lambda M: M.linspace(0.0, 1.0, 5),
+                nondiff=True))
+
+    # ---- sparse storage round-trip (dense-comparable via tostype)
+    t.append(mk("cast_storage", f(4, 16), nondiff=True, sym=False,
+                symreason="sparse storage is eager-only (README Sparse)",
+                call=lambda M, a: M.cast_storage(a, "row_sparse")))
+
     return t
 
 
@@ -267,43 +584,112 @@ SWEEP_TOLS_TRANS = {"float32": (2e-3, 1e-4), "bfloat16": (4e-2, 2e-2),
                     "float16": (1e-2, 2e-3)}
 
 
+def _norm_entry(entry):
+    """Entries are (name, fn, specs) or (name, fn, specs, opts)."""
+    if len(entry) == 3:
+        name, fn, specs = entry
+        return name, fn, specs, {}
+    return entry
+
+
+def _spec_is_float(kind):
+    return kind in ("f", "pos", "unit", "gt1", "perm", "pmf", "b") or \
+        (isinstance(kind, str) and kind.startswith("len"))
+
+
+def _gen_input(rng, shape, kind):
+    """Synthesize one input array for a spec kind (see _sweep_table doc)."""
+    if kind == "const":
+        return shape[0].copy()   # spec carries the payload in `shape`
+    if isinstance(kind, tuple):
+        k0 = kind[0]
+        if k0 == "i":
+            return rng.randint(0, kind[1], size=shape).astype("int32")
+        if k0 == "i1":
+            return rng.randint(1, kind[1], size=shape).astype("int32")
+        raise ValueError("unknown spec kind %r" % (kind,))
+    if kind == "b":
+        return rng.randint(0, 2, size=shape).astype("float32")
+    if kind == "perm":
+        n = int(onp.prod(shape)) if shape else 1
+        return (rng.permutation(n).astype("float32") / n).reshape(shape)
+    if kind == "pmf":
+        a = onp.abs(rng.uniform(0.1, 1.0, size=shape)).astype("float32")
+        return a / a.sum(axis=-1, keepdims=True)
+    if kind.startswith("len"):
+        hi = int(kind[3:] or 4)
+        return rng.randint(1, hi + 1, size=shape).astype("float32")
+    a = rng.uniform(-2.0, 2.0, size=shape).astype("float32")
+    if kind == "pos":
+        a = onp.abs(a) + 0.5
+    elif kind == "unit":
+        a = onp.clip(a * 0.45, -0.9, 0.9)
+    elif kind == "gt1":
+        a = onp.abs(a) + 1.5
+    return a
+
+
+def sweep_inputs(specs, seed=0):
+    """Public input-synthesis hook (shared with tests/test_sym_parity.py)."""
+    rng = onp.random.RandomState(seed)
+    return [_gen_input(rng, shape, kind) for shape, kind in specs]
+
+
+def _norm_outputs(o):
+    """Flatten an op result to a list of float32 numpy arrays (sparse
+    densified, multi-output listed)."""
+    from .ndarray.sparse import BaseSparseNDArray
+    outs = o if isinstance(o, (list, tuple)) else [o]
+    res = []
+    for x in outs:
+        if isinstance(x, BaseSparseNDArray):
+            x = x.tostype("default")
+        res.append(_as_np(x).astype("float32"))
+    return res
+
+
+def sweep_coverage():
+    """(covered, skipped, uncovered) over the public nd registry — the
+    completeness contract: every public nd callable is either in the op
+    table or in SWEEP_SKIP with a reason. ``uncovered`` must be empty."""
+    from .base import public_op_names
+    covered = set()
+    for entry in _sweep_table():
+        name, _, _, opts = _norm_entry(entry)
+        covered.add(opts.get("op", name.partition("@")[0]))
+    eligible = set(public_op_names(nd, exclude=SWEEP_SKIP))
+    return covered, set(SWEEP_SKIP), eligible - covered
+
+
 def op_consistency_sweep(dtypes=("float32", "bfloat16", "float16"),
                          ctx_list=None, quick=False, seed=0):
-    """Walk the op table across contexts x dtypes; returns rows of
-    (op, dtype, max_rel_err, status) where status is 'ok', 'MISMATCH', or
-    'ERROR: ...'. ctx_list defaults to [cpu, default_context] — on TPU
-    hosts that is the real CPU<->TPU cross-backend walk (the reference's
-    GPU-suite re-run); on CPU-only hosts both legs are CPU and the sweep
-    still catches dtype-lowering breaks."""
+    """Walk the FULL registry op table across contexts x dtypes; returns
+    rows of (op, dtype, max_rel_err, status) where status is 'ok',
+    'MISMATCH', or 'ERROR: ...'. ctx_list defaults to
+    [cpu, default_context] — on TPU hosts that is the real CPU<->TPU
+    cross-backend walk (the reference's GPU-suite re-run); on CPU-only
+    hosts both legs are CPU and the sweep still catches dtype-lowering
+    breaks."""
     table = _sweep_table()
     if quick:
-        table = table[::3]
+        table = table[::6]
     if ctx_list is None:
         ctx_list = [cpu(0), default_context()]
     rows = []
-    rng = onp.random.RandomState(seed)
     inputs_cache = {}
     import contextlib
     import jax
-    for entry_name, fn, specs in table:
+    for entry in table:
+        entry_name, fn, specs, opts = _norm_entry(entry)
         name, _, tag = entry_name.partition("@")
-        key = name
-        if key not in inputs_cache:
-            gen = []
-            for shape, kind in specs:
-                if kind == "i":
-                    gen.append(rng.randint(0, 2, size=shape).astype("int32")
-                               if name == "where"
-                               else rng.randint(0, min(shape) if shape
-                                                else 4, size=shape)
-                               .astype("int32"))
-                else:
-                    a = rng.uniform(-2.0, 2.0, size=shape).astype("float32")
-                    if kind == "pos":
-                        a = onp.abs(a) + 0.5
-                    gen.append(a)
-            inputs_cache[key] = gen
+        if entry_name not in inputs_cache:
+            inputs_cache[entry_name] = sweep_inputs(specs, seed)
         for dt in dtypes:
+            if dt != "float32" and (opts.get("nondiff") and
+                                    name not in TRANS_DTYPE_OK):
+                # int-output / update-rule ops: one dtype leg is enough
+                if dt != dtypes[0]:
+                    continue
             rtol, atol = (SWEEP_TOLS_TRANS if tag == "trans"
                           else SWEEP_TOLS)[dt]
             prec = jax.default_matmul_precision("highest") if tag == "mm" \
@@ -314,28 +700,37 @@ def op_consistency_sweep(dtypes=("float32", "bfloat16", "float16"),
                     for ctx in ctx_list:
                         arrs = []
                         for (shape, kind), x in zip(specs,
-                                                    inputs_cache[key]):
+                                                    inputs_cache[entry_name]):
                             a = nd.array(x, ctx=ctx)
-                            if kind != "i" and dt != "float32":
+                            if kind in ("f", "pos", "unit", "gt1", "perm",
+                                        "pmf") and dt != "float32":
                                 a = a.astype(dt)
                             arrs.append(a)
+                        if opts.get("seed"):
+                            nd.random.seed(seed)
                         with ctx:
-                            o = fn(*arrs)
-                        outs.append(o.asnumpy().astype("float32"))
+                            o = fn(nd, *arrs)
+                        outs.append(_norm_outputs(o))
                 ref = outs[0]
                 err = 0.0
                 ok = True
-                for r in outs[1:]:
-                    diff = onp.abs(r - ref)
-                    denom = onp.abs(ref) + atol
-                    err = max(err, float((diff / denom).max())
-                              if diff.size else 0.0)
-                    ok = ok and onp.allclose(r, ref, rtol=rtol, atol=atol)
-                rows.append((name, dt, err, "ok" if ok else "MISMATCH"))
+                for legs in outs[1:]:
+                    for r, b in zip(legs, ref):
+                        diff = onp.abs(r - b)
+                        denom = onp.abs(b) + atol
+                        err = max(err, float((diff / denom).max())
+                                  if diff.size else 0.0)
+                        ok = ok and onp.allclose(r, b, rtol=rtol, atol=atol)
+                rows.append((entry_name.partition("@")[0], dt, err,
+                             "ok" if ok else "MISMATCH"))
             except Exception as e:  # record, keep walking
-                rows.append((name, dt, None,
+                rows.append((entry_name.partition("@")[0], dt, None,
                              "ERROR: %s" % str(e).splitlines()[0][:120]))
     return rows
+
+
+#: nondiff ops that still deserve the low-precision dtype legs
+TRANS_DTYPE_OK = {"round", "floor", "ceil", "trunc", "rint", "fix", "sign"}
 
 
 def grad_consistency_sweep(ctx_list=None, quick=False, seed=0):
@@ -347,25 +742,23 @@ def grad_consistency_sweep(ctx_list=None, quick=False, seed=0):
     import jax
     from . import autograd as _ag
 
-    table = [e for e in _sweep_table()
-             if all(kind != "i" for _, kind in e[2])]
-    # non-differentiable / piecewise-constant outputs excluded
-    skip = {"round", "floor", "argmax", "argmin", "one_hot"}
-    table = [e for e in table if e[0].partition("@")[0] not in skip]
+    table = []
+    for entry in _sweep_table():
+        name, fn, specs, opts = _norm_entry(entry)
+        if opts.get("nondiff") or not specs:
+            continue
+        if not all(_spec_is_float(kind) and kind != "b"
+                   for _, kind in specs):
+            continue
+        table.append((name, fn, specs, opts))
     if quick:
-        table = table[::3]
+        table = table[::6]
     if ctx_list is None:
         ctx_list = [cpu(0), default_context()]
     rows = []
-    rng = onp.random.RandomState(seed)
-    for entry_name, fn, specs in table:
+    for entry_name, fn, specs, opts in table:
         name, _, tag = entry_name.partition("@")
-        inputs = []
-        for shape, kind in specs:
-            a = rng.uniform(-2.0, 2.0, size=shape).astype("float32")
-            if kind == "pos":
-                a = onp.abs(a) + 0.5
-            inputs.append(a)
+        inputs = sweep_inputs(specs, seed)
         rtol, atol = (2e-3, 1e-4) if tag == "trans" else (1e-4, 1e-5)
         prec = jax.default_matmul_precision("highest") if tag == "mm" \
             else contextlib.nullcontext()
@@ -376,12 +769,21 @@ def grad_consistency_sweep(ctx_list=None, quick=False, seed=0):
                     arrs = [nd.array(x, ctx=ctx) for x in inputs]
                     for a in arrs:
                         a.attach_grad()
+                    if opts.get("seed"):
+                        nd.random.seed(seed)
                     with ctx:
                         with _ag.record():
-                            out = fn(*arrs)
-                            s = out.sum()
+                            out = fn(nd, *arrs)
+                            if isinstance(out, (list, tuple)):
+                                s = out[0].sum()
+                                for x in out[1:]:
+                                    s = s + x.sum()
+                            else:
+                                s = out.sum()
                         s.backward()
-                    grads.append([a.grad.asnumpy() for a in arrs])
+                    grads.append([a.grad.asnumpy() if a.grad is not None
+                                  else onp.zeros(1, "float32")
+                                  for a in arrs])
             err = 0.0
             ok = True
             for g in grads[1:]:

@@ -1,0 +1,97 @@
+"""nd/sym registry-parity tests (ref symbol/register.py, ndarray/register.py:
+the reference generates both frontends from ONE op registry, so name parity
+is structural there — these tests make it structural here too).
+
+Two contracts:
+1. NAME parity: every public nd callable (minus the documented exclusion
+   table) has an mx.sym mirror; a new nd op that forgets the symbolic side
+   fails this immediately.
+2. EXECUTION parity: the shared sweep table (test_utils._sweep_table, the
+   same table the numeric sweeps walk) is executed through the SYMBOLIC
+   front — Symbol construction, simple_bind, Executor.forward — and every
+   output must match the eager nd result bitwise-for-bitwise at float32.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import ndarray as nd
+from incubator_mxnet_tpu.base import public_op_names
+from incubator_mxnet_tpu.symbol import _SYM_EXCLUDE
+from incubator_mxnet_tpu.test_utils import (_norm_entry, _norm_outputs,
+                                            _sweep_table, sweep_inputs)
+
+#: frozen mirror of the exclusion table — adding a name to _SYM_EXCLUDE
+#: (which silently removes its mx.sym mirror) must be a VISIBLE decision:
+#: it fails here until this list is updated too.
+_EXPECTED_EXCLUSIONS = frozenset({
+    "array", "empty", "save", "load", "from_dlpack", "from_numpy",
+    "to_dlpack_for_read", "to_dlpack_for_write", "load_frombuffer",
+    "imdecode", "waitall", "rnn_param_size",
+})
+
+
+def test_sym_name_parity():
+    eligible = public_op_names(nd, exclude=_SYM_EXCLUDE)
+    missing = [n for n in eligible if not hasattr(mx.sym, n)]
+    assert not missing, \
+        "nd ops without a symbolic mirror (add to symbol/__init__.py " \
+        "_SYM_EXCLUDE with a reason, or fix the auto-registration): %s" \
+        % missing
+    # excluding an op removes its symbolic mirror — require the frozen
+    # mirror list to change in the same commit, so it can't be accidental
+    assert set(_SYM_EXCLUDE) == set(_EXPECTED_EXCLUSIONS), \
+        "exclusion table changed: %s" % (
+            set(_SYM_EXCLUDE) ^ set(_EXPECTED_EXCLUSIONS))
+    stale = [n for n in _SYM_EXCLUDE if not hasattr(nd, n)]
+    assert not stale, "_SYM_EXCLUDE names nothing in nd: %s" % stale
+
+
+def test_sym_namespace_is_wide():
+    names = [n for n in dir(mx.sym) if not n.startswith("_")]
+    assert len(names) >= 260, len(names)
+
+
+def _sym_entries():
+    rows = []
+    for entry in _sweep_table():
+        name, fn, specs, opts = _norm_entry(entry)
+        if not opts.get("sym", True):
+            continue
+        rows.append((name, fn, specs, opts))
+    return rows
+
+
+@pytest.mark.parametrize("entry", _sym_entries(),
+                         ids=[e[0] for e in _sym_entries()])
+def test_sym_execution_parity(entry):
+    """Build each table op as a Symbol graph, run it through simple_bind +
+    Executor.forward, and compare against the eager nd result."""
+    entry_name, fn, specs, opts = entry
+    inputs = sweep_inputs(specs, seed=0)
+
+    if opts.get("seed"):
+        nd.random.seed(0)
+    ref = _norm_outputs(fn(nd, *[nd.array(x) for x in inputs]))
+
+    names = ["in%d" % i for i in range(len(specs))]
+    svars = [mx.sym.var(n) for n in names]
+    s = fn(mx.sym, *svars)
+    if isinstance(s, (list, tuple)):   # fns returning python lists of syms
+        s = mx.sym.Group(list(s))
+    shapes = {n: tuple(x.shape) for n, x in zip(names, inputs)}
+    ex = s.simple_bind(**shapes)
+    if opts.get("seed"):
+        nd.random.seed(0)
+    outs = ex.forward(**{n: nd.array(x) for n, x in zip(names, inputs)})
+    flat = []
+    for o in outs:
+        flat.extend(_norm_outputs(o))
+    assert len(flat) == len(ref), (len(flat), len(ref))
+    for a, b in zip(flat, ref):
+        onp.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_sym_exclusion_reasons_documented():
+    for name, reason in _SYM_EXCLUDE.items():
+        assert isinstance(reason, str) and len(reason) > 5, (name, reason)
