@@ -519,6 +519,24 @@ class _NPRandom:
             return ndarray(jax.random.permutation(self._key(), x))
         return ndarray(jax.random.permutation(self._key(), _to(x)._data, axis=0))
 
+    def lognormal(self, mean=0.0, sigma=1.0, size=None):
+        return ndarray(jnp.exp(mean + sigma * jax.random.normal(
+            self._key(), self._shp(size))))
+
+    def multivariate_normal(self, mean, cov, size=None):
+        return ndarray(jax.random.multivariate_normal(
+            self._key(), _to(mean)._data.astype(jnp.float32),
+            _to(cov)._data.astype(jnp.float32), self._shp(size) or None))
+
+    def power(self, a, size=None):
+        # inverse-CDF of p(x) = a x^(a-1) on [0, 1]
+        u = jax.random.uniform(self._key(), self._shp(size))
+        return ndarray(u ** (1.0 / a))
+
+    def rayleigh(self, scale=1.0, size=None):
+        u = jax.random.uniform(self._key(), self._shp(size))
+        return ndarray(scale * jnp.sqrt(-2.0 * jnp.log1p(-u)))
+
 
 random = _NPRandom()
 
@@ -902,7 +920,9 @@ def triu_indices(n, k=0, m=None):
 
 
 def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
-    return bool(jnp.allclose(_to(a)._data, _to(b)._data, rtol, atol, equal_nan))
+    import builtins
+    return builtins.bool(jnp.allclose(_to(a)._data, _to(b)._data, rtol, atol,
+                                      equal_nan))
 
 
 def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
@@ -911,7 +931,9 @@ def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
 
 
 def array_equal(a1, a2, equal_nan=False):
-    return bool(jnp.array_equal(_to(a1)._data, _to(a2)._data, equal_nan))
+    import builtins
+    return builtins.bool(jnp.array_equal(_to(a1)._data, _to(a2)._data,
+                                         equal_nan))
 
 
 def ptp(a, axis=None, keepdims=False):
@@ -1274,3 +1296,17 @@ def _ndarray_array(self, dtype=None, copy=None):
 ndarray.__array_function__ = _ndarray_array_function
 ndarray.__array_ufunc__ = _ndarray_array_ufunc
 ndarray.__array__ = _ndarray_array
+
+
+# -------------------------------------------------- long-tail surface
+# (ref numpy/fallback.py category — here mostly device-native; see module
+# docstring in fallback.py). Imported last: fallback wraps the ndarray
+# class defined above.
+from . import fallback  # noqa: E402
+from .fallback import *  # noqa: F401,F403,E402
+
+__all__ += fallback.__all__
+# names long defined above but historically missing from __all__
+__all__ += ["bool_", "e", "float32", "float64", "inf", "int32", "int64",
+            "nan", "newaxis", "pi", "uint8"]
+__all__ = list(dict.fromkeys(__all__))

@@ -1,0 +1,203 @@
+"""mx.np long-tail surface (ref python/mxnet/numpy/fallback.py:1).
+
+The reference routes exactly this category of names through official NumPy
+on the host when no native kernel exists. Here the design is strictly
+better: nearly every one of these is jnp-native, so they run on device and
+under jit like the rest of mx.np; only file io (genfromtxt), scalar/meta
+queries (finfo, promote_types, ...), and the legacy financial functions
+(npv, pv, ... — dropped from NumPy >= 1.20 but still part of the
+reference's exported surface) execute on the host.
+
+Like the reference's fallback ops, names in this module are not recorded
+on the autograd tape.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+#: jnp-native long-tail ops: device-resident, jit-compatible
+_JNP_FNS = [
+    "apply_along_axis", "apply_over_axes", "argpartition", "array_equiv",
+    "choose", "correlate", "frexp", "histogram2d", "histogram_bin_edges",
+    "histogramdd", "i0", "ix_", "lexsort", "modf", "nancumprod",
+    "nanmedian", "nanpercentile", "nanquantile", "packbits", "partition",
+    "piecewise", "poly", "polyadd", "polydiv", "polyfit", "polyint",
+    "polymul", "polysub", "roots", "select", "setxor1d",
+    "tril_indices_from", "triu_indices_from", "trim_zeros", "unpackbits",
+    "unwrap",
+]
+
+__all__ = _JNP_FNS + [
+    "alltrue", "msort", "genfromtxt", "spacing", "min_scalar_type",
+    "promote_types", "result_type", "set_printoptions", "ndim", "size",
+    "dtype", "finfo", "iinfo", "npv", "mirr", "pv", "ppmt",
+    "rate", "NAN", "NaN", "NINF", "NZERO", "PINF", "PZERO", "bool",
+    "bool_", "int8", "int16", "float16", "_NoValue", "_STR_2_DTYPE_",
+    "__version__",
+]
+
+
+def _jx(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_jx(v) for v in x)
+    return x
+
+
+def _wrap_out(r):
+    from . import ndarray as np_ndarray
+    if isinstance(r, (tuple, list)):
+        return type(r)(_wrap_out(v) for v in r)
+    if isinstance(r, jax.Array):
+        return np_ndarray(r)
+    if isinstance(r, onp.ndarray) and r.dtype != object:
+        return np_ndarray(jnp.asarray(r))
+    return r
+
+
+def _make(name, impl):
+    def fn(*args, **kwargs):
+        return _wrap_out(impl(*[_jx(a) for a in args],
+                              **{k: _jx(v) for k, v in kwargs.items()}))
+    fn.__name__ = name
+    fn.__doc__ = "mx.np.%s (device-native long-tail op; ref fallback.py)" \
+        % name
+    return fn
+
+
+_g = globals()
+for _n in _JNP_FNS:
+    _g[_n] = _make(_n, getattr(jnp, _n))
+
+alltrue = _make("alltrue", jnp.all)                  # legacy alias
+msort = _make("msort", lambda a: jnp.sort(a, axis=0))  # removed in np2
+genfromtxt = _make("genfromtxt", onp.genfromtxt)     # host file io
+
+
+# -------------------------------------------------- scalar / meta queries
+def spacing(x):
+    return onp.spacing(x.asnumpy() if isinstance(x, NDArray) else x)
+
+
+def min_scalar_type(a):
+    return onp.min_scalar_type(a.asnumpy() if isinstance(a, NDArray) else a)
+
+
+def promote_types(t1, t2):
+    return jnp.promote_types(t1, t2)
+
+
+def result_type(*args):
+    return jnp.result_type(*[_jx(a) for a in args])
+
+
+set_printoptions = onp.set_printoptions
+
+
+def ndim(a):
+    return len(a.shape) if isinstance(a, NDArray) else onp.ndim(a)
+
+
+def size(a, axis=None):
+    if isinstance(a, NDArray):
+        return a.shape[axis] if axis is not None else int(onp.prod(a.shape))
+    return onp.size(a, axis)
+
+
+# (`shape` deliberately NOT defined here — mx.np already exports it)
+dtype = onp.dtype
+finfo = onp.finfo
+iinfo = onp.iinfo
+
+
+# ---------------------------------------- legacy financial fns (host)
+# NumPy >= 1.20 moved these to numpy-financial; the reference's exported
+# surface still carries them, so the standard closed forms live here.
+def npv(rate, values):
+    v = onp.asarray(_as_host(values), dtype="float64")
+    return float((v / (1.0 + rate) ** onp.arange(v.size)).sum())
+
+
+def _as_host(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+def mirr(values, finance_rate, reinvest_rate):
+    v = onp.asarray(_as_host(values), dtype="float64")
+    n = v.size
+    pos, neg = onp.where(v > 0, v, 0.0), onp.where(v < 0, v, 0.0)
+    if not (pos.any() and neg.any()):
+        return float("nan")
+    fv = npv(reinvest_rate, pos) * (1 + reinvest_rate) ** (n - 1)
+    pv_ = npv(finance_rate, neg) * (1 + finance_rate)
+    return float((fv / -pv_) ** (1.0 / (n - 1)) - 1)
+
+
+def pv(rate, nper, pmt, fv=0, when=0):
+    when = {"end": 0, "begin": 1}.get(when, when)
+    if rate == 0:
+        return -(fv + pmt * nper)
+    tmp = (1 + rate) ** nper
+    return -(fv + pmt * (1 + rate * when) * (tmp - 1) / rate) / tmp
+
+
+def _pmt(rate, nper, pv_, fv=0, when=0):
+    if rate == 0:
+        return -(fv + pv_) / nper
+    tmp = (1 + rate) ** nper
+    return -(fv + pv_ * tmp) * rate / ((1 + rate * when) * (tmp - 1))
+
+
+def ppmt(rate, per, nper, pv_, fv=0, when=0):
+    when = {"end": 0, "begin": 1}.get(when, when)
+    total = _pmt(rate, nper, pv_, fv, when)
+    # interest part: remaining balance after per-1 periods times rate
+    bal = pv_ * (1 + rate) ** (per - 1) + \
+        total * (((1 + rate) ** (per - 1) - 1) / rate if rate else per - 1)
+    ipmt = -bal * rate
+    if when == 1:
+        ipmt = ipmt / (1 + rate)
+    return total - ipmt
+
+
+def rate(nper, pmt, pv_, fv, when=0, guess=0.1, tol=1e-6, maxiter=100):
+    """Newton iteration on the annuity identity (numpy-financial rate)."""
+    when = {"end": 0, "begin": 1}.get(when, when)
+    r = guess
+    for _ in range(maxiter):
+        t = (1 + r) ** nper
+        f = fv + pv_ * t + pmt * (1 + r * when) * (t - 1) / r
+        df = (nper * pv_ * (1 + r) ** (nper - 1)
+              + pmt * (when * (t - 1) / r
+                       + (1 + r * when) * (nper * (1 + r) ** (nper - 1) * r
+                                           - (t - 1)) / (r * r)))
+        step = f / df
+        r -= step
+        if abs(step) < tol:
+            return r
+    return float("nan")
+
+
+# -------------------------------------------------------- np constants
+NAN = NaN = float("nan")
+NINF = float("-inf")
+PINF = float("inf")
+NZERO = -0.0
+PZERO = 0.0
+bool = onp.bool_    # noqa: A001  (ref multiarray exports `bool`)
+bool_ = onp.bool_
+int8 = onp.int8     # scalar-type style matches the existing exports
+int16 = onp.int16
+float16 = onp.float16
+_NoValue = getattr(onp, "_NoValue", object())
+#: ref multiarray._STR_2_DTYPE_: dtype-string lookup used by array()
+_STR_2_DTYPE_ = {k: onp.dtype(k) for k in
+                 ("int8", "uint8", "int16", "int32", "int64", "float16",
+                  "float32", "float64", "bool")}
+__version__ = "1.0.0"

@@ -1,18 +1,28 @@
 """npx — mx.numpy_extension (ref python/mxnet/numpy_extension/):
-neural-net ops usable on mx.np arrays + np-mode switches."""
+neural-net ops usable on mx.np arrays + np-mode switches + the npx image
+and random sub-namespaces (ref _npx_* op registrations,
+src/operator/numpy/*, numpy_extension/random.py, utils.py)."""
 from __future__ import annotations
 
 from .. import ndarray as _nd
 from ..numpy import ndarray as np_ndarray, _apply_np, _to
-from ..util import set_np, reset_np, is_np_array, use_np
+from ..util import set_np, reset_np, is_np_array, is_np_shape, use_np
 from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
 
-__all__ = ["set_np", "reset_np", "is_np_array", "use_np", "cpu", "gpu", "tpu",
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "use_np",
+           "cpu", "gpu", "tpu",
            "num_gpus", "num_tpus", "current_context", "relu", "sigmoid",
            "softmax", "log_softmax", "activation", "batch_norm", "layer_norm",
            "fully_connected", "convolution", "pooling", "dropout", "one_hot",
            "pick", "topk", "embedding", "gamma", "reshape_like", "waitall",
-           "seed"]
+           "seed",
+           # round-5 breadth: the remaining _npx_* op registrations
+           "arange_like", "batch_dot", "batch_flatten", "cast",
+           "deconvolution", "erf", "erfinv", "gammaln", "gather_nd",
+           "leaky_relu", "multibox_detection", "multibox_prior",
+           "multibox_target", "rnn", "roi_pooling", "sequence_mask",
+           "shape_array", "slice", "smooth_l1", "save", "load",
+           "image", "random"]
 
 
 def _wrap(nd_fn):
@@ -54,7 +64,60 @@ gamma = _wrap(_nd.gamma)
 reshape_like = _wrap(_nd.reshape_like)
 waitall = _nd.waitall
 
+# remaining _npx_* op surface (ref src/operator contrib registrations)
+arange_like = _wrap(_nd.arange_like)
+batch_dot = _wrap(_nd.batch_dot)
+# _npx_batch_flatten keeps MXNet semantics (N, prod(rest)) — must NOT
+# route through nd.flatten, which delegates to the .flatten METHOD and
+# would pick up np_ndarray's numpy-ravel override
+batch_flatten = _wrap(lambda x: x.reshape((x.shape[0], -1)))
+cast = _wrap(_nd.cast)
+deconvolution = _wrap(_nd.Deconvolution)
+erf = _wrap(_nd.erf)
+erfinv = _wrap(_nd.erfinv)
+gammaln = _wrap(_nd.gammaln)
+gather_nd = _wrap(_nd.gather_nd)
+leaky_relu = _wrap(_nd.LeakyReLU)
+rnn = _wrap(_nd.RNN)
+roi_pooling = _wrap(_nd.ROIPooling)
+sequence_mask = _wrap(_nd.sequence_mask)
+shape_array = _wrap(_nd.shape_array)
+slice = _wrap(_nd.slice)   # noqa: A001  (ref _npx_slice)
+smooth_l1 = _wrap(_nd.smooth_l1)
+
+
+def _contrib_wrap(name):
+    from ..ndarray import contrib as _c
+    return _wrap(getattr(_c, name))
+
+
+multibox_prior = _contrib_wrap("MultiBoxPrior")
+multibox_target = _contrib_wrap("MultiBoxTarget")
+multibox_detection = _contrib_wrap("MultiBoxDetection")
+
+
+def save(file, arr):
+    """ref numpy_extension/utils.py save — np arrays to a .npz-style file."""
+    arrs = arr if isinstance(arr, (list, tuple, dict)) else [arr]
+    _nd.save(file, arrs)
+
+
+def load(file):
+    """ref numpy_extension/utils.py load — returns np-ndarray payloads."""
+    out = _nd.load(file)
+
+    def reclass(o):
+        o.__class__ = np_ndarray
+        return o
+    if isinstance(out, dict):
+        return {k: reclass(v) for k, v in out.items()}
+    return [reclass(v) for v in out]
+
 
 def seed(s):
     from ..ndarray import random as _r
     _r.seed(s)
+
+
+from . import image  # noqa: E402  (npx.image.* op namespace)
+from . import random  # noqa: E402  (npx.random: bernoulli/normal_n/uniform_n)

@@ -1,0 +1,183 @@
+"""npx.image — the reference's _npx__image_* op family
+(ref src/operator/image/image_random.cc, resize.cc, crop.cc; exposed as
+mx.npx.image.*). Operates on HWC (or NHWC-batched) mx.np arrays; the
+random_* variants draw from the framework PRNG stream so runs are
+reproducible under npx.random.seed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from ..numpy import ndarray as np_ndarray
+
+from ..ndarray.random import _next_key as _key
+
+__all__ = ["to_tensor", "normalize", "resize", "crop", "flip_left_right",
+           "flip_top_bottom", "random_flip_left_right",
+           "random_flip_top_bottom", "random_brightness", "random_contrast",
+           "random_saturation", "random_hue", "random_color_jitter",
+           "random_lighting", "adjust_lighting"]
+
+#: ITU-R BT.601 luma weights (the reference's saturation/gray path)
+_LUMA = (0.299, 0.587, 0.114)
+
+
+def _data(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _out(v):
+    return np_ndarray(v)
+
+
+def to_tensor(data):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (ref image_random.cc ToTensor);
+    batched NHWC → NCHW."""
+    x = _data(data).astype(jnp.float32) / 255.0
+    perm = (2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2)
+    return _out(x.transpose(perm))
+
+
+def normalize(data, mean=0.0, std=1.0):
+    """CHW (or NCHW) channel-wise (x - mean) / std (ref Normalize)."""
+    x = _data(data)
+    c = x.shape[0] if x.ndim == 3 else x.shape[1]
+    shp = (c, 1, 1) if x.ndim == 3 else (1, c, 1, 1)
+    m = jnp.asarray(mean, jnp.float32).reshape(-1)[:c].reshape(shp) \
+        if jnp.ndim(jnp.asarray(mean)) else jnp.asarray(mean)
+    s = jnp.asarray(std, jnp.float32).reshape(-1)[:c].reshape(shp) \
+        if jnp.ndim(jnp.asarray(std)) else jnp.asarray(std)
+    return _out((x - m) / s)
+
+
+def resize(data, size, keep_ratio=False, interp=1):
+    """HWC bilinear/nearest resize (ref resize.cc); size int or (w, h).
+    keep_ratio with an int size resizes the SHORTER edge to size and
+    scales the other proportionally (reference semantics)."""
+    x = _data(data)
+    if isinstance(size, int):
+        if keep_ratio:
+            h0, w0 = (x.shape[0], x.shape[1]) if x.ndim == 3 \
+                else (x.shape[1], x.shape[2])
+            if h0 <= w0:
+                h, w = size, max(1, round(w0 * size / h0))
+            else:
+                w, h = size, max(1, round(h0 * size / w0))
+        else:
+            w = h = size
+    else:
+        w, h = size
+    method = "nearest" if interp == 0 else "bilinear"
+    if x.ndim == 3:
+        out = jax.image.resize(x.astype(jnp.float32), (h, w, x.shape[2]),
+                               method)
+    else:
+        out = jax.image.resize(x.astype(jnp.float32),
+                               (x.shape[0], h, w, x.shape[3]), method)
+    return _out(out.astype(x.dtype) if x.dtype != jnp.float32 else out)
+
+
+def crop(data, x, y, width, height):
+    """HWC spatial crop at (x, y) (ref crop.cc)."""
+    a = _data(data)
+    if a.ndim == 3:
+        return _out(a[y:y + height, x:x + width, :])
+    return _out(a[:, y:y + height, x:x + width, :])
+
+
+def flip_left_right(data):
+    a = _data(data)
+    return _out(jnp.flip(a, axis=-2))
+
+
+def flip_top_bottom(data):
+    a = _data(data)
+    return _out(jnp.flip(a, axis=-3))
+
+
+def _bernoulli():
+    return jax.random.bernoulli(_key())
+
+
+def random_flip_left_right(data):
+    a = _data(data)
+    return _out(jnp.where(_bernoulli(), jnp.flip(a, axis=-2), a))
+
+
+def random_flip_top_bottom(data):
+    a = _data(data)
+    return _out(jnp.where(_bernoulli(), jnp.flip(a, axis=-3), a))
+
+
+def _unit_draw(lo, hi):
+    return jax.random.uniform(_key(), (), minval=lo, maxval=hi)
+
+
+def random_brightness(data, min_factor, max_factor):
+    a = _data(data).astype(jnp.float32)
+    return _out(a * _unit_draw(min_factor, max_factor))
+
+
+def random_contrast(data, min_factor, max_factor):
+    a = _data(data).astype(jnp.float32)
+    f = _unit_draw(min_factor, max_factor)
+    gray = (a * jnp.asarray(_LUMA)).sum(axis=-1, keepdims=True)
+    return _out(a * f + gray.mean(axis=(-3, -2), keepdims=True) * (1 - f))
+
+
+def random_saturation(data, min_factor, max_factor):
+    a = _data(data).astype(jnp.float32)
+    f = _unit_draw(min_factor, max_factor)
+    gray = (a * jnp.asarray(_LUMA)).sum(axis=-1, keepdims=True)
+    return _out(a * f + gray * (1 - f))
+
+
+def random_hue(data, min_factor, max_factor):
+    """YIQ-rotation hue jitter (ref image_random.cc RandomHue)."""
+    a = _data(data).astype(jnp.float32)
+    alpha = _unit_draw(min_factor, max_factor)
+    u, w = jnp.cos(alpha * jnp.pi), jnp.sin(alpha * jnp.pi)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]])
+    t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]])
+    one, zero = jnp.ones(()), jnp.zeros(())
+    rot = jnp.stack([jnp.stack([one, zero, zero]),
+                     jnp.stack([zero, u, -w]),
+                     jnp.stack([zero, w, u])])
+    m = t_rgb @ rot @ t_yiq
+    return _out(a @ m.T)
+
+
+def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0):
+    out = data
+    if brightness:
+        out = random_brightness(out, 1 - brightness, 1 + brightness)
+    if contrast:
+        out = random_contrast(out, 1 - contrast, 1 + contrast)
+    if saturation:
+        out = random_saturation(out, 1 - saturation, 1 + saturation)
+    if hue:
+        out = random_hue(out, -hue, hue)
+    return out
+
+
+def adjust_lighting(data, alpha):
+    """AlexNet-style PCA lighting with fixed eigen basis
+    (ref image_random.cc AdjustLighting)."""
+    a = _data(data).astype(jnp.float32)
+    eigval = jnp.asarray([55.46, 4.794, 1.148])
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]])
+    delta = eigvec @ (jnp.asarray(alpha) * eigval)
+    return _out(a + delta)
+
+
+def random_lighting(data, alpha_std=0.05):
+    alpha = alpha_std * jax.random.normal(_key(), (3,))
+    return adjust_lighting(data, alpha)

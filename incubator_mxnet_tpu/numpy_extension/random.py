@@ -1,0 +1,58 @@
+"""npx.random (ref python/mxnet/numpy_extension/random.py:
+seed / bernoulli / normal_n / uniform_n)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from ..ndarray.random import _next_key as _key
+from ..numpy import ndarray as np_ndarray
+
+__all__ = ["seed", "bernoulli", "normal_n", "uniform_n"]
+
+
+def seed(s):
+    from ..ndarray import random as _r
+    _r.seed(s)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype="float32", **kw):
+    """Draws with P(1) = prob, or sigmoid(logit) when given logits
+    (exactly one of prob/logit, as the reference enforces)."""
+    if (prob is None) == (logit is None):
+        raise ValueError("pass exactly one of prob / logit")
+    if prob is None:
+        prob = jax.nn.sigmoid(logit._data if isinstance(logit, NDArray)
+                              else jnp.asarray(logit))
+    elif isinstance(prob, NDArray):
+        prob = prob._data
+    shp = size if isinstance(size, tuple) else \
+        ((size,) if size is not None else jnp.shape(prob))
+    return np_ndarray(jax.random.bernoulli(_key(), prob, shp).astype(dtype))
+
+
+def _batch_shape(batch_shape):
+    if batch_shape is None:
+        return ()
+    return batch_shape if isinstance(batch_shape, tuple) else (batch_shape,)
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype="float32", **kw):
+    """ref npx.random.normal_n: batch_shape PREPENDS to the broadcast
+    param shape (n independent draws per parameter setting)."""
+    loc_ = loc._data if isinstance(loc, NDArray) else jnp.asarray(loc)
+    scale_ = scale._data if isinstance(scale, NDArray) else jnp.asarray(scale)
+    pshape = jnp.broadcast_shapes(jnp.shape(loc_), jnp.shape(scale_))
+    shp = _batch_shape(batch_shape) + pshape
+    return np_ndarray((loc_ + scale_ * jax.random.normal(_key(), shp))
+                      .astype(dtype))
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype="float32", **kw):
+    low_ = low._data if isinstance(low, NDArray) else jnp.asarray(low)
+    high_ = high._data if isinstance(high, NDArray) else jnp.asarray(high)
+    pshape = jnp.broadcast_shapes(jnp.shape(low_), jnp.shape(high_))
+    shp = _batch_shape(batch_shape) + pshape
+    u = jax.random.uniform(_key(), shp)
+    return np_ndarray((low_ + (high_ - low_) * u).astype(dtype))
