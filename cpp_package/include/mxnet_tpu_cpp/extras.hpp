@@ -1,0 +1,239 @@
+// mxnet_tpu_cpp — header-only C++ wrappers for the EXTENDED C-ABI tier
+// (ref cpp-package/include/mxnet-cpp kvstore.h over c_api.h MXKVStore*,
+// plus MXNDArraySave/Load, MXSymbolInferShape, MXProfile*, MXRandomSeed,
+// MXListAllOpNames).
+//
+//   using namespace mxnet_tpu_cpp;
+//   KVStore kv("local");
+//   kv.Init({3}, {weight});
+//   kv.Push({3}, {grad});
+//   kv.Pull({3}, {weight});
+//   SaveArrays("net.params", {"w"}, {weight});
+//   auto loaded = LoadArrays("net.params");
+//
+// Same zero-dependency dlopen pattern as graph.hpp (MXTPU_PREDICT_LIB).
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "graph.hpp"
+
+namespace mxnet_tpu_cpp {
+
+namespace extras_detail {
+
+struct Api {
+  void* so;
+  const char* (*GetLastError)();
+  int (*NDArraySave)(const char*, int, void**, const char**);
+  int (*NDArrayLoad)(const char*, void**, int*);
+  int (*NDArrayLoadName)(void*, int, char*, int, int64_t*);
+  int (*NDArrayLoadItem)(void*, int, void**);
+  int (*NDArrayLoadFree)(void*);
+  int (*SymbolCreateFromJSON)(const char*, void**);
+  int (*SymbolSaveToFile)(void*, const char*);
+  int (*SymbolInferShape)(void*, const char*, char*, int, int64_t*);
+  int (*KVStoreCreate)(const char*, void**);
+  int (*KVStoreFree)(void*);
+  int (*KVStoreGetType)(void*, char*, int, int64_t*);
+  int (*KVStoreGetRank)(void*, int*);
+  int (*KVStoreGetGroupSize)(void*, int*);
+  int (*KVStoreInit)(void*, int, const int*, void**);
+  int (*KVStorePush)(void*, int, const int*, void**, int);
+  int (*KVStorePull)(void*, int, const int*, void**);
+  int (*ProfilerSetState)(const char*);
+  int (*RandomSeed)(int);
+  int (*ListAllOpNames)(char*, int, int64_t*);
+
+  template <typename T>
+  void Sym(T& fn, const char* name) {
+    fn = reinterpret_cast<T>(dlsym(so, name));
+    if (!fn)
+      throw std::runtime_error(std::string("missing symbol ") + name);
+  }
+
+  static Api& Get() {
+    static Api api = Load();
+    return api;
+  }
+
+  static Api Load() {
+    Api a;
+    const char* path = std::getenv("MXTPU_PREDICT_LIB");
+    a.so = dlopen(path ? path : "libmxtpu_predict.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!a.so)
+      throw std::runtime_error(std::string("dlopen failed: ") + dlerror());
+    a.Sym(a.GetLastError, "MXTPUNDGetLastError");
+    a.Sym(a.NDArraySave, "MXTPUNDArraySave");
+    a.Sym(a.NDArrayLoad, "MXTPUNDArrayLoad");
+    a.Sym(a.NDArrayLoadName, "MXTPUNDArrayLoadName");
+    a.Sym(a.NDArrayLoadItem, "MXTPUNDArrayLoadItem");
+    a.Sym(a.NDArrayLoadFree, "MXTPUNDArrayLoadFree");
+    a.Sym(a.SymbolCreateFromJSON, "MXTPUSymbolCreateFromJSON");
+    a.Sym(a.SymbolSaveToFile, "MXTPUSymbolSaveToFile");
+    a.Sym(a.SymbolInferShape, "MXTPUSymbolInferShape");
+    a.Sym(a.KVStoreCreate, "MXTPUKVStoreCreate");
+    a.Sym(a.KVStoreFree, "MXTPUKVStoreFree");
+    a.Sym(a.KVStoreGetType, "MXTPUKVStoreGetType");
+    a.Sym(a.KVStoreGetRank, "MXTPUKVStoreGetRank");
+    a.Sym(a.KVStoreGetGroupSize, "MXTPUKVStoreGetGroupSize");
+    a.Sym(a.KVStoreInit, "MXTPUKVStoreInit");
+    a.Sym(a.KVStorePush, "MXTPUKVStorePush");
+    a.Sym(a.KVStorePull, "MXTPUKVStorePull");
+    a.Sym(a.ProfilerSetState, "MXTPUProfilerSetState");
+    a.Sym(a.RandomSeed, "MXTPURandomSeed");
+    a.Sym(a.ListAllOpNames, "MXTPUListAllOpNames");
+    return a;
+  }
+};
+
+inline void Check(int rc) {
+  if (rc != 0)
+    throw std::runtime_error(Api::Get().GetLastError());
+}
+
+// probe-then-fetch handshake for any string-out ABI call (the one place
+// the needed/NUL/resize sequence lives — graph.hpp's Symbol::Str_ analog)
+template <typename Fn, typename... Args>
+std::string StrOut(Fn fn, Args... args) {
+  int64_t needed = 0;
+  Check(fn(args..., nullptr, 0, &needed));
+  std::string out(static_cast<size_t>(needed), '\0');
+  Check(fn(args..., &out[0], static_cast<int>(needed), &needed));
+  out.resize(out.find('\0'));
+  return out;
+}
+
+}  // namespace extras_detail
+
+// ------------------------------------------------------------- KVStore
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    extras_detail::Check(
+        extras_detail::Api::Get().KVStoreCreate(type.c_str(), &handle_));
+  }
+  ~KVStore() {
+    if (handle_) extras_detail::Api::Get().KVStoreFree(handle_);
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+
+  std::string Type() {
+    return extras_detail::StrOut(extras_detail::Api::Get().KVStoreGetType,
+                                 handle_);
+  }
+
+  int Rank() {
+    int r = 0;
+    extras_detail::Check(
+        extras_detail::Api::Get().KVStoreGetRank(handle_, &r));
+    return r;
+  }
+
+  int NumWorkers() {
+    int n = 0;
+    extras_detail::Check(
+        extras_detail::Api::Get().KVStoreGetGroupSize(handle_, &n));
+    return n;
+  }
+
+  // NDArray is move-only, so batched calls take pointers:
+  //   kv.Push({3}, {&grad});
+  void Init(const std::vector<int>& keys,
+            const std::vector<const NDArray*>& vals) {
+    auto hs = Handles(vals);
+    extras_detail::Check(extras_detail::Api::Get().KVStoreInit(
+        handle_, static_cast<int>(keys.size()), keys.data(), hs.data()));
+  }
+
+  void Push(const std::vector<int>& keys,
+            const std::vector<const NDArray*>& vals, int priority = 0) {
+    auto hs = Handles(vals);
+    extras_detail::Check(extras_detail::Api::Get().KVStorePush(
+        handle_, static_cast<int>(keys.size()), keys.data(), hs.data(),
+        priority));
+  }
+
+  void Pull(const std::vector<int>& keys,
+            const std::vector<const NDArray*>& outs) {
+    auto hs = Handles(outs);
+    extras_detail::Check(extras_detail::Api::Get().KVStorePull(
+        handle_, static_cast<int>(keys.size()), keys.data(), hs.data()));
+  }
+
+ private:
+  static std::vector<void*> Handles(const std::vector<const NDArray*>& arrs) {
+    std::vector<void*> hs;
+    hs.reserve(arrs.size());
+    for (const auto* a : arrs) hs.push_back(a->handle());
+    return hs;
+  }
+  void* handle_ = nullptr;
+};
+
+// ------------------------------------------------- NDArray file round-trip
+inline void SaveArrays(const std::string& fname,
+                       const std::vector<std::string>& names,
+                       const std::vector<const NDArray*>& arrays) {
+  std::vector<const char*> cn;
+  std::vector<void*> hs;
+  for (const auto& n : names) cn.push_back(n.c_str());
+  for (const auto* a : arrays) hs.push_back(a->handle());
+  extras_detail::Check(extras_detail::Api::Get().NDArraySave(
+      fname.c_str(), static_cast<int>(arrays.size()), hs.data(), cn.data()));
+}
+
+inline std::vector<std::pair<std::string, NDArray>> LoadArrays(
+    const std::string& fname) {
+  auto& api = extras_detail::Api::Get();
+  void* bundle = nullptr;
+  int count = 0;
+  extras_detail::Check(api.NDArrayLoad(fname.c_str(), &bundle, &count));
+  std::vector<std::pair<std::string, NDArray>> out;
+  for (int i = 0; i < count; ++i) {
+    std::string name = extras_detail::StrOut(api.NDArrayLoadName, bundle, i);
+    void* item = nullptr;
+    extras_detail::Check(api.NDArrayLoadItem(bundle, i, &item));
+    out.emplace_back(name, NDArray(item));
+  }
+  api.NDArrayLoadFree(bundle);
+  return out;
+}
+
+// ------------------------------------------------------ Symbol file io
+inline Symbol SymbolFromJSON(const std::string& json_str) {
+  void* h = nullptr;
+  extras_detail::Check(
+      extras_detail::Api::Get().SymbolCreateFromJSON(json_str.c_str(), &h));
+  return Symbol::FromHandle(h);
+}
+
+inline void SaveSymbol(const Symbol& sym, const std::string& fname) {
+  extras_detail::Check(
+      extras_detail::Api::Get().SymbolSaveToFile(sym.handle(),
+                                                 fname.c_str()));
+}
+
+// -------------------------------------------------------- misc wrappers
+inline std::string InferShapeJSON(const Symbol& sym,
+                                  const std::string& shapes_json) {
+  return extras_detail::StrOut(extras_detail::Api::Get().SymbolInferShape,
+                               sym.handle(), shapes_json.c_str());
+}
+
+inline void RandomSeed(int seed) {
+  extras_detail::Check(extras_detail::Api::Get().RandomSeed(seed));
+}
+
+inline std::string ListAllOpNamesJSON() {
+  return extras_detail::StrOut(extras_detail::Api::Get().ListAllOpNames);
+}
+
+}  // namespace mxnet_tpu_cpp

@@ -212,6 +212,11 @@ class Symbol {
   Executor SimpleBind(const std::string& shapes_json,
                       const std::string& grad_req) const;
 
+  // raw ABI handle + adoption — the extras.hpp tier (kvstore, file io,
+  // infer-shape) moves Symbols across the same C surface
+  void* handle() const { return h_; }
+  static Symbol FromHandle(void* owned) { return Symbol(owned); }
+
   Symbol(Symbol&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
   Symbol& operator=(Symbol&& o) noexcept {
     std::swap(h_, o.h_);

@@ -613,11 +613,13 @@ PyObject* graph_module() {
 }
 
 // STEALS the args reference (every call site passes a fresh
-// Py_BuildValue tuple; decref here keeps the 13 call sites leak-free —
-// same contract as call_bool above).
-PyObject* call_graph(const char* fn, PyObject* args) {
+// Py_BuildValue tuple; decref here keeps the call sites leak-free —
+// same contract as call_bool above). Shared by the graph and extended
+// tiers; `modget` is the cached-import accessor for the target module.
+PyObject* call_stealing(PyObject* (*modget)(), const char* fn,
+                        PyObject* args) {
   if (!args) return nullptr;
-  PyObject* mod = graph_module();
+  PyObject* mod = modget();
   if (!mod) {
     Py_DECREF(args);
     return nullptr;
@@ -631,6 +633,10 @@ PyObject* call_graph(const char* fn, PyObject* args) {
   Py_DECREF(f);
   Py_DECREF(args);
   return r;
+}
+
+PyObject* call_graph(const char* fn, PyObject* args) {
+  return call_stealing(graph_module, fn, args);
 }
 
 struct SymHandle {
@@ -872,5 +878,419 @@ int MXTPUExecutorArgGrad(void* ex, const char* name, void** out) {
 }
 
 int MXTPUExecutorFree(void* handle) { return MXTPUSymbolFree(handle); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Extended tier (ref include/mxnet/c_api.h MXKVStore* (~30 fns), MXProfile*,
+// MXNDArraySave/Load, MXSymbolInferShape, MXListAllOpNames, MXRandomSeed,
+// MXLoadLib regions): kvstore init/push/pull/broadcast from C, profiler
+// control, NDArray file io, shape inference, op-registry listing, custom-op
+// library loading. Dispatch through native/_ext_embed.py; arrays ride the
+// existing ND ABI handles, symbols the graph-slice handles.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+PyObject* ext_module() {
+  static PyObject* mod = nullptr;
+  if (!mod)
+    mod = PyImport_ImportModule("incubator_mxnet_tpu.native._ext_embed");
+  return mod;
+}
+
+// STEALS args (delegates to the shared stealing-call helper).
+PyObject* call_ext(const char* fn, PyObject* args) {
+  return call_stealing(ext_module, fn, args);
+}
+
+// int keys -> new PyList
+PyObject* int_list(const int* keys, int n) {
+  PyObject* l = PyList_New(n);
+  if (!l) return nullptr;
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLong(keys[i]));
+  return l;
+}
+
+// ND handles -> new PyList of borrowed-then-increfed arrs
+PyObject* nd_list(void** handles, int n) {
+  PyObject* l = PyList_New(n);
+  if (!l) return nullptr;
+  for (int i = 0; i < n; ++i) {
+    PyObject* a = static_cast<NDHandle*>(handles[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(l, i, a);
+  }
+  return l;
+}
+
+int call_ext_void(const char* fn, PyObject* args, const char* where) {
+  PyObject* r = call_ext(fn, args);
+  if (!r) return fail_py(where);
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------- NDArray save/load
+// ≙ MXNDArraySave (names may be NULL / empty strings for a positional list)
+int MXTPUNDArraySave(const char* fname, int n, void** nd_handles,
+                     const char** names) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* kl = PyList_New(n);
+  PyObject* al = nd_list(nd_handles, n);
+  if (!kl || !al) {
+    Py_XDECREF(kl);
+    Py_XDECREF(al);
+    return fail_py("MXTPUNDArraySave");
+  }
+  for (int i = 0; i < n; ++i) {
+    PyObject* s = PyUnicode_FromString(names && names[i] ? names[i] : "");
+    if (!s) {  // invalid UTF-8 etc. — error out, never store a NULL slot
+      Py_DECREF(kl);
+      Py_DECREF(al);
+      return fail_py("MXTPUNDArraySave");
+    }
+    PyList_SET_ITEM(kl, i, s);
+  }
+  PyObject* tup = Py_BuildValue("(sNN)", fname, kl, al);
+  if (!tup) {
+    Py_DECREF(kl);
+    Py_DECREF(al);
+    return fail_py("MXTPUNDArraySave");
+  }
+  return call_ext_void("nd_save", tup, "MXTPUNDArraySave");
+}
+
+// ≙ MXNDArrayLoad: returns an opaque bundle; read items out, then free it.
+int MXTPUNDArrayLoad(const char* fname, void** out_bundle, int* out_count) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_ext("nd_load_bundle", Py_BuildValue("(s)", fname));
+  if (!r) return fail_py("MXTPUNDArrayLoad");
+  PyObject* n = call_ext("bundle_len", Py_BuildValue("(O)", r));
+  if (!n) {
+    Py_DECREF(r);
+    return fail_py("MXTPUNDArrayLoad");
+  }
+  *out_count = (int)PyLong_AsLong(n);
+  Py_DECREF(n);
+  *out_bundle = new SymHandle{r};  // opaque PyObject carrier
+  return 0;
+}
+
+// name of item i (empty string for positional lists)
+int MXTPUNDArrayLoadName(void* bundle, int index, char* buf, int cap,
+                         int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(bundle);
+  PyObject* r = call_ext("bundle_name", Py_BuildValue("(Oi)", h->obj, index));
+  if (!r) return fail_py("MXTPUNDArrayLoadName");
+  return str_out(r, buf, cap, needed, "MXTPUNDArrayLoadName");
+}
+
+// item i as a NEW ND handle usable with the whole ND ABI
+int MXTPUNDArrayLoadItem(void* bundle, int index, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(bundle);
+  PyObject* r = call_ext("bundle_item", Py_BuildValue("(Oi)", h->obj, index));
+  if (!r) return fail_py("MXTPUNDArrayLoadItem");
+  *out = new NDHandle{r};
+  return 0;
+}
+
+int MXTPUNDArrayLoadFree(void* bundle) { return MXTPUSymbolFree(bundle); }
+
+// ------------------------------------------------------------------ Symbol
+// ≙ MXSymbolCreateFromJSON
+int MXTPUSymbolCreateFromJSON(const char* json_str, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_ext("sym_from_json", Py_BuildValue("(s)", json_str));
+  if (!r) return fail_py("MXTPUSymbolCreateFromJSON");
+  *out = new SymHandle{r};
+  return 0;
+}
+
+// ≙ MXSymbolSaveToFile
+int MXTPUSymbolSaveToFile(void* sym, const char* fname) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(sym);
+  return call_ext_void("sym_save_file",
+                       Py_BuildValue("(Os)", h->obj, fname),
+                       "MXTPUSymbolSaveToFile");
+}
+
+// ≙ MXSymbolListAuxiliaryStates (JSON list out)
+int MXTPUSymbolListAuxiliaryStates(void* sym, char* buf, int cap,
+                                   int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(sym);
+  PyObject* r = call_ext("sym_list_aux", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUSymbolListAuxiliaryStates");
+  return str_out(r, buf, cap, needed, "MXTPUSymbolListAuxiliaryStates");
+}
+
+// ≙ MXSymbolInferShape: shapes_json {"name": [dims]} in; JSON
+// {"arg_shapes": [...], "out_shapes": [...], "aux_shapes": [...]} out.
+int MXTPUSymbolInferShape(void* sym, const char* shapes_json, char* buf,
+                          int cap, int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(sym);
+  PyObject* r = call_ext("sym_infer_shape",
+                         Py_BuildValue("(Os)", h->obj, shapes_json));
+  if (!r) return fail_py("MXTPUSymbolInferShape");
+  return str_out(r, buf, cap, needed, "MXTPUSymbolInferShape");
+}
+
+// ≙ MXSymbolGetAttr / MXSymbolSetAttr
+int MXTPUSymbolGetAttr(void* sym, const char* key, char* buf, int cap,
+                       int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(sym);
+  PyObject* r = call_ext("sym_get_attr", Py_BuildValue("(Os)", h->obj, key));
+  if (!r) return fail_py("MXTPUSymbolGetAttr");
+  return str_out(r, buf, cap, needed, "MXTPUSymbolGetAttr");
+}
+
+int MXTPUSymbolSetAttr(void* sym, const char* key, const char* value) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(sym);
+  return call_ext_void("sym_set_attr",
+                       Py_BuildValue("(Oss)", h->obj, key, value),
+                       "MXTPUSymbolSetAttr");
+}
+
+// ----------------------------------------------------------------- KVStore
+// ≙ MXKVStoreCreate / MXKVStoreFree / MXKVStoreGetType / MXKVStoreGetRank /
+//   MXKVStoreGetGroupSize
+int MXTPUKVStoreCreate(const char* type, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_ext("kv_create", Py_BuildValue("(s)", type));
+  if (!r) return fail_py("MXTPUKVStoreCreate");
+  *out = new SymHandle{r};
+  return 0;
+}
+
+int MXTPUKVStoreFree(void* kv) { return MXTPUSymbolFree(kv); }
+
+int MXTPUKVStoreGetType(void* kv, char* buf, int cap, int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(kv);
+  PyObject* r = call_ext("kv_type", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUKVStoreGetType");
+  return str_out(r, buf, cap, needed, "MXTPUKVStoreGetType");
+}
+
+int MXTPUKVStoreGetRank(void* kv, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(kv);
+  PyObject* r = call_ext("kv_rank", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUKVStoreGetRank");
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUKVStoreGetGroupSize(void* kv, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(kv);
+  PyObject* r = call_ext("kv_num_workers", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("MXTPUKVStoreGetGroupSize");
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+// shared body for init/push/pull-style (kv, keys, arrays) calls
+int kv_keys_arrays(const char* fn, const char* where, void* kv, int n,
+                   const int* keys, void** nd_handles, PyObject* extra) {
+  auto* h = static_cast<SymHandle*>(kv);
+  PyObject* kl = int_list(keys, n);
+  PyObject* al = nd_list(nd_handles, n);
+  if (!kl || !al) {
+    Py_XDECREF(kl);
+    Py_XDECREF(al);
+    Py_XDECREF(extra);
+    return fail_py(where);
+  }
+  PyObject* tup = extra ? Py_BuildValue("(ONNN)", h->obj, kl, al, extra)
+                        : Py_BuildValue("(ONN)", h->obj, kl, al);
+  if (!tup) {
+    Py_DECREF(kl);
+    Py_DECREF(al);
+    Py_XDECREF(extra);
+    return fail_py(where);
+  }
+  return call_ext_void(fn, tup, where);
+}
+
+}  // namespace
+
+// ≙ MXKVStoreInit / MXKVStorePush / MXKVStorePull (int keys)
+int MXTPUKVStoreInit(void* kv, int n, const int* keys, void** nd_handles) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return kv_keys_arrays("kv_init", "MXTPUKVStoreInit", kv, n, keys,
+                        nd_handles, nullptr);
+}
+
+int MXTPUKVStorePush(void* kv, int n, const int* keys, void** nd_handles,
+                     int priority) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return kv_keys_arrays("kv_push", "MXTPUKVStorePush", kv, n, keys,
+                        nd_handles, PyLong_FromLong(priority));
+}
+
+// pull writes INTO the passed handles (their buffers are rebound)
+int MXTPUKVStorePull(void* kv, int n, const int* keys, void** nd_handles) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return kv_keys_arrays("kv_pull", "MXTPUKVStorePull", kv, n, keys,
+                        nd_handles, nullptr);
+}
+
+namespace {
+
+// shared body for (kv, keys, values, outs) two-list calls
+int kv_keys_two_lists(const char* fn, const char* where, void* kv, int n,
+                      const int* keys, void** values, void** outs) {
+  auto* h = static_cast<SymHandle*>(kv);
+  PyObject* kl = int_list(keys, n);
+  PyObject* vl = nd_list(values, n);
+  PyObject* ol = nd_list(outs, n);
+  if (!kl || !vl || !ol) {
+    Py_XDECREF(kl);
+    Py_XDECREF(vl);
+    Py_XDECREF(ol);
+    return fail_py(where);
+  }
+  PyObject* tup = Py_BuildValue("(ONNN)", h->obj, kl, vl, ol);
+  if (!tup) {
+    Py_DECREF(kl);
+    Py_DECREF(vl);
+    Py_DECREF(ol);
+    return fail_py(where);
+  }
+  return call_ext_void(fn, tup, where);
+}
+
+}  // namespace
+
+// ≙ MXKVStorePushPull: values pushed, outs pulled, one call
+int MXTPUKVStorePushPull(void* kv, int n, const int* keys, void** values,
+                         void** outs) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return kv_keys_two_lists("kv_pushpull", "MXTPUKVStorePushPull", kv, n,
+                           keys, values, outs);
+}
+
+// ≙ MXKVStoreBroadcast
+int MXTPUKVStoreBroadcast(void* kv, int n, const int* keys, void** values,
+                          void** outs) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return kv_keys_two_lists("kv_broadcast", "MXTPUKVStoreBroadcast", kv, n,
+                           keys, values, outs);
+}
+
+// ≙ MXKVStoreSetGradientCompression (params as JSON object string)
+int MXTPUKVStoreSetGradientCompression(void* kv, const char* params_json) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  auto* h = static_cast<SymHandle*>(kv);
+  return call_ext_void("kv_set_compression",
+                       Py_BuildValue("(Os)", h->obj, params_json),
+                       "MXTPUKVStoreSetGradientCompression");
+}
+
+// ---------------------------------------------------------------- Profiler
+// ≙ MXSetProcessProfilerConfig (kwargs as JSON object string)
+int MXTPUProfilerSetConfig(const char* params_json) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_ext_void("profiler_set_config",
+                       Py_BuildValue("(s)", params_json),
+                       "MXTPUProfilerSetConfig");
+}
+
+// ≙ MXSetProcessProfilerState ("run"/"stop")
+int MXTPUProfilerSetState(const char* state) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_ext_void("profiler_set_state", Py_BuildValue("(s)", state),
+                       "MXTPUProfilerSetState");
+}
+
+// ≙ MXDumpProcessProfile
+int MXTPUProfilerDump(int finished) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_ext_void("profiler_dump", Py_BuildValue("(i)", finished),
+                       "MXTPUProfilerDump");
+}
+
+// ≙ MXAggregateProfileStatsPrint (table string out)
+int MXTPUProfilerGetSummary(char* buf, int cap, int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_ext("profiler_summary", Py_BuildValue("()"));
+  if (!r) return fail_py("MXTPUProfilerGetSummary");
+  return str_out(r, buf, cap, needed, "MXTPUProfilerGetSummary");
+}
+
+// -------------------------------------------------------------------- misc
+// ≙ MXRandomSeed
+int MXTPURandomSeed(int seed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_ext_void("random_seed", Py_BuildValue("(i)", seed),
+                       "MXTPURandomSeed");
+}
+
+// ≙ MXListAllOpNames (JSON list out)
+int MXTPUListAllOpNames(char* buf, int cap, int64_t* needed) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  PyObject* r = call_ext("list_all_op_names", Py_BuildValue("()"));
+  if (!r) return fail_py("MXTPUListAllOpNames");
+  return str_out(r, buf, cap, needed, "MXTPUListAllOpNames");
+}
+
+// ≙ MXLoadLib: register a user custom-op extension library/module
+int MXTPULoadLib(const char* path) {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_ext_void("load_lib", Py_BuildValue("(s)", path),
+                       "MXTPULoadLib");
+}
+
+// ≙ MXNDArrayWaitAll
+int MXTPUNDArrayWaitAll() {
+  Gil gil;
+  if (!gil.ok) return fail("python init failed: " + g_init_err);
+  return call_ext_void("wait_all", Py_BuildValue("()"),
+                       "MXTPUNDArrayWaitAll");
+}
 
 }  // extern "C"

@@ -1,0 +1,212 @@
+"""Extended C-ABI tier end-to-end through ctypes (ref include/mxnet/c_api.h
+MXKVStore*, MXProfile*, MXNDArraySave/Load, MXSymbolInferShape,
+MXListAllOpNames, MXRandomSeed, MXNDArrayWaitAll regions).
+
+Every function added by the round-5 breadth pass is exercised here
+in-process (the embedded library detects the live interpreter), the same
+harness style as tests/test_cpp_package.py's ABI layer.
+"""
+import ctypes as c
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.native import lib as native_lib
+
+
+@pytest.fixture(scope="module")
+def lib():
+    try:
+        so = native_lib.build_predict()
+    except Exception as e:
+        pytest.skip("cannot build libmxtpu_predict.so: %s" % e)
+    lib = c.CDLL(so)
+    lib.MXTPUPredGetLastError.restype = c.c_char_p
+    return lib
+
+
+def check(lib, rc):
+    assert rc == 0, lib.MXTPUPredGetLastError().decode()
+
+
+def _nd_handle(lib, arr):
+    """Create an ABI-side NDArray handle from a numpy array."""
+    a = onp.ascontiguousarray(arr, dtype=onp.float32)
+    shape = (c.c_int64 * a.ndim)(*a.shape)
+    h = c.c_void_p()
+    check(lib, lib.MXTPUNDCreate(b"float32", shape, a.ndim,
+                                 a.ctypes.data_as(c.c_void_p),
+                                 c.c_int64(a.nbytes), c.byref(h)))
+    return h
+
+
+def _nd_numpy(lib, h, size):
+    buf = (c.c_float * size)()
+    n = c.c_int64()
+    check(lib, lib.MXTPUNDGetData(h, buf, c.c_int64(size * 4), c.byref(n)))
+    return onp.frombuffer(buf, dtype=onp.float32, count=size).copy()
+
+
+def _str_out(lib, fn, *args):
+    needed = c.c_int64()
+    check(lib, fn(*args, None, 0, c.byref(needed)))
+    buf = c.create_string_buffer(needed.value)
+    check(lib, fn(*args, buf, needed.value, c.byref(needed)))
+    return buf.value.decode()
+
+
+def test_ndarray_save_load(lib, tmp_path):
+    fname = str(tmp_path / "arrays.params").encode()
+    a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    b = onp.ones((4,), dtype=onp.float32) * 7
+    ha, hb = _nd_handle(lib, a), _nd_handle(lib, b)
+    names = (c.c_char_p * 2)(b"weight", b"bias")
+    handles = (c.c_void_p * 2)(ha, hb)
+    check(lib, lib.MXTPUNDArraySave(fname, 2, handles, names))
+
+    bundle = c.c_void_p()
+    count = c.c_int()
+    check(lib, lib.MXTPUNDArrayLoad(fname, c.byref(bundle), c.byref(count)))
+    assert count.value == 2
+    got = {}
+    for i in range(2):
+        name = _str_out(lib, lib.MXTPUNDArrayLoadName, bundle, i)
+        item = c.c_void_p()
+        check(lib, lib.MXTPUNDArrayLoadItem(bundle, i, c.byref(item)))
+        nd_ndim = c.c_int()
+        shp = (c.c_int64 * 8)()
+        check(lib, lib.MXTPUNDGetShape(item, shp, 8, c.byref(nd_ndim)))
+        size = int(onp.prod([shp[j] for j in range(nd_ndim.value)]))
+        got[name] = _nd_numpy(lib, item, size)
+        check(lib, lib.MXTPUNDFree(item))
+    check(lib, lib.MXTPUNDArrayLoadFree(bundle))
+    onp.testing.assert_array_equal(got["weight"], a.ravel())
+    onp.testing.assert_array_equal(got["bias"], b)
+    check(lib, lib.MXTPUNDFree(ha))
+    check(lib, lib.MXTPUNDFree(hb))
+
+
+def test_symbol_json_inference_attrs(lib, tmp_path):
+    # build in python, round-trip through the ABI
+    x = mx.sym.var("data")
+    net = mx.sym.FullyConnected(x, num_hidden=8, name="fc")
+    js = net.tojson().encode()
+    h = c.c_void_p()
+    check(lib, lib.MXTPUSymbolCreateFromJSON(js, c.byref(h)))
+
+    args = json.loads(_str_out(lib, lib.MXTPUSymbolListArguments, h))
+    assert "data" in args and "fc_weight" in args
+
+    aux = json.loads(_str_out(lib, lib.MXTPUSymbolListAuxiliaryStates, h))
+    assert aux == []
+
+    shapes = json.dumps({"data": [4, 32], "fc_weight": [8, 32],
+                         "fc_bias": [8]}).encode()
+    inferred = json.loads(_str_out(lib, lib.MXTPUSymbolInferShape, h, shapes))
+    assert inferred["out_shapes"] == [[4, 8]]
+
+    check(lib, lib.MXTPUSymbolSetAttr(h, b"__lr_mult__", b"2.0"))
+    assert _str_out(lib, lib.MXTPUSymbolGetAttr, h, b"__lr_mult__") == "2.0"
+
+    fname = str(tmp_path / "net.json").encode()
+    check(lib, lib.MXTPUSymbolSaveToFile(h, fname))
+    reloaded = mx.sym.load(fname.decode())
+    assert "fc_weight" in reloaded.list_arguments()
+    check(lib, lib.MXTPUSymbolFree(h))
+
+
+def test_kvstore_roundtrip(lib):
+    kv = c.c_void_p()
+    check(lib, lib.MXTPUKVStoreCreate(b"local", c.byref(kv)))
+    assert _str_out(lib, lib.MXTPUKVStoreGetType, kv).startswith("local")
+    rank, size = c.c_int(), c.c_int()
+    check(lib, lib.MXTPUKVStoreGetRank(kv, c.byref(rank)))
+    check(lib, lib.MXTPUKVStoreGetGroupSize(kv, c.byref(size)))
+    assert rank.value == 0 and size.value == 1
+
+    a = onp.arange(4, dtype=onp.float32)
+    keys = (c.c_int * 1)(3)
+    init_h = _nd_handle(lib, a)
+    check(lib, lib.MXTPUKVStoreInit(kv, 1, keys, (c.c_void_p * 1)(init_h)))
+
+    push_h = _nd_handle(lib, 2 * a)
+    check(lib, lib.MXTPUKVStorePush(kv, 1, keys, (c.c_void_p * 1)(push_h), 0))
+
+    out_h = _nd_handle(lib, onp.zeros_like(a))
+    check(lib, lib.MXTPUKVStorePull(kv, 1, keys, (c.c_void_p * 1)(out_h)))
+    onp.testing.assert_array_equal(_nd_numpy(lib, out_h, 4), 2 * a)
+
+    # pushpull + broadcast single-call forms
+    v_h = _nd_handle(lib, 3 * a)
+    o_h = _nd_handle(lib, onp.zeros_like(a))
+    check(lib, lib.MXTPUKVStorePushPull(kv, 1, keys, (c.c_void_p * 1)(v_h),
+                                        (c.c_void_p * 1)(o_h)))
+    onp.testing.assert_array_equal(_nd_numpy(lib, o_h, 4), 3 * a)
+
+    b_h = _nd_handle(lib, 5 * a)
+    bo_h = _nd_handle(lib, onp.zeros_like(a))
+    keys2 = (c.c_int * 1)(9)
+    check(lib, lib.MXTPUKVStoreBroadcast(kv, 1, keys2, (c.c_void_p * 1)(b_h),
+                                         (c.c_void_p * 1)(bo_h)))
+    onp.testing.assert_array_equal(_nd_numpy(lib, bo_h, 4), 5 * a)
+
+    check(lib, lib.MXTPUKVStoreSetGradientCompression(
+        kv, json.dumps({"type": "2bit", "threshold": 0.5}).encode()))
+
+    for h in (init_h, push_h, out_h, v_h, o_h, b_h, bo_h):
+        check(lib, lib.MXTPUNDFree(h))
+    check(lib, lib.MXTPUKVStoreFree(kv))
+
+
+def test_profiler_and_misc(lib, tmp_path):
+    trace = str(tmp_path / "trace.json")
+    check(lib, lib.MXTPUProfilerSetConfig(
+        json.dumps({"filename": trace, "aggregate_stats": True}).encode()))
+    check(lib, lib.MXTPUProfilerSetState(b"run"))
+    check(lib, lib.MXTPURandomSeed(7))
+    x = nd.random.uniform(shape=(8, 8))
+    (x @ x).asnumpy() if hasattr(nd.NDArray, "__matmul__") else \
+        nd.dot(x, x).asnumpy()
+    check(lib, lib.MXTPUNDArrayWaitAll())
+    check(lib, lib.MXTPUProfilerSetState(b"stop"))
+    summary = _str_out(lib, lib.MXTPUProfilerGetSummary)
+    assert isinstance(summary, str)
+    check(lib, lib.MXTPUProfilerDump(1))
+    assert os.path.exists(trace)
+
+    ops = json.loads(_str_out(lib, lib.MXTPUListAllOpNames))
+    assert len(ops) >= 250 and "Convolution" in ops
+
+
+def test_loadlib_registers_custom_op(lib, tmp_path):
+    ext = tmp_path / "my_ext.py"
+    ext.write_text(
+        "from incubator_mxnet_tpu import operator as op\n"
+        "class _ScaleProp(op.CustomOpProp):\n"
+        "    def __init__(self, scale=2.0):\n"
+        "        super().__init__(need_top_grad=True)\n"
+        "        self.scale = float(scale)\n"
+        "    def list_arguments(self):\n"
+        "        return ['data']\n"
+        "    def infer_shape(self, in_shape):\n"
+        "        return in_shape, [in_shape[0]], []\n"
+        "    def create_operator(self, ctx, shapes, dtypes):\n"
+        "        prop = self\n"
+        "        class _Scale(op.CustomOp):\n"
+        "            def forward(self, is_train, req, in_data, out_data,"
+        " aux):\n"
+        "                self.assign(out_data[0], req[0],"
+        " in_data[0] * prop.scale)\n"
+        "            def backward(self, req, out_grad, in_data, out_data,"
+        " in_grad, aux):\n"
+        "                self.assign(in_grad[0], req[0],"
+        " out_grad[0] * prop.scale)\n"
+        "        return _Scale()\n"
+        "op.register('abi_ext_scale')(_ScaleProp)\n")
+    check(lib, lib.MXTPULoadLib(str(ext).encode()))
+    out = nd.Custom(nd.array([1.0, 2.0]), op_type="abi_ext_scale")
+    onp.testing.assert_allclose(out.asnumpy(), [2.0, 4.0])

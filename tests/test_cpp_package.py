@@ -144,3 +144,29 @@ def test_cpp_graph_train_mlp(tmp_path):
     assert "CPP GRAPH TRAIN OK" in r.stdout, r.stdout
     # the composed symbol auto-created the layer weights (compose parity)
     assert "fc1_weight" in r.stdout and "fc2_bias" in r.stdout
+
+
+def test_cpp_ext_tier_binary(tmp_path):
+    """r5 extended tier (ref c_api.h MXKVStore*/MXNDArraySave/Load/
+    MXSymbolInferShape/MXListAllOpNames): a standalone C++ binary drives
+    kvstore init/push/pull, the NDArray file round-trip, symbol JSON
+    save/reload + shape inference, and the registry listing through
+    extras.hpp over the flat C ABI."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    so_path = _predict_lib()
+    exe = str(tmp_path / "kvstore_io")
+    src = os.path.join(ROOT, "cpp_package", "example", "kvstore_io.cc")
+    inc = os.path.join(ROOT, "cpp_package", "include")
+    subprocess.run(["g++", "-O2", "-std=c++17", src, "-I", inc, "-ldl",
+                    "-o", exe], check=True, capture_output=True)
+    env = dict(os.environ)
+    env["MXTPU_PREDICT_LIB"] = so_path
+    env["MXTPU_PYTHON"] = sys.executable
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([exe, str(tmp_path)], capture_output=True, text=True,
+                       env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "CPP EXT TIER OK" in r.stdout, r.stdout
+    assert os.path.exists(str(tmp_path / "cpp_kv_io.params"))
