@@ -197,11 +197,17 @@ inline std::vector<std::pair<std::string, NDArray>> LoadArrays(
   int count = 0;
   extras_detail::Check(api.NDArrayLoad(fname.c_str(), &bundle, &count));
   std::vector<std::pair<std::string, NDArray>> out;
-  for (int i = 0; i < count; ++i) {
-    std::string name = extras_detail::StrOut(api.NDArrayLoadName, bundle, i);
-    void* item = nullptr;
-    extras_detail::Check(api.NDArrayLoadItem(bundle, i, &item));
-    out.emplace_back(name, NDArray(item));
+  try {
+    for (int i = 0; i < count; ++i) {
+      std::string name =
+          extras_detail::StrOut(api.NDArrayLoadName, bundle, i);
+      void* item = nullptr;
+      extras_detail::Check(api.NDArrayLoadItem(bundle, i, &item));
+      out.emplace_back(name, NDArray(item));
+    }
+  } catch (...) {
+    api.NDArrayLoadFree(bundle);  // a throw mid-loop must not leak the file
+    throw;
   }
   api.NDArrayLoadFree(bundle);
   return out;

@@ -12,7 +12,10 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-NWORKERS = 2
+# 3 workers, matching the reference nightly's shape
+# (ref tests/nightly/dist_sync_kvstore.py:36-81: 3-worker sync/async
+# x {none, 2bit} compression x {dense, row_sparse})
+NWORKERS = 3
 
 
 def _free_port():
@@ -21,7 +24,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_dist_sync_two_processes():
+def test_dist_matrix_three_processes():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers use 1 CPU device each
     # the axon (TPU-tunnel) sitecustomize initialises the backend at
@@ -41,7 +44,7 @@ def test_dist_sync_two_processes():
     # workers share the stdout pipe, so lines may interleave — parse by regex
     # the tempered token stops a value at a glued "RESULT..." from another worker
     results = re.findall(r"RESULT (\w+) (\d+)(?: ((?:(?!RESULT)\S)+))?", out)
-    for check in ("pushpull", "compress", "spmd", "done"):
+    for check in ("pushpull", "compress", "spmd", "rowsparse_sync", "done"):
         ranks = {r for c, r, _ in results if c == check}
         assert len(ranks) == NWORKERS, (check, out)
 
@@ -78,3 +81,9 @@ def test_dist_sync_two_processes():
     assert len(mkv) == NWORKERS, out
     assert len(set(mkv.values())) == 1, \
         "Module update-on-kvstore diverged: %s" % mkv
+
+    # row_sparse x dist_async: every worker converged to the same average
+    rsa = {r: v for c, r, v in results if c == "rowsparse_async"}
+    assert len(rsa) == NWORKERS, out
+    assert len(set(rsa.values())) == 1, \
+        "dist_async row_sparse diverged after sync: %s" % rsa
