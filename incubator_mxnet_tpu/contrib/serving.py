@@ -12,9 +12,14 @@ ecosystem serves. The .mxtpu file this module writes is loadable:
 - from C/C++ without Python: the payload is a standard jax.export
   serialization whose StableHLO module (``export_mlir`` extracts it) is
   consumable by any PJRT plugin through the PJRT C API — the same contract
-  TF-Serving/IFRT production loaders use. This replaces c_predict_api.cc's
-  role; the operator registry needed by the reference's C loader does not
-  exist here by design (programs are self-contained).
+  TF-Serving/IFRT production loaders use. DEMONSTRATED by
+  ``tools/pjrt_serve.c`` (plain C, vendored ``pjrt_c_api.h``, dlopen
+  only), which compiles and executes the exported module on a real TPU
+  through the axon PJRT plugin with no Python in the serving process
+  (tests/test_serving.py::test_pjrt_c_serving, full tier). This replaces
+  c_predict_api.cc's role; the operator registry needed by the
+  reference's C loader does not exist here by design (programs are
+  self-contained).
 
 Format: 8-byte magic "MXTPU\\x00v1" + jax.export bytes.
 """
@@ -25,7 +30,8 @@ import jax
 from ..gluon import _functional
 from ..ndarray import NDArray
 
-__all__ = ["export_model", "load", "export_mlir", "ServedModel"]
+__all__ = ["export_model", "load", "export_mlir", "export_pjrt_bundle",
+           "ServedModel"]
 
 _MAGIC = b"MXTPU\x00v1"
 
@@ -66,6 +72,28 @@ def load(path):
 def export_mlir(path):
     """The artifact's StableHLO module text (feed to PJRT C API loaders)."""
     return load(path).mlir_module()
+
+
+def export_pjrt_bundle(artifact_path, out_dir):
+    """Materialize the Python-free serving bundle for tools/pjrt_serve.c:
+    ``module.mlir`` (the artifact's StableHLO) + ``compile_options.pb``
+    (a serialized single-replica CompileOptionsProto — the opaque options
+    blob PJRT_Client_Compile requires). After this one-time export step, a
+    plain-C loader runs the model against any PJRT plugin with no Python
+    anywhere in the serving process (ref c_predict_api.cc deployment)."""
+    import os
+
+    from jax._src import compiler as _compiler
+
+    os.makedirs(out_dir, exist_ok=True)
+    mlir_path = os.path.join(out_dir, "module.mlir")
+    with open(mlir_path, "w") as f:
+        f.write(export_mlir(artifact_path))
+    opts = _compiler.get_compile_options(num_replicas=1, num_partitions=1)
+    opts_path = os.path.join(out_dir, "compile_options.pb")
+    with open(opts_path, "wb") as f:
+        f.write(opts.SerializeAsString())
+    return mlir_path, opts_path
 
 
 class ServedModel:
