@@ -173,6 +173,7 @@ def main():
     tok_s, bert_mfu = bench_transformer(peak)
     lc_tok_s = bench_long_context()
     int8_res = bench_int8()
+    int8_e2e = bench_quantized_inference()
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(img_s, 2),
@@ -203,6 +204,7 @@ def main():
                     "observed ratio",
         },
         "int8": int8_res,
+        "int8_e2e": int8_e2e,
     }))
 
 
@@ -278,6 +280,81 @@ def bench_long_context():
         del step, trainer, net, tokens, loss
         gc.collect()
     return out
+
+
+def bench_quantized_inference(batch=256, steps=20):
+    """End-to-end int8 ResNet-50 inference vs bf16 — the reference's actual
+    int8 deliverable (example/quantization/imagenet_inference.py: whole-model
+    quantized scoring, not a matmul microbench). quantize_net swaps every
+    Conv2D for a native s8xs8->s32 MXU conv and the Dense head for an int8
+    dot (contrib/quantization.py), calibrated minmax on one batch. Both legs
+    run as ONE compiled XLA program (jit.EvalStep)."""
+    import gc
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, jit
+    from incubator_mxnet_tpu.contrib import quantization as quant
+
+    FWD_FLOPS_PER_IMG = 8.2e9  # 4.1 GMACs x 2 FLOPs/MAC at 224^2
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    x = nd.random.normal(shape=(batch, 3, 224, 224)).astype("bfloat16")
+    net(x[:1])  # finalize deferred shapes before the compiled step
+
+    def once(step_fn, x):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step_fn(x)
+        out.asnumpy()  # one sync at the end
+        return (time.perf_counter() - t0) / steps
+
+    bf16_step = jit.EvalStep(net)
+    ref_logits = bf16_step(x).asnumpy()   # warm + capture
+
+    # calibration runs the un-hybridized net so the per-layer hooks fire;
+    # a small calib batch keeps the eager walk cheap
+    calib = x[:32]
+    qnet = quant.quantize_net(net, calib_data=[(calib,)],
+                              num_calib_batches=1)
+    gc.collect()
+    int8_step = jit.EvalStep(qnet)
+    q_logits = int8_step(x).asnumpy()
+
+    # contention-robust estimator (same rationale as bench_int8): the chip
+    # is time-shared and co-tenant wait only ever ADDS, so alternate the
+    # legs and take each leg's MIN over the pairs
+    pairs = [(once(bf16_step, x), once(int8_step, x)) for _ in range(4)]
+    bf16_img_s = batch / min(b for b, _ in pairs)
+    int8_img_s = batch / min(i for _, i in pairs)
+
+    a = ref_logits.astype(onp.float32).ravel()
+    b = q_logits.astype(onp.float32).ravel()
+    cos = float((a * b).sum() /
+                ((onp.linalg.norm(a) * onp.linalg.norm(b)) or 1.0))
+    agree = float((ref_logits.astype(onp.float32).argmax(1) ==
+                   q_logits.astype(onp.float32).argmax(1)).mean())
+    return {"metric": "resnet50_int8_inference_speedup_vs_bf16",
+            "value": round(int8_img_s / bf16_img_s, 2),
+            "bf16_img_s": round(bf16_img_s, 1),
+            "int8_img_s": round(int8_img_s, 1),
+            "bf16_tflops": round(bf16_img_s * FWD_FLOPS_PER_IMG / 1e12, 1),
+            "int8_tops": round(int8_img_s * FWD_FLOPS_PER_IMG / 1e12, 1),
+            "native_int8_conv": quant._native_int8_conv_supported(),
+            "logit_cos": round(cos, 4),
+            "argmax_agreement": round(agree, 3),
+            "batch": batch,
+            "note": "whole-model quantize_net(resnet50_v1) scoring: "
+                    "BN-folded int8 conv groups + V1 residual wrappers "
+                    "with int8 chained between layers (docs/PERF_INT8.md; "
+                    "profiled device step 11.5 ms int8 vs 14.9 unchained, "
+                    "7.8 vs 12.1 GB HBM). Legs alternate and report per-leg "
+                    "minima (shared chip); wall numbers include ~7 ms/step "
+                    "host+tunnel dispatch on both legs; logit_cos + argmax "
+                    "agreement vs the bf16 net are the numeric-sanity "
+                    "fields"}
 
 
 def bench_int8():

@@ -14,7 +14,40 @@ from ..ndarray import NDArray, _apply
 
 __all__ = ["quantize", "dequantize", "requantize", "calib_minmax", "calib_entropy",
            "quantize_model", "quantize_net", "QuantizedDense",
-           "QuantizedDenseBlock", "QuantizedConv2DBlock"]
+           "QuantizedDenseBlock", "QuantizedConv2DBlock", "QuantizedConvGroup"]
+
+
+_INT8_CONV_OK = None
+
+
+def _native_int8_conv_supported():
+    """Probe (once) whether the backend compiles s8 x s8 -> s32 convolution.
+    XLA's TPU and CPU backends do; a backend that rejects it routes
+    QuantizedConv2DBlock to the QDQ fallback instead of failing at
+    inference time. MXTPU_INT8_SIM=1 (the documented escape hatch the
+    quantized_* op family honors) forces the fp-simulated path here too —
+    checked per call, only the hardware probe is cached."""
+    from ..ndarray.contrib import _int8_native
+    if not _int8_native():
+        return False
+    global _INT8_CONV_OK
+    if _INT8_CONV_OK is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        try:
+            x = jnp.ones((1, 2, 4, 4), jnp.int8)
+            w = jnp.ones((2, 2, 3, 3), jnp.int8)
+            dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+            out = jax.jit(lambda x, w: lax.conv_general_dilated(
+                x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+                preferred_element_type=jnp.int32))(x, w)
+            out.block_until_ready()
+            _INT8_CONV_OK = True
+        except Exception:
+            _INT8_CONV_OK = False
+    return _INT8_CONV_OK
 
 
 def quantize(data, min_range=None, max_range=None, out_type="int8"):
@@ -126,8 +159,14 @@ class QuantizedDense:
         wmax = float(self._wmax.asnumpy()[0])
         ws = max(abs(wmin), abs(wmax)) / 127.0 or 1.0
 
+        from jax import lax
+
         def fn(xq_, wq_):
-            acc = jnp.matmul(xq_.astype(jnp.int32), wq_.astype(jnp.int32).T)
+            # int8 OPERANDS with an int32 accumulator — the MXU's native 2:1
+            # int8 path. (Upcasting the operands to int32 first, as r4 did,
+            # runs an int32xint32 matmul and forfeits the speedup.)
+            acc = lax.dot_general(xq_, wq_, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
             return acc.astype(jnp.float32) * (xs * ws)
 
         out = _apply(fn, xq, self._wq)
@@ -160,6 +199,52 @@ class QuantizedDenseBlock:
     pass  # replaced below (kept for pickle name stability)
 
 
+def _int8_conv_apply(x, wq, bias, conv_kwargs, in_scale, w_scale,
+                     act_type=None, emit_scale=None, fp_dtype=None):
+    """Shared s8 x s8 -> s32 conv lowering (the one place the int8 conv is
+    written): quantize the input unless it already arrives int8, run the MXU
+    conv with an int32 accumulator, rescale + bias in fp32, optionally fuse
+    a relu, and either emit int8 at ``emit_scale`` or cast to ``fp_dtype``
+    (input dtype when None). Used by QuantizedConv2DBlock and
+    QuantizedConvGroup so a fix lands in both."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    kw = conv_kwargs
+    n = len(kw["kernel"])
+    stride = tuple(kw.get("stride") or (1,) * n)
+    dilate = tuple(kw.get("dilate") or (1,) * n)
+    pad = tuple(kw.get("pad") or (0,) * n)
+    groups = kw.get("num_group", 1)
+    spatial = "".join("DHW"[3 - n:][i] for i in range(n))
+    dn_str = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+
+    def fn(x_, wq_, *b_):
+        out_dt = fp_dtype if fp_dtype is not None else x_.dtype
+        if x_.dtype != jnp.int8:
+            xq = jnp.clip(jnp.round(x_.astype(jnp.float32) / in_scale),
+                          -127, 127).astype(jnp.int8)
+        else:
+            xq = x_
+        dn = lax.conv_dimension_numbers(xq.shape, wq_.shape, dn_str)
+        acc = lax.conv_general_dilated(
+            xq, wq_, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (in_scale * w_scale)
+        if b_:
+            y = y + b_[0].astype(jnp.float32).reshape((1, -1) + (1,) * n)
+        if act_type == "relu":
+            y = jnp.maximum(y, 0)
+        if emit_scale is not None:
+            return jnp.clip(jnp.round(y / emit_scale),
+                            -127, 127).astype(jnp.int8)
+        return y.astype(out_dt)
+
+    return _apply(fn, x, wq, *([bias] if bias is not None else []))
+
+
 def _make_quantized_classes():
     """Built lazily so contrib.quantization does not import gluon at module
     import (package init order)."""
@@ -185,22 +270,33 @@ def _make_quantized_classes():
             return out
 
     class _QuantizedConv2DBlock(HybridBlock):
-        """QDQ (fake-quant) int8 Conv2D replacement: weights and
-        activations quantize->dequantize around the fp conv. The reference
-        runs native int8 conv kernels (quantized_conv.cc); XLA has no int8
-        conv path, so storage numerics are int8 while the MXU conv stays
-        bf16/fp32 — documented divergence."""
+        """Int8 Conv2D replacement. Native path (r5): int8 operands into
+        `lax.conv_general_dilated` with an int32 accumulator — the MXU's
+        2:1 int8 conv (the analog of the reference's quantized_conv.cc
+        int8 kernels) — with quantize/rescale fused around it by XLA.
+        Fallback when the backend rejects int8 conv: QDQ (fake-quant)
+        around the fp conv — storage numerics int8, compute fp."""
 
         def __init__(self, conv_block, calib_min, calib_max, **kw):
             super().__init__(**kw)
             w = conv_block.weight.data()
             wq, wmin, wmax = quantize(w)
-            self._w_deq = dequantize(wq, wmin, wmax)
             self._conv = conv_block  # NOT registered: its hooks/params stay out
             self.__dict__["_conv"] = conv_block
             self._cmin, self._cmax = calib_min, calib_max
+            self._native = _native_int8_conv_supported()
+            if self._native:
+                self._wq = wq
+                wl = float(wmin.asnumpy()[0])
+                wh = float(wmax.asnumpy()[0])
+                self._ws = max(abs(wl), abs(wh)) / 127.0 or 1.0
+                self._xs = max(abs(calib_min), abs(calib_max)) / 127.0 or 1.0
+            else:
+                self._w_deq = dequantize(wq, wmin, wmax)
 
         def forward(self, x):
+            if self._native:
+                return self._forward_native(x)
             xq, xmin, xmax = quantize(x, self._cmin, self._cmax)
             x_deq = dequantize(xq, xmin, xmax)
             arr = self._conv.weight.data()   # the live NDArray wrapper
@@ -211,12 +307,188 @@ def _make_quantized_classes():
             finally:
                 arr._data = saved
 
+        def _forward_native(self, x):
+            cb = self._conv
+            bias = cb.bias.data() if cb.bias is not None else None
+            out = _int8_conv_apply(x, self._wq, bias, cb._kwargs,
+                                   self._xs, self._ws)
+            if cb.act_type:
+                out = nd.Activation(out, act_type=cb.act_type)
+            return out
+
     QuantizedDenseBlock = _QuantizedDenseBlock
     QuantizedConv2DBlock = _QuantizedConv2DBlock
     return _QuantizedDenseBlock, _QuantizedConv2DBlock
 
 
 QuantizedConv2DBlock = None
+QuantizedConvGroup = None
+
+
+def _make_group_class():
+    """Fused [Conv2D (+folded BatchNorm) (+ReLU)] int8 group — the block-level
+    analog of the reference's quantize_graph_pass.cc fusion: BN folds into the
+    conv weights/bias at quantize time, the conv runs int8 operands with int32
+    accumulation on the MXU, and when the NEXT conv group is a direct consumer
+    (same HybridSequential, only int8-transparent blocks between) the group
+    emits int8 directly so the activation never round-trips HBM at fp width."""
+    global QuantizedConvGroup
+    if QuantizedConvGroup is not None:
+        return QuantizedConvGroup
+    from ..gluon.block import HybridBlock
+
+    class _QuantizedConvGroup(HybridBlock):
+
+        def __init__(self, conv_block, bn_block, act_type, in_rng, out_rng,
+                     **kw):
+            super().__init__(**kw)
+            w = conv_block.weight.data()
+            self._fp_dtype = str(w.dtype)
+            wf = w.asnumpy().astype(onp.float64)
+            bias = (conv_block.bias.data().asnumpy().astype(onp.float64)
+                    if conv_block.bias is not None
+                    else onp.zeros(wf.shape[0], onp.float64))
+            if bn_block is not None:
+                g = bn_block.gamma.data().asnumpy().astype(onp.float64)
+                be = bn_block.beta.data().asnumpy().astype(onp.float64)
+                m = bn_block.running_mean.data().asnumpy().astype(onp.float64)
+                v = bn_block.running_var.data().asnumpy().astype(onp.float64)
+                s = g / onp.sqrt(v + bn_block._epsilon)
+                wf = wf * s.reshape((-1,) + (1,) * (wf.ndim - 1))
+                bias = (bias - m) * s + be
+            ws = (float(onp.abs(wf).max()) / 127.0) or 1.0
+            wq = onp.clip(onp.round(wf / ws), -127, 127).astype(onp.int8)
+            self._wq = nd.array(wq, dtype="int8")
+            self._bias = nd.array(bias.astype(onp.float32))
+            self._ws = ws
+            self._in_scale = (max(abs(in_rng[0]), abs(in_rng[1])) / 127.0) or 1.0
+            self._out_scale = (max(abs(out_rng[0]), abs(out_rng[1])) / 127.0) or 1.0
+            self._act_type = act_type
+            self._kwargs = dict(conv_block._kwargs)
+            self.emit_int8 = False   # set by the pass when a linked consumer exists
+
+        def set_in_scale(self, s):
+            self._in_scale = s
+
+        def out_scale(self):
+            return self._out_scale
+
+        def forward(self, x):
+            act, emit, out_s = self._act_type, self.emit_int8, self._out_scale
+            fuse_act = act in (None, "relu")
+            out = _int8_conv_apply(
+                x, self._wq, self._bias, self._kwargs,
+                self._in_scale, self._ws,
+                act_type=act if fuse_act else None,
+                emit_scale=out_s if (fuse_act and emit) else None,
+                fp_dtype=self._fp_dtype)
+            if not fuse_act:   # exotic activation: fp act, then (re)quantize
+                out = nd.Activation(out, act_type=act)
+                if emit:
+                    out, _, _ = quantize(out, -127.0 * out_s, 127.0 * out_s)
+            return out
+
+    QuantizedConvGroup = _QuantizedConvGroup
+    return _QuantizedConvGroup
+
+
+_PASSTHROUGH_CLS = None
+QuantizedResidualBlock = None
+
+
+def _make_passthrough_class():
+    global _PASSTHROUGH_CLS
+    if _PASSTHROUGH_CLS is not None:
+        return _PASSTHROUGH_CLS
+    from ..gluon.block import HybridBlock
+
+    class _Passthrough(HybridBlock):
+        """Replaces a BatchNorm/Activation absorbed into a conv group."""
+
+        def forward(self, x):
+            return x
+
+    _PASSTHROUGH_CLS = _Passthrough
+    return _Passthrough
+
+
+def _make_residual_class():
+    """Int8-aware wrapper for model-zoo V1 residual blocks
+    (BasicBlockV1/BottleneckV1: out = relu(body(x) + [downsample](x))).
+    The reference's quantize_graph_pass.cc pattern-matches exactly such
+    known op sequences; here the wrapper re-expresses the block's forward
+    so that (a) an int8 input flows straight into the body's first conv
+    group and the downsample conv (no dequantize round-trip — only the
+    identity-residual leg rescales, elementwise), and (b) when the next
+    block in the stage consumes int8, the post-relu output quantizes once
+    at the block boundary — so whole stages chain at 1 byte/elem."""
+    global QuantizedResidualBlock
+    if QuantizedResidualBlock is not None:
+        return QuantizedResidualBlock
+    from ..gluon.block import HybridBlock
+    GroupCls = _make_group_class()
+
+    class _QuantizedResidualBlock(HybridBlock):
+
+        def __init__(self, inner, in_rng, out_rng, **kw):
+            super().__init__(**kw)
+            self.inner = inner
+            self._in_scale = (max(abs(in_rng[0]), abs(in_rng[1])) / 127.0) or 1.0
+            self._out_scale = (max(abs(out_rng[0]), abs(out_rng[1])) / 127.0) or 1.0
+            self.emit_int8 = False
+            self.set_in_scale(self._in_scale)
+
+        def _entry_groups(self):
+            inner = self.inner
+            outs = []
+            body = getattr(inner, "body", None)
+            if body is not None and body._children:
+                first = next(iter(body._children.values()))
+                if isinstance(first, GroupCls):
+                    outs.append(first)
+            ds = getattr(inner, "downsample", None)
+            if ds is not None and getattr(ds, "_children", None):
+                first = next(iter(ds._children.values()))
+                if isinstance(first, GroupCls):
+                    outs.append(first)
+            return outs
+
+        def set_in_scale(self, s):
+            self._in_scale = s
+            for g in self._entry_groups():
+                g.set_in_scale(s)
+
+        def can_accept_int8(self):
+            """int8 may only flow in when EVERY entry conv is a quantized
+            group: with an excluded (still-fp) body-first or downsample
+            conv, raw int8 codes would hit a plain Conv2D unscaled."""
+            inner = self.inner
+            n_entries = 1 + (getattr(inner, "downsample", None) is not None)
+            return len(self._entry_groups()) == n_entries
+
+        def out_scale(self):
+            return self._out_scale
+
+        def forward(self, x):
+            import jax.numpy as jnp
+
+            inner = self.inner
+            ds = getattr(inner, "downsample", None)
+            residual = ds(x) if ds is not None else x
+            y = inner.body(x)
+            if str(residual.dtype) == "int8":   # identity leg: rescale only
+                in_s, dt = self._in_scale, str(y.dtype)
+                residual = _apply(
+                    lambda r: (r.astype(jnp.float32) * in_s).astype(dt),
+                    residual)
+            out = nd.Activation(y + residual, act_type="relu")
+            if self.emit_int8:
+                out_s = self._out_scale
+                out, _, _ = quantize(out, -127.0 * out_s, 127.0 * out_s)
+            return out
+
+    QuantizedResidualBlock = _QuantizedResidualBlock
+    return _QuantizedResidualBlock
 
 
 def _calibrate(net, layers, calib_data, calib_mode, num_calib_batches):
@@ -255,14 +527,33 @@ def _calibrate(net, layers, calib_data, calib_mode, num_calib_batches):
 
 def quantize_net(net, calib_data=None, calib_mode="minmax",
                  num_calib_batches=4, quantize_conv=True,
-                 exclude_layers=()):
+                 exclude_layers=(), fold_bn=True):
     """Graph-level int8 conversion of a Gluon net (ref contrib/
-    quantization.py quantize_net): Dense layers become real-int8 matmul
-    blocks, Conv2D layers become QDQ blocks, swapped IN PLACE so the
-    returned net runs end-to-end. Calibration collects per-layer input
+    quantization.py quantize_net + quantize_graph_pass.cc): Dense layers
+    become real-int8 matmul blocks; Conv2D layers become, by default
+    (``fold_bn=True`` on a backend with s8 conv), fused
+    [conv + folded-BN + relu] int8 groups with int8 flowing BETWEEN
+    directly-chained groups — the reference pass's fusion + requantize
+    chaining. Everything is swapped IN PLACE so the returned net runs
+    end-to-end. Calibration collects per-layer input (and group output)
     ranges over ``calib_data`` (minmax or KL-entropy). Compiled-forward
     caches are invalidated after the swap (a hybridized net would otherwise
     keep running its cached fp32 program)."""
+    from ..gluon import nn
+
+    if (fold_bn and quantize_conv and _native_int8_conv_supported()
+            and not isinstance(net, (nn.Dense, nn.Conv2D))):
+        return _quantize_net_groups(net, calib_data, calib_mode,
+                                    num_calib_batches, exclude_layers)
+    return _quantize_net_legacy(net, calib_data, calib_mode,
+                                num_calib_batches, quantize_conv,
+                                exclude_layers)
+
+
+def _quantize_net_legacy(net, calib_data, calib_mode, num_calib_batches,
+                         quantize_conv, exclude_layers):
+    """Per-block swap (no BN folding, no inter-layer int8): Dense -> int8
+    matmul block, Conv2D -> native-int8 (or QDQ-fallback) block."""
     from ..gluon import nn
     QD, QC = _make_quantized_classes()
 
@@ -305,13 +596,227 @@ def quantize_net(net, calib_data=None, calib_mode="minmax",
             if val is block:
                 object.__setattr__(parent, attr, q)
 
-    # invalidate compiled-forward caches everywhere: a hybridized net would
-    # otherwise keep executing the cached fp32 program for known shapes
-    def clear(b):
-        if hasattr(b, "_cached_fn"):
-            b._cached_fn = None
-        for c in b._children.values():
-            clear(c)
-
-    clear(net)
+    _clear_forward_caches(net)
     return net
+
+
+def _clear_forward_caches(net):
+    """Invalidate compiled-forward caches after a swap: a hybridized net
+    would otherwise keep executing the cached fp32 program."""
+    if hasattr(net, "_cached_fn"):
+        net._cached_fn = None
+    for c in net._children.values():
+        _clear_forward_caches(c)
+
+
+def _quantize_net_groups(net, calib_data, calib_mode, num_calib_batches,
+                         exclude_layers):
+    """The fused-group pass (ref quantize_graph_pass.cc analog):
+
+    1. Walk every container. Inside a HybridSequential (child order ==
+       dataflow), each Conv2D absorbs a directly-following BatchNorm
+       (folded into weights/bias) and relu Activation into ONE group; in
+       non-sequential parents each Conv2D becomes a standalone fp-in/fp-out
+       group (their forward() wiring is opaque, so no folding/chaining).
+    2. Calibrate group INPUT and OUTPUT ranges, V1-residual-block in/out
+       ranges, and Dense input ranges in one hooked eager walk.
+    3. Swap groups in (absorbed BN/Activation blocks become passthroughs)
+       and wrap V1 residual blocks int8-aware, then link chains over the
+       swapped tree: when only int8-transparent blocks (max-pool,
+       passthroughs) separate two int8-capable nodes in a sequential —
+       where a nested HybridSequential's endpoints count as its first/last
+       child's, so whole stages chain — the producer emits int8 and the
+       consumer reads it with the producer's output scale. Chained
+       activations cross HBM at 1 byte/elem and never re-quantize.
+    """
+    from ..gluon import nn
+    from ..gluon.model_zoo import vision as _zoo
+    GroupCls = _make_group_class()
+    ResCls = _make_residual_class()
+    Pass = _make_passthrough_class()
+    QD, _ = _make_quantized_classes()
+    res_types = (_zoo.BasicBlockV1, _zoo.BottleneckV1)
+
+    groups = []         # group descriptors
+    res_blocks = []     # (parent, key, block)
+    dense_targets = []  # (parent, key, block)
+
+    def walk(parent):
+        seq = isinstance(parent, nn.HybridSequential)
+        kids = list(parent._children.items())
+        i = 0
+        while i < len(kids):
+            key, child = kids[i]
+            if isinstance(child, nn.Dense) and child.name not in exclude_layers:
+                dense_targets.append((parent, key, child))
+                i += 1
+                continue
+            if isinstance(child, nn.Conv2D) and child.name not in exclude_layers:
+                bn = act = None
+                j = i + 1
+                if seq and j < len(kids) \
+                        and isinstance(kids[j][1], nn.BatchNorm) \
+                        and kids[j][1]._axis == 1:
+                    bn = kids[j]
+                    j += 1
+                if seq and j < len(kids) \
+                        and isinstance(kids[j][1], nn.Activation) \
+                        and kids[j][1]._act_type == "relu" \
+                        and child.act_type is None:
+                    act = kids[j]
+                    j += 1
+                groups.append({"parent": parent, "key": key, "conv": child,
+                               "bn": bn, "act": act})
+                i = j
+                continue
+            if isinstance(child, res_types) and child.name not in exclude_layers:
+                res_blocks.append((parent, key, child))
+            walk(child)
+            i += 1
+
+    walk(net)
+
+    # --- calibration: group input/output + dense input ranges, one walk ---
+    stats_in, stats_out = {}, {}
+
+    def in_hook(key):
+        def hook(blk, inputs, output):
+            stats_in.setdefault(key, []).append(inputs[0])
+        return hook
+
+    def out_hook(key):
+        def hook(blk, inputs, output):
+            stats_out.setdefault(key, []).append(output)
+        return hook
+
+    handles = []
+    for gi, g in enumerate(groups):
+        handles.append(g["conv"].register_forward_hook(in_hook(("g", gi))))
+        last = (g["act"] or g["bn"] or (None, g["conv"]))[1]
+        handles.append(last.register_forward_hook(out_hook(("g", gi))))
+    for parent, key, blk in res_blocks:
+        handles.append(blk.register_forward_hook(in_hook(("r", id(blk)))))
+        handles.append(blk.register_forward_hook(out_hook(("r", id(blk)))))
+    for parent, key, blk in dense_targets:
+        handles.append(blk.register_forward_hook(in_hook(("d", id(blk)))))
+    try:
+        if calib_data is not None:
+            for i, batch in enumerate(calib_data):
+                if i >= num_calib_batches:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                x = x.data[0] if hasattr(x, "data") else x
+                net(x)
+    finally:
+        for h in handles:
+            if h is not None:
+                h.detach()
+
+    calib = calib_entropy if calib_mode == "entropy" else calib_minmax
+
+    def rng(stats, key):
+        acts = stats.get(key)
+        return calib(acts) if acts else (-1.0, 1.0)
+
+    # --- build + swap ---
+    for gi, g in enumerate(groups):
+        obj = GroupCls(g["conv"], g["bn"] and g["bn"][1],
+                       g["act"][1]._act_type if g["act"] else g["conv"].act_type,
+                       rng(stats_in, ("g", gi)), rng(stats_out, ("g", gi)))
+        parent = g["parent"]
+        parent._children[g["key"]] = obj
+        for attr, val in list(vars(parent).items()):
+            if val is g["conv"]:
+                object.__setattr__(parent, attr, obj)
+        for absorbed in (g["bn"], g["act"]):
+            if absorbed is not None:
+                parent._children[absorbed[0]] = Pass()
+
+    for parent, key, blk in res_blocks:
+        obj = ResCls(blk, rng(stats_in, ("r", id(blk))),
+                     rng(stats_out, ("r", id(blk))))
+        parent._children[key] = obj
+        for attr, val in list(vars(parent).items()):
+            if val is blk:
+                object.__setattr__(parent, attr, obj)
+
+    for parent, key, blk in dense_targets:
+        lo, hi = rng(stats_in, ("d", id(blk)))
+        q = QD(blk, lo, hi)
+        parent._children[key] = q
+        for attr, val in list(vars(parent).items()):
+            if val is blk:
+                object.__setattr__(parent, attr, q)
+
+    _link_chains(net)
+    _clear_forward_caches(net)
+    return net
+
+
+def _link_chains(root):
+    """Generic int8 chain linking over the already-swapped tree: inside every
+    HybridSequential, walk children in dataflow order; a producer whose exit
+    node is int8-capable and a consumer whose entry node is int8-capable,
+    separated only by int8-transparent blocks (max-pool preserves values and
+    scale on int8; passthroughs are identity), get linked — the producer
+    emits int8 and the consumer's input scale becomes the producer's output
+    scale (same tensor, so the wiring is exact, not just calibrated-equal).
+    A nested HybridSequential's entry/exit are its first/last child's, so
+    model-zoo stages chain end-to-end through block wrappers."""
+    from ..gluon import nn
+    GroupCls = _make_group_class()
+    ResCls = _make_residual_class()
+    Pass = _make_passthrough_class()
+
+    def transparent(b):
+        return isinstance(b, (Pass, nn.MaxPool2D))
+
+    def entry(b):
+        if isinstance(b, GroupCls):
+            return b
+        if isinstance(b, ResCls):
+            # an excluded (still-fp) entry conv means raw int8 codes would
+            # hit a plain Conv2D — such a wrapper cannot consume int8
+            return b if b.can_accept_int8() else None
+        if isinstance(b, nn.HybridSequential):
+            for c in b._children.values():
+                if transparent(c):   # int8 passes through unchanged
+                    continue
+                return entry(c)
+        return None
+
+    def exit_(b):
+        if isinstance(b, (GroupCls, ResCls)):
+            return b
+        if isinstance(b, nn.HybridSequential):
+            for c in reversed(list(b._children.values())):
+                if transparent(c):   # trailing pool/passthrough keeps int8
+                    continue
+                return exit_(c)
+        return None
+
+    def link(parent):
+        if isinstance(parent, GroupCls):
+            return
+        if isinstance(parent, ResCls):
+            # the wrapper manages its own entry/exit scales; its body still
+            # chains internally (conv groups feed each other) — the last
+            # body group keeps fp out since the residual add consumes it
+            body = getattr(parent.inner, "body", None)
+            if body is not None:
+                link(body)
+            return
+        if isinstance(parent, nn.HybridSequential):
+            prev_exit = None
+            for child in parent._children.values():
+                if isinstance(child, (Pass, nn.MaxPool2D)):
+                    continue   # transparent: chain continues across
+                e = entry(child)
+                if prev_exit is not None and e is not None:
+                    prev_exit.emit_int8 = True
+                    e.set_in_scale(prev_exit.out_scale())
+                prev_exit = exit_(child)
+        for child in parent._children.values():
+            link(child)
+
+    link(root)

@@ -11,7 +11,11 @@ import os
 
 from . import ndarray as nd
 
-__all__ = ["load", "register_op"]
+__all__ = ["load", "register_op", "unregister_op"]
+
+_REGISTERED_OPS = {}   # name -> {module: shadowed attr or _ABSENT}; only
+                       # these names may be unregistered (guards builtins)
+_ABSENT = object()
 
 
 def register_op(name, fn, gradient=None):
@@ -48,14 +52,41 @@ def register_op(name, fn, gradient=None):
         def op(*args, **kwargs):
             return fn(*args, **kwargs)
 
+    saved = _REGISTERED_OPS.setdefault(name, {})
+    saved.setdefault("ndarray", getattr(nd, name, _ABSENT))
     setattr(nd, name, op)
     try:
         from . import symbol as sym_mod
         from .symbol import _symbolize
+        saved.setdefault("symbol", getattr(sym_mod, name, _ABSENT))
         setattr(sym_mod, name, _symbolize(op, name))
     except Exception:
         pass
     return op
+
+
+def unregister_op(name):
+    """Remove a custom operator previously registered via
+    :func:`register_op` from the nd and sym namespaces, restoring whatever
+    the name bound before (so a plugin that shadowed a builtin gives it
+    back). Only names that went through register_op are removable —
+    builtins are refused. Lets tests and short-lived plugins leave the
+    registry the way they found it."""
+    if name not in _REGISTERED_OPS:
+        raise ValueError(
+            "'%s' was not registered via register_op (builtin ops cannot "
+            "be unregistered)" % name)
+    saved = _REGISTERED_OPS.pop(name)
+    for mod_name, prev in saved.items():
+        try:
+            mod = importlib.import_module("." + mod_name, __package__)
+        except Exception:
+            continue
+        if prev is _ABSENT:
+            if hasattr(mod, name):
+                delattr(mod, name)
+        else:
+            setattr(mod, name, prev)
 
 
 def load(path, verbose=True):

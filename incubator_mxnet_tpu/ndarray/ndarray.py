@@ -1295,7 +1295,10 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
 
     if pool_type == "max":
         def fn(x):
-            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            # init must carry the operand dtype (an int python literal binds
+            # as int32 and reduce_window rejects the mismatch for int8/int16)
+            init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                    else x.dtype.type(jnp.iinfo(x.dtype).min))
             return lax.reduce_window(x, init, lax.max, dims, strides, spad)
         return _apply(fn, data)
     if pool_type in ("avg", "sum"):

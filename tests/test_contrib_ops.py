@@ -368,3 +368,159 @@ def test_quantized_native_int8_vs_simulated(monkeypatch):
     b = q.dequantize(co_s, cs0, cs1).asnumpy()
     assert a.shape == (2, 4, 8, 8)
     assert onp.abs(a - b).max() < 0.05
+
+
+def test_maxpool_int8():
+    """reduce_window init must carry the operand dtype (int8 max-pool is the
+    int8-transparent link in the quantization chain)."""
+    x = nd.array(onp.random.RandomState(0).randint(-127, 128, (1, 2, 6, 6))
+                 .astype(onp.int8), dtype="int8")
+    out = nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    assert str(out.dtype) == "int8"
+    ref = x.asnumpy()[:, :, 0:3, 0:3].max(axis=(2, 3))
+    assert (out.asnumpy()[:, :, 0, 0] == ref).all()
+
+
+def test_quantize_net_group_pass():
+    """r5 quantize_graph_pass analog: BN folds into int8 conv groups, V1
+    residual blocks wrap int8-aware, and int8 chains across groups/blocks/
+    stages (ref quantize_graph_pass.cc fusion + requantize chaining)."""
+    from incubator_mxnet_tpu.contrib import quantization as q
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 3, 64, 64))
+    net(x)
+    # nontrivial BN stats so the folding math is actually exercised
+    for name, p in net.collect_params().items():
+        if "running_mean" in name:
+            p.set_data(nd.random.normal(shape=p.shape) * 0.1)
+        if "running_var" in name:
+            p.set_data(nd.random.uniform(shape=p.shape) * 0.5 + 0.75)
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], num_calib_batches=1)
+    got = qnet(x).asnumpy()
+
+    n_groups = n_emit = n_res = n_res_emit = 0
+
+    def walk(b):
+        nonlocal n_groups, n_emit, n_res, n_res_emit
+        if isinstance(b, q.QuantizedConvGroup):
+            n_groups += 1
+            n_emit += bool(b.emit_int8)
+        if isinstance(b, q.QuantizedResidualBlock):
+            n_res += 1
+            n_res_emit += bool(b.emit_int8)
+        for ch in b._children.values():
+            walk(ch)
+
+    walk(qnet)
+    # resnet18: 20 convs -> 20 groups; 8 basic blocks wrap; every block but
+    # the last (avg-pool consumer) chains int8 to its successor
+    assert n_groups == 20 and n_res == 8
+    assert n_res_emit == n_res - 1
+    assert n_emit >= 8  # intra-body + stem chains
+    cos = float((got * ref).sum() /
+                (onp.linalg.norm(got) * onp.linalg.norm(ref)))
+    assert cos > 0.99, cos
+
+
+def test_quantize_net_legacy_path():
+    """fold_bn=False keeps the per-block swap (no folding/chaining) — and it
+    must agree with the fp net too."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib import quantization as q
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4, activation="relu"))
+    net.add(gluon.nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 4, 8, 8))
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], num_calib_batches=1,
+                          fold_bn=False)
+    kids = list(qnet._children.values())
+    assert isinstance(kids[0], q.QuantizedConv2DBlock)
+    got = qnet(x).asnumpy()
+    cos = float((got * ref).sum() /
+                (onp.linalg.norm(got) * onp.linalg.norm(ref)))
+    assert cos > 0.99, cos
+
+
+def test_quantize_net_chains_through_nested_transparent_tails():
+    """VGG-style nesting: sub-sequentials ending in absorbed-BN passthroughs
+    and max-pools must still chain int8 across sub-blocks (the linker skips
+    int8-transparent children when resolving a sequential's endpoints)."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib import quantization as q
+    mx.random.seed(0)
+
+    def sub(ch, in_ch):
+        s = gluon.nn.HybridSequential()
+        s.add(gluon.nn.Conv2D(ch, 3, padding=1, in_channels=in_ch),
+              gluon.nn.BatchNorm(in_channels=ch),
+              gluon.nn.Activation("relu"),
+              gluon.nn.MaxPool2D(2, 2))
+        return s
+
+    net = gluon.nn.HybridSequential()
+    net.add(sub(8, 4), sub(16, 8), gluon.nn.Flatten(), gluon.nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 4, 16, 16))
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], num_calib_batches=1)
+    subs = list(qnet._children.values())
+    g1 = next(iter(subs[0]._children.values()))
+    g2 = next(iter(subs[1]._children.values()))
+    assert isinstance(g1, q.QuantizedConvGroup)
+    assert g1.emit_int8, "sub1's group must chain to sub2 through the pool"
+    assert not g2.emit_int8, "sub2 feeds Flatten/Dense: fp boundary"
+    assert g2._in_scale == g1.out_scale()
+    got = qnet(x).asnumpy()
+    cos = float((got * ref).sum() /
+                (onp.linalg.norm(got) * onp.linalg.norm(ref)))
+    assert cos > 0.99, cos
+
+
+def test_register_unregister_op_restores_shadowed_builtin():
+    from incubator_mxnet_tpu import library
+    import pytest
+    builtin_dot = nd.dot
+    library.register_op("dot", lambda x, y: x + y)   # shadow a builtin
+    try:
+        assert nd.dot is not builtin_dot
+    finally:
+        library.unregister_op("dot")
+    assert nd.dot is builtin_dot   # restored, not deleted
+    with pytest.raises(ValueError):
+        library.unregister_op("dot")   # not custom anymore -> refused
+
+
+def test_quantize_net_excluded_entry_conv_blocks_int8_chain():
+    """A residual block whose entry conv is excluded keeps an fp Conv2D
+    inside — the linker must NOT feed it int8 codes (and numerics must
+    still match the fp net)."""
+    from incubator_mxnet_tpu.contrib import quantization as q
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 3, 64, 64))
+    net(x)
+    # exclude the first conv of a stage-interior block's body
+    stage1 = net.features._children["5"] if "5" in net.features._children \
+        else list(net.features._children.values())[4]
+    blk2 = list(stage1._children.values())[1]
+    excl = next(iter(blk2.body._children.values())).name
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], num_calib_batches=1,
+                          exclude_layers=(excl,))
+    wrapped = [b for b in list(stage1._children.values())
+               if isinstance(b, q.QuantizedResidualBlock)]
+    assert len(wrapped) == 2 and not wrapped[1].can_accept_int8(), \
+        "excluded-entry wrapper must refuse int8"
+    # its predecessor must therefore keep an fp boundary toward it
+    assert not wrapped[0].emit_int8
+    got = qnet(x).asnumpy()
+    cos = float((got * ref).sum() /
+                (onp.linalg.norm(got) * onp.linalg.norm(ref)))
+    assert cos > 0.99, cos

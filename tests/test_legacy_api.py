@@ -252,9 +252,11 @@ def test_quantize_net_graph_conversion():
 
     calib = [(x[i:i + 64],) for i in range(0, 256, 64)]
     qnet = quantize_net(net, calib_data=calib, calib_mode="minmax")
-    # layers actually swapped
+    # layers actually swapped (r5: the default pass fuses convs into
+    # QuantizedConvGroup blocks; fold_bn=False keeps the per-block swap,
+    # covered by test_contrib_ops.py::test_quantize_net_legacy_path)
     kinds = [type(c).__name__.lstrip("_") for c in qnet._children.values()]
-    assert "QuantizedConv2DBlock" in kinds and "QuantizedDenseBlock" in kinds
+    assert "QuantizedConvGroup" in kinds and "QuantizedDenseBlock" in kinds
     qnet.save_parameters(str(__import__("tempfile").mktemp()))  # Block API works
     q_acc = (qnet(x).asnumpy().argmax(-1) == label).mean()
     assert q_acc > fp_acc - 0.05, (fp_acc, q_acc)

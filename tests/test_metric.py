@@ -155,8 +155,14 @@ def test_custom_op_library():
         return x * 3
 
     library.register_op("triple_op", myop)
-    out = nd.triple_op(nd.array([1.0]))
-    assert out.asnumpy()[0] == 3.0 and called["yes"]
+    try:
+        out = nd.triple_op(nd.array([1.0]))
+        assert out.asnumpy()[0] == 3.0 and called["yes"]
+    finally:
+        # leave the registry clean: the numerics-sweep coverage test
+        # enumerates every public nd callable
+        library.unregister_op("triple_op")
+    assert not hasattr(nd, "triple_op")
 
 
 def test_estimator_fit():
