@@ -142,6 +142,11 @@ def attr_tensor(name, arr):
     return f_bytes(1, name) + f_bytes(5, tensor("", arr)) + f_varint(20, 4)
 
 
+def attr_strings(name, vs):
+    return (f_bytes(1, name) + b"".join(f_bytes(9, v) for v in vs)
+            + f_varint(20, 8))
+
+
 def node(op_type, inputs, outputs, name="", attrs=()):
     body = b"".join(f_bytes(1, i) for i in inputs)
     body += b"".join(f_bytes(2, o) for o in outputs)
@@ -205,6 +210,8 @@ def read_nodes(g):
                 attrs[aname] = a[4][0].decode()
             elif atype == 7:
                 attrs[aname] = [_signed(int(v)) for v in a.get(8, [])]
+            elif atype == 8:
+                attrs[aname] = [v.decode() for v in a.get(9, [])]
             elif atype == 4:
                 attrs[aname] = read_tensor(parse(a[5][0]))
         out.append({

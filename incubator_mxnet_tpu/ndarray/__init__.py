@@ -20,6 +20,17 @@ from .ndarray import (  # noqa
     LinearRegressionOutput, LogisticRegressionOutput, MAERegressionOutput,
 )
 from .ndarray import slice_op as slice  # noqa  (MXNet nd.slice)
+
+# flat linalg_* aliases (ref src/operator/tensor/la_op.cc registers each op
+# under BOTH mx.nd.linalg.<name> and the flat mx.nd.linalg_<name> —
+# e.g. nd.linalg_gemm2 in the reference's pytorch-migration docs); the
+# unified registry then mirrors them into mx.sym automatically
+for _n in ("gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "sumlogdiag",
+           "extractdiag", "makediag", "extracttrian", "maketrian", "syrk",
+           "gelqf", "syevd", "det", "slogdet", "inverse", "svd"):
+    if hasattr(linalg, _n):
+        globals()["linalg_" + _n] = getattr(linalg, _n)
+del _n
 from . import contrib  # noqa  (control flow: foreach/while_loop/cond)
 from . import sparse  # noqa  (row_sparse/csr storage types)
 

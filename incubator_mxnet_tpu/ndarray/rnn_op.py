@@ -72,6 +72,14 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
     assert state_size, "state_size required"
     T, N, I = data.shape
     D = 2 if bidirectional else 1
+    if state is None:   # cuDNN convention: absent initial state = zeros
+        from .ndarray import zeros as _nd_zeros
+        state = _nd_zeros((num_layers * D, N, state_size),
+                          dtype=str(data.dtype))
+    if state_cell is None and mode == "lstm":
+        from .ndarray import zeros as _nd_zeros
+        state_cell = _nd_zeros((num_layers * D, N, state_size),
+                               dtype=str(data.dtype))
     shapes = _dims(mode, I, state_size, num_layers, bidirectional)
     act = "relu" if mode == "rnn_relu" else "tanh"
     has_cell = mode == "lstm"

@@ -71,8 +71,12 @@ def _flat_adapter(fn, spec):
         it = iter(vals)
         rebuilt = []
         for s in spec:
-            rebuilt.append(next(it) if s is None
-                           else [next(it) for _ in range(s)])
+            if s is None:
+                rebuilt.append(next(it))
+            elif s == "N":          # an omitted optional input (None)
+                rebuilt.append(None)
+            else:
+                rebuilt.append([next(it) for _ in range(s)])
         return fn(*rebuilt, **kw)
     return call
 
@@ -88,6 +92,8 @@ def _symbolize(fn, op_name):
             if isinstance(a, Symbol):
                 inputs.append(a)
                 spec.append(None)
+            elif a is None:         # absent optional input (e.g. RNN state)
+                spec.append("N")
             elif isinstance(a, (list, tuple)) and a and \
                     all(isinstance(x, Symbol) for x in a):
                 inputs.extend(a)

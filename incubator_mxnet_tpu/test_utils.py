@@ -166,6 +166,11 @@ def _sweep_skip():
     skip = dict(_SYM_EXCLUDE)
     skip["Custom"] = "needs a registered op_type; exercised in test_extension"
     skip["reset_arrays"] = "in-place void op; exercised in test_optimizer_ops"
+    for _n in dir(nd):
+        if _n.startswith("linalg_"):
+            skip[_n] = ("flat alias of nd.linalg.%s (family numerics swept "
+                        "via the linalg.gemm2 entry; ONNX MatMul import "
+                        "rides linalg_gemm2)" % _n[len("linalg_"):])
     return skip
 
 

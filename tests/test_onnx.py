@@ -24,8 +24,9 @@ def _lenet_sym():
     b2 = sym.BatchNorm(c2, name="bn2")
     a2 = sym.Activation(b2, act_type="tanh")
     p2 = sym.Pooling(a2, kernel=(2, 2), stride=(2, 2), pool_type="avg")
-    f = sym.flatten(p2)
-    fc1 = sym.FullyConnected(f, num_hidden=32, flatten=False, name="fc1")
+    # fc1 rides the flatten=True Gemm export path, fc2 the flatten=False
+    # MatMul+Add path (ONNX Gemm is strictly 2-D)
+    fc1 = sym.FullyConnected(p2, num_hidden=32, name="fc1")
     a3 = sym.Activation(fc1, act_type="sigmoid")
     fc2 = sym.FullyConnected(a3, num_hidden=10, flatten=False, name="fc2")
     return sym.softmax(fc2, axis=-1)
@@ -103,3 +104,169 @@ def test_onnx_elemwise_and_reshape(tmp_path):
     ref = out.eval(a=x)[0]
     got = sym2.eval(a=x, **arg2)[0]
     assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def _encoder_sym(U=16, H=2, V=50):
+    """Symbolic mini transformer encoder layer: embedding, scaled-dot
+    self-attention (batch_dot), LayerNorm, gelu FFN — the op surface the
+    r5 ONNX extension adds (transformer export parity)."""
+    sym = mx.sym
+    Dh = U // H
+    tokens = sym.var("tokens")                      # (N, T) int-ish floats
+    emb = sym.Embedding(tokens, sym.var("embed_w"), input_dim=V,
+                        output_dim=U, name="embed")  # (N, T, U)
+    q = sym.FullyConnected(emb, num_hidden=U, flatten=False, name="q")
+    k = sym.FullyConnected(emb, num_hidden=U, flatten=False, name="k")
+    v = sym.FullyConnected(emb, num_hidden=U, flatten=False, name="v")
+
+    # keep the reshapes explicit-static for ONNX: fixed shapes below
+    N, T = 2, 5
+    def heads_static(x):
+        x = sym.reshape(x, shape=(N, T, H, Dh))
+        x = sym.transpose(x, axes=(0, 2, 1, 3))
+        return sym.reshape(x, shape=(N * H, T, Dh))
+
+    qh, kh, vh = heads_static(q), heads_static(k), heads_static(v)
+    scores = sym.batch_dot(qh, kh, transpose_b=True) / float(Dh ** 0.5)
+    att = sym.softmax(scores, axis=-1)
+    ctx = sym.batch_dot(att, vh)                      # (N*H, T, Dh)
+    ctx = sym.reshape(ctx, shape=(N, H, T, Dh))
+    ctx = sym.transpose(ctx, axes=(0, 2, 1, 3))
+    ctx = sym.reshape(ctx, shape=(N, T, U))
+    proj = sym.FullyConnected(ctx, num_hidden=U, flatten=False, name="proj")
+    h1 = sym.LayerNorm(emb + proj, sym.var("ln1_g"), sym.var("ln1_b"),
+                       axis=-1, name="ln1")
+    ffn = sym.FullyConnected(h1, num_hidden=2 * U, flatten=False, name="f1")
+    ffn = sym.LeakyReLU(ffn, act_type="gelu")
+    ffn = sym.FullyConnected(ffn, num_hidden=U, flatten=False, name="f2")
+    return sym.LayerNorm(h1 + ffn, sym.var("ln2_g"), sym.var("ln2_b"),
+                         axis=-1, name="ln2")
+
+
+def test_onnx_transformer_roundtrip(tmp_path):
+    U, V = 16, 50
+    sym_out = _encoder_sym(U=U, V=V)
+    shape = (2, 5)
+    rng = onp.random.RandomState(0)
+    ex = sym_out.simple_bind(tokens=shape, embed_w=(V, U),
+                             ln1_g=(U,), ln1_b=(U,),
+                             ln2_g=(U,), ln2_b=(U,))
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name == "tokens":
+            continue
+        if name.startswith("ln") and name.endswith("_g"):
+            params[name] = nd.array(onp.ones(arr.shape, "float32"))
+        elif name.startswith("ln"):
+            params[name] = nd.array(onp.zeros(arr.shape, "float32"))
+        else:
+            params[name] = nd.array(
+                rng.randn(*arr.shape).astype("float32") * 0.1)
+    path = str(tmp_path / "encoder.onnx")
+    mx_onnx.export_model(sym_out, params, shape, onnx_file_path=path)
+
+    sym2, arg2, aux2 = mx_onnx.import_model(path)
+    toks = nd.array(rng.randint(0, 50, shape).astype("float32"))
+    ref = sym_out.eval(tokens=toks, **params)[0]
+    got = sym2.eval(tokens=toks, **arg2)[0]
+    assert got.shape == ref.shape
+    assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_rnn_roundtrip(tmp_path):
+    """Fused RNN op <-> ONNX LSTM/GRU/RNN nodes: gate repacking + layout
+    fix-ups must round-trip numerically, incl. bidirectional and stacked."""
+    from incubator_mxnet_tpu.ndarray.rnn_op import rnn_param_size
+    sym = mx.sym
+    T, N, I, H = 4, 3, 6, 5
+    rng = onp.random.RandomState(3)
+    for mode, bidir, L in [("lstm", False, 1), ("lstm", True, 1),
+                           ("gru", False, 1), ("rnn_relu", False, 1),
+                           ("lstm", False, 2)]:
+        D = 2 if bidir else 1
+        data = sym.var("data")
+        p = sym.var("rnn_params")
+        h0 = sym.var("h0")
+        c0 = sym.var("c0") if mode == "lstm" else None
+        out = sym.RNN(data, p, h0, c0, state_size=H, num_layers=L,
+                      mode=mode, bidirectional=bidir, name="rnn0")
+        nparam = rnn_param_size(mode, I, H, L, bidir)
+        params = {"rnn_params":
+                  nd.array(rng.randn(nparam).astype("float32") * 0.3)}
+        shapes = [(T, N, I), (L * D, N, H)] + \
+            ([(L * D, N, H)] if mode == "lstm" else [])
+        path = str(tmp_path / ("rnn_%s_%d_%d.onnx" % (mode, bidir, L)))
+        mx_onnx.export_model(out, params, shapes, onnx_file_path=path)
+        sym2, arg2, aux2 = mx_onnx.import_model(path)
+
+        x = nd.array(rng.randn(T, N, I).astype("float32"))
+        h = nd.array(rng.randn(L * D, N, H).astype("float32") * 0.1)
+        binds = {"data": x, "h0": h, **params}
+        if mode == "lstm":
+            binds["c0"] = nd.array(
+                rng.randn(L * D, N, H).astype("float32") * 0.1)
+        ref = out.eval(**binds)[0]
+        got = sym2.eval(**{k: v for k, v in binds.items()
+                           if k != "rnn_params"}, **arg2)[0]
+        assert got.shape == ref.shape, (mode, bidir, L, got.shape, ref.shape)
+        assert_almost_equal(got.asnumpy(), ref.asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_new_ops_roundtrip(tmp_path):
+    """Where/Erf/Unsqueeze/Squeeze/Slice/Cast/Pow/scalar ops round-trip."""
+    sym = mx.sym
+    a = sym.var("a")
+    b = sym.slice_axis(a, axis=1, begin=1, end=3)        # (2,2)
+    c = sym.expand_dims(b * 2.0 + 1.0, axis=0)           # (1,2,2)
+    d = sym.squeeze(c, axis=0)                           # (2,2)
+    e = sym.where(d > 0.0, sym.erf(d), sym.square(d))
+    out = sym.cast(e, dtype="float32") ** 2.0
+    path = str(tmp_path / "newops.onnx")
+    mx_onnx.export_model(out, {}, (2, 4), onnx_file_path=path)
+    sym2, arg2, aux2 = mx_onnx.import_model(path)
+    x = nd.array(onp.random.RandomState(4).randn(2, 4).astype("float32"))
+    ref = out.eval(a=x)[0]
+    got = sym2.eval(a=x, **arg2)[0]
+    assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_strided_slice_and_symbol_comparisons(tmp_path):
+    """Strided slice carries its step through export (was silently dropped);
+    two-symbol operator sugar (_greater/_pow/_maximum) exports too."""
+    sym = mx.sym
+    a = sym.var("a")
+    b = sym.slice(a, begin=(0, 0), end=(4, 4), step=(2, 2))   # (2,2)
+    c = sym.where(b > (b * 0.0), b ** (b * 0.0 + 2.0),
+                  sym.maximum(b, b * 0.5))
+    path = str(tmp_path / "stride.onnx")
+    mx_onnx.export_model(c, {}, (4, 4), onnx_file_path=path)
+    sym2, arg2, aux2 = mx_onnx.import_model(path)
+    x = nd.array(onp.random.RandomState(5).randn(4, 4).astype("float32"))
+    ref = c.eval(a=x)[0]
+    got = sym2.eval(a=x, **arg2)[0]
+    assert got.shape == ref.shape == (2, 2)
+    assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_rnn_yh_consumer_fails_loudly(tmp_path):
+    """An imported graph consuming LSTM Y_h must raise, not silently get Y."""
+    import pytest
+    from incubator_mxnet_tpu.contrib import onnx_proto as P
+    # hand-build a minimal model whose output is the LSTM's second output
+    T_, N_, I_, H_ = 2, 1, 3, 4
+    rng = onp.random.RandomState(0)
+    W = rng.randn(1, 4 * H_, I_).astype("float32") * 0.1
+    R = rng.randn(1, 4 * H_, H_).astype("float32") * 0.1
+    nodes = [P.node("LSTM", ["x", "W", "R"], ["Y", "Y_h"], "lstm0",
+                    [P.attr_int("hidden_size", H_),
+                     P.attr_string("direction", "forward")])]
+    g = P.graph("g", nodes,
+                [P.value_info("x", (T_, N_, I_))],
+                [P.value_info("Y_h", (1, N_, H_))],
+                [P.tensor("W", W), P.tensor("R", R)])
+    path = str(tmp_path / "yh.onnx")
+    with open(path, "wb") as f:
+        f.write(P.model(g, opset=17))
+    with pytest.raises(ValueError, match="undefined input"):
+        mx_onnx.import_model(path)
