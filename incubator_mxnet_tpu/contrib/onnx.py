@@ -348,10 +348,10 @@ def export_model(sym, params, input_shape, input_type="float32",
             pair = [sc, ins[0]] if kw.get("reverse") else [ins[0], sc]
             nodes.append(P.node(_SCALAR[op], pair, [out], out))
         elif op == "RNN":
+            # pops the flat parameter vector from params (it is re-emitted
+            # as per-layer W/R/B initializers)
             _export_rnn(base, ins, kw, params, nodes, extra_inits,
                         fresh, out)
-            params.pop((getattr(base._inputs[1], "_base", None)
-                        or base._inputs[1]).name, None)
         elif op in _ELEM:
             nodes.append(P.node(_ELEM[op], ins, [out], out))
         elif op in _UNARY:
@@ -430,9 +430,12 @@ def import_model(model_file):
 
     last = None
     for n in P.read_nodes(g):
-        # "" marks an omitted optional input (e.g. LSTM sequence_lens)
-        ins = [sym_of(i) if i else None for i in n["inputs"]]
         op, at = n["op_type"], n["attrs"]
+        if op in ("LSTM", "GRU", "RNN"):
+            # "" marks an omitted optional input (sequence_lens, B, h0, c0)
+            ins = [sym_of(i) if i else None for i in n["inputs"]]
+        else:
+            ins = [sym_of(i) for i in n["inputs"]]
         if op == "Gemm":
             if at.get("alpha", 1.0) != 1.0 or at.get("beta", 1.0) != 1.0 \
                     or at.get("transA", 0):
@@ -566,8 +569,19 @@ def import_model(model_file):
         elif op == "Unsqueeze":
             axes = [int(a) for a in onp.asarray(inits[n["inputs"][1]])]
             arg_params.pop(n["inputs"][1], None)
+            # axes reference positions in the OUTPUT rank: non-negative
+            # axes apply ascending, all-negative apply descending (each
+            # expand_dims(-k) then lands at its final position); a mix
+            # cannot be resolved without the input rank
+            if all(a >= 0 for a in axes):
+                order = sorted(axes)
+            elif all(a < 0 for a in axes):
+                order = sorted(axes, reverse=True)
+            else:
+                raise NotImplementedError(
+                    "ONNX import: Unsqueeze with mixed-sign axes %r" % axes)
             out = ins[0]
-            for a in sorted(axes):
+            for a in order:
                 out = mxsym.expand_dims(out, axis=a)
         elif op == "Squeeze":
             if len(n["inputs"]) > 1 and n["inputs"][1]:
@@ -642,10 +656,26 @@ def _import_rnn(n, at, ins, inits, arg_params, value, mxsym, nd, op):
     H = int(at["hidden_size"])
     bidir = at.get("direction", "forward") == "bidirectional"
     D = 2 if bidir else 1
+    if at.get("clip") or at.get("layout"):
+        raise NotImplementedError("ONNX import: RNN clip/layout attrs")
+    acts = at.get("activations")
     mode = {"LSTM": "lstm", "GRU": "gru"}.get(op)
     if mode is None:
-        acts = at.get("activations", ["Tanh"])
-        mode = "rnn_relu" if acts and acts[0] == "Relu" else "rnn_tanh"
+        # vanilla RNN: one activation per direction, all equal
+        acts = acts or ["Tanh"] * D
+        if len(set(acts)) != 1 or acts[0] not in ("Relu", "Tanh"):
+            raise NotImplementedError(
+                "ONNX import: RNN activations %r (need uniform Relu or "
+                "Tanh)" % (acts,))
+        mode = "rnn_relu" if acts[0] == "Relu" else "rnn_tanh"
+    elif acts is not None:
+        # sym.RNN's recurrence is the cuDNN fixed set — anything else
+        # would silently change numerics
+        default = (["Sigmoid", "Tanh", "Tanh"] if op == "LSTM"
+                   else ["Sigmoid", "Tanh"]) * D
+        if list(acts) != default:
+            raise NotImplementedError(
+                "ONNX import: non-default %s activations %r" % (op, acts))
     G = {"lstm": 4, "gru": 3}.get(mode, 1)
     names = n["inputs"]
     if len(names) > 4 and names[4]:
@@ -706,11 +736,29 @@ def _export_rnn(base, ins, kw, params, nodes, extra_inits, fresh, out):
     D = 2 if bidir else 1
     if kw.get("state_outputs"):
         raise NotImplementedError("ONNX export: RNN state_outputs=True")
-    pbase = getattr(base._inputs[1], "_base", None) or base._inputs[1]
-    if not (pbase.is_var and pbase.name in params):
+    # resolve the POSITIONAL slots (data, parameters, state, state_cell):
+    # an omitted optional input is an "N" entry in __arg_spec__ with NO
+    # corresponding element in ins/_inputs, so raw positions shift —
+    # e.g. RNN(data, p, None, c0) has c0 at ins[2], not ins[3]
+    spec = kw.get("__arg_spec__")
+    slot_names, slot_syms = [], []
+    ii = 0
+    for s in (spec or (None,) * len(ins)):
+        if s == "N":
+            slot_names.append(None)
+            slot_syms.append(None)
+        elif s is None:
+            slot_names.append(ins[ii])
+            slot_syms.append(base._inputs[ii])
+            ii += 1
+        else:
+            raise NotImplementedError("ONNX export: RNN list inputs")
+    psym = slot_syms[1] if len(slot_syms) > 1 else None
+    pbase = psym and (getattr(psym, "_base", None) or psym)
+    if not (pbase is not None and pbase.is_var and pbase.name in params):
         raise NotImplementedError(
             "ONNX export: the RNN parameter vector must be an initializer")
-    flat = params[pbase.name]
+    flat = params.pop(pbase.name)
     flat = flat.asnumpy() if isinstance(flat, NDArray) else onp.asarray(flat)
     G = {"lstm": 4, "gru": 3}.get(mode, 1)
     # input size from the flat length: total = D*G*H*(I+H) [layer 0]
@@ -725,11 +773,13 @@ def _export_rnn(base, ins, kw, params, nodes, extra_inits, fresh, out):
     if off != flat.size:
         raise ValueError("RNN parameter vector length mismatch")
 
-    x_name = ins[0]
-    state_name = ins[2] if len(ins) > 2 else ""
-    cell_name = ins[3] if len(ins) > 3 else ""
+    x_name = slot_names[0]
+    state_name = (slot_names[2] or "") if len(slot_names) > 2 else ""
+    cell_name = (slot_names[3] or "") if len(slot_names) > 3 else ""
 
     def state_slice(src, layer, tag):
+        if L == 1:
+            return src   # the whole state IS this layer's (D, N, H)
         o = fresh("rnn_%s" % tag)
         sn, en, an = fresh("rnn_st"), fresh("rnn_en"), fresh("rnn_ax")
         extra_inits[sn] = onp.asarray([layer * D], "int64")

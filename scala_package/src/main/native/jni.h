@@ -1,0 +1,287 @@
+/* Minimal vendored JNI header (spec-layout JNINativeInterface, JNI 1.6).
+ *
+ * Vendored so the Scala binding's JNI shim compiles with NO JDK in the
+ * image (there is none — docs/STATUS.md par. Scala). The function-table
+ * slot ORDER below follows the JNI specification exactly, so a shim
+ * compiled against this header is binary-compatible with a real JVM's
+ * JNIEnv; the CI harness (scala_package/test/jni_harness.c) builds a
+ * fake table with the same layout and drives the exported Java_*
+ * symbols the way the JVM would.
+ *
+ * Only the slots the shim uses carry full prototypes; the rest are
+ * layout-preserving void* entries. (ref jni.h, JNI 1.6; analog of the
+ * reference's use of <jni.h> in
+ * scala-package/native/src/main/native/org_apache_mxnet_native_c_api.cc)
+ */
+#ifndef MXTPU_VENDORED_JNI_H_
+#define MXTPU_VENDORED_JNI_H_
+
+#include <stdint.h>
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+typedef void* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jintArray;
+typedef jarray jlongArray;
+typedef jarray jfloatArray;
+typedef jarray jdoubleArray;
+typedef jarray jobjectArray;
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+#define JNI_TRUE 1
+#define JNI_FALSE 0
+#define JNI_OK 0
+
+struct JNINativeInterface_;
+typedef const struct JNINativeInterface_* JNIEnv_;
+typedef JNIEnv_ JNIEnv;
+
+struct JNINativeInterface_ {
+  void* reserved0;  /* 0 */
+  void* reserved1;  /* 1 */
+  void* reserved2;  /* 2 */
+  void* reserved3;  /* 3 */
+  void* GetVersion;  /* 4 */
+  void* DefineClass;  /* 5 */
+  void* FindClass;  /* 6 */
+  void* FromReflectedMethod;  /* 7 */
+  void* FromReflectedField;  /* 8 */
+  void* ToReflectedMethod;  /* 9 */
+  void* GetSuperclass;  /* 10 */
+  void* IsAssignableFrom;  /* 11 */
+  void* ToReflectedField;  /* 12 */
+  void* Throw_;  /* 13 */
+  void* ThrowNew;  /* 14 */
+  void* ExceptionOccurred;  /* 15 */
+  void* ExceptionDescribe;  /* 16 */
+  void* ExceptionClear;  /* 17 */
+  void* FatalError;  /* 18 */
+  void* PushLocalFrame;  /* 19 */
+  void* PopLocalFrame;  /* 20 */
+  void* NewGlobalRef;  /* 21 */
+  void* DeleteGlobalRef;  /* 22 */
+  void* DeleteLocalRef;  /* 23 */
+  void* IsSameObject;  /* 24 */
+  void* NewLocalRef;  /* 25 */
+  void* EnsureLocalCapacity;  /* 26 */
+  void* AllocObject;  /* 27 */
+  void* NewObject;  /* 28 */
+  void* NewObjectV;  /* 29 */
+  void* NewObjectA;  /* 30 */
+  void* GetObjectClass;  /* 31 */
+  void* IsInstanceOf;  /* 32 */
+  void* GetMethodID;  /* 33 */
+  void* CallObjectMethod;  /* 34 */
+  void* CallObjectMethodV;  /* 35 */
+  void* CallObjectMethodA;  /* 36 */
+  void* CallBooleanMethod;  /* 37 */
+  void* CallBooleanMethodV;  /* 38 */
+  void* CallBooleanMethodA;  /* 39 */
+  void* CallByteMethod;  /* 40 */
+  void* CallByteMethodV;  /* 41 */
+  void* CallByteMethodA;  /* 42 */
+  void* CallCharMethod;  /* 43 */
+  void* CallCharMethodV;  /* 44 */
+  void* CallCharMethodA;  /* 45 */
+  void* CallShortMethod;  /* 46 */
+  void* CallShortMethodV;  /* 47 */
+  void* CallShortMethodA;  /* 48 */
+  void* CallIntMethod;  /* 49 */
+  void* CallIntMethodV;  /* 50 */
+  void* CallIntMethodA;  /* 51 */
+  void* CallLongMethod;  /* 52 */
+  void* CallLongMethodV;  /* 53 */
+  void* CallLongMethodA;  /* 54 */
+  void* CallFloatMethod;  /* 55 */
+  void* CallFloatMethodV;  /* 56 */
+  void* CallFloatMethodA;  /* 57 */
+  void* CallDoubleMethod;  /* 58 */
+  void* CallDoubleMethodV;  /* 59 */
+  void* CallDoubleMethodA;  /* 60 */
+  void* CallVoidMethod;  /* 61 */
+  void* CallVoidMethodV;  /* 62 */
+  void* CallVoidMethodA;  /* 63 */
+  void* CallNonvirtualObjectMethod;  /* 64 */
+  void* CallNonvirtualObjectMethodV;  /* 65 */
+  void* CallNonvirtualObjectMethodA;  /* 66 */
+  void* CallNonvirtualBooleanMethod;  /* 67 */
+  void* CallNonvirtualBooleanMethodV;  /* 68 */
+  void* CallNonvirtualBooleanMethodA;  /* 69 */
+  void* CallNonvirtualByteMethod;  /* 70 */
+  void* CallNonvirtualByteMethodV;  /* 71 */
+  void* CallNonvirtualByteMethodA;  /* 72 */
+  void* CallNonvirtualCharMethod;  /* 73 */
+  void* CallNonvirtualCharMethodV;  /* 74 */
+  void* CallNonvirtualCharMethodA;  /* 75 */
+  void* CallNonvirtualShortMethod;  /* 76 */
+  void* CallNonvirtualShortMethodV;  /* 77 */
+  void* CallNonvirtualShortMethodA;  /* 78 */
+  void* CallNonvirtualIntMethod;  /* 79 */
+  void* CallNonvirtualIntMethodV;  /* 80 */
+  void* CallNonvirtualIntMethodA;  /* 81 */
+  void* CallNonvirtualLongMethod;  /* 82 */
+  void* CallNonvirtualLongMethodV;  /* 83 */
+  void* CallNonvirtualLongMethodA;  /* 84 */
+  void* CallNonvirtualFloatMethod;  /* 85 */
+  void* CallNonvirtualFloatMethodV;  /* 86 */
+  void* CallNonvirtualFloatMethodA;  /* 87 */
+  void* CallNonvirtualDoubleMethod;  /* 88 */
+  void* CallNonvirtualDoubleMethodV;  /* 89 */
+  void* CallNonvirtualDoubleMethodA;  /* 90 */
+  void* CallNonvirtualVoidMethod;  /* 91 */
+  void* CallNonvirtualVoidMethodV;  /* 92 */
+  void* CallNonvirtualVoidMethodA;  /* 93 */
+  void* GetFieldID;  /* 94 */
+  void* GetObjectField;  /* 95 */
+  void* GetBooleanField;  /* 96 */
+  void* GetByteField;  /* 97 */
+  void* GetCharField;  /* 98 */
+  void* GetShortField;  /* 99 */
+  void* GetIntField;  /* 100 */
+  void* GetLongField;  /* 101 */
+  void* GetFloatField;  /* 102 */
+  void* GetDoubleField;  /* 103 */
+  void* SetObjectField;  /* 104 */
+  void* SetBooleanField;  /* 105 */
+  void* SetByteField;  /* 106 */
+  void* SetCharField;  /* 107 */
+  void* SetShortField;  /* 108 */
+  void* SetIntField;  /* 109 */
+  void* SetLongField;  /* 110 */
+  void* SetFloatField;  /* 111 */
+  void* SetDoubleField;  /* 112 */
+  void* GetStaticMethodID;  /* 113 */
+  void* CallStaticObjectMethod;  /* 114 */
+  void* CallStaticObjectMethodV;  /* 115 */
+  void* CallStaticObjectMethodA;  /* 116 */
+  void* CallStaticBooleanMethod;  /* 117 */
+  void* CallStaticBooleanMethodV;  /* 118 */
+  void* CallStaticBooleanMethodA;  /* 119 */
+  void* CallStaticByteMethod;  /* 120 */
+  void* CallStaticByteMethodV;  /* 121 */
+  void* CallStaticByteMethodA;  /* 122 */
+  void* CallStaticCharMethod;  /* 123 */
+  void* CallStaticCharMethodV;  /* 124 */
+  void* CallStaticCharMethodA;  /* 125 */
+  void* CallStaticShortMethod;  /* 126 */
+  void* CallStaticShortMethodV;  /* 127 */
+  void* CallStaticShortMethodA;  /* 128 */
+  void* CallStaticIntMethod;  /* 129 */
+  void* CallStaticIntMethodV;  /* 130 */
+  void* CallStaticIntMethodA;  /* 131 */
+  void* CallStaticLongMethod;  /* 132 */
+  void* CallStaticLongMethodV;  /* 133 */
+  void* CallStaticLongMethodA;  /* 134 */
+  void* CallStaticFloatMethod;  /* 135 */
+  void* CallStaticFloatMethodV;  /* 136 */
+  void* CallStaticFloatMethodA;  /* 137 */
+  void* CallStaticDoubleMethod;  /* 138 */
+  void* CallStaticDoubleMethodV;  /* 139 */
+  void* CallStaticDoubleMethodA;  /* 140 */
+  void* CallStaticVoidMethod;  /* 141 */
+  void* CallStaticVoidMethodV;  /* 142 */
+  void* CallStaticVoidMethodA;  /* 143 */
+  void* GetStaticFieldID;  /* 144 */
+  void* GetStaticObjectField;  /* 145 */
+  void* GetStaticBooleanField;  /* 146 */
+  void* GetStaticByteField;  /* 147 */
+  void* GetStaticCharField;  /* 148 */
+  void* GetStaticShortField;  /* 149 */
+  void* GetStaticIntField;  /* 150 */
+  void* GetStaticLongField;  /* 151 */
+  void* GetStaticFloatField;  /* 152 */
+  void* GetStaticDoubleField;  /* 153 */
+  void* SetStaticObjectField;  /* 154 */
+  void* SetStaticBooleanField;  /* 155 */
+  void* SetStaticByteField;  /* 156 */
+  void* SetStaticCharField;  /* 157 */
+  void* SetStaticShortField;  /* 158 */
+  void* SetStaticIntField;  /* 159 */
+  void* SetStaticLongField;  /* 160 */
+  void* SetStaticFloatField;  /* 161 */
+  void* SetStaticDoubleField;  /* 162 */
+  void* NewString;  /* 163 */
+  void* GetStringLength;  /* 164 */
+  void* GetStringChars;  /* 165 */
+  void* ReleaseStringChars;  /* 166 */
+  jstring (*NewStringUTF)(JNIEnv_*, const char*);  /* 167 */
+  void* GetStringUTFLength;  /* 168 */
+  const char* (*GetStringUTFChars)(JNIEnv_*, jstring, jboolean*);  /* 169 */
+  void (*ReleaseStringUTFChars)(JNIEnv_*, jstring, const char*);  /* 170 */
+  jsize (*GetArrayLength)(JNIEnv_*, jarray);  /* 171 */
+  void* NewObjectArray;  /* 172 */
+  void* GetObjectArrayElement;  /* 173 */
+  void* SetObjectArrayElement;  /* 174 */
+  void* NewBooleanArray;  /* 175 */
+  void* NewByteArray;  /* 176 */
+  void* NewCharArray;  /* 177 */
+  void* NewShortArray;  /* 178 */
+  void* NewIntArray;  /* 179 */
+  void* NewLongArray;  /* 180 */
+  void* NewFloatArray;  /* 181 */
+  void* NewDoubleArray;  /* 182 */
+  void* GetBooleanArrayElements;  /* 183 */
+  void* GetByteArrayElements;  /* 184 */
+  void* GetCharArrayElements;  /* 185 */
+  void* GetShortArrayElements;  /* 186 */
+  jint* (*GetIntArrayElements)(JNIEnv_*, jintArray, jboolean*);  /* 187 */
+  jlong* (*GetLongArrayElements)(JNIEnv_*, jlongArray, jboolean*);  /* 188 */
+  jfloat* (*GetFloatArrayElements)(JNIEnv_*, jfloatArray, jboolean*);  /* 189 */
+  void* GetDoubleArrayElements;  /* 190 */
+  void* ReleaseBooleanArrayElements;  /* 191 */
+  void* ReleaseByteArrayElements;  /* 192 */
+  void* ReleaseCharArrayElements;  /* 193 */
+  void* ReleaseShortArrayElements;  /* 194 */
+  void (*ReleaseIntArrayElements)(JNIEnv_*, jintArray, jint*, jint);  /* 195 */
+  void (*ReleaseLongArrayElements)(JNIEnv_*, jlongArray, jlong*, jint);  /* 196 */
+  void (*ReleaseFloatArrayElements)(JNIEnv_*, jfloatArray, jfloat*, jint);  /* 197 */
+  void* ReleaseDoubleArrayElements;  /* 198 */
+  void* GetBooleanArrayRegion;  /* 199 */
+  void* GetByteArrayRegion;  /* 200 */
+  void* GetCharArrayRegion;  /* 201 */
+  void* GetShortArrayRegion;  /* 202 */
+  void* GetIntArrayRegion;  /* 203 */
+  void* GetLongArrayRegion;  /* 204 */
+  void* GetFloatArrayRegion;  /* 205 */
+  void* GetDoubleArrayRegion;  /* 206 */
+  void* SetBooleanArrayRegion;  /* 207 */
+  void* SetByteArrayRegion;  /* 208 */
+  void* SetCharArrayRegion;  /* 209 */
+  void* SetShortArrayRegion;  /* 210 */
+  void (*SetIntArrayRegion)(JNIEnv_*, jintArray, jsize, jsize, const jint*);  /* 211 */
+  void (*SetLongArrayRegion)(JNIEnv_*, jlongArray, jsize, jsize, const jlong*);  /* 212 */
+  void (*SetFloatArrayRegion)(JNIEnv_*, jfloatArray, jsize, jsize, const jfloat*);  /* 213 */
+  void* SetDoubleArrayRegion;  /* 214 */
+  void* RegisterNatives;  /* 215 */
+  void* UnregisterNatives;  /* 216 */
+  void* MonitorEnter;  /* 217 */
+  void* MonitorExit;  /* 218 */
+  void* GetJavaVM;  /* 219 */
+  void* GetStringRegion;  /* 220 */
+  void* GetStringUTFRegion;  /* 221 */
+  void* GetPrimitiveArrayCritical;  /* 222 */
+  void* ReleasePrimitiveArrayCritical;  /* 223 */
+  void* GetStringCritical;  /* 224 */
+  void* ReleaseStringCritical;  /* 225 */
+  void* NewWeakGlobalRef;  /* 226 */
+  void* DeleteWeakGlobalRef;  /* 227 */
+  jboolean (*ExceptionCheck)(JNIEnv_*);  /* 228 */
+  void* NewDirectByteBuffer;  /* 229 */
+  void* GetDirectBufferAddress;  /* 230 */
+  void* GetDirectBufferCapacity;  /* 231 */
+  void* GetObjectRefType;  /* 232 */
+};
+
+#endif  /* MXTPU_VENDORED_JNI_H_ */

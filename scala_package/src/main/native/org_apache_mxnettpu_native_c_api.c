@@ -1,0 +1,343 @@
+/* JNI shim for incubator_mxnet_tpu's Scala binding.
+ *
+ * Analog of the reference's
+ * scala-package/native/src/main/native/org_apache_mxnet_native_c_api.cc:1
+ * — every exported Java_org_apache_mxnettpu_LibInfo_* function below is
+ * exactly what a JVM resolves for the @native declarations in
+ * src/main/scala/org/apache/mxnettpu/LibInfo.scala. The image ships no
+ * JVM (docs/STATUS.md), so CI drives these SAME symbols through a
+ * compiled C harness (test/jni_harness.c) that presents a spec-layout
+ * JNIEnv function table; with a real JVM, System.loadLibrary on this .so
+ * works unchanged because the vendored jni.h preserves the JNI 1.6
+ * table layout.
+ *
+ * NDArray handles cross the boundary as jlong (the reference does the
+ * same — JNI carries pointers in 64-bit longs). The flat MXTPU* ABI is
+ * resolved with dlopen from MXTPU_PREDICT_LIB, like the R/Julia shims.
+ *
+ * Build: gcc -O2 -shared -fPIC -I. org_apache_mxnettpu_native_c_api.c \
+ *            -ldl -o libmxtpu_scala.so
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "jni.h"
+
+/* ------------------------------------------------------------------ ABI */
+typedef int (*nd_create_t)(const char*, const int64_t*, int, const void*,
+                           int64_t, void**);
+typedef int (*nd_shape_t)(void*, int64_t*, int, int*);
+typedef int (*nd_dtype_t)(void*, char*, int);
+typedef int (*nd_data_t)(void*, void*, int64_t, int64_t*);
+typedef int (*nd_setdata_t)(void*, const char*, const void*, int64_t);
+typedef int (*nd_free_t)(void*);
+typedef int (*invoke_t)(const char*, void**, int, const char*, void**, int,
+                        int*);
+typedef int (*v_t)(void*);
+typedef int (*v0_t)(void);
+typedef int (*gg_t)(void*, void**);
+typedef const char* (*err_t)(void);
+
+static struct {
+  void* so;
+  nd_create_t nd_create;
+  nd_shape_t nd_shape;
+  nd_dtype_t nd_dtype;
+  nd_data_t nd_data;
+  nd_setdata_t nd_setdata;
+  nd_free_t nd_free;
+  invoke_t invoke;
+  v_t attach_grad, backward;
+  v0_t rec_begin, rec_end;
+  gg_t grad_of;
+  err_t last_err;
+} g_api;
+
+static char g_err[4096];
+
+static int api_init(void) {
+  if (g_api.so) return 0;
+  const char* path = getenv("MXTPU_PREDICT_LIB");
+  g_api.so = dlopen(path ? path : "libmxtpu_predict.so",
+                    RTLD_NOW | RTLD_GLOBAL);
+  if (!g_api.so) {
+    snprintf(g_err, sizeof(g_err), "dlopen: %s", dlerror());
+    return -1;
+  }
+#define SYM(field, name)                                      \
+  do {                                                        \
+    g_api.field = (typeof(g_api.field))dlsym(g_api.so, name); \
+    if (!g_api.field) {                                       \
+      snprintf(g_err, sizeof(g_err), "missing %s", name);     \
+      return -1;                                              \
+    }                                                         \
+  } while (0)
+  SYM(nd_create, "MXTPUNDCreate");
+  SYM(nd_shape, "MXTPUNDGetShape");
+  SYM(nd_dtype, "MXTPUNDGetDType");
+  SYM(nd_data, "MXTPUNDGetData");
+  SYM(nd_setdata, "MXTPUNDSetData");
+  SYM(nd_free, "MXTPUNDFree");
+  SYM(invoke, "MXTPUImperativeInvoke");
+  SYM(attach_grad, "MXTPUNDAttachGrad");
+  SYM(backward, "MXTPUNDBackward");
+  SYM(rec_begin, "MXTPUAutogradRecordBegin");
+  SYM(rec_end, "MXTPUAutogradRecordEnd");
+  SYM(grad_of, "MXTPUNDGetGrad");
+  SYM(last_err, "MXTPUNDGetLastError");
+#undef SYM
+  return 0;
+}
+
+static void set_err(const char* where) {
+  const char* e = g_api.last_err ? g_api.last_err() : "";
+  snprintf(g_err, sizeof(g_err), "%s: %s", where, e && *e ? e : "error");
+}
+
+/* ------------------------------------------------- JNI entry points
+ * Return jint rc (0 = ok); results cross through caller arrays via
+ * SetXxxArrayRegion, handles as jlong — the reference shim's idiom. */
+
+JNIEXPORT jstring JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuGetLastError(
+    JNIEnv* env, jobject obj) {
+  (void)obj;
+  return (*env)->NewStringUTF(env, g_err);
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayCreate(
+    JNIEnv* env, jobject obj, jstring jdtype, jlongArray jshape,
+    jfloatArray jdata, jlongArray jout) {
+  (void)obj;
+  if (api_init()) return -1;
+  const char* dtype = (*env)->GetStringUTFChars(env, jdtype, NULL);
+  jsize ndim = (*env)->GetArrayLength(env, jshape);
+  jsize n = (*env)->GetArrayLength(env, jdata);
+  jlong* shp = (*env)->GetLongArrayElements(env, jshape, NULL);
+  jfloat* data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  int64_t shape64[32];
+  int rc = -1;
+  const char* dt = dtype && *dtype ? dtype : "float32";
+  if (ndim > 32) {
+    snprintf(g_err, sizeof(g_err), "ndim %d exceeds shim cap 32", (int)ndim);
+  } else {
+    for (jsize i = 0; i < ndim; ++i) shape64[i] = shp[i];
+    void* h = NULL;
+    /* the Scala payload is Array[Float]; VALUE-convert (not bit-cast) to
+     * the requested storage dtype before crossing the ABI */
+    if (strcmp(dt, "float32") == 0) {
+      rc = g_api.nd_create(dt, shape64, ndim, data, (int64_t)n * 4, &h);
+    } else if (strcmp(dt, "int32") == 0) {
+      int32_t* buf = (int32_t*)malloc((size_t)n * 4);
+      if (!buf) goto create_done;
+      for (jsize i = 0; i < n; ++i) buf[i] = (int32_t)data[i];
+      rc = g_api.nd_create(dt, shape64, ndim, buf, (int64_t)n * 4, &h);
+      free(buf);
+    } else if (strcmp(dt, "float64") == 0) {
+      double* buf = (double*)malloc((size_t)n * 8);
+      if (!buf) goto create_done;
+      for (jsize i = 0; i < n; ++i) buf[i] = (double)data[i];
+      rc = g_api.nd_create(dt, shape64, ndim, buf, (int64_t)n * 8, &h);
+      free(buf);
+    } else {
+      snprintf(g_err, sizeof(g_err),
+               "unsupported dtype %s for the scala binding "
+               "(float32/int32/float64)", dt);
+      goto create_done;
+    }
+    if (rc) {
+      set_err("nd_create");
+    } else {
+      jlong hv = (jlong)(intptr_t)h;
+      (*env)->SetLongArrayRegion(env, jout, 0, 1, &hv);
+    }
+  }
+create_done:
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, 0);
+  (*env)->ReleaseLongArrayElements(env, jshape, shp, 0);
+  (*env)->ReleaseStringUTFChars(env, jdtype, dtype);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayGetShape(
+    JNIEnv* env, jobject obj, jlong handle, jintArray jndim,
+    jlongArray jshape) {
+  (void)obj;
+  if (api_init()) return -1;
+  int64_t shp[32];
+  int nd = 0;
+  jsize cap = (*env)->GetArrayLength(env, jshape);
+  if (g_api.nd_shape((void*)(intptr_t)handle, shp, 32, &nd)) {
+    set_err("nd_shape");
+    return -1;
+  }
+  if (nd > cap) {
+    snprintf(g_err, sizeof(g_err), "shape cap too small");
+    return -1;
+  }
+  jlong shpj[32];
+  for (int i = 0; i < nd; ++i) shpj[i] = shp[i];
+  (*env)->SetLongArrayRegion(env, jshape, 0, nd, shpj);
+  jint ndj = nd;
+  (*env)->SetIntArrayRegion(env, jndim, 0, 1, &ndj);
+  return 0;
+}
+
+/* payload out as float32 (converted from the array's dtype) */
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayGetData(
+    JNIEnv* env, jobject obj, jlong handle, jfloatArray jout) {
+  (void)obj;
+  if (api_init()) return -1;
+  void* h = (void*)(intptr_t)handle;
+  char dt[16] = {0};
+  if (g_api.nd_dtype(h, dt, sizeof(dt))) {
+    set_err("nd_dtype");
+    return -1;
+  }
+  int64_t nbytes = 0;
+  if (g_api.nd_data(h, NULL, 0, &nbytes)) {
+    set_err("nd_data");
+    return -1;
+  }
+  int item = strcmp(dt, "float64") == 0 ? 8 :
+             strcmp(dt, "float32") == 0 ? 4 :
+             strcmp(dt, "int32") == 0 ? 4 : 0;
+  if (!item) {
+    snprintf(g_err, sizeof(g_err), "unsupported dtype %s for scala", dt);
+    return -1;
+  }
+  int64_t count = nbytes / item;
+  jsize cap = (*env)->GetArrayLength(env, jout);
+  if (count > cap) {
+    snprintf(g_err, sizeof(g_err), "data cap too small");
+    return -1;
+  }
+  void* buf = malloc((size_t)nbytes);
+  if (!buf) return -1;
+  if (g_api.nd_data(h, buf, nbytes, NULL)) {
+    free(buf);
+    set_err("nd_data");
+    return -1;
+  }
+  jfloat* outf = (jfloat*)malloc((size_t)count * 4);
+  if (!outf) {
+    free(buf);
+    return -1;
+  }
+  for (int64_t i = 0; i < count; ++i) {
+    outf[i] = strcmp(dt, "float64") == 0 ? (jfloat)((double*)buf)[i] :
+              strcmp(dt, "float32") == 0 ? ((float*)buf)[i]
+                                         : (jfloat)((int32_t*)buf)[i];
+  }
+  (*env)->SetFloatArrayRegion(env, jout, 0, (jsize)count, outf);
+  free(outf);
+  free(buf);
+  return 0;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArraySetData(
+    JNIEnv* env, jobject obj, jlong handle, jfloatArray jdata) {
+  (void)obj;
+  if (api_init()) return -1;
+  jsize n = (*env)->GetArrayLength(env, jdata);
+  jfloat* data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  int rc = g_api.nd_setdata((void*)(intptr_t)handle, "float32", data,
+                            (int64_t)n * 4);
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, 0);
+  if (rc) set_err("nd_set_data");
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayFree(
+    JNIEnv* env, jobject obj, jlong handle) {
+  (void)env;
+  (void)obj;
+  if (api_init()) return -1;
+  return g_api.nd_free((void*)(intptr_t)handle);
+}
+
+/* name-dispatched eager op (≙ MXImperativeInvokeEx); attrs JSON string */
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuImperativeInvoke(
+    JNIEnv* env, jobject obj, jstring jop, jlongArray jins, jstring jattrs,
+    jlongArray jouts, jintArray jnout) {
+  (void)obj;
+  if (api_init()) return -1;
+  const char* op = (*env)->GetStringUTFChars(env, jop, NULL);
+  const char* attrs = (*env)->GetStringUTFChars(env, jattrs, NULL);
+  jsize nin = (*env)->GetArrayLength(env, jins);
+  jsize cap = (*env)->GetArrayLength(env, jouts);
+  jlong* in_h = (*env)->GetLongArrayElements(env, jins, NULL);
+  int rc = -1;
+  void* ins[64];
+  void* outs[64];
+  int n_out = 0;
+  if (nin > 64 || cap > 64) {
+    snprintf(g_err, sizeof(g_err), "nin/cap exceeds shim cap 64");
+  } else {
+    for (jsize i = 0; i < nin; ++i) ins[i] = (void*)(intptr_t)in_h[i];
+    rc = g_api.invoke(op, ins, nin, attrs, outs, 64, &n_out);
+    if (rc) {
+      set_err("invoke");
+    } else if (n_out > cap) {
+      snprintf(g_err, sizeof(g_err), "output cap too small");
+      rc = -1;
+    } else {
+      jlong out_h[64];
+      for (int i = 0; i < n_out; ++i) out_h[i] = (jlong)(intptr_t)outs[i];
+      (*env)->SetLongArrayRegion(env, jouts, 0, n_out, out_h);
+      jint nj = n_out;
+      (*env)->SetIntArrayRegion(env, jnout, 0, 1, &nj);
+    }
+  }
+  (*env)->ReleaseLongArrayElements(env, jins, in_h, 0);
+  (*env)->ReleaseStringUTFChars(env, jattrs, attrs);
+  (*env)->ReleaseStringUTFChars(env, jop, op);
+  return rc;
+}
+
+/* autograd slice: attach/record/backward/grad — train from Scala */
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayAttachGrad(
+    JNIEnv* env, jobject obj, jlong handle) {
+  (void)env;
+  (void)obj;
+  if (api_init()) return -1;
+  int rc = g_api.attach_grad((void*)(intptr_t)handle);
+  if (rc) set_err("attach_grad");
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuAutogradRecord(
+    JNIEnv* env, jobject obj, jint begin) {
+  (void)env;
+  (void)obj;
+  if (api_init()) return -1;
+  int rc = begin ? g_api.rec_begin() : g_api.rec_end();
+  if (rc) set_err("record");
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayBackward(
+    JNIEnv* env, jobject obj, jlong handle) {
+  (void)env;
+  (void)obj;
+  if (api_init()) return -1;
+  int rc = g_api.backward((void*)(intptr_t)handle);
+  if (rc) set_err("backward");
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxnettpu_LibInfo_mxtpuNDArrayGetGrad(
+    JNIEnv* env, jobject obj, jlong handle, jlongArray jout) {
+  (void)obj;
+  if (api_init()) return -1;
+  void* g = NULL;
+  if (g_api.grad_of((void*)(intptr_t)handle, &g)) {
+    set_err("grad_of");
+    return -1;
+  }
+  jlong gv = (jlong)(intptr_t)g;
+  (*env)->SetLongArrayRegion(env, jout, 0, 1, &gv);
+  return 0;
+}

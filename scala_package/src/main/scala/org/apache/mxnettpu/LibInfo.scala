@@ -1,0 +1,36 @@
+/* @native declarations for the incubator_mxnet_tpu JNI shim
+ * (src/main/native/org_apache_mxnettpu_native_c_api.c).
+ *
+ * Analog of the reference's
+ * scala-package/core/src/main/scala/org/apache/mxnet/LibInfo.scala over
+ * org_apache_mxnet_native_c_api.cc. Each @native method resolves to the
+ * exported Java_org_apache_mxnettpu_LibInfo_<name> symbol; the CI drift
+ * gate (tests/test_scala_package.py) pins name + argument count against
+ * the C shim, and the compiled harness drives the identical symbols with
+ * a spec-layout JNIEnv, so the FFI layer is exercised without a JVM.
+ */
+package org.apache.mxnettpu
+
+private[mxnettpu] class LibInfo {
+  @native def mxtpuGetLastError(): String
+  @native def mxtpuNDArrayCreate(dtype: String, shape: Array[Long],
+                                 data: Array[Float],
+                                 out: Array[Long]): Int
+  @native def mxtpuNDArrayGetShape(handle: Long, ndim: Array[Int],
+                                   shape: Array[Long]): Int
+  @native def mxtpuNDArrayGetData(handle: Long, out: Array[Float]): Int
+  @native def mxtpuNDArraySetData(handle: Long, data: Array[Float]): Int
+  @native def mxtpuNDArrayFree(handle: Long): Int
+  @native def mxtpuImperativeInvoke(op: String, inputs: Array[Long],
+                                    attrsJson: String, outputs: Array[Long],
+                                    numOutputs: Array[Int]): Int
+  @native def mxtpuNDArrayAttachGrad(handle: Long): Int
+  @native def mxtpuAutogradRecord(begin: Int): Int
+  @native def mxtpuNDArrayBackward(handle: Long): Int
+  @native def mxtpuNDArrayGetGrad(handle: Long, out: Array[Long]): Int
+}
+
+object LibInfo {
+  System.loadLibrary("mxtpu_scala")
+  private[mxnettpu] val lib = new LibInfo
+}

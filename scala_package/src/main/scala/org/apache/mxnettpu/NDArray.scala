@@ -1,0 +1,91 @@
+/* Scala NDArray over the JNI shim — the user-facing slice of the binding
+ * (ref scala-package/core/src/main/scala/org/apache/mxnet/NDArray.scala).
+ *
+ * The call sequences these methods make (create -> invoke -> autograd
+ * record/backward/grad -> free) are EXACTLY what the CI harness
+ * (test/jni_harness.c) drives through the exported Java_* symbols; the
+ * drift gate keeps this file and the C shim in lock-step.
+ */
+package org.apache.mxnettpu
+
+class MXTPUError(msg: String) extends RuntimeException(msg)
+
+class NDArray private[mxnettpu] (private[mxnettpu] val handle: Long)
+    extends AutoCloseable {
+  private def check(rc: Int): Unit =
+    if (rc != 0) throw new MXTPUError(LibInfo.lib.mxtpuGetLastError())
+
+  def shape: Array[Long] = {
+    val ndim = new Array[Int](1)
+    val shp = new Array[Long](32)
+    check(LibInfo.lib.mxtpuNDArrayGetShape(handle, ndim, shp))
+    shp.take(ndim(0))
+  }
+
+  def toArray: Array[Float] = {
+    val n = shape.product.toInt
+    val out = new Array[Float](n)
+    check(LibInfo.lib.mxtpuNDArrayGetData(handle, out))
+    out
+  }
+
+  def set(data: Array[Float]): NDArray = {
+    check(LibInfo.lib.mxtpuNDArraySetData(handle, data))
+    this
+  }
+
+  def attachGrad(): Unit =
+    check(LibInfo.lib.mxtpuNDArrayAttachGrad(handle))
+
+  def backward(): Unit =
+    check(LibInfo.lib.mxtpuNDArrayBackward(handle))
+
+  def grad: NDArray = {
+    val out = new Array[Long](1)
+    check(LibInfo.lib.mxtpuNDArrayGetGrad(handle, out))
+    new NDArray(out(0))
+  }
+
+  def +(other: NDArray): NDArray = NDArray.invoke("add", Array(this, other))
+  def -(other: NDArray): NDArray =
+    NDArray.invoke("subtract", Array(this, other))
+  def *(other: NDArray): NDArray =
+    NDArray.invoke("multiply", Array(this, other))
+
+  override def close(): Unit = {
+    LibInfo.lib.mxtpuNDArrayFree(handle)
+  }
+}
+
+object NDArray {
+  private def check(rc: Int): Unit =
+    if (rc != 0) throw new MXTPUError(LibInfo.lib.mxtpuGetLastError())
+
+  def array(data: Array[Float], shape: Array[Long],
+            dtype: String = "float32"): NDArray = {
+    val out = new Array[Long](1)
+    check(LibInfo.lib.mxtpuNDArrayCreate(dtype, shape, data, out))
+    new NDArray(out(0))
+  }
+
+  /** Name-dispatched eager op (≙ mx.nd.<op>); attrs as a JSON object. */
+  def invoke(op: String, inputs: Array[NDArray],
+             attrsJson: String = "{}"): NDArray = {
+    val outs = new Array[Long](64)
+    val nout = new Array[Int](1)
+    check(LibInfo.lib.mxtpuImperativeInvoke(
+      op, inputs.map(_.handle), attrsJson, outs, nout))
+    new NDArray(outs(0))
+  }
+}
+
+object Autograd {
+  private def check(rc: Int): Unit =
+    if (rc != 0) throw new MXTPUError(LibInfo.lib.mxtpuGetLastError())
+
+  def record[T](body: => T): T = {
+    check(LibInfo.lib.mxtpuAutogradRecord(1))
+    try body
+    finally check(LibInfo.lib.mxtpuAutogradRecord(0))
+  }
+}

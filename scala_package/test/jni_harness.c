@@ -1,0 +1,298 @@
+/* Drives the Scala JNI shim's exported Java_* symbols with the exact call
+ * sequence NDArray.scala/Autograd.scala make (create -> invoke -> autograd
+ * train step -> grads vs closed form -> set_data round trip -> error
+ * path). The image ships no JVM, so this compiled harness IS the CI
+ * execution of the binding's FFI layer: it presents a JNIEnv whose
+ * function table uses the SAME spec layout a real JVM provides (the
+ * _Static_asserts below pin the slot offsets to the JNI 1.6 numbers), so
+ * the shim binary is exercised exactly as the JVM would exercise it.
+ *
+ * Build: gcc -O2 -I../src/main/native jni_harness.c -ldl -o jni_harness
+ */
+#include <dlfcn.h>
+#include <math.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "jni.h"
+
+/* pin the vendored header's layout to the JNI spec slot numbers */
+_Static_assert(offsetof(struct JNINativeInterface_, NewStringUTF)
+               == 167 * sizeof(void*), "NewStringUTF slot");
+_Static_assert(offsetof(struct JNINativeInterface_, GetStringUTFChars)
+               == 169 * sizeof(void*), "GetStringUTFChars slot");
+_Static_assert(offsetof(struct JNINativeInterface_, GetArrayLength)
+               == 171 * sizeof(void*), "GetArrayLength slot");
+_Static_assert(offsetof(struct JNINativeInterface_, GetIntArrayElements)
+               == 187 * sizeof(void*), "GetIntArrayElements slot");
+_Static_assert(offsetof(struct JNINativeInterface_, GetLongArrayElements)
+               == 188 * sizeof(void*), "GetLongArrayElements slot");
+_Static_assert(offsetof(struct JNINativeInterface_, GetFloatArrayElements)
+               == 189 * sizeof(void*), "GetFloatArrayElements slot");
+_Static_assert(offsetof(struct JNINativeInterface_, ReleaseIntArrayElements)
+               == 195 * sizeof(void*), "ReleaseIntArrayElements slot");
+_Static_assert(offsetof(struct JNINativeInterface_, SetIntArrayRegion)
+               == 211 * sizeof(void*), "SetIntArrayRegion slot");
+_Static_assert(offsetof(struct JNINativeInterface_, SetLongArrayRegion)
+               == 212 * sizeof(void*), "SetLongArrayRegion slot");
+_Static_assert(offsetof(struct JNINativeInterface_, SetFloatArrayRegion)
+               == 213 * sizeof(void*), "SetFloatArrayRegion slot");
+_Static_assert(sizeof(struct JNINativeInterface_) == 233 * sizeof(void*),
+               "JNI 1.6 table size");
+
+/* ------------------------------------------------- fake JVM objects */
+typedef struct {
+  jsize len;
+  void* data;
+} fake_arr;
+
+static jstring S(const char* s) { return (jstring)s; }
+
+static fake_arr* A(jsize len, void* data) {
+  fake_arr* a = (fake_arr*)malloc(sizeof(fake_arr));
+  a->len = len;
+  a->data = data;
+  return a;
+}
+
+/* ------------------------------------------------- fake JNIEnv table */
+static const char* f_GetStringUTFChars(JNIEnv_* env, jstring s,
+                                       jboolean* copy) {
+  (void)env;
+  if (copy) *copy = JNI_FALSE;
+  return (const char*)s;
+}
+static void f_ReleaseStringUTFChars(JNIEnv_* env, jstring s,
+                                    const char* c) {
+  (void)env; (void)s; (void)c;
+}
+static jstring f_NewStringUTF(JNIEnv_* env, const char* s) {
+  (void)env;
+  return (jstring)strdup(s);
+}
+static jsize f_GetArrayLength(JNIEnv_* env, jarray a) {
+  (void)env;
+  return ((fake_arr*)a)->len;
+}
+static jint* f_GetIntArrayElements(JNIEnv_* env, jintArray a, jboolean* c) {
+  (void)env; (void)c;
+  return (jint*)((fake_arr*)a)->data;
+}
+static jlong* f_GetLongArrayElements(JNIEnv_* env, jlongArray a,
+                                     jboolean* c) {
+  (void)env; (void)c;
+  return (jlong*)((fake_arr*)a)->data;
+}
+static jfloat* f_GetFloatArrayElements(JNIEnv_* env, jfloatArray a,
+                                       jboolean* c) {
+  (void)env; (void)c;
+  return (jfloat*)((fake_arr*)a)->data;
+}
+static void f_ReleaseIntArrayElements(JNIEnv_* env, jintArray a, jint* p,
+                                      jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+static void f_ReleaseLongArrayElements(JNIEnv_* env, jlongArray a, jlong* p,
+                                       jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+static void f_ReleaseFloatArrayElements(JNIEnv_* env, jfloatArray a,
+                                        jfloat* p, jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+static void f_SetIntArrayRegion(JNIEnv_* env, jintArray a, jsize start,
+                                jsize len, const jint* buf) {
+  (void)env;
+  memcpy((jint*)((fake_arr*)a)->data + start, buf, (size_t)len * 4);
+}
+static void f_SetLongArrayRegion(JNIEnv_* env, jlongArray a, jsize start,
+                                 jsize len, const jlong* buf) {
+  (void)env;
+  memcpy((jlong*)((fake_arr*)a)->data + start, buf, (size_t)len * 8);
+}
+static void f_SetFloatArrayRegion(JNIEnv_* env, jfloatArray a, jsize start,
+                                  jsize len, const jfloat* buf) {
+  (void)env;
+  memcpy((jfloat*)((fake_arr*)a)->data + start, buf, (size_t)len * 4);
+}
+
+static struct JNINativeInterface_ g_iface;
+static JNIEnv_ g_env = &g_iface;
+
+static void env_init(void) {
+  memset(&g_iface, 0, sizeof(g_iface));
+  g_iface.NewStringUTF = f_NewStringUTF;
+  g_iface.GetStringUTFChars = f_GetStringUTFChars;
+  g_iface.ReleaseStringUTFChars = f_ReleaseStringUTFChars;
+  g_iface.GetArrayLength = f_GetArrayLength;
+  g_iface.GetIntArrayElements = f_GetIntArrayElements;
+  g_iface.GetLongArrayElements = f_GetLongArrayElements;
+  g_iface.GetFloatArrayElements = f_GetFloatArrayElements;
+  g_iface.ReleaseIntArrayElements = f_ReleaseIntArrayElements;
+  g_iface.ReleaseLongArrayElements = f_ReleaseLongArrayElements;
+  g_iface.ReleaseFloatArrayElements = f_ReleaseFloatArrayElements;
+  g_iface.SetIntArrayRegion = f_SetIntArrayRegion;
+  g_iface.SetLongArrayRegion = f_SetLongArrayRegion;
+  g_iface.SetFloatArrayRegion = f_SetFloatArrayRegion;
+}
+
+/* ------------------------------------------------- shim symbols */
+typedef jint (*create_t)(JNIEnv*, jobject, jstring, jlongArray, jfloatArray,
+                         jlongArray);
+typedef jint (*shape_t)(JNIEnv*, jobject, jlong, jintArray, jlongArray);
+typedef jint (*data_t)(JNIEnv*, jobject, jlong, jfloatArray);
+typedef jint (*setdata_t)(JNIEnv*, jobject, jlong, jfloatArray);
+typedef jint (*free_t)(JNIEnv*, jobject, jlong);
+typedef jint (*invoke_t)(JNIEnv*, jobject, jstring, jlongArray, jstring,
+                         jlongArray, jintArray);
+typedef jint (*h1_t)(JNIEnv*, jobject, jlong);
+typedef jint (*rec_t)(JNIEnv*, jobject, jint);
+typedef jint (*grad_t)(JNIEnv*, jobject, jlong, jlongArray);
+typedef jstring (*err_t)(JNIEnv*, jobject);
+
+static void* shim;
+static err_t f_err;
+
+#define LOAD(var, name)                                        \
+  var = (typeof(var))dlsym(shim, "Java_org_apache_mxnettpu_LibInfo_" name); \
+  if (!var) {                                                  \
+    fprintf(stderr, "missing Java_..._%s\n", name);            \
+    return 1;                                                  \
+  }
+
+#define CHECK(rc)                                              \
+  if ((rc) != 0) {                                             \
+    jstring e = f_err(&g_env, NULL);                           \
+    fprintf(stderr, "rc!=0 err=%s (line %d)\n",                \
+            e ? (const char*)e : "?", __LINE__);               \
+    return 1;                                                  \
+  }
+
+int main(void) {
+  env_init();
+  const char* path = getenv("SCALA_SHIM");
+  shim = dlopen(path ? path : "./libmxtpu_scala.so", RTLD_NOW);
+  if (!shim) {
+    fprintf(stderr, "dlopen shim: %s\n", dlerror());
+    return 1;
+  }
+  create_t f_create;
+  shape_t f_shape;
+  data_t f_data;
+  setdata_t f_setdata;
+  free_t f_free;
+  invoke_t f_invoke;
+  h1_t f_attach, f_backward;
+  rec_t f_record;
+  grad_t f_grad;
+  LOAD(f_err, "mxtpuGetLastError");
+  LOAD(f_create, "mxtpuNDArrayCreate");
+  LOAD(f_shape, "mxtpuNDArrayGetShape");
+  LOAD(f_data, "mxtpuNDArrayGetData");
+  LOAD(f_setdata, "mxtpuNDArraySetData");
+  LOAD(f_free, "mxtpuNDArrayFree");
+  LOAD(f_invoke, "mxtpuImperativeInvoke");
+  LOAD(f_attach, "mxtpuNDArrayAttachGrad");
+  LOAD(f_record, "mxtpuAutogradRecord");
+  LOAD(f_backward, "mxtpuNDArrayBackward");
+  LOAD(f_grad, "mxtpuNDArrayGetGrad");
+
+  /* --- create (2,3) arrays, elementwise multiply, read back ------- */
+  jlong shp[2] = {2, 3};
+  jfloat xd[6] = {1, 2, 3, 4, 5, 6};
+  jfloat wd[6] = {2, 2, 2, 3, 3, 3};
+  jlong hbuf[1];
+  fake_arr* jshape = A(2, shp);
+  fake_arr* jout = A(1, hbuf);
+  CHECK(f_create(&g_env, NULL, S("float32"), (jlongArray)jshape,
+                 (jfloatArray)A(6, xd), (jlongArray)jout));
+  jlong hx = hbuf[0];
+  CHECK(f_create(&g_env, NULL, S("float32"), (jlongArray)jshape,
+                 (jfloatArray)A(6, wd), (jlongArray)jout));
+  jlong hw = hbuf[0];
+
+  jlong ins[2] = {hw, hx};
+  jlong outs[64];
+  jint nout[1];
+  CHECK(f_invoke(&g_env, NULL, S("multiply"), (jlongArray)A(2, ins),
+                 S("{}"), (jlongArray)A(64, outs), (jintArray)A(1, nout)));
+  if (nout[0] != 1) return 1;
+  jfloat got[6];
+  CHECK(f_data(&g_env, NULL, outs[0], (jfloatArray)A(6, got)));
+  for (int i = 0; i < 6; ++i)
+    if (fabsf(got[i] - xd[i] * wd[i]) > 1e-5f) {
+      fprintf(stderr, "multiply mismatch at %d: %f\n", i, got[i]);
+      return 1;
+    }
+  CHECK(f_free(&g_env, NULL, outs[0]));
+  printf("INVOKE ok\n");
+
+  /* --- shape introspection ---------------------------------------- */
+  jint ndim[1];
+  jlong shp_out[32];
+  CHECK(f_shape(&g_env, NULL, hx, (jintArray)A(1, ndim),
+                (jlongArray)A(32, shp_out)));
+  if (ndim[0] != 2 || shp_out[0] != 2 || shp_out[1] != 3) {
+    fprintf(stderr, "shape mismatch\n");
+    return 1;
+  }
+  printf("ATTRS ok\n");
+
+  /* --- autograd: y = sum(w * x); dy/dw == x ------------------------ */
+  CHECK(f_attach(&g_env, NULL, hw));
+  CHECK(f_record(&g_env, NULL, 1));
+  CHECK(f_invoke(&g_env, NULL, S("multiply"), (jlongArray)A(2, ins),
+                 S("{}"), (jlongArray)A(64, outs), (jintArray)A(1, nout)));
+  jlong hy = outs[0];
+  jlong one_in[1] = {hy};
+  CHECK(f_invoke(&g_env, NULL, S("sum"), (jlongArray)A(1, one_in), S("{}"),
+                 (jlongArray)A(64, outs), (jintArray)A(1, nout)));
+  jlong hloss = outs[0];
+  CHECK(f_record(&g_env, NULL, 0));
+  CHECK(f_backward(&g_env, NULL, hloss));
+  jlong gbuf[1];
+  CHECK(f_grad(&g_env, NULL, hw, (jlongArray)A(1, gbuf)));
+  jfloat gw[6];
+  CHECK(f_data(&g_env, NULL, gbuf[0], (jfloatArray)A(6, gw)));
+  for (int i = 0; i < 6; ++i)
+    if (fabsf(gw[i] - xd[i]) > 1e-5f) {
+      fprintf(stderr, "grad mismatch at %d: %f vs %f\n", i, gw[i], xd[i]);
+      return 1;
+    }
+  CHECK(f_free(&g_env, NULL, hy));
+  CHECK(f_free(&g_env, NULL, hloss));
+  printf("TRAINOK\n");
+
+  /* --- set_data round trip ----------------------------------------- */
+  jfloat nv[6] = {9, 8, 7, 6, 5, 4};
+  CHECK(f_setdata(&g_env, NULL, hx, (jfloatArray)A(6, nv)));
+  CHECK(f_data(&g_env, NULL, hx, (jfloatArray)A(6, got)));
+  for (int i = 0; i < 6; ++i)
+    if (fabsf(got[i] - nv[i]) > 1e-5f) {
+      fprintf(stderr, "set_data mismatch at %d\n", i);
+      return 1;
+    }
+  printf("SETDATAOK\n");
+
+  /* --- error path: bogus op must fail with a message ---------------- */
+  jlong live_in[1] = {hx};
+  if (f_invoke(&g_env, NULL, S("definitely_not_an_op"),
+               (jlongArray)A(1, live_in), S("{}"), (jlongArray)A(64, outs),
+               (jintArray)A(1, nout)) == 0) {
+    fprintf(stderr, "bogus op unexpectedly succeeded\n");
+    return 1;
+  }
+  jstring e = f_err(&g_env, NULL);
+  if (!e || !strlen((const char*)e)) {
+    fprintf(stderr, "empty error message\n");
+    return 1;
+  }
+  printf("ERRPATH ok\n");
+
+  CHECK(f_free(&g_env, NULL, hx));
+  CHECK(f_free(&g_env, NULL, hw));
+  printf("SCALA HARNESS OK\n");
+  return 0;
+}

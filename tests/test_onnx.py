@@ -270,3 +270,65 @@ def test_onnx_rnn_yh_consumer_fails_loudly(tmp_path):
         f.write(P.model(g, opset=17))
     with pytest.raises(ValueError, match="undefined input"):
         mx_onnx.import_model(path)
+
+
+def test_onnx_rnn_state_none_with_cell(tmp_path):
+    """RNN(data, p, None, c0): the omitted state shifts input positions —
+    export must NOT read c0 as initial_h (the __arg_spec__ slot map)."""
+    from incubator_mxnet_tpu.ndarray.rnn_op import rnn_param_size
+    sym = mx.sym
+    T, N, I, H = 3, 2, 4, 5
+    rng = onp.random.RandomState(6)
+    out = sym.RNN(sym.var("data"), sym.var("p"), None, sym.var("c0"),
+                  state_size=H, num_layers=1, mode="lstm", name="rnn0")
+    params = {"p": nd.array(
+        rng.randn(rnn_param_size("lstm", I, H)).astype("float32") * 0.3)}
+    path = str(tmp_path / "sn.onnx")
+    mx_onnx.export_model(out, params, [(T, N, I), (1, N, H)],
+                         onnx_file_path=path)
+    sym2, arg2, aux2 = mx_onnx.import_model(path)
+    x = nd.array(rng.randn(T, N, I).astype("float32"))
+    c = nd.array(rng.randn(1, N, H).astype("float32") * 0.5)
+    ref = out.eval(data=x, c0=c, **params)[0]
+    got = sym2.eval(data=x, c0=c, **arg2)[0]
+    assert_almost_equal(got.asnumpy(), ref.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_import_guards():
+    """External-file robustness: negative Unsqueeze axes resolve against
+    the output rank; non-default LSTM activations are refused loudly."""
+    import pytest
+    from incubator_mxnet_tpu.contrib import onnx_proto as P
+    import tempfile, os as _os
+
+    def write_model(nodes, inputs, outputs, inits):
+        d = tempfile.mkdtemp()
+        p = _os.path.join(d, "m.onnx")
+        g = P.graph("g", nodes, inputs, outputs, inits)
+        with open(p, "wb") as f:
+            f.write(P.model(g, opset=17))
+        return p
+
+    # Unsqueeze axes=[-2,-1] on shape (2,) -> (2,1,1)
+    p = write_model(
+        [P.node("Unsqueeze", ["x", "ax"], ["y"], "unsq")],
+        [P.value_info("x", (2,))], [P.value_info("y", (2, 1, 1))],
+        [P.tensor("ax", onp.asarray([-2, -1], "int64"))])
+    sym2, arg2, _ = mx_onnx.import_model(p)
+    got = sym2.eval(x=nd.array(onp.array([3.0, 4.0], "float32")), **arg2)[0]
+    assert got.shape == (2, 1, 1)
+
+    # LSTM with non-default activations must raise, not silently map
+    H_ = 4
+    rng = onp.random.RandomState(1)
+    p = write_model(
+        [P.node("LSTM", ["x", "W", "R"], ["Y"], "lstm0",
+                [P.attr_int("hidden_size", H_),
+                 P.attr_string("direction", "forward"),
+                 P.attr_strings("activations",
+                                ["HardSigmoid", "Tanh", "Tanh"])])],
+        [P.value_info("x", (2, 1, 3))], [P.value_info("Y", (2, 1, 1, H_))],
+        [P.tensor("W", rng.randn(1, 4 * H_, 3).astype("float32")),
+         P.tensor("R", rng.randn(1, 4 * H_, H_).astype("float32"))])
+    with pytest.raises(NotImplementedError, match="activations"):
+        mx_onnx.import_model(p)
