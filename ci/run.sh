@@ -11,6 +11,8 @@
 #             TSAN (-fsanitize=thread) compile of the native layer (the
 #             race-detection build the TSAN test also uses; ref ASAN job)
 #   suite   - quick test suite on the 8-device virtual CPU mesh
+#   serving - inference serving subsystem end-to-end on CPU (dynamic
+#             batching, hot reload, backpressure, HTTP front-end)
 #   smoke   - driver contract: entry() jit-compiles on CPU and
 #             dryrun_multichip(8) runs a full sharded train step
 #   large   - int64 large-tensor tier (>2^31 elements; int8/uint8 dtypes
@@ -21,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -50,7 +52,14 @@ fi
 
 if has_stage suite; then
   echo "=== suite: quick tests on the 8-device virtual CPU mesh ==="
-  MXTPU_TEST_QUICK=1 python -m pytest tests/ -q -x
+  # test_serving.py runs in its own stage below — don't pay for the
+  # 64-client e2e tier twice in the default pipeline
+  MXTPU_TEST_QUICK=1 python -m pytest tests/ -q -x --ignore=tests/test_serving.py
+fi
+
+if has_stage serving; then
+  echo "=== serving: inference serving subsystem e2e on CPU ==="
+  python -m pytest tests/test_serving.py -q
 fi
 
 if has_stage smoke; then

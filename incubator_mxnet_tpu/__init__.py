@@ -91,6 +91,7 @@ from . import numpy as np
 from . import numpy_extension
 from . import numpy_extension as npx
 from . import contrib
+from . import serving
 
 # ---- env-driven startup behaviors (config.ENV_VARS documents each) ----
 if config.get_env("MXTPU_SEED") is not None:

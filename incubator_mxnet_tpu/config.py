@@ -114,6 +114,35 @@ ENV_VARS = {
         "slicing): no collective carries more than this many elements, so "
         "time-to-first-averaged-parameter is bounded by the slice, not "
         "the largest tensor."),
+    "MXTPU_SERVE_MAX_BATCH": (
+        int, 8,
+        "Dynamic batcher dispatch bound (serving/batcher.py): a batch is "
+        "dispatched when this many requests are waiting, or when "
+        "MXTPU_SERVE_TIMEOUT_MS elapses after the first one. Match it to "
+        "the batch axis the servable compiles best at (an exported .mxtpu "
+        "artifact re-chunks buckets onto its one exported batch shape)."),
+    "MXTPU_SERVE_TIMEOUT_MS": (
+        float, 5.0,
+        "Dynamic batcher coalescing window in milliseconds: the longest a "
+        "request waits for companions before a partial batch is flushed. "
+        "Raise to trade tail latency for bigger batches (TF-Serving "
+        "batch_timeout_micros analog)."),
+    "MXTPU_SERVE_QUEUE_SIZE": (
+        int, 64,
+        "Bound on each model's serving request queue (serving/batcher.py). "
+        "A full queue rejects submits with QueueFullError (HTTP 429) — "
+        "explicit backpressure instead of unbounded latency; /healthz "
+        "reports degraded at >= 80% occupancy."),
+    "MXTPU_SERVE_DEADLINE_MS": (
+        float, None,
+        "Default per-request serving deadline in milliseconds: requests "
+        "still queued when it passes fail with DeadlineExceededError "
+        "(HTTP 504) instead of dispatching stale work. None = no deadline; "
+        "a request's own deadline_ms overrides."),
+    "MXTPU_SERVE_PORT": (
+        int, 8080,
+        "Default port for serving.ServingServer's HTTP front-end "
+        "(serving/server.py); 0 picks an ephemeral port (tests)."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

@@ -324,12 +324,21 @@ def export_model(sym, params, input_shape, input_type="float32",
                 step = tuple(kw.get("step") or (1,) * len(begin))
                 axes = tuple(range(len(begin)))
             INT_MAX = 2 ** 62
-            st = onp.asarray([b if b is not None else 0 for b in begin],
-                             "int64")
-            en = onp.asarray([e if e is not None else INT_MAX for e in end],
-                             "int64")
             sp = onp.asarray([s if s is not None else 1 for s in step],
                              "int64")
+            if any(s == 0 for s in sp):
+                raise ValueError("ONNX export: slice step 0")
+            # open (None) bounds follow the step's direction: a negative
+            # step starts from the far end (runtimes clamp INT_MAX to
+            # dim-1) and runs to before the beginning (-INT_MAX) — the
+            # former unconditional +INT_MAX end made conformant runtimes
+            # (onnxruntime) evaluate reversed slices as empty
+            st = onp.asarray([b if b is not None
+                              else (0 if s > 0 else INT_MAX)
+                              for b, s in zip(begin, sp)], "int64")
+            en = onp.asarray([e if e is not None
+                              else (INT_MAX if s > 0 else -INT_MAX)
+                              for e, s in zip(end, sp)], "int64")
             sn, enn, axn, spn = (fresh("sl_st"), fresh("sl_en"),
                                  fresh("sl_ax"), fresh("sl_sp"))
             extra_inits[sn] = st
@@ -613,7 +622,14 @@ def import_model(model_file):
                                            end=None if e >= INT_MAX else e)
             else:
                 # strided slice: mx.sym.slice takes per-leading-axis
-                # begin/end/step tuples
+                # begin/end/step tuples, so axes must be non-negative —
+                # a raw -1 would compute rank 0 and mis-index; the input
+                # rank is not known symbolically here, so reject loudly
+                # (the unit-step slice_axis path above tolerates them)
+                if any(a < 0 for a in axes):
+                    raise NotImplementedError(
+                        "ONNX import: strided Slice with negative axes %r "
+                        "(input rank unknown at import)" % (axes,))
                 rank = max(axes) + 1
                 bg, en_, sp = ([0] * rank, [None] * rank, [1] * rank)
                 for ax, b, e, s in zip(axes, starts, ends, steps):
@@ -683,9 +699,9 @@ def _import_rnn(n, at, ins, inits, arg_params, value, mxsym, nd, op):
     if op == "GRU" and not int(at.get("linear_before_reset", 0)):
         raise NotImplementedError(
             "ONNX import: GRU linear_before_reset=0 (cuDNN layout is 1)")
-    W = onp.asarray(inits[names[1]].asnumpy()
-                    if hasattr(inits[names[1]], "asnumpy")
-                    else inits[names[1]], "float32")
+    # read_initializers yields plain numpy arrays — one uniform conversion
+    # for W, R, and B (no wrapper special-cases)
+    W = onp.asarray(inits[names[1]], "float32")
     R = onp.asarray(inits[names[2]], "float32")
     B = (onp.asarray(inits[names[3]], "float32")
          if len(names) > 3 and names[3]

@@ -26,6 +26,7 @@ Format: 8-byte magic "MXTPU\\x00v1" + jax.export bytes.
 from __future__ import annotations
 
 import jax
+import jax.export  # jax>=0.4.30 does not re-export the submodule lazily
 
 from ..gluon import _functional
 from ..ndarray import NDArray
@@ -121,3 +122,45 @@ class ServedModel:
         if isinstance(out, (list, tuple)):
             return tuple(NDArray(o) for o in out)
         return NDArray(out)
+
+    @property
+    def batch_size(self):
+        """The exported batch-axis extent (dim 0 of the first input)."""
+        shp = self.input_shapes[0]
+        if not shp:
+            raise ValueError("exported model has a rank-0 input — no "
+                             "batch axis to serve over")
+        return int(shp[0])
+
+    def predict_batch(self, *stacked_inputs):
+        """Serving-batcher entry point: run ``n`` stacked items (dim 0)
+        through the FIXED exported batch shape by re-chunking.
+
+        The artifact compiled exactly one batch size ``B``; a dynamic
+        batcher produces buckets of any size. Inputs are split into
+        ceil(n/B) chunks, the last chunk padded to ``B`` by repeating its
+        final row (shape/dtype-exact, values in-distribution), and outputs
+        are concatenated with the padding rows dropped — so callers see a
+        true dim-0 batch axis whatever ``B`` was. Returns a tuple of
+        numpy arrays (host-side: results go straight onto the wire).
+        """
+        import numpy as onp
+
+        B = self.batch_size
+        ins = [onp.asarray(x._data if isinstance(x, NDArray) else x)
+               for x in stacked_inputs]
+        avals = self._exp.in_avals
+        ins = [x.astype(a.dtype, copy=False) for x, a in zip(ins, avals)]
+        n = ins[0].shape[0]
+        out_chunks = []
+        for lo in range(0, n, B):
+            chunk = [x[lo:lo + B] for x in ins]
+            pad = B - chunk[0].shape[0]
+            if pad:
+                chunk = [onp.concatenate([c, onp.repeat(c[-1:], pad, axis=0)])
+                         for c in chunk]
+            out = self._exp.call(*chunk)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            out_chunks.append([onp.asarray(o)[:B - pad] for o in outs])
+        return tuple(onp.concatenate([ch[i] for ch in out_chunks])
+                     for i in range(len(out_chunks[0])))

@@ -12,7 +12,7 @@ import threading
 import time
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
-           "scope", "Marker", "record_event", "device_memory",
+           "scope", "Marker", "record_event", "record_batch", "device_memory",
            "memory_summary", "set_memory_source"]
 
 _CONFIG = {"filename": "profile.json", "aggregate_stats": True,
@@ -65,7 +65,8 @@ def state():
     return "run" if _STATE["running"] else "stop"
 
 
-def record_event(name, categories="host", start_us=None, dur_us=None):
+def record_event(name, categories="host", start_us=None, dur_us=None,
+                 args=None):
     """Record one host-side event (complete-event 'X' phase).
 
     The per-event trace list is bounded (config max_events, default 500k;
@@ -75,13 +76,26 @@ def record_event(name, categories="host", start_us=None, dur_us=None):
         return
     with _LOCK:
         if len(_EVENTS) < _CONFIG.get("max_events", 500_000):
-            _EVENTS.append({"name": name, "cat": categories, "ph": "X",
-                            "ts": start_us if start_us is not None else time.time() * 1e6,
-                            "dur": dur_us or 0, "pid": 0, "tid": threading.get_ident()})
+            ev = {"name": name, "cat": categories, "ph": "X",
+                  "ts": start_us if start_us is not None else time.time() * 1e6,
+                  "dur": dur_us or 0, "pid": 0, "tid": threading.get_ident()}
+            if args is not None:
+                ev["args"] = args
+            _EVENTS.append(ev)
         agg = _AGG.setdefault(name, {"count": 0, "total_us": 0.0, "max_us": 0.0})
         agg["count"] += 1
         agg["total_us"] += dur_us or 0
         agg["max_us"] = max(agg["max_us"], dur_us or 0)
+
+
+def record_batch(model, size, bucket, start_us=None, dur_us=None):
+    """Per-dispatch serving hook (serving/batcher.py): one complete event
+    per dispatched batch, named by model and padded bucket shape so the
+    aggregate table groups rows per compiled executable; the real
+    (non-padding) item count rides along as an event arg."""
+    record_event("serve:%s:batch%d" % (model, bucket), "serving",
+                 start_us, dur_us,
+                 args={"batch_size": size, "bucket": bucket})
 
 
 class Marker:

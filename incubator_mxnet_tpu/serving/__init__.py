@@ -1,0 +1,47 @@
+"""Production inference serving (TF-Serving analog, arXiv:1605.08695 §4.3).
+
+The training side of this framework compiles whole steps; this package is
+the traffic side: it turns exported ``.mxtpu`` artifacts
+(``contrib.serving``) and live Gluon blocks into a servable endpoint that
+saturates an accelerator under many small requests.
+
+Layers (each importable alone):
+
+- ``batcher``  — DynamicBatcher: bounded queue + size-or-deadline
+  coalescing into bucketed batch shapes (each bucket compiles once).
+- ``registry`` — ModelRegistry: named, versioned models, hot reload with
+  connection draining, one batcher per model.
+- ``metrics``  — ServingMetrics: counters, batch-size histogram,
+  p50/p95/p99 latency from a ring buffer.
+- ``server``   — ServingServer: stdlib ThreadingHTTPServer front-end with
+  JSON tensors, /healthz, /metrics, and explicit 429 backpressure.
+
+Sixty-second start::
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import serving
+
+    reg = serving.ModelRegistry()
+    reg.load("mnist", mx.contrib.serving.load("model.mxtpu"))
+    with serving.ServingServer(reg, port=8080) as srv:
+        ...   # POST /v1/models/mnist:predict
+
+Capacity knobs are the ``MXTPU_SERVE_*`` env vars (config.py registry;
+docs/SERVING.md has tuning guidance). Single-host scope: one process,
+one registry — put a load balancer in front for fleet serving.
+"""
+from __future__ import annotations
+
+from .batcher import (DynamicBatcher, QueueFullError, DeadlineExceededError,
+                      ServingClosedError, default_buckets)
+from .metrics import ServingMetrics, percentile
+from .registry import ModelRegistry, BlockServable, ModelNotFoundError
+from .server import ServingServer, serve
+
+__all__ = [
+    "DynamicBatcher", "QueueFullError", "DeadlineExceededError",
+    "ServingClosedError", "default_buckets",
+    "ServingMetrics", "percentile",
+    "ModelRegistry", "BlockServable", "ModelNotFoundError",
+    "ServingServer", "serve",
+]

@@ -1,0 +1,348 @@
+"""Dynamic request batcher (TF-Serving BatchingSession analog,
+arXiv:1605.08695 §4.3: cross-request batching in front of a compiled
+executable is how many small requests saturate an accelerator).
+
+One worker thread per model pulls single-item requests off a BOUNDED
+queue and dispatches a stacked batch when either ``max_batch_size``
+requests are waiting or ``batch_timeout_ms`` has passed since the first
+one — classic size-or-deadline coalescing. Batches are padded up to a
+small set of bucket sizes (powers of two by default) so the servable
+underneath sees only a handful of shapes: a live Gluon block compiles
+once per bucket through jit.EvalStep's shape-keyed executable cache, and
+an exported .mxtpu artifact re-chunks every bucket onto its one compiled
+batch shape (contrib/serving.ServedModel.predict_batch).
+
+Robustness contract:
+- full queue  -> ``QueueFullError`` raised at submit time (explicit
+  backpressure; HTTP maps it to 429 — never unbounded latency),
+- per-request deadline -> ``DeadlineExceededError`` for requests still
+  queued when it passes (they are dropped BEFORE padding/dispatch),
+- ``close(drain=True)`` -> stops intake, finishes everything queued,
+  then joins the worker.
+
+Only the worker thread touches the servable (and therefore JAX), so
+arbitrary many client threads can submit concurrently.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import queue as _queue
+
+import numpy as onp
+
+from .. import config
+from .metrics import ServingMetrics
+
+__all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
+           "ServingClosedError", "default_buckets"]
+
+
+class QueueFullError(RuntimeError):
+    """Overload rejection: the bounded request queue is at capacity."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before it could be dispatched."""
+
+
+class ServingClosedError(RuntimeError):
+    """Submit after close(): the batcher is shutting down."""
+
+
+def default_buckets(max_batch_size):
+    """Powers of two up to (and always including) max_batch_size."""
+    buckets, b = [], 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return buckets
+
+
+class _Request:
+    """One queued inference item + the completion event its client waits on."""
+
+    __slots__ = ("inputs", "deadline", "enqueued_at", "_event", "_result",
+                 "_error")
+
+    def __init__(self, inputs, deadline):
+        self.inputs = inputs            # tuple of per-input arrays, NO batch dim
+        self.deadline = deadline        # absolute time.monotonic() or None
+        self.enqueued_at = time.monotonic()
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def succeed(self, result):
+        self._result = result
+        self._event.set()
+
+    def fail(self, error):
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout=None):
+        """Block until the batch containing this request ran (or failed)."""
+        if not self._event.wait(timeout):
+            if (self.deadline is not None
+                    and time.monotonic() >= self.deadline):
+                raise DeadlineExceededError(
+                    "deadline exceeded: no result after %.3fs (request "
+                    "still queued or in flight)" % timeout)
+            # deadline not (yet) passed: a plain caller-side wait timeout,
+            # not a client-requested 504
+            raise TimeoutError("request not completed after %.3fs" % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class DynamicBatcher:
+    """Coalesce concurrent single-item requests into bucketed batches.
+
+    ``servable`` is either an object with ``predict_batch(*stacked) ->
+    tuple of stacked outputs`` or a bare callable with that signature
+    (the registry passes its version-resolving dispatch closure here, so
+    hot-reload swaps take effect at batch granularity).
+    """
+
+    def __init__(self, servable, max_batch_size=None, batch_timeout_ms=None,
+                 queue_size=None, buckets=None, default_deadline_ms=None,
+                 metrics=None, name="model"):
+        self._dispatch_fn = (servable.predict_batch
+                             if hasattr(servable, "predict_batch")
+                             else servable)
+        self.name = name
+        self.max_batch_size = int(max_batch_size
+                                  if max_batch_size is not None
+                                  else config.get_env("MXTPU_SERVE_MAX_BATCH"))
+        self.batch_timeout_ms = float(
+            batch_timeout_ms if batch_timeout_ms is not None
+            else config.get_env("MXTPU_SERVE_TIMEOUT_MS"))
+        qsize = int(queue_size if queue_size is not None
+                    else config.get_env("MXTPU_SERVE_QUEUE_SIZE"))
+        self.queue_size = qsize
+        self.default_deadline_ms = (
+            default_deadline_ms if default_deadline_ms is not None
+            else config.get_env("MXTPU_SERVE_DEADLINE_MS"))
+        self.buckets = sorted(buckets) if buckets \
+            else default_buckets(self.max_batch_size)
+        if self.buckets[-1] < self.max_batch_size:
+            self.buckets.append(self.max_batch_size)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.metrics.queue_depth_fn = lambda: self._queue.qsize()
+        self._queue = _queue.Queue(maxsize=qsize)
+        self._closed = False
+        self._paused = False
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="mxtpu-batcher-%s" % name)
+        self._worker.start()
+
+    # ------------------------------------------------------------ client side
+    def submit(self, *inputs, deadline_ms=None):
+        """Enqueue one item (arrays WITHOUT the batch dim); returns a future-
+        like _Request. Raises QueueFullError/ServingClosedError immediately
+        instead of blocking — backpressure is the caller's signal to shed
+        load upstream."""
+        if self._closed or self._paused:
+            raise ServingClosedError("batcher %r is shut down" % self.name)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        # NB `is not None`: deadline_ms=0 means expired-unless-dispatched-
+        # immediately, not "no deadline"
+        deadline = (time.monotonic() + max(0.0, deadline_ms) / 1000.0
+                    if deadline_ms is not None else None)
+        # materialize on the client thread: the worker groups requests by
+        # shape/dtype signature, which needs real arrays
+        req = _Request(tuple(onp.asarray(x) for x in inputs), deadline)
+        try:
+            self._queue.put_nowait(req)
+        except _queue.Full:
+            self.metrics.inc("rejected_count")
+            raise QueueFullError(
+                "model %r queue full (%d pending): rejecting — raise "
+                "MXTPU_SERVE_QUEUE_SIZE or add capacity"
+                % (self.name, self.queue_size)) from None
+        # close() can win the race between the _closed check above and the
+        # enqueue; if the worker is already gone nobody will ever service
+        # this request — fail it instead of letting the client hang
+        if self._closed and not self._worker.is_alive():
+            err = ServingClosedError("batcher %r is shut down" % self.name)
+            req.fail(err)
+            raise err
+        self.metrics.inc("request_count")
+        return req
+
+    def predict(self, *inputs, deadline_ms=None, timeout=None):
+        """Blocking convenience: submit + wait for the result tuple.
+
+        A request with a deadline never waits (much) past it: the wait is
+        capped at deadline + one batch window, so a client behind a stuck
+        batch gets DeadlineExceededError at its deadline instead of
+        hanging — the worker-side check then drops the stale entry when it
+        finally dequeues it."""
+        req = self.submit(*inputs, deadline_ms=deadline_ms)
+        if timeout is None:
+            timeout = 600.0
+            if req.deadline is not None:
+                timeout = min(timeout,
+                              max(0.0, req.deadline - time.monotonic())
+                              + self.batch_timeout_ms / 1000.0 + 0.05)
+        return req.result(timeout)
+
+    def queue_depth(self):
+        return self._queue.qsize()
+
+    def pause_intake(self):
+        """Reject new submits (ServingClosedError) while the worker keeps
+        draining what's queued — the unload-last-version drain uses this.
+        Unlike close(), fully reversible via resume_intake()."""
+        self._paused = True
+
+    def resume_intake(self):
+        self._paused = False
+
+    @property
+    def alive(self):
+        return self._worker.is_alive()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def close(self, drain=True, timeout=30.0):
+        """Graceful shutdown: refuse new requests, optionally finish the
+        queued ones, join the worker. With drain=False queued requests fail
+        with ServingClosedError."""
+        self._closed = True
+        if not drain:
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except _queue.Empty:
+                    break
+                req.fail(ServingClosedError("server shutting down"))
+        self._worker.join(timeout)
+        # a submit racing this close can slip a request in after the
+        # worker's final empty-queue check; fail any such leftovers so no
+        # client waits on a queue nobody services
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            req.fail(ServingClosedError("server shutting down"))
+
+    # ------------------------------------------------------------ worker side
+    def _gather(self):
+        """Collect the next batch: block for the first request, then keep
+        taking until max_batch_size or the batch window elapses."""
+        try:
+            # the poll period only bounds close() latency — keep it coarse
+            # so idle models cost ~4 wakeups/s, not 20
+            first = self._queue.get(timeout=0.25)
+        except _queue.Empty:
+            return None
+        batch = [first]
+        window_end = time.monotonic() + self.batch_timeout_ms / 1000.0
+        while len(batch) < self.max_batch_size:
+            remaining = window_end - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._queue.get(timeout=remaining))
+            except _queue.Empty:
+                break
+        return batch
+
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _run(self):
+        while True:
+            batch = self._gather()
+            if batch is None:
+                if self._closed and self._queue.empty():
+                    return
+                continue
+            now = time.monotonic()
+            live = []
+            for req in batch:
+                if req.deadline is not None and now >= req.deadline:
+                    self.metrics.inc("expired_count")
+                    req.fail(DeadlineExceededError(
+                        "deadline passed while queued (model %r)" % self.name))
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            # group by per-input shape/dtype signature: one client's
+            # malformed request must not fail well-formed requests that
+            # happened to share its gather window (cross-client isolation);
+            # homogeneous traffic stays one group = one dispatch
+            groups = {}
+            for req in live:
+                sig = tuple((x.shape, x.dtype.str) for x in req.inputs)
+                groups.setdefault(sig, []).append(req)
+            for group in groups.values():
+                self._dispatch_batch(group)
+
+    def _dispatch_batch(self, live):
+        """Pad one shape-homogeneous group to its bucket, dispatch, and
+        deliver results (or one shared error) to every waiter."""
+        n = len(live)
+        bucket = self._bucket_for(n)
+        t0 = time.monotonic()
+        try:
+            # pad by repeating the last row: always shape/dtype-consistent,
+            # never introduces out-of-range values. A raising servable must
+            # fail THIS batch, not kill the worker thread.
+            stacked = tuple(
+                onp.stack([r.inputs[i] for r in live]
+                          + [live[-1].inputs[i]] * (bucket - n))
+                for i in range(len(live[0].inputs)))
+            outs = self._dispatch_fn(*stacked)
+        except Exception as e:  # noqa: BLE001 — forwarded to every waiter
+            self.metrics.inc("error_count", n)
+            for req in live:
+                req.fail(e)
+            return
+        dur = time.monotonic() - t0
+        try:
+            # normalize + slice BEFORE delivering anything: malformed
+            # servable output (scalar, short dim 0, ragged) must fail the
+            # batch loudly, not kill the worker or deliver to only some
+            # waiters
+            if not isinstance(outs, (list, tuple)):
+                outs = (outs,)
+            outs = [onp.asarray(o) for o in outs]
+            results = [tuple(o[j] for o in outs) for j in range(n)]
+        except Exception as e:  # noqa: BLE001 — forwarded to every waiter
+            self.metrics.inc("error_count", n)
+            for req in live:
+                req.fail(e)
+            return
+        done = time.monotonic()
+        for j, req in enumerate(live):
+            req.succeed(results[j])
+            self.metrics.observe_latency_ms(
+                (done - req.enqueued_at) * 1000.0)
+        self.metrics.inc("ok_count", n)
+        self.metrics.observe_batch(n, bucket)
+        self._profile_batch(n, bucket, dur)
+
+    def _profile_batch(self, n, bucket, dur):
+        """Per-batch hook into the framework profiler (no-op unless
+        profiler.set_state('run'))."""
+        try:
+            from .. import profiler
+            # profiler timestamps are wall-clock epoch us (chrome trace)
+            profiler.record_batch(self.name, n, bucket,
+                                  start_us=(time.time() - dur) * 1e6,
+                                  dur_us=dur * 1e6)
+        except Exception:  # profiling must never take down serving
+            pass

@@ -1,0 +1,98 @@
+"""Serving observability (TF-Serving BatchingSession metrics analog).
+
+One ``ServingMetrics`` per registered model: monotonic counters, the
+dispatched batch-size histogram (the coalescing proof), and request
+latency percentiles from a bounded ring buffer — cheap enough to stay on
+for every request, rich enough to tune ``MXTPU_SERVE_*`` capacity knobs
+from (see docs/SERVING.md). Exposed programmatically via ``snapshot()``
+and over HTTP at ``GET /metrics`` (serving/server.py).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(sorted_values, q):
+    """Nearest-rank percentile of an ascending-sorted sequence (q in 0..100)."""
+    if not sorted_values:
+        return None
+    rank = max(1, -(-len(sorted_values) * q // 100))  # ceil without floats
+    return sorted_values[min(int(rank), len(sorted_values)) - 1]
+
+
+class ServingMetrics:
+    """Thread-safe per-model serving counters + batch histogram + latency ring.
+
+    Latency is end-to-end request time (enqueue -> result ready), the number
+    a client observes; the ring buffer bounds memory so a long-lived server
+    reports a moving window, not its whole history.
+    """
+
+    def __init__(self, latency_window=4096):
+        self._lock = threading.Lock()
+        self.request_count = 0        # accepted into the queue
+        self.ok_count = 0
+        self.error_count = 0          # dispatch raised
+        self.rejected_count = 0       # queue full (backpressure)
+        self.expired_count = 0        # deadline passed while queued
+        self.batch_count = 0          # dispatches
+        self.batched_items = 0        # real (non-padding) items dispatched
+        self.padded_items = 0         # padding rows added to reach a bucket
+        self.batch_size_hist = {}     # real batch size -> count
+        self._latencies_ms = deque(maxlen=latency_window)
+        self.queue_depth_fn = None    # injected by the batcher
+
+    # ------------------------------------------------------------------
+    def inc(self, counter, n=1):
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def observe_batch(self, size, bucket):
+        with self._lock:
+            self.batch_count += 1
+            self.batched_items += size
+            self.padded_items += bucket - size
+            self.batch_size_hist[size] = self.batch_size_hist.get(size, 0) + 1
+
+    def observe_latency_ms(self, ms):
+        with self._lock:
+            self._latencies_ms.append(ms)
+
+    # ------------------------------------------------------------------
+    @property
+    def mean_batch_size(self):
+        """Mean REAL items per dispatch — > 1 means coalescing is happening."""
+        with self._lock:
+            if not self.batch_count:
+                return 0.0
+            return self.batched_items / self.batch_count
+
+    def latency_percentiles_ms(self, qs=(50, 95, 99)):
+        with self._lock:
+            ordered = sorted(self._latencies_ms)
+        return {"p%d" % q: percentile(ordered, q) for q in qs}
+
+    def snapshot(self):
+        """One JSON-able dict with every counter, the histogram, and p50/95/99."""
+        with self._lock:
+            out = {
+                "request_count": self.request_count,
+                "ok_count": self.ok_count,
+                "error_count": self.error_count,
+                "rejected_count": self.rejected_count,
+                "expired_count": self.expired_count,
+                "batch_count": self.batch_count,
+                "batched_items": self.batched_items,
+                "padded_items": self.padded_items,
+                "batch_size_hist": dict(self.batch_size_hist),
+                "mean_batch_size": (self.batched_items / self.batch_count
+                                    if self.batch_count else 0.0),
+                "latency_window": len(self._latencies_ms),
+            }
+        out["latency_ms"] = self.latency_percentiles_ms()
+        if self.queue_depth_fn is not None:
+            out["queue_depth"] = self.queue_depth_fn()
+        return out

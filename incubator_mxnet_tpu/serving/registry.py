@@ -1,0 +1,284 @@
+"""Multi-model registry: named, versioned servables behind one batcher each
+(TF-Serving ServerCore/ModelManager analog).
+
+A *servable* is anything with ``predict_batch(*stacked_inputs) -> tuple of
+stacked outputs``:
+
+- ``contrib.serving.ServedModel`` — a loaded ``.mxtpu`` artifact (its
+  predict_batch re-chunks any bucket onto the one exported batch shape),
+- ``BlockServable`` below — a live Gluon block through jit.EvalStep
+  (each batcher bucket compiles once in EvalStep's shape-keyed cache),
+- any user object with that method (e.g. a quantized net wrapper).
+
+Hot reload: ``load()`` on an existing name installs a NEW version and
+atomically repoints dispatch at it; batches already in flight hold a
+reference to the old servable and finish on it (connection draining).
+``unload(..., drain=True)`` blocks until that version's in-flight count
+hits zero before dropping it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .batcher import DynamicBatcher
+from .metrics import ServingMetrics
+
+__all__ = ["ModelRegistry", "BlockServable", "ModelNotFoundError"]
+
+
+class ModelNotFoundError(KeyError):
+    """Unknown model name (or version) — HTTP maps this to 404."""
+
+
+class BlockServable:
+    """Serve a live, initialized Gluon block: forwards run through
+    jit.EvalStep, so each padded bucket shape compiles exactly once and is
+    reused (the CachedOp-style executable cache the batcher relies on)."""
+
+    def __init__(self, net):
+        from .. import jit
+        self._step = jit.EvalStep(net)
+
+    def predict_batch(self, *stacked_inputs):
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+        out = self._step(*[NDArray(jnp.asarray(x)) for x in stacked_inputs])
+        outs = out if isinstance(out, tuple) else (out,)
+        return tuple(o.asnumpy() for o in outs)
+
+
+def _as_servable(obj):
+    if hasattr(obj, "predict_batch"):
+        return obj
+    from ..gluon.block import Block
+    if isinstance(obj, Block):
+        return BlockServable(obj)
+    raise TypeError("not a servable: %r (need predict_batch() or a Gluon "
+                    "block)" % (obj,))
+
+
+class _ModelEntry:
+    """One name: version->servable map + the batcher + in-flight accounting."""
+
+    def __init__(self, name, **batcher_kw):
+        self.name = name
+        self.versions = {}
+        self.current_version = None
+        self.metrics = ServingMetrics()
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._inflight = {}             # version -> dispatched-batch count
+        self.batcher = DynamicBatcher(self._dispatch, name=name,
+                                      metrics=self.metrics, **batcher_kw)
+
+    def _dispatch(self, *stacked_inputs):
+        """Resolve the CURRENT version at dispatch time (batch granularity),
+        pin it with an in-flight count so unload can drain."""
+        with self._lock:
+            version = self.current_version
+            if version is None:
+                raise ModelNotFoundError(
+                    "model %r has no loaded version" % self.name)
+            servable = self.versions[version]
+            self._inflight[version] = self._inflight.get(version, 0) + 1
+        try:
+            return servable.predict_batch(*stacked_inputs)
+        finally:
+            with self._drained:
+                # an unload(drain=False) may have already forgotten this
+                # version (popped its _inflight slot) — the batch's results
+                # must still reach their waiters
+                if version in self._inflight:
+                    self._inflight[version] -= 1
+                self._drained.notify_all()
+
+    def install(self, servable, version):
+        """Install (version=None: the next one) and repoint dispatch.
+        Version choice and installation are one atomic step so concurrent
+        hot-reloads cannot pick the same number."""
+        with self._lock:
+            if version is None:
+                version = (max(self.versions) + 1) if self.versions else 1
+            self.versions[version] = servable
+            self.current_version = version
+            return version
+
+    def drop(self, version, drain, timeout, wait_queue_empty=False):
+        """Remove one version. With a successor available, dispatch is
+        repointed FIRST so the victim can drain; with drain of the LAST
+        version the victim stays routable until queued + in-flight work
+        settles (wait_queue_empty; the registry pauses intake around this)
+        and is unrouted only at removal — a timed-out drain changes no
+        routing at all. (A batch the worker has dequeued but not yet begun
+        dispatching at the instant the predicate passes can still lose the
+        race and fail loudly — microsecond window on the single worker.)"""
+        with self._drained:
+            remaining = [v for v in self.versions if v != version]
+            if version == self.current_version and remaining:
+                self.current_version = max(remaining)
+            if drain:
+                def settled():
+                    return (self._inflight.get(version, 0) == 0
+                            and (not wait_queue_empty
+                                 or self.batcher.queue_depth() == 0))
+                # poll as well as wait on notify: the batcher's deadline-
+                # expiry path consumes queued requests WITHOUT a dispatch
+                # (so nothing notifies this condition) — a pure wait_for
+                # would sleep the whole timeout after such a drain finished
+                end = time.monotonic() + timeout
+                while not settled():
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "model %r v%s still has in-flight batches"
+                            % (self.name, version))
+                    self._drained.wait(min(remaining, 0.05))
+            self.versions.pop(version, None)
+            self._inflight.pop(version, None)
+            if version == self.current_version:
+                self.current_version = (max(self.versions)
+                                        if self.versions else None)
+
+    def describe(self):
+        with self._lock:
+            return {"name": self.name,
+                    "versions": sorted(self.versions),
+                    "current_version": self.current_version,
+                    "queue_depth": self.batcher.queue_depth(),
+                    "queue_size": self.batcher.queue_size,
+                    "max_batch_size": self.batcher.max_batch_size,
+                    "batch_timeout_ms": self.batcher.batch_timeout_ms}
+
+
+class ModelRegistry:
+    """Thread-safe name -> _ModelEntry map; the server front-end's substrate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def load(self, name, servable, version=None, **batcher_kw):
+        """Register (or hot-reload) ``name``. Returns the installed version.
+
+        First load creates the entry + its batcher (batcher_kw:
+        max_batch_size, batch_timeout_ms, queue_size, buckets,
+        default_deadline_ms — defaults come from MXTPU_SERVE_*). A load on
+        an existing name installs the next version and repoints dispatch;
+        in-flight batches finish on the old servable.
+        """
+        servable = _as_servable(servable)
+        # install happens INSIDE the registry lock: paired with unload()'s
+        # locked entry-removal check this makes load-vs-unload-of-the-last-
+        # version atomic (never installs into an entry whose batcher a
+        # concurrent unload is closing), and concurrent hot-reloads
+        # serialize on the entry lock inside install()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("registry is shut down")
+            entry = self._entries.get(name)
+            if entry is None:
+                entry = _ModelEntry(name, **batcher_kw)
+                self._entries[name] = entry
+            elif batcher_kw:
+                raise ValueError("batcher options are fixed at first load "
+                                 "of %r" % name)
+            return entry.install(servable, version)
+
+    def unload(self, name, version=None, drain=True, timeout=30.0):
+        """Drop one version (default: current). Dropping the last version
+        shuts the entry's batcher down and forgets the name."""
+        entry = self._entry(name)
+        if version is None:
+            version = entry.current_version
+        if version not in entry.versions:
+            raise ModelNotFoundError("model %r has no version %s"
+                                     % (name, version))
+        with entry._lock:
+            last = set(entry.versions) == {version}
+        if last and drain:
+            # no successor to repoint at: pause intake so the queue can
+            # only shrink, let the departing version serve every request
+            # already accepted (never a spurious 404), and unroute at the
+            # end; a timed-out drain reopens intake with routing untouched
+            entry.batcher.pause_intake()
+        try:
+            entry.drop(version, drain, timeout, wait_queue_empty=last)
+        except TimeoutError:
+            if last and drain:
+                entry.batcher.resume_intake()
+            raise
+        close_batcher = False
+        with self._lock:
+            # re-check under the registry lock: a concurrent load() (which
+            # installs inside this lock) may have revived the entry
+            if not entry.versions and self._entries.get(name) is entry:
+                self._entries.pop(name)
+                close_batcher = True
+        if close_batcher:
+            entry.batcher.close(drain=drain)
+        elif last and drain:
+            # a concurrent load() revived the entry mid-drain: the new
+            # version must serve, so the pause cannot stick
+            entry.batcher.resume_intake()
+
+    def close(self, drain=True):
+        """Graceful shutdown of every model's batcher (queue drained first)."""
+        with self._lock:
+            self._closed = True
+            entries = list(self._entries.values())
+        for entry in entries:
+            entry.batcher.close(drain=drain)
+
+    # ------------------------------------------------------------ inference
+    def _entry(self, name):
+        with self._lock:
+            entry = self._entries.get(name)
+            names = sorted(self._entries) if entry is None else None
+        if entry is None:
+            raise ModelNotFoundError("no model %r loaded (have: %s)"
+                                     % (name, names))
+        return entry
+
+    def submit(self, name, *inputs, deadline_ms=None):
+        return self._entry(name).batcher.submit(*inputs,
+                                                deadline_ms=deadline_ms)
+
+    def predict(self, name, *inputs, deadline_ms=None, timeout=None):
+        return self._entry(name).batcher.predict(
+            *inputs, deadline_ms=deadline_ms, timeout=timeout)
+
+    def metrics(self, name):
+        return self._entry(name).metrics
+
+    # ------------------------------------------------------------ inspection
+    def models(self):
+        with self._lock:
+            entries = list(self._entries.values())
+        return [e.describe() for e in entries]
+
+    def metrics_snapshot(self):
+        with self._lock:
+            entries = list(self._entries.items())
+        return {name: e.metrics.snapshot() for name, e in entries}
+
+    def health(self):
+        """healthy | degraded (any queue >= 80% full) | unhealthy (shut down
+        or a dead worker thread) — the load-balancer-facing contract."""
+        with self._lock:
+            closed = self._closed
+            entries = list(self._entries.values())
+        if closed:
+            return {"status": "unhealthy", "reason": "shutting down"}
+        for e in entries:
+            if not e.batcher.alive and not e.batcher.closed:
+                return {"status": "unhealthy",
+                        "reason": "worker thread dead for model %r" % e.name}
+        for e in entries:
+            if e.batcher.queue_depth() >= 0.8 * e.batcher.queue_size:
+                return {"status": "degraded",
+                        "reason": "queue >= 80%% for model %r" % e.name,
+                        "queue_depth": e.batcher.queue_depth()}
+        return {"status": "healthy", "models": len(entries)}

@@ -1,0 +1,214 @@
+"""HTTP serving front-end on the stdlib ThreadingHTTPServer (no new deps).
+
+One thread per connection; each request thread blocks on its batcher
+future while the single worker thread per model does the actual compiled
+dispatch — so the server scales to many concurrent clients without ever
+running JAX outside the worker. JSON tensor encoding keeps the whole
+stack exercisable end-to-end in tier-1 CPU tests (tests/test_serving.py
+drives 64+ concurrent requests through a real socket).
+
+Routes (TF-Serving REST-shaped):
+
+- ``POST /v1/models/<name>:predict`` — body ``{"inputs": [<nested list>,
+  ...], "deadline_ms": <optional>, "dtype": <optional, default float32>}``;
+  response ``{"outputs": [<nested list>, ...]}``. Each input is ONE item,
+  WITHOUT the batch dim — cross-request batching is the server's job.
+- ``GET /v1/models``            — registered models + queue/batch config.
+- ``GET /v1/models/<name>``     — one model + its metrics snapshot.
+- ``GET /metrics``              — per-model counters, batch-size
+  histogram, p50/p95/p99 latency.
+- ``GET /healthz``              — healthy | degraded | unhealthy (503).
+
+Error contract (the robustness story made visible):
+
+- queue full        -> 429 (explicit backpressure; shed load upstream)
+- deadline exceeded -> 504
+- unknown model     -> 404
+- shutting down     -> 503
+- malformed body    -> 400
+- servable raised   -> 500
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import config
+from .batcher import (DeadlineExceededError, QueueFullError,
+                      ServingClosedError)
+from .registry import ModelNotFoundError, ModelRegistry
+
+__all__ = ["ServingServer", "serve"]
+
+_PREDICT_SUFFIX = ":predict"
+_MODELS_PREFIX = "/v1/models"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Bound to a registry via the per-server subclass ServingServer makes."""
+
+    registry = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass  # serving metrics replace per-request stderr lines
+
+    # ------------------------------------------------------------------
+    def _send(self, code, payload):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _model_name(self):
+        rest = self.path[len(_MODELS_PREFIX):].lstrip("/")
+        if rest.endswith(_PREDICT_SUFFIX):
+            rest = rest[:-len(_PREDICT_SUFFIX)]
+        return rest
+
+    # ------------------------------------------------------------------
+    def do_GET(self):
+        if self.path in ("/healthz", "/health"):
+            h = self.registry.health()
+            self._send(503 if h["status"] == "unhealthy" else 200, h)
+        elif self.path == "/metrics":
+            self._send(200, self.registry.metrics_snapshot())
+        elif self.path.rstrip("/") == _MODELS_PREFIX:
+            self._send(200, {"models": self.registry.models()})
+        elif self.path.startswith(_MODELS_PREFIX + "/"):
+            name = self._model_name()
+            try:
+                entry = self.registry._entry(name)
+            except ModelNotFoundError as e:
+                self._send(404, {"error": str(e)})
+                return
+            desc = entry.describe()
+            desc["metrics"] = entry.metrics.snapshot()
+            self._send(200, desc)
+        else:
+            self._send(404, {"error": "no route %r" % self.path})
+
+    def do_POST(self):
+        if not (self.path.startswith(_MODELS_PREFIX + "/")
+                and self.path.endswith(_PREDICT_SUFFIX)):
+            self._send(404, {"error": "no route %r (POST "
+                             "/v1/models/<name>:predict)" % self.path})
+            return
+        import numpy as onp
+        name = self._model_name()
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length) or b"{}")
+            raw = req["inputs"]
+            if not isinstance(raw, list) or not raw:
+                raise ValueError("'inputs' must be a non-empty list (one "
+                                 "entry per model input, no batch dim)")
+            dtypes = req.get("dtype", "float32")
+            if isinstance(dtypes, str):
+                dtypes = [dtypes] * len(raw)
+            elif len(dtypes) != len(raw):
+                raise ValueError("'dtype' list length %d != %d inputs"
+                                 % (len(dtypes), len(raw)))
+            inputs = [onp.asarray(x, dtype=onp.dtype(d))
+                      for x, d in zip(raw, dtypes)]
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)  # non-numeric -> 400
+        except Exception as e:  # noqa: BLE001 — anything malformed is a 400
+            self._send(400, {"error": "bad request: %s" % e})
+            return
+        try:
+            outs = self.registry.predict(name, *inputs,
+                                         deadline_ms=deadline_ms)
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)})
+        except DeadlineExceededError as e:
+            self._send(504, {"error": str(e)})
+        except ModelNotFoundError as e:
+            self._send(404, {"error": str(e)})
+        except ServingClosedError as e:
+            self._send(503, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — servable failure
+            self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
+        else:
+            self._send(200, {"outputs": [onp.asarray(o).tolist()
+                                         for o in outs]})
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # the socketserver default backlog of 5 refuses connections under a
+    # concurrent burst — size it to a queue's worth of clients instead
+    request_queue_size = 128
+
+
+class ServingServer:
+    """The single-host serving endpoint: a ModelRegistry behind HTTP.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``) —
+    what the tier-1 tests use. ``start()`` returns immediately (the accept
+    loop runs in a daemon thread); ``stop(drain=True)`` stops accepting,
+    drains every model's queue, and joins — the graceful-shutdown path.
+    Usable as a context manager.
+    """
+
+    def __init__(self, registry=None, host="127.0.0.1", port=None):
+        self.registry = registry if registry is not None else ModelRegistry()
+        if port is None:
+            port = config.get_env("MXTPU_SERVE_PORT")
+        handler = type("_BoundHandler", (_Handler,),
+                       {"registry": self.registry})
+        self._httpd = _Server((host, int(port)), handler)
+        self._thread = None
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="mxtpu-serve-http")
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Graceful shutdown: close the listener, then drain (or fail)
+        queued requests via the registry, then join the accept loop.
+        Safe to call even if start() never ran (shutdown() would block
+        forever waiting on serve_forever's loop-exit event)."""
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self.registry.close(drain=drain)
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def serve(models, host="127.0.0.1", port=None, **batcher_kw):
+    """Convenience bring-up: ``models`` maps name -> servable OR a path to
+    a ``.mxtpu`` artifact. Returns the STARTED ServingServer (caller owns
+    ``stop()``)."""
+    registry = ModelRegistry()
+    for name, obj in models.items():
+        if isinstance(obj, str):
+            from ..contrib import serving as _artifact
+            obj = _artifact.load(obj)
+        registry.load(name, obj, **batcher_kw)
+    return ServingServer(registry, host=host, port=port).start()

@@ -332,3 +332,53 @@ def test_onnx_import_guards():
          P.tensor("R", rng.randn(1, 4 * H_, H_).astype("float32"))])
     with pytest.raises(NotImplementedError, match="activations"):
         mx_onnx.import_model(p)
+
+
+def test_onnx_export_negative_step_open_ends(tmp_path):
+    """Open (None) slice bounds must follow the step's direction: a
+    negative-step dim gets starts=+2^62 (clamped to dim-1 by conformant
+    runtimes) and ends=-2^62 — the former unconditional +2^62 end made
+    onnxruntime evaluate reversed slices as empty."""
+    import pytest
+    from incubator_mxnet_tpu.contrib import onnx_proto as P
+    sym = mx.sym
+    a = sym.var("a")
+    rev = sym.slice(a, begin=(None, 1), end=(None, None), step=(-1, 2))
+    path = str(tmp_path / "rev.onnx")
+    mx_onnx.export_model(rev, {}, (3, 5), onnx_file_path=path)
+    with open(path, "rb") as f:
+        g = P.read_model(f.read())["graph"]
+    (slice_node,) = [n for n in P.read_nodes(g) if n["op_type"] == "Slice"]
+    inits = P.read_initializers(g)
+    starts = inits[slice_node["inputs"][1]].tolist()
+    ends = inits[slice_node["inputs"][2]].tolist()
+    steps = inits[slice_node["inputs"][4]].tolist()
+    assert steps == [-1, 2]
+    assert starts == [2 ** 62, 1]       # open start on the reversed dim
+    assert ends == [-2 ** 62, 2 ** 62]  # open end follows the direction
+    # step 0 is meaningless — reject at export, not at serving time
+    with pytest.raises(ValueError, match="step 0"):
+        mx_onnx.export_model(
+            sym.slice(a, begin=(0,), end=(3,), step=(0,)), {}, (5,),
+            onnx_file_path=str(tmp_path / "z.onnx"))
+
+
+def test_onnx_import_strided_slice_negative_axes_rejected(tmp_path):
+    """mx.sym.slice takes per-leading-axis tuples, so a strided Slice with
+    axes=[-1] (rank unknown at import) must raise — the old code computed
+    rank 0 from it and mis-indexed."""
+    import pytest
+    from incubator_mxnet_tpu.contrib import onnx_proto as P
+    g = P.graph(
+        "g",
+        [P.node("Slice", ["x", "st", "en", "ax", "sp"], ["y"], "sl")],
+        [P.value_info("x", (4, 6))], [P.value_info("y", (4, 3))],
+        [P.tensor("st", onp.asarray([0], "int64")),
+         P.tensor("en", onp.asarray([6], "int64")),
+         P.tensor("ax", onp.asarray([-1], "int64")),
+         P.tensor("sp", onp.asarray([2], "int64"))])
+    path = str(tmp_path / "negax.onnx")
+    with open(path, "wb") as f:
+        f.write(P.model(g, opset=13))
+    with pytest.raises(NotImplementedError, match="negative axes"):
+        mx_onnx.import_model(path)

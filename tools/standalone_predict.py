@@ -19,6 +19,7 @@ _MAGIC = b"MXTPU\x00v1"
 def load(path):
     """Load a .mxtpu artifact → callable(*numpy arrays) -> numpy array(s)."""
     import jax
+    import jax.export  # jax>=0.4.30 does not re-export the submodule lazily
 
     with open(path, "rb") as f:
         buf = f.read()
