@@ -13,6 +13,10 @@
 #   suite   - quick test suite on the 8-device virtual CPU mesh
 #   serving - inference serving subsystem end-to-end on CPU (dynamic
 #             batching, hot reload, backpressure, HTTP front-end)
+#   observability - boot the serving server, drive traffic, scrape
+#             GET /metrics over the wire, and validate the Prometheus
+#             exposition with the stdlib parser (tools/promcheck.py);
+#             also exercises the headless periodic-flush file path
 #   smoke   - driver contract: entry() jit-compiles on CPU and
 #             dryrun_multichip(8) runs a full sharded train step
 #   large   - int64 large-tensor tier (>2^31 elements; int8/uint8 dtypes
@@ -23,7 +27,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving observability smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -60,6 +64,51 @@ fi
 if has_stage serving; then
   echo "=== serving: inference serving subsystem e2e on CPU ==="
   python -m pytest tests/test_serving.py -q
+fi
+
+if has_stage observability; then
+  echo "=== observability: scrape /metrics + validate Prometheus text ==="
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import json, sys, tempfile, threading, urllib.request
+sys.path.insert(0, "tools")
+import promcheck
+from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+from incubator_mxnet_tpu import telemetry
+
+class Echo:
+    def predict_batch(self, x):
+        return (x + 1.0,)
+
+reg = ModelRegistry()
+reg.load("ci", Echo(), max_batch_size=4, batch_timeout_ms=10.0)
+with ServingServer(reg, port=0) as srv:
+    def fire(i):
+        body = json.dumps({"inputs": [[float(i)]]}).encode()
+        req = urllib.request.Request(
+            srv.url + "/v1/models/ci:predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200 and r.headers["X-Request-Id"]
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(16)]
+    for t in threads: t.start()
+    for t in threads: t.join(60)
+    with urllib.request.urlopen(srv.url + "/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain"), \
+            r.headers["Content-Type"]
+        text = r.read().decode()
+    with urllib.request.urlopen(srv.url + "/metrics.json", timeout=30) as r:
+        legacy = json.loads(r.read())
+types = promcheck.validate(text)
+assert types["mxtpu_serving_requests_total"] == "counter", types
+assert types["mxtpu_serving_batch_size"] == "histogram", types
+assert 'mxtpu_serving_ok_total{model="ci"} 16' in text
+assert legacy["ci"]["ok_count"] == 16, legacy
+# headless path: flush the same registry to a file and re-validate
+path = tempfile.mktemp(suffix=".prom")
+telemetry.flush_to_file(path)
+promcheck.validate(open(path).read())
+print("observability OK: %d families scraped + flushed" % len(types))
+EOF
 fi
 
 if has_stage smoke; then

@@ -34,6 +34,11 @@ if _config.get_env("MXTPU_MATMUL_PRECISION"):
     _jax.config.update("jax_default_matmul_precision",
                        _config.get_env("MXTPU_MATMUL_PRECISION"))
 
+# telemetry depends only on config/stdlib — import it before the
+# subsystems that instrument against it, and honor the autoflush knob
+from . import telemetry
+telemetry._maybe_autostart()
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus

@@ -143,6 +143,23 @@ ENV_VARS = {
         int, 8080,
         "Default port for serving.ServingServer's HTTP front-end "
         "(serving/server.py); 0 picks an ephemeral port (tests)."),
+    "MXTPU_TELEMETRY_FLUSH_S": (
+        float, 0.0,
+        "Periodic telemetry flush interval in seconds (telemetry package): "
+        "> 0 starts a daemon thread at package import that writes the full "
+        "Prometheus exposition to MXTPU_TELEMETRY_FILE every interval — "
+        "how headless training jobs emit metrics without the HTTP server. "
+        "0 disables (telemetry.start_periodic_flush() still works)."),
+    "MXTPU_TELEMETRY_FILE": (
+        str, "telemetry.prom",
+        "Path the periodic telemetry flusher writes (atomic tmp+rename; "
+        "node-exporter textfile-collector compatible)."),
+    "MXTPU_TELEMETRY_MAX_SERIES": (
+        int, 64,
+        "Per-metric bound on distinct label combinations in the telemetry "
+        "registry. Past the bound, new label values are clamped onto the "
+        "'_other_' series with a one-time RuntimeWarning — unbounded label "
+        "cardinality (request ids) must never OOM the process."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

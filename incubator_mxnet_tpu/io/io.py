@@ -8,13 +8,27 @@ an optional native (C++) RecordIO scanner accelerates the file layer.
 from __future__ import annotations
 
 import threading
+import time as _time
 from collections import namedtuple
 from queue import Queue
 
 import numpy as onp
 
 from .. import ndarray as nd
+from .. import telemetry
 from ..ndarray import NDArray
+
+# Input-pipeline stall observability: seconds the CONSUMER (the training
+# loop) spends blocked waiting for the next batch, by iterator class. A
+# wait rate near the step rate means the input pipeline, not the
+# accelerator, sets the epoch time (the MLPerf-pod tuning signal).
+_IO_WAIT_SECONDS = telemetry.counter(
+    "mxtpu_io_wait_seconds_total",
+    "Seconds the consumer spent blocked in next() waiting for a batch.",
+    ("iter",))
+_IO_BATCHES = telemetry.counter(
+    "mxtpu_io_batches_total", "Batches delivered to the consumer.",
+    ("iter",))
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "ImageRecordIter", "MNISTIter", "CSVIter",
@@ -215,8 +229,23 @@ class PrefetchingIter(DataIter):
         self._thread = None
         self._start()
 
+    def _mark_producer_chain(self, ident):
+        """Tag the whole wrapped chain (ResizeIter.data_iter, CSVIter
+        ._inner, ...) with the producer thread's ident: inner iterators'
+        next() time on THAT thread is overlapped work, not consumer wait,
+        and must not hit the IO-wait counters. Scoped to the thread ident
+        (re-tagged each (re)start, compared at call time) so the same
+        iterator object reused directly by a consumer later counts again."""
+        inner, hops = self.iter, 0
+        while inner is not None and hops < 16:
+            inner._io_wait_suppressed_ident = ident
+            inner = getattr(inner, "data_iter", None) \
+                or getattr(inner, "_inner", None)
+            hops += 1
+
     def _start(self):
         def run():
+            self._mark_producer_chain(threading.get_ident())
             while not self._stop.is_set():
                 try:
                     batch = self.iter.next()
@@ -241,9 +270,16 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
+        # the queue wait IS the pipeline stall: with the prefetch thread
+        # keeping up this is ~0; when it isn't, the whole decode cost
+        # lands here and the counter makes it visible
+        t0 = _time.perf_counter()
         batch = self._queue.get()
+        _IO_WAIT_SECONDS.inc(_time.perf_counter() - t0,
+                             iter="PrefetchingIter")
         if batch is None:
             raise StopIteration
+        _IO_BATCHES.inc(iter="PrefetchingIter")
         return batch
 
     @property
@@ -435,6 +471,23 @@ class ImageRecordIter(DataIter):
         return chw, label
 
     def next(self):
+        # synchronous decode: the consumer waits for the whole assembly,
+        # so all of next() is input-pipeline wait (wrap in PrefetchingIter
+        # to overlap it with the step — the counter shows when to). When a
+        # PrefetchingIter drives this from its producer thread, the decode
+        # is overlapped work, not consumer wait, and must not be counted
+        # (thread-scoped: direct reuse of this object elsewhere counts).
+        if getattr(self, "_io_wait_suppressed_ident", None) \
+                == threading.get_ident():
+            return self._next_impl()
+        t0 = _time.perf_counter()
+        batch = self._next_impl()
+        _IO_WAIT_SECONDS.inc(_time.perf_counter() - t0,
+                             iter=type(self).__name__)
+        _IO_BATCHES.inc(iter=type(self).__name__)
+        return batch
+
+    def _next_impl(self):
         if self._native_pipe is not None:
             res = self._native_pipe.next()
             if res is None:

@@ -16,11 +16,14 @@ the batch axis of this same program.
 """
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 
 from . import autograd
 from . import config
+from . import telemetry
 from .gluon import _functional
 from .ndarray import NDArray
 from .ndarray import random as _rnd
@@ -31,6 +34,31 @@ def _donate(argnums):
     return () if config.get_env("MXTPU_NO_DONATE") else argnums
 
 __all__ = ["TrainStep", "EvalStep"]
+
+# Compile-cache observability: each shape-keyed cache miss is one XLA
+# compile (jax.jit compiles lazily on the first call, so the miss's FIRST
+# step — trace + compile + run — is what gets attributed to compile time).
+# Watching compiles_total climb under bucketed variable-shape traffic is
+# how an undersized MXTPU_EXEC_CACHE_SIZE shows itself.
+_COMPILES = telemetry.counter(
+    "mxtpu_jit_compiles_total",
+    "Shape-keyed executable-cache misses (one XLA compile each).",
+    ("kind",))
+_COMPILE_SECONDS = telemetry.counter(
+    "mxtpu_jit_compile_seconds_total",
+    "Wall seconds spent in cache-miss first steps (trace+compile+run).",
+    ("kind",))
+_STEP_SECONDS = telemetry.histogram(
+    "mxtpu_train_step_seconds",
+    "Wall time per TrainStep call (cache-hit steady state included).",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+_STEPS = telemetry.counter(
+    "mxtpu_train_steps_total", "Completed TrainStep calls.")
+_EXAMPLES = telemetry.counter(
+    "mxtpu_train_examples_total",
+    "Examples consumed by TrainStep (batch-size sum); rate() of this is "
+    "examples/sec.")
 
 
 def _tree_to_data(state):
@@ -264,7 +292,9 @@ class TrainStep:
             trainer._init_states()
 
         meta = (n_net_inputs, tuple((a.shape, str(a.dtype)) for a in arrs))
-        if meta not in self._cache:
+        step_t0 = _time.perf_counter()
+        compile_miss = meta not in self._cache
+        if compile_miss:
             self._cache[meta] = self._build(meta, n_net_inputs)
             config.evict_to_bound(self._cache)
         jitted, trainable, frozen, t_arrs, f_arrs, aux_box = self._cache[meta]
@@ -300,6 +330,13 @@ class TrainStep:
             trainer._states[idx] = _rewrap_state(trainer._states[idx], new_opt[i])
         for a, v in zip(aux_box, aux_vals):
             a._data = v
+        step_dur = _time.perf_counter() - step_t0
+        _STEP_SECONDS.observe(step_dur)
+        _STEPS.inc()
+        _EXAMPLES.inc(int(batch_size))
+        if compile_miss:
+            _COMPILES.inc(kind="train")
+            _COMPILE_SECONDS.inc(step_dur, kind="train")
         return NDArray(loss_full)
 
 
@@ -325,7 +362,9 @@ class EvalStep:
     def __call__(self, *inputs):
         arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in inputs]
         meta = tuple((a.shape, str(a.dtype)) for a in arrs)
-        if meta not in self._cache:
+        compile_miss = meta not in self._cache
+        t0 = _time.perf_counter() if compile_miss else 0.0
+        if compile_miss:
             params, param_arrs, pure_fn, aux_box = _functional.make_pure_fn(
                 self.net, train_mode=False)
             jitted = jax.jit(pure_fn)
@@ -336,4 +375,7 @@ class EvalStep:
         out_datas, _aux = jitted([a._data for a in param_arrs],
                                  [a._data for a in arrs], key)
         outs = [NDArray(o) for o in out_datas]
+        if compile_miss:
+            _COMPILES.inc(kind="eval")
+            _COMPILE_SECONDS.inc(_time.perf_counter() - t0, kind="eval")
         return outs[0] if len(outs) == 1 else tuple(outs)

@@ -5,11 +5,43 @@ from __future__ import annotations
 import pickle
 
 from .. import optimizer as opt
+from .. import telemetry
 from ..ndarray import NDArray
 from .. import ndarray as nd
 
 __all__ = ["KVStore", "KVStoreBase", "create", "LocalKVStore", "DistKVStore",
            "DistAsyncKVStore"]
+
+# Parameter-traffic observability: bytes through push/pull, labeled by the
+# store type ('local', 'dist_sync', 'dist_async', ... — a bounded label).
+# rate(push_bytes) vs the step rate is the gradient-traffic share of a run.
+_PUSH_BYTES = telemetry.counter(
+    "mxtpu_kvstore_push_bytes_total",
+    "Payload bytes pushed into the kvstore (per-device values summed).",
+    ("store",))
+_PULL_BYTES = telemetry.counter(
+    "mxtpu_kvstore_pull_bytes_total",
+    "Payload bytes pulled out of the kvstore (per-device outs summed).",
+    ("store",))
+
+
+def _nbytes(v):
+    """Best-effort payload size of one pushed/pulled value (NDArray, raw
+    array, sparse, or a per-device list of them)."""
+    if isinstance(v, (list, tuple)):
+        return sum(_nbytes(x) for x in v)
+    try:
+        import numpy as onp
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is None or dtype is None:
+            return 0
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n * onp.dtype(str(dtype)).itemsize
+    except Exception:
+        return 0
 
 
 def _key_int(k):
@@ -49,6 +81,7 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         from ..ndarray.sparse import BaseSparseNDArray
         keys, values = self._normalize(key, value)
+        _PUSH_BYTES.inc(sum(_nbytes(v) for v in values), store=self.name)
         for k, v in zip(keys, values):
             agg = self._aggregate(v, k)
             if self._updater is not None:
@@ -66,9 +99,14 @@ class KVStore(KVStoreBase):
         # the buffer, so neither side can observe the other's later updates
         # (regression-tested in tests/test_parallel.py::test_kvstore_pull_isolation).
         keys, outs = self._normalize(key, out)
+        pulled = 0
         for k, o in zip(keys, outs):
             for oo in (o if isinstance(o, (list, tuple)) else [o]):
                 oo._data = self._data[k]._data
+                pulled += _nbytes(oo)
+        # one inc per pull (not per out tensor): the shared counter lock
+        # must not be contended O(keys x devices) in the step hot path
+        _PULL_BYTES.inc(pulled, store=self.name)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -256,6 +294,7 @@ class DistKVStore(KVStore):
             return super().push(key, value, priority)
         from ..ndarray.sparse import BaseSparseNDArray
         keys, values = self._normalize(key, value)
+        _PUSH_BYTES.inc(sum(_nbytes(v) for v in values), store=self.name)
         # local (per-process) aggregation + compression first
         local = [KVStore._aggregate(self, v, k)
                  for k, v in zip(keys, values)]

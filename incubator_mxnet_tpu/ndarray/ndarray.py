@@ -497,8 +497,8 @@ def _apply(fn, *inputs):
     from .. import profiler as _prof
     profiling = _prof.imperative_active()
     if profiling:
-        import time as _time
-        t0 = _time.time() * 1e6
+        # epoch-anchored monotonic us (NTP-step safe; profiler.now_us)
+        t0 = _prof.now_us()
     data = [x._data for x in inputs]
     out = fn(*data)
     if profiling:

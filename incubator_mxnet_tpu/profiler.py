@@ -13,7 +13,21 @@ import time
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
            "scope", "Marker", "record_event", "record_batch", "device_memory",
-           "memory_summary", "set_memory_source"]
+           "memory_summary", "set_memory_source", "now_us"]
+
+# Event timing: time.time() is NOT monotonic — an NTP clock step mid-run
+# makes durations negative and reorders trace events. All event timestamps
+# derive from time.perf_counter() (monotonic) anchored ONCE to the wall
+# clock at import, so traces still carry real epoch microseconds but
+# differences are always perf_counter differences.
+_EPOCH_TIME_S = time.time()
+_EPOCH_PERF_S = time.perf_counter()
+
+
+def now_us():
+    """Epoch-anchored monotonic timestamp in microseconds — the one clock
+    every profiler event (and serving's record_batch hook) uses."""
+    return (_EPOCH_TIME_S + (time.perf_counter() - _EPOCH_PERF_S)) * 1e6
 
 _CONFIG = {"filename": "profile.json", "aggregate_stats": True,
            # profile_imperative: instrument EVERY eager op at the _apply
@@ -77,7 +91,7 @@ def record_event(name, categories="host", start_us=None, dur_us=None,
     with _LOCK:
         if len(_EVENTS) < _CONFIG.get("max_events", 500_000):
             ev = {"name": name, "cat": categories, "ph": "X",
-                  "ts": start_us if start_us is not None else time.time() * 1e6,
+                  "ts": start_us if start_us is not None else now_us(),
                   "dur": dur_us or 0, "pid": 0, "tid": threading.get_ident()}
             if args is not None:
                 ev["args"] = args
@@ -88,14 +102,19 @@ def record_event(name, categories="host", start_us=None, dur_us=None,
         agg["max_us"] = max(agg["max_us"], dur_us or 0)
 
 
-def record_batch(model, size, bucket, start_us=None, dur_us=None):
+def record_batch(model, size, bucket, start_us=None, dur_us=None,
+                 request_ids=None):
     """Per-dispatch serving hook (serving/batcher.py): one complete event
     per dispatched batch, named by model and padded bucket shape so the
     aggregate table groups rows per compiled executable; the real
-    (non-padding) item count rides along as an event arg."""
+    (non-padding) item count rides along as an event arg, and
+    ``request_ids`` — the trace ids of the coalesced requests — make one
+    slow HTTP request followable queue -> bucket -> device in the dump."""
+    args = {"batch_size": size, "bucket": bucket}
+    if request_ids:
+        args["request_ids"] = list(request_ids)
     record_event("serve:%s:batch%d" % (model, bucket), "serving",
-                 start_us, dur_us,
-                 args={"batch_size": size, "bucket": bucket})
+                 start_us, dur_us, args=args)
 
 
 class Marker:
@@ -106,12 +125,12 @@ class Marker:
         self.categories = categories
 
     def __enter__(self):
-        self._t0 = time.time() * 1e6
+        self._t0 = now_us()
         return self
 
     def __exit__(self, *a):
         record_event(self.name, self.categories, self._t0,
-                     time.time() * 1e6 - self._t0)
+                     now_us() - self._t0)
 
 
 class scope:
@@ -149,7 +168,7 @@ def record_op(name, t0_us, outs):
         pass
     prefix = getattr(scope._current, "value", "")
     full = "op:" + prefix + name
-    record_event(full, "operator", t0_us, time.time() * 1e6 - t0_us)
+    record_event(full, "operator", t0_us, now_us() - t0_us)
     if _CONFIG.get("profile_memory", True):
         _sample_memory(full)
 
@@ -201,7 +220,7 @@ def _sample_memory(op_name):
             agg["peak_mem_bytes"] = max(agg.get("peak_mem_bytes", 0), live)
         if len(_EVENTS) < _CONFIG.get("max_events", 500_000):
             _EVENTS.append({"name": "device_memory", "ph": "C",
-                            "ts": time.time() * 1e6, "pid": 0,
+                            "ts": now_us(), "pid": 0,
                             "args": {"bytes_in_use": live}})
 
 

@@ -12,9 +12,12 @@ Layers (each importable alone):
 - ``registry`` — ModelRegistry: named, versioned models, hot reload with
   connection draining, one batcher per model.
 - ``metrics``  — ServingMetrics: counters, batch-size histogram,
-  p50/p95/p99 latency from a ring buffer.
+  p50/p95/p99 latency from a ring buffer; every update is mirrored onto
+  the process-wide telemetry registry (docs/OBSERVABILITY.md).
 - ``server``   — ServingServer: stdlib ThreadingHTTPServer front-end with
-  JSON tensors, /healthz, /metrics, and explicit 429 backpressure.
+  JSON tensors, /healthz, Prometheus text at /metrics (legacy JSON at
+  /metrics.json), per-request X-Request-Id tracing, and explicit 429
+  backpressure.
 
 Sixty-second start::
 

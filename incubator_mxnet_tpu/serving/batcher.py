@@ -63,12 +63,13 @@ def default_buckets(max_batch_size):
 class _Request:
     """One queued inference item + the completion event its client waits on."""
 
-    __slots__ = ("inputs", "deadline", "enqueued_at", "_event", "_result",
-                 "_error")
+    __slots__ = ("inputs", "deadline", "enqueued_at", "request_id",
+                 "_event", "_result", "_error")
 
-    def __init__(self, inputs, deadline):
+    def __init__(self, inputs, deadline, request_id=None):
         self.inputs = inputs            # tuple of per-input arrays, NO batch dim
         self.deadline = deadline        # absolute time.monotonic() or None
+        self.request_id = request_id    # trace id riding queue -> dispatch
         self.enqueued_at = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -122,6 +123,12 @@ class DynamicBatcher:
             else config.get_env("MXTPU_SERVE_TIMEOUT_MS"))
         qsize = int(queue_size if queue_size is not None
                     else config.get_env("MXTPU_SERVE_QUEUE_SIZE"))
+        if qsize < 1:
+            # Queue(maxsize=0) would mean UNBOUNDED — silently deleting
+            # the backpressure contract (and /healthz's >=80% threshold)
+            raise ValueError(
+                "queue_size must be >= 1 (got %d): the bounded queue IS "
+                "the backpressure contract (MXTPU_SERVE_QUEUE_SIZE)" % qsize)
         self.queue_size = qsize
         self.default_deadline_ms = (
             default_deadline_ms if default_deadline_ms is not None
@@ -130,7 +137,8 @@ class DynamicBatcher:
             else default_buckets(self.max_batch_size)
         if self.buckets[-1] < self.max_batch_size:
             self.buckets.append(self.max_batch_size)
-        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.metrics = metrics if metrics is not None \
+            else ServingMetrics(model=name)
         self.metrics.queue_depth_fn = lambda: self._queue.qsize()
         self._queue = _queue.Queue(maxsize=qsize)
         self._closed = False
@@ -140,11 +148,13 @@ class DynamicBatcher:
         self._worker.start()
 
     # ------------------------------------------------------------ client side
-    def submit(self, *inputs, deadline_ms=None):
+    def submit(self, *inputs, deadline_ms=None, request_id=None):
         """Enqueue one item (arrays WITHOUT the batch dim); returns a future-
         like _Request. Raises QueueFullError/ServingClosedError immediately
         instead of blocking — backpressure is the caller's signal to shed
-        load upstream."""
+        load upstream. ``request_id`` (assigned by the HTTP front-end or
+        any caller) rides the queue and is emitted on the dispatch's
+        profiler trace event, tying one request to its batch."""
         if self._closed or self._paused:
             raise ServingClosedError("batcher %r is shut down" % self.name)
         if deadline_ms is None:
@@ -155,11 +165,15 @@ class DynamicBatcher:
                     if deadline_ms is not None else None)
         # materialize on the client thread: the worker groups requests by
         # shape/dtype signature, which needs real arrays
-        req = _Request(tuple(onp.asarray(x) for x in inputs), deadline)
+        req = _Request(tuple(onp.asarray(x) for x in inputs), deadline,
+                       request_id=request_id)
         try:
             self._queue.put_nowait(req)
         except _queue.Full:
-            self.metrics.inc("rejected_count")
+            try:
+                self.metrics.inc("rejected_count")
+            except Exception:
+                pass
             raise QueueFullError(
                 "model %r queue full (%d pending): rejecting — raise "
                 "MXTPU_SERVE_QUEUE_SIZE or add capacity"
@@ -171,10 +185,17 @@ class DynamicBatcher:
             err = ServingClosedError("batcher %r is shut down" % self.name)
             req.fail(err)
             raise err
-        self.metrics.inc("request_count")
+        # guarded like the worker-side updates: the request is already
+        # enqueued — a telemetry failure here would error a client whose
+        # work the worker still dispatches (result delivered to nobody)
+        try:
+            self.metrics.inc("request_count")
+        except Exception:
+            pass
         return req
 
-    def predict(self, *inputs, deadline_ms=None, timeout=None):
+    def predict(self, *inputs, deadline_ms=None, timeout=None,
+                request_id=None):
         """Blocking convenience: submit + wait for the result tuple.
 
         A request with a deadline never waits (much) past it: the wait is
@@ -182,7 +203,8 @@ class DynamicBatcher:
         batch gets DeadlineExceededError at its deadline instead of
         hanging — the worker-side check then drops the stale entry when it
         finally dequeues it."""
-        req = self.submit(*inputs, deadline_ms=deadline_ms)
+        req = self.submit(*inputs, deadline_ms=deadline_ms,
+                          request_id=request_id)
         if timeout is None:
             timeout = 600.0
             if req.deadline is not None:
@@ -233,6 +255,13 @@ class DynamicBatcher:
             except _queue.Empty:
                 break
             req.fail(ServingClosedError("server shutting down"))
+        # unbind the queue-depth gauge callback from the shared telemetry
+        # registry (it would otherwise pin this batcher's queue forever
+        # and export a stale series for an unloaded model)
+        try:
+            self.metrics.detach_telemetry()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ worker side
     def _gather(self):
@@ -273,7 +302,10 @@ class DynamicBatcher:
             live = []
             for req in batch:
                 if req.deadline is not None and now >= req.deadline:
-                    self.metrics.inc("expired_count")
+                    try:
+                        self.metrics.inc("expired_count")
+                    except Exception:
+                        pass
                     req.fail(DeadlineExceededError(
                         "deadline passed while queued (model %r)" % self.name))
                 else:
@@ -307,7 +339,10 @@ class DynamicBatcher:
                 for i in range(len(live[0].inputs)))
             outs = self._dispatch_fn(*stacked)
         except Exception as e:  # noqa: BLE001 — forwarded to every waiter
-            self.metrics.inc("error_count", n)
+            try:
+                self.metrics.inc("error_count", n)
+            except Exception:
+                pass
             for req in live:
                 req.fail(e)
             return
@@ -322,27 +357,44 @@ class DynamicBatcher:
             outs = [onp.asarray(o) for o in outs]
             results = [tuple(o[j] for o in outs) for j in range(n)]
         except Exception as e:  # noqa: BLE001 — forwarded to every waiter
-            self.metrics.inc("error_count", n)
+            try:
+                self.metrics.inc("error_count", n)
+            except Exception:
+                pass
             for req in live:
                 req.fail(e)
             return
         done = time.monotonic()
+        # instrument BEFORE delivering: a client unblocks the moment
+        # succeed() fires, and a scrape right after a response must see
+        # this batch's counters and trace event already recorded. Guarded:
+        # a telemetry failure (misconfigured registry bound, -W error)
+        # must neither kill the worker nor leave the waiters hanging.
+        try:
+            for req in live:
+                self.metrics.observe_latency_ms(
+                    (done - req.enqueued_at) * 1000.0)
+            self.metrics.inc("ok_count", n)
+            self.metrics.observe_batch(n, bucket)
+        except Exception:
+            pass
+        self._profile_batch(n, bucket, dur,
+                            [r.request_id for r in live
+                             if r.request_id is not None])
         for j, req in enumerate(live):
             req.succeed(results[j])
-            self.metrics.observe_latency_ms(
-                (done - req.enqueued_at) * 1000.0)
-        self.metrics.inc("ok_count", n)
-        self.metrics.observe_batch(n, bucket)
-        self._profile_batch(n, bucket, dur)
 
-    def _profile_batch(self, n, bucket, dur):
+    def _profile_batch(self, n, bucket, dur, request_ids=None):
         """Per-batch hook into the framework profiler (no-op unless
-        profiler.set_state('run'))."""
+        profiler.set_state('run')). ``request_ids`` — the trace ids of the
+        live requests in the batch — land as an event arg, so one HTTP
+        request is findable queue -> bucket -> device in the trace dump."""
         try:
             from .. import profiler
-            # profiler timestamps are wall-clock epoch us (chrome trace)
+            # epoch-anchored monotonic us (profiler.now_us — NTP-step safe)
             profiler.record_batch(self.name, n, bucket,
-                                  start_us=(time.time() - dur) * 1e6,
-                                  dur_us=dur * 1e6)
+                                  start_us=profiler.now_us() - dur * 1e6,
+                                  dur_us=dur * 1e6,
+                                  request_ids=request_ids)
         except Exception:  # profiling must never take down serving
             pass

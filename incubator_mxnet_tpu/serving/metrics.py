@@ -4,23 +4,90 @@ One ``ServingMetrics`` per registered model: monotonic counters, the
 dispatched batch-size histogram (the coalescing proof), and request
 latency percentiles from a bounded ring buffer — cheap enough to stay on
 for every request, rich enough to tune ``MXTPU_SERVE_*`` capacity knobs
-from (see docs/SERVING.md). Exposed programmatically via ``snapshot()``
-and over HTTP at ``GET /metrics`` (serving/server.py).
+from (see docs/SERVING.md).
+
+Every update is double-written: the per-instance fields feed the
+JSON ``snapshot()`` (served at ``GET /metrics.json`` for back-compat) and
+the process-wide telemetry registry feeds the Prometheus exposition at
+``GET /metrics`` (telemetry/registry.py) — one coherent surface shared
+with training, kvstore, and IO metrics (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
+import math
 import threading
 from collections import deque
+
+from .. import telemetry
 
 __all__ = ["ServingMetrics", "percentile"]
 
 
 def percentile(sorted_values, q):
-    """Nearest-rank percentile of an ascending-sorted sequence (q in 0..100)."""
+    """Nearest-rank percentile of an ascending-sorted sequence (q in 0..100).
+
+    rank = ceil(n * q / 100), clamped to [1, n]. The epsilon guards float
+    representation error at exact-integer products (e.g. n=70, q=30 gives
+    21.000000000000004, which a bare ceil would round UP to rank 22); it
+    also keeps small windows exact: q=50 of 1 element is that element,
+    q=99 of 2 elements is the max.
+    """
     if not sorted_values:
         return None
-    rank = max(1, -(-len(sorted_values) * q // 100))  # ceil without floats
-    return sorted_values[min(int(rank), len(sorted_values)) - 1]
+    n = len(sorted_values)
+    q = min(max(float(q), 0.0), 100.0)
+    rank = int(math.ceil(n * q / 100.0 - 1e-9))
+    return sorted_values[min(max(rank, 1), n) - 1]
+
+
+# ---------------------------------------------------------------------------
+# Shared-registry metrics (one series per model label). Batch-size buckets
+# cover the power-of-two bucketing the batcher pads to; latency buckets
+# span sub-ms CPU echoes to multi-second compiled first calls.
+_REQS = telemetry.counter(
+    "mxtpu_serving_requests_total",
+    "Requests accepted into a model's serving queue.", ("model",))
+_OK = telemetry.counter(
+    "mxtpu_serving_ok_total",
+    "Requests completed successfully.", ("model",))
+_ERRORS = telemetry.counter(
+    "mxtpu_serving_errors_total",
+    "Requests failed by a raising servable.", ("model",))
+_REJECTED = telemetry.counter(
+    "mxtpu_serving_rejected_total",
+    "Requests rejected at submit time (queue full backpressure).",
+    ("model",))
+_EXPIRED = telemetry.counter(
+    "mxtpu_serving_expired_total",
+    "Requests whose deadline passed while queued.", ("model",))
+_BATCHES = telemetry.counter(
+    "mxtpu_serving_batches_total", "Dispatched batches.", ("model",))
+_BATCHED_ITEMS = telemetry.counter(
+    "mxtpu_serving_batched_items_total",
+    "Real (non-padding) items dispatched.", ("model",))
+_PADDED_ITEMS = telemetry.counter(
+    "mxtpu_serving_padded_items_total",
+    "Padding rows added to reach a bucket shape.", ("model",))
+_BATCH_SIZE = telemetry.histogram(
+    "mxtpu_serving_batch_size",
+    "Real items per dispatched batch (the coalescing proof).",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256), labelnames=("model",))
+_LATENCY_MS = telemetry.histogram(
+    "mxtpu_serving_request_latency_ms",
+    "End-to-end request latency (enqueue -> result ready) in ms.",
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+    labelnames=("model",))
+_QUEUE_DEPTH = telemetry.gauge(
+    "mxtpu_serving_queue_depth",
+    "Requests currently waiting in the model's bounded queue.", ("model",))
+
+_COUNTER_MAP = {
+    "request_count": _REQS,
+    "ok_count": _OK,
+    "error_count": _ERRORS,
+    "rejected_count": _REJECTED,
+    "expired_count": _EXPIRED,
+}
 
 
 class ServingMetrics:
@@ -28,11 +95,13 @@ class ServingMetrics:
 
     Latency is end-to-end request time (enqueue -> result ready), the number
     a client observes; the ring buffer bounds memory so a long-lived server
-    reports a moving window, not its whole history.
+    reports a moving window, not its whole history. ``model`` names the
+    telemetry-registry label this instance's updates are mirrored onto.
     """
 
-    def __init__(self, latency_window=4096):
+    def __init__(self, latency_window=4096, model="model"):
         self._lock = threading.Lock()
+        self.model = model
         self.request_count = 0        # accepted into the queue
         self.ok_count = 0
         self.error_count = 0          # dispatch raised
@@ -43,12 +112,38 @@ class ServingMetrics:
         self.padded_items = 0         # padding rows added to reach a bucket
         self.batch_size_hist = {}     # real batch size -> count
         self._latencies_ms = deque(maxlen=latency_window)
-        self.queue_depth_fn = None    # injected by the batcher
+        self._queue_depth_fn = None   # injected by the batcher
+
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth_fn(self):
+        return self._queue_depth_fn
+
+    @queue_depth_fn.setter
+    def queue_depth_fn(self, fn):
+        self._queue_depth_fn = fn
+        if fn is not None:
+            # sampled at scrape time — depth is a point-in-time gauge
+            _QUEUE_DEPTH.set_function(fn, model=self.model)
+
+    def detach_telemetry(self):
+        """Drop this instance's gauge-callback series from the shared
+        registry (batcher close/unload): a dead model must not keep
+        exporting a stale depth, nor keep its queue object alive through
+        the callback closure. Removal is by callback IDENTITY, so a
+        hot-reload that already re-registered the same model name keeps
+        its series, and a series the cardinality clamp re-keyed is still
+        found. Counters/histograms stay — they are process-lifetime
+        cumulative by Prometheus convention."""
+        _QUEUE_DEPTH.remove_function(self._queue_depth_fn)
 
     # ------------------------------------------------------------------
     def inc(self, counter, n=1):
         with self._lock:
             setattr(self, counter, getattr(self, counter) + n)
+        prom = _COUNTER_MAP.get(counter)
+        if prom is not None:
+            prom.inc(n, model=self.model)
 
     def observe_batch(self, size, bucket):
         with self._lock:
@@ -56,10 +151,15 @@ class ServingMetrics:
             self.batched_items += size
             self.padded_items += bucket - size
             self.batch_size_hist[size] = self.batch_size_hist.get(size, 0) + 1
+        _BATCHES.inc(model=self.model)
+        _BATCHED_ITEMS.inc(size, model=self.model)
+        _PADDED_ITEMS.inc(bucket - size, model=self.model)
+        _BATCH_SIZE.observe(size, model=self.model)
 
     def observe_latency_ms(self, ms):
         with self._lock:
             self._latencies_ms.append(ms)
+        _LATENCY_MS.observe(ms, model=self.model)
 
     # ------------------------------------------------------------------
     @property

@@ -65,7 +65,7 @@ class _ModelEntry:
         self.name = name
         self.versions = {}
         self.current_version = None
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(model=name)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._inflight = {}             # version -> dispatched-batch count
@@ -242,13 +242,15 @@ class ModelRegistry:
                                      % (name, names))
         return entry
 
-    def submit(self, name, *inputs, deadline_ms=None):
-        return self._entry(name).batcher.submit(*inputs,
-                                                deadline_ms=deadline_ms)
+    def submit(self, name, *inputs, deadline_ms=None, request_id=None):
+        return self._entry(name).batcher.submit(
+            *inputs, deadline_ms=deadline_ms, request_id=request_id)
 
-    def predict(self, name, *inputs, deadline_ms=None, timeout=None):
+    def predict(self, name, *inputs, deadline_ms=None, timeout=None,
+                request_id=None):
         return self._entry(name).batcher.predict(
-            *inputs, deadline_ms=deadline_ms, timeout=timeout)
+            *inputs, deadline_ms=deadline_ms, timeout=timeout,
+            request_id=request_id)
 
     def metrics(self, name):
         return self._entry(name).metrics

@@ -15,9 +15,19 @@ Routes (TF-Serving REST-shaped):
   WITHOUT the batch dim — cross-request batching is the server's job.
 - ``GET /v1/models``            — registered models + queue/batch config.
 - ``GET /v1/models/<name>``     — one model + its metrics snapshot.
-- ``GET /metrics``              — per-model counters, batch-size
-  histogram, p50/p95/p99 latency.
+- ``GET /metrics``              — Prometheus text exposition of the
+  process-wide telemetry registry (serving counters, batch-size
+  histogram, latency histogram, plus training/compile/kvstore/io
+  metrics recorded in this process — docs/OBSERVABILITY.md).
+- ``GET /metrics.json``         — the legacy per-model JSON snapshot
+  (counters, batch-size histogram, p50/p95/p99 latency), byte-compatible
+  with what ``GET /metrics`` returned before the Prometheus move.
 - ``GET /healthz``              — healthy | degraded | unhealthy (503).
+
+Tracing: every predict request gets a request ID (client-supplied
+``X-Request-Id`` wins, else one is generated), echoed on the response
+header and propagated through the batcher queue onto the profiler's
+``record_batch`` chrome-trace events.
 
 Error contract (the robustness story made visible):
 
@@ -35,6 +45,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import config
+from .. import telemetry
 from .batcher import (DeadlineExceededError, QueueFullError,
                       ServingClosedError)
 from .registry import ModelNotFoundError, ModelRegistry
@@ -55,10 +66,20 @@ class _Handler(BaseHTTPRequestHandler):
         pass  # serving metrics replace per-request stderr lines
 
     # ------------------------------------------------------------------
-    def _send(self, code, payload):
+    def _send(self, code, payload, request_id=None):
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if request_id is not None:
+            self.send_header(telemetry.REQUEST_ID_HEADER, request_id)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code, text, content_type):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -75,6 +96,12 @@ class _Handler(BaseHTTPRequestHandler):
             h = self.registry.health()
             self._send(503 if h["status"] == "unhealthy" else 200, h)
         elif self.path == "/metrics":
+            # Prometheus text exposition of the process-wide registry
+            self._send_text(200, telemetry.export_text(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/metrics.json":
+            # legacy JSON snapshot (byte-compatible with the pre-Prometheus
+            # GET /metrics payload)
             self._send(200, self.registry.metrics_snapshot())
         elif self.path.rstrip("/") == _MODELS_PREFIX:
             self._send(200, {"models": self.registry.models()})
@@ -99,6 +126,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         import numpy as onp
         name = self._model_name()
+        # request-scoped trace id: a client-supplied X-Request-Id wins (the
+        # caller's trace context survives), else assign one here — this is
+        # the id the batcher carries queue -> dispatch -> profiler event
+        req_id = self.headers.get(telemetry.REQUEST_ID_HEADER) \
+            or telemetry.new_request_id()
         try:
             length = int(self.headers.get("Content-Length") or 0)
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -118,24 +150,28 @@ class _Handler(BaseHTTPRequestHandler):
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)  # non-numeric -> 400
         except Exception as e:  # noqa: BLE001 — anything malformed is a 400
-            self._send(400, {"error": "bad request: %s" % e})
+            self._send(400, {"error": "bad request: %s" % e},
+                       request_id=req_id)
             return
         try:
             outs = self.registry.predict(name, *inputs,
-                                         deadline_ms=deadline_ms)
+                                         deadline_ms=deadline_ms,
+                                         request_id=req_id)
         except QueueFullError as e:
-            self._send(429, {"error": str(e)})
+            self._send(429, {"error": str(e)}, request_id=req_id)
         except DeadlineExceededError as e:
-            self._send(504, {"error": str(e)})
+            self._send(504, {"error": str(e)}, request_id=req_id)
         except ModelNotFoundError as e:
-            self._send(404, {"error": str(e)})
+            self._send(404, {"error": str(e)}, request_id=req_id)
         except ServingClosedError as e:
-            self._send(503, {"error": str(e)})
+            self._send(503, {"error": str(e)}, request_id=req_id)
         except Exception as e:  # noqa: BLE001 — servable failure
-            self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
+            self._send(500, {"error": "%s: %s" % (type(e).__name__, e)},
+                       request_id=req_id)
         else:
             self._send(200, {"outputs": [onp.asarray(o).tolist()
-                                         for o in outs]})
+                                         for o in outs]},
+                       request_id=req_id)
 
 
 class _Server(ThreadingHTTPServer):

@@ -53,6 +53,52 @@ def test_scope_prefixes_op_names():
     assert "op:myphase:" in table, table
 
 
+def test_event_timing_immune_to_wall_clock_steps(monkeypatch):
+    """Durations derive from time.perf_counter() anchored once to the wall
+    clock — an NTP step (time.time jumping backwards mid-event) must not
+    produce negative durations or reordered timestamps."""
+    import time as _t
+    # simulate a 1-hour backwards NTP step for the duration of the test
+    real_time = _t.time
+    monkeypatch.setattr(profiler.time, "time",
+                        lambda: real_time() - 3600.0)
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    try:
+        t_before = profiler.now_us()
+        with profiler.Marker("ntp_probe"):
+            _t.sleep(0.01)
+        t_after = profiler.now_us()
+    finally:
+        profiler.set_state("stop")
+    assert t_after > t_before            # monotonic despite the step
+    with profiler._LOCK:
+        evs = [e for e in profiler._EVENTS if e["name"] == "ntp_probe"]
+    assert evs, "marker event missing"
+    assert evs[0]["dur"] >= 10_000 * 0.5  # ~10ms slept, never negative
+    assert evs[0]["ts"] >= t_before      # anchored to the import epoch,
+    profiler.dumps(reset=True)           # not the stepped wall clock
+    with profiler._LOCK:
+        profiler._EVENTS.clear()
+
+
+def test_record_batch_carries_request_ids(tmp_path):
+    import json
+    profiler.set_config(filename=str(tmp_path / "t.json"))
+    profiler.set_state("run")
+    try:
+        profiler.record_batch("m", 3, 4, dur_us=5.0,
+                              request_ids=["ab12", "cd34", "ef56"])
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    profiler.set_config(filename="profile.json")
+    trace = json.load(open(tmp_path / "t.json"))
+    evs = [e for e in trace["traceEvents"] if e["name"] == "serve:m:batch4"]
+    assert evs and evs[0]["args"]["request_ids"] == ["ab12", "cd34", "ef56"]
+    assert evs[0]["args"]["batch_size"] == 3
+
+
 def test_env_registry():
     from incubator_mxnet_tpu import config
     assert config.get_env("MXTPU_NUM_PROC") >= 1

@@ -227,6 +227,27 @@ def test_percentile_nearest_rank():
     assert percentile(vals, 100) == 100
 
 
+def test_percentile_small_window_edges():
+    """Nearest-rank edges at tiny windows (the serving latency ring starts
+    life with 1-2 samples): q=50 of one element is that element; q=99 of
+    two elements is the max; q=50 of two is the LOWER (rank ceil(1.0)=1);
+    and exact-integer rank products must not float-round UP a rank."""
+    assert percentile([7.0], 50) == 7.0
+    assert percentile([7.0], 99) == 7.0
+    assert percentile([7.0], 1) == 7.0
+    assert percentile([1.0, 2.0], 99) == 2.0
+    assert percentile([1.0, 2.0], 50) == 1.0
+    assert percentile([1.0, 2.0], 100) == 2.0
+    # n*q/100 exactly integral: n=70, q=30 -> rank 21, not 22 (float
+    # 70*30/100 = 21.000000000000004 would ceil to 22)
+    vals = list(range(1, 71))
+    assert percentile(vals, 30) == 21
+    # clamping: out-of-range q never indexes out of the window
+    assert percentile([3.0], 0) == 3.0
+    assert percentile([1.0, 2.0], 150) == 2.0
+    assert percentile([1.0, 2.0], -5) == 1.0
+
+
 def test_batcher_coalesces_concurrent_requests():
     """N requests submitted inside one batch window -> fewer dispatches
     than requests, mean dispatched batch > 1 (the coalescing proof)."""
@@ -546,6 +567,70 @@ def test_http_backpressure_returns_429():
     assert reg.health()["status"] == "unhealthy"  # stopped -> unhealthy
 
 
+def test_metrics_routes_prometheus_and_json_backcompat():
+    """GET /metrics now serves Prometheus text; GET /metrics.json serves
+    the EXACT JSON payload /metrics used to (byte-compatible with
+    json.dumps(registry.metrics_snapshot()))."""
+    import urllib.request as _u
+    from incubator_mxnet_tpu import telemetry as _tel
+    sv = _EchoServable()
+    reg = ModelRegistry()
+    reg.load("echo2", sv, max_batch_size=2, batch_timeout_ms=5.0)
+    with ServingServer(reg, port=0) as srv:
+        code, body = _post_json(srv.url + "/v1/models/echo2:predict",
+                                {"inputs": [[1.0]]})
+        assert code == 200
+        # ---- /metrics: Prometheus text, validated by the stdlib parser
+        with _u.urlopen(srv.url + "/metrics", timeout=30.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode("utf-8")
+        assert "# TYPE mxtpu_serving_requests_total counter" in text
+        assert 'mxtpu_serving_requests_total{model="echo2"}' in text
+        assert "# TYPE mxtpu_serving_batch_size histogram" in text
+        import os as _os
+        import sys as _sys
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        _sys.path.insert(0, _os.path.join(root, "tools"))
+        try:
+            import promcheck
+            promcheck.validate(text)
+        finally:
+            _sys.path.pop(0)
+        # the exposition matches the in-process registry's view
+        assert text == _tel.export_text()
+        # ---- /metrics.json: byte-compatible with the old JSON route
+        with _u.urlopen(srv.url + "/metrics.json", timeout=30.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            raw = resp.read()
+        assert raw == _json.dumps(reg.metrics_snapshot()).encode("utf-8")
+        snap = _json.loads(raw)
+        assert snap["echo2"]["ok_count"] >= 1
+
+
+def test_http_predict_echoes_request_id_header():
+    """Every predict response carries X-Request-Id: a client-supplied id
+    is echoed verbatim; otherwise the server assigns one."""
+    import urllib.request as _u
+    reg = ModelRegistry()
+    reg.load("echo3", _EchoServable(), max_batch_size=2, batch_timeout_ms=5.0)
+    with ServingServer(reg, port=0) as srv:
+        body = _json.dumps({"inputs": [[1.0]]}).encode("utf-8")
+        req = _u.Request(srv.url + "/v1/models/echo3:predict", data=body,
+                         headers={"Content-Type": "application/json",
+                                  "X-Request-Id": "trace-abc-123"})
+        with _u.urlopen(req, timeout=30.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-Request-Id"] == "trace-abc-123"
+        req = _u.Request(srv.url + "/v1/models/echo3:predict", data=body,
+                         headers={"Content-Type": "application/json"})
+        with _u.urlopen(req, timeout=30.0) as resp:
+            assert resp.status == 200
+            assigned = resp.headers["X-Request-Id"]
+            assert assigned and len(assigned) == 16
+
+
 def test_http_end_to_end_64_concurrent_over_exported_model(tmp_path):
     """The acceptance demo: >= 64 concurrent single-item HTTP requests
     against a real exported .mxtpu artifact on CPU. Proves (1) real
@@ -592,7 +677,7 @@ def test_http_end_to_end_64_concurrent_over_exported_model(tmp_path):
                 onp.asarray(body["outputs"][0]), ref[i],
                 rtol=1e-4, atol=1e-4)
 
-        code, metrics = _get_json(srv.url + "/metrics")
+        code, metrics = _get_json(srv.url + "/metrics.json")
         assert code == 200
         m = metrics["cnn"]
         assert m["request_count"] == N and m["ok_count"] == N

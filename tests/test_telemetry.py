@@ -1,0 +1,493 @@
+"""Unified telemetry: registry semantics (concurrency, closed-right
+buckets, label cardinality), Prometheus exposition, periodic flush, and
+the e2e acceptance run — concurrent HTTP requests whose trace IDs land in
+the profiler dump while GET /metrics carries serving + training metrics.
+"""
+import json as _json
+import os as _os
+import sys as _sys
+import threading
+import time as _time
+import urllib.request as _urlreq
+import warnings
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, jit, nd, profiler, telemetry
+from incubator_mxnet_tpu.telemetry import (Counter, Gauge, Histogram,
+                                           MetricsRegistry, OVERFLOW_LABEL)
+
+_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+_sys.path.insert(0, _os.path.join(_ROOT, "tools"))
+import promcheck  # noqa: E402  (stdlib-only exposition validator)
+
+
+# ======================================================================
+# registry unit tier (isolated MetricsRegistry instances — the global
+# default registry is process-lifetime state other tests share)
+# ======================================================================
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", ("model",))
+    c.inc(model="a")
+    c.inc(4, model="a")
+    c.inc(model="b")
+    assert c.value(model="a") == 5 and c.value(model="b") == 1
+    assert c.value(model="never") == 0
+    with pytest.raises(ValueError):
+        c.inc(-1, model="a")            # counters are monotonic
+    g = reg.gauge("t_depth", "depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 2
+    g2 = reg.gauge("t_live", "sampled", ("model",))
+    g2.set_function(lambda: 42, model="m")
+    assert g2.value(model="m") == 42
+    h = reg.histogram("t_lat", "lat", buckets=(1, 10))
+    h.observe(0.5)
+    h.observe(100)
+    assert h.value() == (100.5, 2)
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    reg = MetricsRegistry()
+    c1 = reg.counter("t_x_total", "x", ("k",))
+    assert reg.counter("t_x_total", "x", ("k",)) is c1    # same object back
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.gauge("t_x_total", "x", ("k",))
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("t_x_total", "x", ("other",))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("0bad", "x")
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("t_y_total", "y", ("bad-label",))
+    with pytest.raises(ValueError, match="takes labels"):
+        c1.inc(wrong="v")
+
+
+def test_histogram_redeclare_with_different_buckets_raises():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_h_seconds", "h", buckets=(0.1, 1.0))
+    assert reg.histogram("t_h_seconds", "h", buckets=(1.0, 0.1)) is h  # order-insensitive
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("t_h_seconds", "h", buckets=(10.0, 60.0))
+
+
+def test_gauge_inc_on_function_bound_series_raises():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_g_fn", "g")
+    g.set_function(lambda: 5)
+    with pytest.raises(ValueError, match="set_function"):
+        g.inc()
+    assert g.value() == 5                # sampler stays live
+
+
+def test_gauge_series_removal():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_g_rm", "g", ("model",))
+    g.set_function(lambda: 3, model="dead")
+    assert 't_g_rm{model="dead"} 3' in reg.export_text()
+    g.remove(model="dead")
+    assert 't_g_rm{model="dead"}' not in reg.export_text()
+    g.remove(model="dead")               # idempotent
+
+
+def test_batcher_close_detaches_queue_depth_gauge():
+    """Unloading a model must not leave a stale queue-depth series (or a
+    pinned queue object) in the process-wide registry."""
+    from incubator_mxnet_tpu.serving import DynamicBatcher
+    from incubator_mxnet_tpu.serving.metrics import _QUEUE_DEPTH
+
+    class _Echo:
+        def predict_batch(self, x):
+            return (x,)
+
+    b = DynamicBatcher(_Echo(), max_batch_size=2, batch_timeout_ms=1.0,
+                       queue_size=4, name="ephemeral-model")
+    assert 'mxtpu_serving_queue_depth{model="ephemeral-model"}' \
+        in telemetry.export_text()
+    b.close()
+    assert 'mxtpu_serving_queue_depth{model="ephemeral-model"}' \
+        not in telemetry.export_text()
+    assert _QUEUE_DEPTH.value(model="ephemeral-model") == 0
+    # reload race: closing the OLD batcher after a new one re-registered
+    # the same model name must not delete the new batcher's series
+    # (removal is by callback identity, not label)
+    b_old = DynamicBatcher(_Echo(), max_batch_size=2, batch_timeout_ms=1.0,
+                           queue_size=4, name="ephemeral-model")
+    b_new = DynamicBatcher(_Echo(), max_batch_size=2, batch_timeout_ms=1.0,
+                           queue_size=4, name="ephemeral-model")
+    b_old.close()
+    assert 'mxtpu_serving_queue_depth{model="ephemeral-model"}' \
+        in telemetry.export_text()
+    b_new.close()
+    assert 'mxtpu_serving_queue_depth{model="ephemeral-model"}' \
+        not in telemetry.export_text()
+
+
+def test_gauge_bad_callback_does_not_break_scrape():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_bad_cb", "g")
+    g.set_function(lambda: None)         # non-numeric return
+    g2 = reg.gauge("t_dead_cb", "g")
+    g2.set_function(lambda: 1 / 0)       # raising callback
+    text = reg.export_text()             # must not raise
+    assert "t_bad_cb 0" in text and "t_dead_cb 0" in text
+    promcheck.validate(text)
+
+
+def test_batcher_rejects_unbounded_queue():
+    from incubator_mxnet_tpu.serving import DynamicBatcher
+
+    class _Echo:
+        def predict_batch(self, x):
+            return (x,)
+
+    with pytest.raises(ValueError, match="queue_size"):
+        DynamicBatcher(_Echo(), max_batch_size=2, batch_timeout_ms=1.0,
+                       queue_size=0, name="unbounded")
+
+
+def test_concurrent_counter_increments_lose_no_updates():
+    """16 threads x 2000 increments on one series (plus a per-thread
+    labeled series) — the lock must not drop a single update."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_conc_total", "c", ("thread",))
+    h = reg.histogram("t_conc_lat", "h", buckets=(0.5, 1.0))
+    N_THREADS, N_INC = 16, 2000
+    barrier = threading.Barrier(N_THREADS)
+
+    def worker(tid):
+        barrier.wait()                  # maximize interleaving
+        for _ in range(N_INC):
+            c.inc(thread="shared")
+            c.inc(thread="t%d" % tid)
+            h.observe(0.75)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    assert c.value(thread="shared") == N_THREADS * N_INC
+    for i in range(N_THREADS):
+        assert c.value(thread="t%d" % i) == N_INC
+    assert h.value()[1] == N_THREADS * N_INC
+    assert h.bucket_counts() == [0, N_THREADS * N_INC, N_THREADS * N_INC]
+
+
+def test_histogram_buckets_closed_right_in_exposition():
+    """Prometheus ``le`` is an INCLUSIVE upper bound: an observation equal
+    to a boundary lands in that boundary's bucket, both programmatically
+    and in the rendered text."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_cr", "closed right", buckets=(1.0, 2.0, 4.0))
+    for v in (1.0, 2.0, 2.5, 4.0, 5.0):
+        h.observe(v)
+    # cumulative: le=1 -> {1.0}; le=2 -> +{2.0}; le=4 -> +{2.5, 4.0}
+    assert h.bucket_counts() == [1, 2, 4, 5]
+    text = reg.export_text()
+    assert 't_cr_bucket{le="1"} 1' in text
+    assert 't_cr_bucket{le="2"} 2' in text
+    assert 't_cr_bucket{le="4"} 4' in text
+    assert 't_cr_bucket{le="+Inf"} 5' in text
+    assert "t_cr_count 5" in text
+    promcheck.validate(text)
+
+
+def test_label_cardinality_bounded_with_loud_warning(monkeypatch):
+    """Past MXTPU_TELEMETRY_MAX_SERIES distinct label sets, new values are
+    clamped onto the overflow series and a RuntimeWarning fires once —
+    an unbounded label must never OOM the process."""
+    monkeypatch.setenv("MXTPU_TELEMETRY_MAX_SERIES", "4")
+    reg = MetricsRegistry()
+    c = reg.counter("t_card_total", "c", ("rid",))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for i in range(20):
+            c.inc(rid="req-%d" % i)
+        runtime = [w for w in caught
+                   if issubclass(w.category, RuntimeWarning)]
+    assert len(runtime) == 1, "exactly one loud warning"
+    assert "MXTPU_TELEMETRY_MAX_SERIES" in str(runtime[0].message)
+    # first 4 series stayed distinct; the other 16 all fold onto _other_
+    for i in range(4):
+        assert c.value(rid="req-%d" % i) == 1
+    assert c.value(rid=OVERFLOW_LABEL) == 16
+    text = reg.export_text()
+    assert text.count("t_card_total{") == 5       # 4 real + 1 overflow
+    promcheck.validate(text)
+
+
+def test_exposition_escapes_label_values():
+    reg = MetricsRegistry()
+    c = reg.counter("t_esc_total", "c", ("path",))
+    c.inc(path='mod"el\\one\n')
+    text = reg.export_text()
+    assert 'path="mod\\"el\\\\one\\n"' in text
+    promcheck.validate(text)
+
+
+def test_global_registry_export_is_valid_prometheus():
+    """The process-wide registry (every subsystem's import-time metric
+    declarations plus whatever the suite has recorded) always renders a
+    parseable exposition."""
+    text = telemetry.export_text()
+    types = promcheck.validate(text)
+    assert types.get("mxtpu_serving_requests_total") == "counter"
+    assert types.get("mxtpu_serving_batch_size") == "histogram"
+    assert types.get("mxtpu_jit_compiles_total") == "counter"
+    assert types.get("mxtpu_io_wait_seconds_total") == "counter"
+    assert types.get("mxtpu_kvstore_push_bytes_total") == "counter"
+
+
+def test_reset_zeroes_series_but_keeps_cached_metric_objects():
+    """reset() must clear values IN PLACE: modules cache metric objects at
+    import time, so dropping the name->metric map would orphan them
+    (updates applied but invisible to every future export)."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_reset_total", "c", ("k",))
+    c.inc(3, k="a")
+    reg.reset()
+    assert c.value(k="a") == 0
+    assert reg.get("t_reset_total") is c       # same object, still wired
+    c.inc(k="a")                               # the cached handle still
+    assert "t_reset_total" in reg.export_text()  # reaches the exposition
+
+
+def test_request_id_helpers():
+    a, b = telemetry.new_request_id(), telemetry.new_request_id()
+    assert a != b and len(a) == 16
+    int(a, 16)                           # hex
+    assert telemetry.current_request_id() is None
+    with telemetry.request_scope("rid-1"):
+        assert telemetry.current_request_id() == "rid-1"
+        with telemetry.request_scope("rid-2"):
+            assert telemetry.current_request_id() == "rid-2"
+        assert telemetry.current_request_id() == "rid-1"
+    assert telemetry.current_request_id() is None
+
+
+# ======================================================================
+# headless flush tier
+# ======================================================================
+def test_periodic_flush_writes_valid_exposition(tmp_path):
+    """The headless-training path: no HTTP server, metrics land in a file
+    every interval (atomic rename — a reader never sees a torn write)."""
+    path = str(tmp_path / "telemetry.prom")
+    c = telemetry.counter("t_flush_total", "flush probe")
+    c.inc(7)
+    try:
+        telemetry.start_periodic_flush(path=path, interval_s=0.05)
+        deadline = _time.monotonic() + 10.0
+        while not _os.path.exists(path) and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+    finally:
+        telemetry.stop_periodic_flush()
+    assert _os.path.exists(path)
+    text = open(path).read()
+    assert "t_flush_total 7" in text
+    promcheck.validate(text)
+    # flush_to_file is also callable directly (one-shot, e.g. atexit)
+    c.inc()
+    telemetry.flush_to_file(path)
+    assert "t_flush_total 8" in open(path).read()
+
+
+def test_kvstore_push_pull_bytes_counted():
+    from incubator_mxnet_tpu import kvstore as kv
+    from incubator_mxnet_tpu.kvstore.kvstore import _PULL_BYTES, _PUSH_BYTES
+    store = kv.create("local")
+    push0 = _PUSH_BYTES.value(store="local")
+    pull0 = _PULL_BYTES.value(store="local")
+    v = nd.ones((16, 8))                 # 128 f32 = 512 bytes
+    store.init("w", v)
+    store.push("w", nd.ones((16, 8)))
+    out = nd.zeros((16, 8))
+    store.pull("w", out=out)
+    assert _PUSH_BYTES.value(store="local") - push0 == 512
+    assert _PULL_BYTES.value(store="local") - pull0 == 512
+
+
+def test_io_wait_seconds_counted():
+    from incubator_mxnet_tpu import io as mxio
+    from incubator_mxnet_tpu.io.io import _IO_BATCHES, _IO_WAIT_SECONDS
+    base = mxio.NDArrayIter(onp.random.randn(32, 4).astype("float32"),
+                            onp.zeros(32, "float32"), batch_size=8)
+    it = mxio.PrefetchingIter(base)
+    n0 = _IO_BATCHES.value(iter="PrefetchingIter")
+    w0 = _IO_WAIT_SECONDS.value(iter="PrefetchingIter")
+    batches = list(it)
+    assert len(batches) == 4
+    assert _IO_BATCHES.value(iter="PrefetchingIter") - n0 == 4
+    assert _IO_WAIT_SECONDS.value(iter="PrefetchingIter") >= w0
+
+
+def test_prefetched_inner_iter_does_not_double_count_wait():
+    """An inner iterator driven by PrefetchingIter's producer thread is
+    overlapped work, not consumer wait — its own wait accounting must be
+    suppressed or rate(io_wait) would read ~= decode rate even when the
+    consumer never blocks."""
+    from incubator_mxnet_tpu import io as mxio
+    from incubator_mxnet_tpu.io.io import _IO_BATCHES
+
+    class _SlowIter(mxio.ImageRecordIter):
+        # reuse only the instrumented next() wrapper, not the record file
+        def __init__(self):
+            self.batch_size = 2
+            self._n = 0
+
+        def _next_impl(self):
+            if self._n >= 3:
+                raise StopIteration
+            self._n += 1
+            return mxio.DataBatch([nd.zeros((2, 1))], [nd.zeros((2,))])
+
+        def reset(self):
+            self._n = 0
+
+        @property
+        def provide_data(self):
+            return []
+
+        @property
+        def provide_label(self):
+            return []
+
+    inner0 = _IO_BATCHES.value(iter="_SlowIter")
+    it = mxio.PrefetchingIter(_SlowIter())
+    assert len(list(it)) == 3
+    # the inner iterator recorded NOTHING (suppressed on the producer
+    # thread); the wrapper recorded the consumer-side batches
+    assert _IO_BATCHES.value(iter="_SlowIter") == inner0
+    # suppression reaches THROUGH intermediate wrappers (ResizeIter)
+    resize0 = _IO_BATCHES.value(iter="_SlowIter")
+    slow = _SlowIter()
+    it2 = mxio.PrefetchingIter(mxio.ResizeIter(slow, size=2))
+    assert len(list(it2)) == 2
+    assert _IO_BATCHES.value(iter="_SlowIter") == resize0
+    # ...but is scoped to the PRODUCER THREAD: the same object consumed
+    # directly afterwards counts again (no permanent stamp)
+    slow.reset()
+    assert len(list(slow)) == 3
+    assert _IO_BATCHES.value(iter="_SlowIter") == resize0 + 3
+
+
+# ======================================================================
+# e2e acceptance: concurrent serving + training metrics + trace IDs
+# ======================================================================
+def _post_with_headers(url, payload, headers=None, timeout=120.0):
+    body = _json.dumps(payload).encode("utf-8")
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = _urlreq.Request(url, data=body, headers=hdrs)
+    with _urlreq.urlopen(req, timeout=timeout) as resp:
+        return resp.status, _json.loads(resp.read()), dict(resp.headers)
+
+
+def test_e2e_concurrent_requests_metrics_and_trace_ids(tmp_path):
+    """The acceptance demo: a tiny training run plus concurrent HTTP
+    inference against one server, then (a) GET /metrics is valid
+    Prometheus text carrying serving counters, the batch-size histogram,
+    AND training/compile metrics from the same process; (b) the profiler
+    chrome-trace dump from the same run holds record_batch events whose
+    request_ids are exactly the IDs the HTTP clients were assigned."""
+    from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+
+    # --- a little real training so train-side metrics exist ------------
+    steps0 = jit._STEPS.value()
+    net_t = gluon.nn.Dense(4, in_units=8)
+    net_t.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net_t.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = jit.TrainStep(net_t, loss_fn, trainer)
+    X = nd.random.normal(shape=(16, 8))
+    Y = nd.random.normal(shape=(16, 4))
+    for _ in range(3):
+        step(X, Y)
+    assert jit._STEPS.value() - steps0 == 3
+    assert jit._COMPILES.value(kind="train") >= 1
+    sum_s, cnt = jit._STEP_SECONDS.value()
+    assert cnt >= 3 and sum_s > 0
+
+    # --- serving over a live block (EvalStep -> compile metrics) -------
+    net_s = gluon.nn.Dense(3, in_units=4)
+    net_s.initialize(mx.init.Xavier())
+    reg = ModelRegistry()
+    reg.load("tele", net_s, max_batch_size=8, batch_timeout_ms=25.0,
+             queue_size=64)
+
+    trace_path = str(tmp_path / "telemetry_trace.json")
+    profiler.set_config(filename=trace_path)
+    profiler.set_state("run")
+    client_ids = []
+    lock = threading.Lock()
+    try:
+        with ServingServer(reg, port=0) as srv:
+            N = 24
+            barrier = threading.Barrier(N)
+            errors = []
+
+            def client(i):
+                barrier.wait()
+                try:
+                    code, body, headers = _post_with_headers(
+                        srv.url + "/v1/models/tele:predict",
+                        {"inputs": [[float(i)] * 4]})
+                    assert code == 200, body
+                    with lock:
+                        client_ids.append(headers["X-Request-Id"])
+                except Exception as e:  # surfaced after join
+                    with lock:
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(N)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120.0)
+            assert not errors, errors
+            assert len(set(client_ids)) == N
+
+            # ---- (a) the Prometheus scrape, over the real socket -------
+            with _urlreq.urlopen(srv.url + "/metrics", timeout=30.0) as resp:
+                assert resp.status == 200
+                text = resp.read().decode("utf-8")
+            types = promcheck.validate(text)
+            assert types["mxtpu_serving_requests_total"] == "counter"
+            assert 'mxtpu_serving_requests_total{model="tele"} %d' % N in text
+            assert 'mxtpu_serving_ok_total{model="tele"} %d' % N in text
+            assert types["mxtpu_serving_batch_size"] == "histogram"
+            assert 'mxtpu_serving_batch_size_count{model="tele"}' in text
+            assert 'mxtpu_serving_request_latency_ms_count{model="tele"}' \
+                in text
+            # training/compile metrics ride the SAME exposition
+            assert types["mxtpu_train_step_seconds"] == "histogram"
+            assert "mxtpu_train_steps_total" in text
+            assert 'mxtpu_jit_compiles_total{kind="train"}' in text
+            assert 'mxtpu_jit_compiles_total{kind="eval"}' in text
+    finally:
+        profiler.set_state("stop")
+
+    # ---- (b) trace IDs followed the requests into the profiler dump ---
+    profiler.dump()
+    profiler.set_config(filename="profile.json")
+    trace = _json.load(open(trace_path))
+    batch_events = [e for e in trace["traceEvents"]
+                    if e.get("name", "").startswith("serve:tele:batch")]
+    assert batch_events, "no record_batch events in the trace"
+    traced_ids = [rid for e in batch_events
+                  for rid in e.get("args", {}).get("request_ids", [])]
+    assert sorted(traced_ids) == sorted(client_ids), \
+        "every HTTP request's ID must appear in exactly one batch event"
+    # coalescing happened: fewer batch events than requests
+    assert len(batch_events) < len(client_ids)
+    # durations are perf_counter-derived: never negative
+    assert all(e["dur"] >= 0 for e in batch_events)
+    reg.close()

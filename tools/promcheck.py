@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Validate Prometheus text exposition format (version 0.0.4) — stdlib only.
+
+CI's observability stage scrapes the serving server's ``GET /metrics`` and
+pipes the body through this checker; tests import ``validate()`` directly.
+No external prometheus client is involved anywhere in the repo (the
+serving image must not grow a dependency for its own monitoring).
+
+Checks:
+- every non-comment line parses as ``name{labels} value`` (timestamp
+  optional), names/labels legal, values float-parsable;
+- every sample belongs to a ``# TYPE``-declared metric family (histogram
+  samples may use the ``_bucket``/``_sum``/``_count`` suffixes);
+- histograms: every series has a ``+Inf`` bucket, bucket counts are
+  cumulative non-decreasing in ``le`` order, the ``+Inf`` count equals
+  ``_count``, and ``le`` bounds parse;
+- counters never carry negative values.
+
+Usage::
+
+    python tools/promcheck.py metrics.txt     # or stdin with no arg
+"""
+from __future__ import annotations
+
+import math
+import re
+import sys
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+# name{label="value",...} value [timestamp] — label values may contain
+# escaped quotes/backslashes/newlines
+SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+    r'\s+(?P<value>[^ ]+)(?:\s+(?P<ts>-?[0-9]+))?$')
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_value(raw, line_no):
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError("line %d: unparsable sample value %r"
+                         % (line_no, raw)) from None
+
+
+def validate(text):
+    """Validate one exposition; returns {family -> type}. Raises
+    ValueError with a line-numbered message on the first violation."""
+    types = {}                # family name -> declared type
+    helped = set()
+    samples = []              # (line_no, name, labels-dict, value)
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                fam, typ = parts[2], (parts[3] if len(parts) > 3 else "")
+                if not NAME_RE.match(fam):
+                    raise ValueError("line %d: bad family name %r" % (i, fam))
+                if typ not in TYPES:
+                    raise ValueError("line %d: bad TYPE %r" % (i, typ))
+                if fam in types:
+                    raise ValueError("line %d: duplicate TYPE for %r"
+                                     % (i, fam))
+                types[fam] = typ
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helped.add(parts[2])
+            continue
+        m = SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError("line %d: unparsable sample line %r" % (i, line))
+        labels = dict(LABEL_RE.findall(m.group("labels") or ""))
+        samples.append((i, m.group("name"), labels,
+                        _parse_value(m.group("value"), i)))
+
+    def family_of(name):
+        for fam, typ in types.items():
+            if typ == "histogram" and name in (
+                    fam + "_bucket", fam + "_sum", fam + "_count"):
+                return fam
+            if typ == "summary" and name in (fam + "_sum", fam + "_count"):
+                return fam
+            if name == fam:
+                return fam
+        return None
+
+    # histogram series accounting: (family, non-le label items) -> state
+    hist = {}
+    for line_no, name, labels, value in samples:
+        fam = family_of(name)
+        if fam is None:
+            raise ValueError("line %d: sample %r has no # TYPE declaration"
+                             % (line_no, name))
+        typ = types[fam]
+        if typ == "counter" and value < 0:
+            raise ValueError("line %d: counter %r is negative (%r)"
+                             % (line_no, name, value))
+        if typ == "histogram":
+            series = (fam, tuple(sorted((k, v) for k, v in labels.items()
+                                        if k != "le")))
+            st = hist.setdefault(series, {"buckets": [], "sum": None,
+                                          "count": None})
+            if name == fam + "_bucket":
+                if "le" not in labels:
+                    raise ValueError("line %d: %s_bucket without le"
+                                     % (line_no, fam))
+                st["buckets"].append((line_no, labels["le"], value))
+            elif name == fam + "_sum":
+                st["sum"] = value
+            elif name == fam + "_count":
+                st["count"] = value
+
+    for (fam, lbls), st in hist.items():
+        if not st["buckets"]:
+            raise ValueError("histogram %r series %r has no buckets"
+                             % (fam, lbls))
+        bounds = []
+        for line_no, le, v in st["buckets"]:
+            bounds.append((math.inf if le == "+Inf" else
+                           _parse_value(le, line_no), line_no, v))
+        bounds.sort(key=lambda b: b[0])
+        if bounds[-1][0] != math.inf:
+            raise ValueError("histogram %r series %r lacks a +Inf bucket"
+                             % (fam, lbls))
+        prev = -1.0
+        for bound, line_no, v in bounds:
+            if v < prev:
+                raise ValueError(
+                    "line %d: histogram %r bucket le=%r count %r below the "
+                    "previous bucket (%r) — not cumulative"
+                    % (line_no, fam, bound, v, prev))
+            prev = v
+        if st["count"] is None:
+            raise ValueError("histogram %r series %r lacks _count"
+                             % (fam, lbls))
+        if bounds[-1][2] != st["count"]:
+            raise ValueError(
+                "histogram %r series %r: +Inf bucket (%r) != _count (%r)"
+                % (fam, lbls, bounds[-1][2], st["count"]))
+    return types
+
+
+def main(argv):
+    text = open(argv[1]).read() if len(argv) > 1 else sys.stdin.read()
+    types = validate(text)
+    n_hist = sum(1 for t in types.values() if t == "histogram")
+    print("promcheck OK: %d metric families (%d histograms)"
+          % (len(types), n_hist))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
