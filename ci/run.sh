@@ -6,7 +6,11 @@
 #   ci/run.sh lint native     # selected stages
 #
 # Stages:
-#   lint    - syntax walk over every python file (compileall)
+#   lint    - syntax walk over every python file (compileall) + the
+#             framework-aware static-analysis gate (tools/mxtpulint/:
+#             hot-path host syncs, env-registry bypasses, lock/thread
+#             hygiene, label cardinality, NTP-unsafe durations) — hard
+#             fail on any non-baselined finding
 #   native  - rebuild libmxtpu.so + libmxtpu_predict.so from src, then a
 #             TSAN (-fsanitize=thread) compile of the native layer (the
 #             race-detection build the TSAN test also uses; ref ASAN job)
@@ -32,8 +36,19 @@ STAGES=("$@")
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
 if has_stage lint; then
-  echo "=== lint: syntax walk ==="
+  echo "=== lint: syntax walk + mxtpulint gate ==="
   python -m compileall -q incubator_mxnet_tpu tests tools benchmark bench.py __graft_entry__.py
+  # framework-aware rules R001-R007; exits nonzero on any finding that is
+  # neither inline-suppressed nor in tools/mxtpulint/baseline.json. One
+  # run emits the JSON artifact (shape shared with `tools/promcheck.py
+  # --json`) so a downstream aggregator merges both gates with one
+  # parser; on failure the findings are echoed human-readably.
+  LINT_JSON=$(mktemp -t mxtpulint.XXXXXX.json)   # per-run: no clobber
+  python -m tools.mxtpulint incubator_mxnet_tpu --json > "$LINT_JSON" \
+    || { python -m tools.mxtpulint incubator_mxnet_tpu || true; exit 1; }
+  python -c "import json,sys; r=json.load(open(sys.argv[1])); \
+print('mxtpulint OK: %d baselined, artifact %s' % (r['baselined'], sys.argv[1]))" \
+    "$LINT_JSON"
 fi
 
 if has_stage native; then
@@ -70,8 +85,7 @@ if has_stage observability; then
   echo "=== observability: scrape /metrics + validate Prometheus text ==="
   JAX_PLATFORMS=cpu python - <<'EOF'
 import json, sys, tempfile, threading, urllib.request
-sys.path.insert(0, "tools")
-import promcheck
+from tools import promcheck
 from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
 from incubator_mxnet_tpu import telemetry
 

@@ -59,7 +59,10 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 try:
-                    speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                    # perf_counter, not time.time(): an NTP step mid-epoch
+                    # must not print negative/absurd samples/sec (R006)
+                    speed = self.frequent * self.batch_size / (
+                        time.perf_counter() - self.tic)
                 except ZeroDivisionError:
                     speed = float("inf")
                 if param.eval_metric is not None:
@@ -73,10 +76,10 @@ class Speedometer:
                 else:
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                                  param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.perf_counter()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.perf_counter()
 
 
 class ProgressBar:

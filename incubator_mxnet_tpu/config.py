@@ -72,6 +72,12 @@ ENV_VARS = {
         int, 0,
         "Verbose logging in the kvstore server-role facade "
         "(kvstore_server.py)."),
+    "MXTPU_ROLE": (
+        str, "worker",
+        "Process role for launch scripts that branch on it "
+        "(kvstore_server._init_kvstore_server_module): 'worker' or "
+        "'server'. DMLC_ROLE, when set, takes precedence (reference "
+        "launcher compatibility)."),
     "MXTPU_EXEC_CACHE_SIZE": (
         int, 16,
         "Bound on each compiled-executable cache (TrainStep/EvalStep/"

@@ -117,11 +117,14 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, Bat
         self.processed_samples = 0
 
     def train_begin(self, estimator, *args, **kwargs):
-        self.train_start = time.time()
+        # perf_counter anchors: train/epoch cost are durations — an NTP
+        # clock step mid-run must not corrupt them (R006)
+        self.train_start = time.perf_counter()
         logging.info("Training begin")
 
     def train_end(self, estimator, *args, **kwargs):
-        logging.info("Train finished using total %ds", time.time() - self.train_start)
+        logging.info("Train finished using total %ds",
+                     time.perf_counter() - self.train_start)
         for m in self.metrics:
             name, value = m.get()
             logging.info("Train end: %s: %.4f", name, value)
@@ -137,11 +140,11 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, Bat
                 logging.info(msg)
 
     def epoch_begin(self, estimator, *args, **kwargs):
-        self.epoch_start = time.time()
+        self.epoch_start = time.perf_counter()
 
     def epoch_end(self, estimator, *args, **kwargs):
-        msg = "[Epoch %d] finished in %.3fs:" % (self.current_epoch,
-                                                 time.time() - self.epoch_start)
+        msg = "[Epoch %d] finished in %.3fs:" % (
+            self.current_epoch, time.perf_counter() - self.epoch_start)
         for m in self.metrics:
             name, value = m.get()
             msg += " %s: %.4f" % (name, value)

@@ -18,6 +18,8 @@ import logging
 import os
 import pickle
 
+from ..config import get_env
+
 __all__ = ["KVStoreServer"]
 
 
@@ -29,7 +31,7 @@ class KVStoreServer(object):
         self.init_logging()
 
     def init_logging(self):
-        self._verbose = int(os.environ.get("MXTPU_KVSTORE_DEBUG", "0"))
+        self._verbose = get_env("MXTPU_KVSTORE_DEBUG")
 
     def _controller(self):
         """ref server_controller: head-0 commands (optimizer blob, sync
@@ -61,7 +63,9 @@ class KVStoreServer(object):
 
 def _init_kvstore_server_module():
     """ref kvstore_server.py module entry (invoked when DMLC_ROLE=server)."""
-    role = os.environ.get("DMLC_ROLE", os.environ.get("MXTPU_ROLE", "worker"))
+    # DMLC_ROLE (reference launcher) wins; MXTPU_ROLE rides the typed
+    # registry like every other framework knob (R002)
+    role = os.environ.get("DMLC_ROLE") or get_env("MXTPU_ROLE")
     if role == "server":
         from . import kvstore as _kv
         server = KVStoreServer(_kv.KVStore("local"))

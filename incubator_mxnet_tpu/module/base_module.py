@@ -140,7 +140,8 @@ class BaseModule:
         kv_async = kv is not None and hasattr(kv, "begin_epoch")
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            # perf_counter: epoch cost is a duration — NTP-step safe (R006)
+            tic = time.perf_counter()
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
@@ -170,7 +171,8 @@ class BaseModule:
                 kv.sync()
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.perf_counter() - tic)
             if epoch_end_callback is not None:
                 arg_params, aux_params = self.get_params()
                 for cb in _as_list(epoch_end_callback):

@@ -25,6 +25,7 @@ arbitrary many client threads can submit concurrently.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import queue as _queue
@@ -36,6 +37,8 @@ from .metrics import ServingMetrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
            "ServingClosedError", "default_buckets"]
+
+_LOG = logging.getLogger(__name__)
 
 
 class QueueFullError(RuntimeError):
@@ -305,7 +308,10 @@ class DynamicBatcher:
                     try:
                         self.metrics.inc("expired_count")
                     except Exception:
-                        pass
+                        # telemetry failure must not fail the request path,
+                        # but the dropped increment is debug-visible (R005)
+                        _LOG.debug("expired_count update failed",
+                                   exc_info=True)
                     req.fail(DeadlineExceededError(
                         "deadline passed while queued (model %r)" % self.name))
                 else:
@@ -354,7 +360,12 @@ class DynamicBatcher:
             # waiters
             if not isinstance(outs, (list, tuple)):
                 outs = (outs,)
-            outs = [onp.asarray(o) for o in outs]
+            # reviewed sync point: results MUST land on host here — they
+            # are sliced per request and handed to arbitrary client
+            # threads/HTTP JSON; this is the one place the whole batch
+            # pays a single device->host transfer instead of each client
+            # paying its own
+            outs = [onp.asarray(o) for o in outs]  # mxtpulint: disable=R001
             results = [tuple(o[j] for o in outs) for j in range(n)]
         except Exception as e:  # noqa: BLE001 — forwarded to every waiter
             try:

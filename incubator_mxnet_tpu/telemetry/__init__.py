@@ -24,6 +24,7 @@ name, store type, iterator class) — never request IDs.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
@@ -83,12 +84,16 @@ def start_periodic_flush(path=None, interval_s=None):
                 flush_to_file(path)
             except Exception:
                 # a full disk / unwritable path must not kill the job the
-                # telemetry exists to observe
-                pass
+                # telemetry exists to observe — but the skip must not be
+                # silent either (R005): debug-log it so a flusher that
+                # never lands a file is diagnosable
+                logging.getLogger(__name__).debug(
+                    "telemetry flush to %r failed", path, exc_info=True)
         try:                      # final flush so short jobs leave a file
             flush_to_file(path)
         except Exception:
-            pass
+            logging.getLogger(__name__).debug(
+                "final telemetry flush to %r failed", path, exc_info=True)
 
     # stop-old + register-new is ONE critical section: concurrent starts
     # must never orphan a running flusher (its Event would be lost and the

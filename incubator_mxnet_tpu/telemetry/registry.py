@@ -187,6 +187,14 @@ class Gauge(_Metric):
     def set(self, v, **labels):
         s = self._series_for(labels, lambda: [0.0])
         with self._lock:
+            # same guard as inc/dec: a series bound to a live sampler via
+            # set_function() must not be silently frozen to a constant
+            if callable(s[0]):
+                raise ValueError(
+                    "gauge %r series is bound to a callback via "
+                    "set_function(); set() would silently detach the "
+                    "live sampler (use set_function again, or "
+                    "remove_function first)" % self.name)
             s[0] = v
 
     def inc(self, n=1, **labels):

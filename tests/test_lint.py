@@ -1,0 +1,500 @@
+"""mxtpulint tier: per-rule positive/negative fixtures, suppression
+comments, baseline round-trip, the shared CI JSON shape (promcheck
+parity), and the repo-clean gate (the same assertion ci/run.sh's lint
+stage enforces)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mxtpulint import (RULES, lint_file, lint_paths,       # noqa: E402
+                             load_baseline, save_baseline, apply_baseline,
+                             make_report, DEFAULT_BASELINE)
+from tools import promcheck                                      # noqa: E402
+
+
+def run_snippet(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return lint_file(str(p), root=str(tmp_path))
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+def test_rule_catalog_complete():
+    assert {"R001", "R002", "R003", "R004", "R005", "R006",
+            "R007"} <= set(RULES)
+
+
+# ------------------------------------------------------------------ R001
+def test_r001_hot_path_sync_positive(tmp_path):
+    findings = run_snippet(tmp_path, "jit.py", """
+        class TrainStep:
+            def __call__(self, x):
+                return x.asnumpy()
+    """)
+    assert rule_ids(findings) == ["R001"]
+
+
+def test_r001_batcher_dispatch_positive_client_side_clean(tmp_path):
+    findings = run_snippet(tmp_path, "batcher.py", """
+        import numpy as onp
+
+        class DynamicBatcher:
+            def _dispatch_batch(self, live):
+                return onp.asarray(live[0].item())
+
+            def submit(self, x):
+                # client thread: materializing here is the DESIGN
+                return onp.asarray(x)
+    """)
+    # two syncs on the dispatch line (asarray + .item()), none for submit
+    assert rule_ids(findings) == ["R001", "R001"]
+    assert all(f.line == 6 for f in findings)
+
+
+def test_r001_cold_path_clean(tmp_path):
+    findings = run_snippet(tmp_path, "model.py", """
+        class Exporter:
+            def save(self, x):
+                return x.asnumpy()
+    """)
+    assert "R001" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------ R002
+def test_r002_env_bypass_positive(tmp_path):
+    findings = run_snippet(tmp_path, "feature.py", """
+        import os
+
+        def knobs():
+            a = os.environ.get("MXTPU_FOO", "0")
+            b = os.getenv("MXTPU_BAR")
+            c = os.environ["MXTPU_BAZ"]
+            return a, b, c
+    """)
+    assert rule_ids(findings) == ["R002", "R002", "R002"]
+
+
+def test_r002_clean_cases(tmp_path):
+    # config.py is the registry itself; non-MXTPU vars and env *writes*
+    # (pop/del/assign) are out of scope
+    for name, src in [
+        ("config.py", "import os\nX = os.environ.get('MXTPU_FOO')\n"),
+        ("other.py", "import os\nX = os.environ.get('HOME')\n"),
+        ("worker.py", "import os\nos.environ.pop('MXTPU_COORD_ADDR', None)\n"),
+    ]:
+        findings = run_snippet(tmp_path, name, src)
+        assert "R002" not in rule_ids(findings), (name, findings)
+
+
+def test_r002_exemption_is_exact_path_not_basename(tmp_path):
+    # a future serving/config.py gets NO free pass — only the registry
+    # module itself may read the raw environment
+    sub = tmp_path / "serving"
+    sub.mkdir()
+    (sub / "config.py").write_text(
+        "import os\nX = os.environ.get('MXTPU_SERVE_FOO')\n")
+    findings = lint_file(str(sub / "config.py"), root=str(tmp_path))
+    assert rule_ids(findings) == ["R002"]
+
+
+# ------------------------------------------------------------------ R003
+def test_r003_bare_acquire_positive(tmp_path):
+    findings = run_snippet(tmp_path, "locks.py", """
+        import threading
+        lock = threading.Lock()
+
+        def f():
+            lock.acquire()
+            do_work()
+            lock.release()
+    """)
+    assert rule_ids(findings) == ["R003"]
+
+
+def test_r003_bare_acquire_inside_with_still_flagged(tmp_path):
+    # nesting inside `with lock:` does NOT excuse a bare re-acquire —
+    # that's the exception-leak pattern plus a self-deadlock on Lock
+    findings = run_snippet(tmp_path, "locks.py", """
+        import threading
+        lock = threading.Lock()
+
+        def f():
+            with lock:
+                lock.acquire()
+                do_work()
+                lock.release()
+    """)
+    assert rule_ids(findings) == ["R003"]
+
+
+def test_r003_protected_forms_clean(tmp_path):
+    findings = run_snippet(tmp_path, "locks.py", """
+        import threading
+        lock = threading.Lock()
+
+        def canonical():
+            lock.acquire()
+            try:
+                do_work()
+            finally:
+                lock.release()
+
+        def ctx_managed():
+            with lock:
+                do_work()
+
+        def timed():
+            if lock.acquire(timeout=1.0):
+                try:
+                    do_work()
+                finally:
+                    lock.release()
+    """)
+    assert "R003" not in rule_ids(findings)
+
+
+def test_r003_conditional_acquire_without_finally_flagged(tmp_path):
+    findings = run_snippet(tmp_path, "locks.py", """
+        import threading
+        lock = threading.Lock()
+
+        def timed():
+            if lock.acquire(timeout=1.0):
+                do_work()              # raises => lock held forever
+                lock.release()
+    """)
+    assert rule_ids(findings) == ["R003"]
+
+
+# ------------------------------------------------------------------ R004
+def test_r004_unbounded_labels_positive(tmp_path):
+    findings = run_snippet(tmp_path, "metrics_use.py", """
+        from incubator_mxnet_tpu import telemetry
+
+        REQS = telemetry.counter("reqs_total", "doc", ("model",))
+
+        def handle(rid, model):
+            REQS.inc(model=f"req-{rid}")       # f-string label
+            REQS.inc(1, model=str(rid))        # call-derived label
+            REQS.inc(1, model=model)           # bounded: fine
+    """)
+    assert rule_ids(findings) == ["R004", "R004"]
+
+
+def test_r004_bounded_labels_clean(tmp_path):
+    findings = run_snippet(tmp_path, "metrics_use.py", """
+        from incubator_mxnet_tpu.telemetry import counter
+
+        PUSH = counter("push_bytes_total", "doc", ("store",))
+
+        class KV:
+            def push(self, nbytes):
+                PUSH.inc(nbytes, store=self.name)
+                PUSH.inc(1, store="device")
+    """)
+    assert "R004" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------ R005
+def test_r005_silent_worker_positive(tmp_path):
+    findings = run_snippet(tmp_path, "worker.py", """
+        import threading
+
+        def run():
+            while True:
+                try:
+                    work()
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+    """)
+    assert rule_ids(findings) == ["R005"]
+
+
+def test_r005_logged_handler_and_non_thread_clean(tmp_path):
+    findings = run_snippet(tmp_path, "worker.py", """
+        import logging
+        import threading
+
+        def run():
+            while True:
+                try:
+                    work()
+                except Exception:
+                    logging.exception("worker iteration failed")
+
+        def not_a_thread_target():
+            try:
+                work()
+            except Exception:
+                pass
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+    """)
+    assert "R005" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------ R006
+def test_r006_walltime_duration_positive(tmp_path):
+    findings = run_snippet(tmp_path, "timing.py", """
+        import time
+
+        def f():
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """)
+    # both halves flagged: the anchor assignment and the subtraction
+    assert rule_ids(findings) == ["R006", "R006"]
+
+
+def test_r006_alias_bindings(tmp_path):
+    # binding-accurate: module aliases are tracked, and a name that merely
+    # LOOKS like time() but binds perf_counter is not flagged
+    findings = run_snippet(tmp_path, "aliased.py", """
+        import time as t
+        from time import time as now
+
+        def f():
+            a = t.time() - 1.0
+            b = now() - 1.0
+            return a, b
+    """)
+    assert rule_ids(findings) == ["R006", "R006"]
+
+    findings = run_snippet(tmp_path, "shadowed.py", """
+        from time import perf_counter as time
+
+        def f(t0):
+            return time() - t0     # monotonic despite the name
+    """)
+    assert "R006" not in rule_ids(findings)
+
+
+def test_r006_perf_counter_and_timestamps_clean(tmp_path):
+    findings = run_snippet(tmp_path, "timing.py", """
+        import time
+
+        def f():
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0
+
+        def log_record():
+            return {"ts": time.time()}     # wall-clock TIMESTAMP: fine
+    """)
+    assert "R006" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------ R007
+def test_r007_unjoined_thread_positive(tmp_path):
+    findings = run_snippet(tmp_path, "threads.py", """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+    """)
+    assert rule_ids(findings) == ["R007"]
+
+
+def test_r007_post_construction_daemon_clean(tmp_path):
+    findings = run_snippet(tmp_path, "threads.py", """
+        import threading
+
+        def spawn(fn):
+            w = threading.Thread(target=fn)
+            w.daemon = True
+            w.start()
+            s = threading.Thread(target=fn)
+            s.setDaemon(True)
+            s.start()
+    """)
+    assert "R007" not in rule_ids(findings)
+
+
+def test_r007_daemon_or_joined_clean(tmp_path):
+    findings = run_snippet(tmp_path, "threads.py", """
+        import threading
+
+        class Owner:
+            def start(self, fn):
+                self._t = threading.Thread(target=fn, daemon=True)
+                self._t.start()
+                self._w = threading.Thread(target=fn)
+                self._w.start()
+
+            def close(self):
+                self._w.join()
+    """)
+    assert "R007" not in rule_ids(findings)
+
+
+# ----------------------------------------------------------- suppression
+def test_per_line_suppression(tmp_path):
+    findings = run_snippet(tmp_path, "feature.py", """
+        import os
+
+        def knob():
+            # reviewed: bootstrap read before config is importable
+            return os.environ.get("MXTPU_FOO")  # mxtpulint: disable=R002
+    """)
+    assert findings == []
+
+
+def test_suppression_is_per_rule(tmp_path):
+    findings = run_snippet(tmp_path, "feature.py", """
+        import os
+
+        def knob():
+            return os.environ.get("MXTPU_FOO")  # mxtpulint: disable=R001
+    """)
+    assert rule_ids(findings) == ["R002"]      # wrong rule id: still fails
+
+
+def test_unreadable_file_is_a_finding_not_a_crash(tmp_path):
+    p = tmp_path / "legacy_enc.py"
+    p.write_bytes(b"# -*- coding: latin-1 -*-\n# caf\xe9\nX = 1\n")
+    findings = lint_file(str(p), root=str(tmp_path))
+    assert rule_ids(findings) == ["E000"]
+    assert "unreadable" in findings[0].message
+    # null bytes: ast.parse raises bare ValueError — also a finding
+    q = tmp_path / "nul.py"
+    q.write_bytes(b"X = 1\x00\n")
+    findings = lint_file(str(q), root=str(tmp_path))
+    assert rule_ids(findings) == ["E000"]
+
+
+def test_write_baseline_refuses_rule_filter(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "incubator_mxnet_tpu",
+         "--rules", "R006", "--write-baseline",
+         "--baseline", str(tmp_path / "bl.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "cannot be combined" in r.stderr
+    assert not (tmp_path / "bl.json").exists()
+
+
+# ------------------------------------------------------------- baseline
+def test_baseline_round_trip(tmp_path):
+    src = """
+        import os
+
+        def knob():
+            return os.environ.get("MXTPU_OLD")
+    """
+    findings = run_snippet(tmp_path, "legacy.py", src)
+    assert rule_ids(findings) == ["R002"]
+
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(str(bl_path), findings)
+    counts = load_baseline(str(bl_path))
+    new, old = apply_baseline(findings, counts)
+    assert new == [] and len(old) == 1
+
+    # unrelated edits move line numbers: the (path, rule, text) key holds
+    shifted = "\n\n\n" + textwrap.dedent(src)
+    (tmp_path / "legacy.py").write_text(shifted)
+    findings2 = lint_file(str(tmp_path / "legacy.py"), root=str(tmp_path))
+    new2, old2 = apply_baseline(findings2, counts)
+    assert new2 == [] and len(old2) == 1
+
+    # a NEW finding is not absorbed by the baseline
+    (tmp_path / "legacy.py").write_text(
+        shifted + "\nX = os.environ.get('MXTPU_NEW')\n")
+    findings3 = lint_file(str(tmp_path / "legacy.py"), root=str(tmp_path))
+    new3, old3 = apply_baseline(findings3, counts)
+    assert len(old3) == 1 and len(new3) == 1
+    assert "MXTPU_NEW" in new3[0].message
+
+
+# ------------------------------------------------- shared CI JSON shape
+def test_shared_json_shape_with_promcheck(tmp_path):
+    findings = run_snippet(tmp_path, "feature.py",
+                           "import os\nX = os.environ.get('MXTPU_FOO')\n")
+    lint_rep = make_report("mxtpulint", findings)
+    ok_rep = promcheck.report("# TYPE a counter\na 1\n")
+    bad_rep = promcheck.report("total{model= 1\n", path="m.prom")
+
+    keys = {"tool", "ok", "findings", "counts", "baselined"}
+    for rep in (lint_rep, ok_rep, bad_rep):
+        assert set(rep) == keys, rep
+    assert lint_rep["tool"] == "mxtpulint" and not lint_rep["ok"]
+    assert ok_rep["ok"] and ok_rep["findings"] == []
+    assert not bad_rep["ok"]
+    # finding entries are field-compatible across both tools
+    f_keys = {"path", "line", "rule", "message"}
+    assert set(lint_rep["findings"][0]) == f_keys
+    assert set(bad_rep["findings"][0]) == f_keys
+    assert bad_rep["findings"][0]["rule"] == "P001"
+    assert bad_rep["findings"][0]["line"] == 1
+    json.dumps(lint_rep), json.dumps(bad_rep)   # both serializable
+
+
+# ------------------------------------------------------- repo-clean gate
+def test_repo_clean_non_baselined():
+    """The acceptance gate: zero non-baselined findings over the package,
+    and nothing for R002/R006 hides in the baseline (fixed, not
+    grandfathered)."""
+    findings = lint_paths([os.path.join(REPO, "incubator_mxnet_tpu")],
+                          root=REPO)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new, _old = apply_baseline(findings, baseline)
+    assert not new, "non-baselined findings:\n" + "\n".join(map(repr, new))
+    grandfathered = [k for k in baseline if k[1] in ("R002", "R006")]
+    assert not grandfathered, grandfathered
+
+
+def test_cli_gate_and_json():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "incubator_mxnet_tpu",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["tool"] == "mxtpulint" and rep["ok"] \
+        and rep["findings"] == []
+
+
+def test_cli_missing_path_fails_loudly():
+    # a typo'd path must not pass a vacuous gate
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "no_such_dir_xyz"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "do not exist" in r.stderr
+
+
+def test_cli_gate_portable_cwd(tmp_path):
+    # baseline paths are repo-root-anchored: the gate is clean from any cwd
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint",
+         os.path.join(REPO, "incubator_mxnet_tpu")],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_list_rules():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    for rid in ("R001", "R002", "R003", "R004", "R005", "R006", "R007"):
+        assert rid in r.stdout

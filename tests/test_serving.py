@@ -591,12 +591,10 @@ def test_metrics_routes_prometheus_and_json_backcompat():
         import os as _os
         import sys as _sys
         root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-        _sys.path.insert(0, _os.path.join(root, "tools"))
-        try:
-            import promcheck
-            promcheck.validate(text)
-        finally:
-            _sys.path.pop(0)
+        if root not in _sys.path:
+            _sys.path.insert(0, root)
+        from tools import promcheck   # one module identity repo-wide
+        promcheck.validate(text)
         # the exposition matches the in-process registry's view
         assert text == _tel.export_text()
         # ---- /metrics.json: byte-compatible with the old JSON route

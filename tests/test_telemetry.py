@@ -19,9 +19,12 @@ from incubator_mxnet_tpu import gluon, jit, nd, profiler, telemetry
 from incubator_mxnet_tpu.telemetry import (Counter, Gauge, Histogram,
                                            MetricsRegistry, OVERFLOW_LABEL)
 
+# tools/ is a package: import under ONE module identity so module-level
+# state never diverges between copies (test_lint.py uses the same form)
 _ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-_sys.path.insert(0, _os.path.join(_ROOT, "tools"))
-import promcheck  # noqa: E402  (stdlib-only exposition validator)
+if _ROOT not in _sys.path:
+    _sys.path.insert(0, _ROOT)
+from tools import promcheck  # noqa: E402  (stdlib-only exposition validator)
 
 
 # ======================================================================
@@ -82,7 +85,11 @@ def test_gauge_inc_on_function_bound_series_raises():
     g.set_function(lambda: 5)
     with pytest.raises(ValueError, match="set_function"):
         g.inc()
+    with pytest.raises(ValueError, match="set_function"):
+        g.set(0)                         # same guard: no silent freeze
     assert g.value() == 5                # sampler stays live
+    g.set_function(lambda: 7)            # explicit rebind stays legal
+    assert g.value() == 7
 
 
 def test_gauge_series_removal():

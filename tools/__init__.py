@@ -1,0 +1,4 @@
+"""Repo tooling namespace (not shipped in the wheel — setup.py's
+find_packages is scoped to incubator_mxnet_tpu). Makes
+``python -m tools.mxtpulint`` and ``from tools.mxtpulint import ...``
+deterministic from a repo-root checkout."""

@@ -1,0 +1,35 @@
+"""mxtpulint — framework-aware static analysis for incubator_mxnet_tpu.
+
+Seven stdlib-``ast`` rules encoding this codebase's own latency/threading
+failure modes (the Python analog of the reference MXNet's C++ sanitizer +
+engine-dependency checks; see docs/STATIC_ANALYSIS.md for the catalog,
+suppression and baseline workflow, and how to add a rule):
+
+  R001  host-device sync (.asnumpy()/.item()/np.asarray) in a jit-step or
+        batcher-dispatch hot path
+  R002  MXTPU_* env var read via os.environ/os.getenv outside config.py's
+        typed registry
+  R003  Lock/RLock acquired without `with` or try/finally release
+  R004  telemetry metric labeled with an f-string / call-derived value
+        (unbounded series cardinality)
+  R005  exception swallowed silently inside a thread-run function
+        (silent worker death)
+  R006  time.time() differences used as durations (NTP-unsafe)
+  R007  non-daemon threading.Thread without a matching join()
+
+Run the gate::
+
+    python -m tools.mxtpulint incubator_mxnet_tpu/           # human output
+    python -m tools.mxtpulint incubator_mxnet_tpu/ --json    # CI shape
+
+Exit code 0 iff every finding is suppressed inline or baselined.
+"""
+from .core import (Finding, RULES, lint_file, lint_paths, load_baseline,
+                   save_baseline, apply_baseline, make_report,
+                   DEFAULT_BASELINE)
+from . import rules as _rules          # noqa: F401  (registers R001-R007)
+from .rules import HOT_PATH_PATTERNS
+
+__all__ = ["Finding", "RULES", "lint_file", "lint_paths", "load_baseline",
+           "save_baseline", "apply_baseline", "make_report",
+           "DEFAULT_BASELINE", "HOT_PATH_PATTERNS"]

@@ -1,0 +1,108 @@
+"""CLI: ``python -m tools.mxtpulint [paths...] [options]``.
+
+Exit codes: 0 = clean (all findings suppressed/baselined), 1 = new
+findings, 2 = usage error. ``--json`` emits the shared report shape that
+``tools/promcheck.py --json`` also produces, so CI aggregates both lint
+gates with one parser.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (RULES, lint_paths, iter_py_files, load_baseline,
+                   save_baseline, apply_baseline, make_report,
+                   DEFAULT_BASELINE)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxtpulint",
+        description="framework-aware static analysis for incubator_mxnet_tpu")
+    ap.add_argument("paths", nargs="*", default=["incubator_mxnet_tpu"],
+                    help="files/directories to lint "
+                         "(default: incubator_mxnet_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the shared CI report shape on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/mxtpulint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (title, _fn) in sorted(RULES.items()):
+            print("%s  %s" % (rule_id, title))
+        return 0
+
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = only - set(RULES)
+        if unknown:
+            print("unknown rule(s): %s" % ", ".join(sorted(unknown)),
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["incubator_mxnet_tpu"]
+    # a typo'd/renamed path must fail loudly, not pass a vacuous gate
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print("path(s) do not exist: %s" % ", ".join(missing),
+              file=sys.stderr)
+        return 2
+    files = list(iter_py_files(paths))
+    if not files:
+        print("no .py files found under: %s" % ", ".join(paths),
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(paths, only_rules=only)
+
+    if args.write_baseline and only:
+        # a rule-filtered rewrite would silently drop every OTHER rule's
+        # grandfathered entries
+        print("--write-baseline cannot be combined with --rules: it "
+              "rewrites the whole baseline", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = save_baseline(args.baseline, findings)
+        print("wrote %d finding(s) to %s" % (len(findings), path))
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, old = apply_baseline(findings, baseline)
+    report = make_report("mxtpulint", new, baselined=len(old))
+
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print("%s:%d:%d: %s %s" % (f.path, f.line, f.col, f.rule,
+                                       f.message))
+        if new:
+            by_rule = ", ".join("%s=%d" % kv
+                                for kv in sorted(report["counts"].items()))
+            print("mxtpulint: %d finding(s) [%s]%s"
+                  % (len(new), by_rule,
+                     " (+%d baselined)" % len(old) if old else ""))
+            print("fix it, or suppress a reviewed exception with "
+                  "'# mxtpulint: disable=<rule>' (docs/STATIC_ANALYSIS.md)")
+        else:
+            print("mxtpulint OK: 0 findings%s"
+                  % (" (+%d baselined)" % len(old) if old else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,302 @@
+"""mxtpulint core: file walking, suppression comments, baseline, reports.
+
+The engine is deliberately dependency-free (stdlib ``ast`` + ``re`` only):
+the serving image that runs CI must not grow a lint dependency any more
+than it grows a prometheus client (see tools/promcheck.py).
+
+Three escape hatches, in order of preference:
+
+1. **Fix the code** — every rule names the concrete runtime failure it
+   prevents (docs/STATIC_ANALYSIS.md has a before/after per rule).
+2. **Per-line suppression** — ``# mxtpulint: disable=R001`` (comma list,
+   or ``disable=all``) on the offending line marks a reviewed-deliberate
+   exception; pair it with a WHY comment.
+3. **Baseline** — ``tools/mxtpulint/baseline.json`` grandfathers existing
+   findings so the CI gate can land before a long fix queue drains.
+   Entries match on (path, rule, stripped source text), not line numbers,
+   so unrelated edits don't resurrect them. ``--write-baseline``
+   regenerates it; the goal state is an empty list.
+
+Report shape (shared with ``tools/promcheck.py --json`` so CI can
+aggregate both gates with one parser)::
+
+    {"tool": "<name>", "ok": bool,
+     "findings": [{"path", "line", "rule", "message"}, ...],
+     "counts": {"R001": 2, ...}, "baselined": <int>}
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+__all__ = ["Finding", "FileContext", "rule", "RULES", "lint_file",
+           "lint_paths", "iter_py_files", "load_baseline", "save_baseline",
+           "apply_baseline", "make_report", "DEFAULT_BASELINE"]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+# Baseline/report paths are repo-root-relative (two levels above this
+# file), NOT cwd-relative: the baseline must match no matter where the
+# gate is invoked from.
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+RULES = {}          # rule id -> (title, check_fn)
+
+
+def rule(rule_id, title):
+    """Register ``fn(ctx) -> iterable[Finding]`` under ``rule_id``."""
+    def deco(fn):
+        RULES[rule_id] = (title, fn)
+        return fn
+    return deco
+
+
+class Finding:
+    """One lint hit; ``text`` (the stripped source line) is the
+    line-number-independent half of the baseline key."""
+
+    __slots__ = ("path", "line", "col", "rule", "message", "text")
+
+    def __init__(self, path, line, col, rule_id, message, text=""):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule_id
+        self.message = message
+        self.text = text
+
+    def baseline_key(self):
+        return (self.path, self.rule, self.text)
+
+    def to_json(self):
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+    def __repr__(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col,
+                                    self.rule, self.message)
+
+
+# ---------------------------------------------------------------- suppression
+_SUPPRESS_RE = re.compile(r"#\s*mxtpulint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def suppressions(src_lines):
+    """{1-based line -> set of rule ids (or {'all'})} from per-line
+    ``# mxtpulint: disable=R00x[,R00y]`` comments."""
+    out = {}
+    for i, line in enumerate(src_lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {tok.strip() for tok in m.group(1).split(",")
+                      if tok.strip()}
+    return out
+
+
+# ---------------------------------------------------------------- file context
+class FileContext:
+    """Parsed file + the cross-rule indexes every rule shares: parent
+    links, function qualnames, thread-target functions, telemetry-metric
+    and lock variable names."""
+
+    def __init__(self, path, relpath, src):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.modkey = self.relpath[:-3] if self.relpath.endswith(".py") \
+            else self.relpath
+        self.basename = os.path.basename(path)
+        self.src_lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self._parents = {}
+        self.qualnames = {}          # FunctionDef/AsyncFunctionDef -> "A.b.c"
+        # binding-accurate time-module tracking (R006): names bound to the
+        # time MODULE (`import time`, `import time as _time`) vs names
+        # bound to the time.time FUNCTION (`from time import time [as x]`).
+        # `from time import perf_counter as time` binds neither.
+        self.time_module_aliases = set()
+        self.walltime_func_names = set()
+        self._index()
+
+    # -- indexes -----------------------------------------------------------
+    def _index(self):
+        stack = []
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                stack.append(node.name)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.qualnames[node] = ".".join(stack)
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        self.time_module_aliases.add(alias.asname or "time")
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name == "time":
+                        self.walltime_func_names.add(alias.asname
+                                                     or alias.name)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                stack.pop()
+        visit(self.tree)
+
+    # -- navigation helpers ------------------------------------------------
+    def parent(self, node):
+        return self._parents.get(node)
+
+    def ancestors(self, node):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_functions(self, node):
+        """Innermost-first chain of enclosing function defs."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield anc
+
+    def walk(self, *types):
+        for node in ast.walk(self.tree):
+            if not types or isinstance(node, types):
+                yield node
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.src_lines):
+            return self.src_lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, node, rule_id, message):
+        return Finding(self.relpath, node.lineno,
+                       getattr(node, "col_offset", 0), rule_id, message,
+                       self.line_text(node.lineno))
+
+
+def terminal_name(node):
+    """Rightmost identifier of a Name/Attribute chain ('' otherwise):
+    ``self._worker`` -> ``_worker``, ``t`` -> ``t``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+# ---------------------------------------------------------------- the runner
+SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules"}
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in SKIP_DIRS
+                                 and not d.startswith(".")
+                                 and not d.endswith(".egg-info"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_file(path, root=None, only_rules=None):
+    """Lint one file; returns non-suppressed findings (suppressed ones are
+    dropped here, before baseline matching)."""
+    root = root or REPO_ROOT
+    relpath = os.path.relpath(path, root)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        ctx = FileContext(path, relpath, src)
+    except SyntaxError as e:
+        return [Finding(relpath.replace(os.sep, "/"), e.lineno or 0, 0,
+                        "E000", "syntax error: %s" % e.msg)]
+    except (ValueError, OSError) as e:
+        # one unreadable file must fail AS A FINDING, not take the whole
+        # gate down with a traceback. ValueError covers both non-UTF-8
+        # bytes (UnicodeDecodeError) and ast.parse's bare ValueError for
+        # null bytes on py3.10/3.11.
+        return [Finding(relpath.replace(os.sep, "/"), 0, 0, "E000",
+                        "unreadable source (%s)" % e)]
+    findings = []
+    for rule_id, (_title, fn) in sorted(RULES.items()):
+        if only_rules and rule_id not in only_rules:
+            continue
+        findings.extend(fn(ctx))
+    sup = suppressions(ctx.src_lines)
+    kept = []
+    for f in findings:
+        rules_off = sup.get(f.line, ())
+        if "all" in rules_off or f.rule in rules_off:
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+def lint_paths(paths, root=None, only_rules=None):
+    findings = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, root=root, only_rules=only_rules))
+    return findings
+
+
+# ---------------------------------------------------------------- baseline
+def load_baseline(path):
+    """Baseline file -> multiset {key: count}. A missing file is an empty
+    baseline (the gate still works before the file exists)."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    counts = {}
+    for entry in data.get("findings", []):
+        key = (entry["path"], entry["rule"], entry.get("text", ""))
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def save_baseline(path, findings):
+    data = {"version": 1,
+            "comment": "grandfathered mxtpulint findings — shrink to zero; "
+                       "matched on (path, rule, text), line-number free",
+            "findings": [{"path": f.path, "rule": f.rule, "text": f.text}
+                         for f in findings]}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def apply_baseline(findings, baseline_counts):
+    """Split findings into (new, grandfathered) against the multiset."""
+    remaining = dict(baseline_counts)
+    new, old = [], []
+    for f in findings:
+        key = f.baseline_key()
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------- reporting
+def make_report(tool, findings, baselined=0):
+    """The shared CI-aggregatable JSON shape (see module docstring)."""
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {"tool": tool, "ok": not findings,
+            "findings": [f.to_json() for f in findings],
+            "counts": counts, "baselined": baselined}

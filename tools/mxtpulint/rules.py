@@ -1,0 +1,468 @@
+"""The framework-aware rule set (R001-R007).
+
+Each rule encodes a bug class this codebase has actually hit (or that the
+reference MXNet catches natively with sanitizers / engine dependency
+checks): hidden host-device syncs in compiled-step and batcher hot paths,
+config reads that bypass the typed registry, locks that deadlock on an
+exception, unbounded telemetry label cardinality, worker threads that die
+silently, NTP-unsafe wall-clock durations, and forgotten thread joins.
+
+Rules are pattern checks over a single file's AST — intra-file only, no
+type inference. False positives are expected to be rare and are handled
+with per-line ``# mxtpulint: disable=R00x`` suppressions (plus a WHY
+comment), never by weakening the rule.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+
+from .core import rule, terminal_name
+
+# --------------------------------------------------------------------- R001
+# Hot paths: the compiled train/eval step and the serving batcher's worker
+# side. A host-device sync here (.asnumpy(), .item(), np.asarray on a
+# device array) blocks the dispatching thread on a device transfer and
+# serializes the pipeline — exactly what the reference's async engine
+# WaitToRead discipline exists to avoid (PAPER.md §1).
+HOT_PATH_PATTERNS = (
+    "*jit:TrainStep.__call__",
+    "*jit:TrainStep._build",        # nested inner/step_fn trace under it
+    "*jit:EvalStep.__call__",
+    "*:*TrainStep.__call__",        # DataParallelTrainStep & friends
+    "*:*EvalStep.__call__",
+    "*batcher:DynamicBatcher._run",
+    "*batcher:DynamicBatcher._gather",
+    "*batcher:DynamicBatcher._dispatch_batch",
+)
+
+_SYNC_ATTRS = ("asnumpy", "item")
+_NUMPY_MODULES = ("np", "onp", "numpy")
+
+
+def _in_hot_path(ctx, node):
+    for fn in ctx.enclosing_functions(node):
+        qual = ctx.qualnames.get(fn, fn.name)
+        key = "%s:%s" % (ctx.modkey, qual)
+        for pat in HOT_PATH_PATTERNS:
+            if fnmatch.fnmatch(key, pat):
+                return qual
+    return None
+
+
+@rule("R001", "host-device sync in a jit-step / batcher-dispatch hot path")
+def r001_host_sync(ctx):
+    for node in ctx.walk(ast.Call):
+        hot = None
+        f = node.func
+        what = None
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+            what = ".%s()" % f.attr
+        elif (isinstance(f, ast.Attribute) and f.attr == "asarray"
+              and isinstance(f.value, ast.Name)
+              and f.value.id in _NUMPY_MODULES):
+            what = "%s.asarray()" % f.value.id
+        if what is None:
+            continue
+        hot = _in_hot_path(ctx, node)
+        if hot is None:
+            continue
+        yield ctx.finding(
+            node, "R001",
+            "%s inside hot path %r forces a host-device sync — the "
+            "dispatching thread blocks on device transfer; keep device "
+            "values lazy or move the materialization off the hot path"
+            % (what, hot))
+
+
+# --------------------------------------------------------------------- R002
+# Every MXTPU_* knob is declared once, typed, and documented in
+# config.ENV_VARS (the dmlc::Parameter idiom); a raw os.environ read
+# silently forks the default/parsing logic and hides the knob from
+# docs/ENV_VARS.md. config.py itself is the one legitimate reader.
+def _is_os_environ(node):
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os") \
+        or (isinstance(node, ast.Name) and node.id == "environ")
+
+
+def _mxtpu_literal(node):
+    return (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.startswith("MXTPU_"))
+
+
+# the ONE legitimate raw-environ reader (exact path, not basename: a
+# future serving/config.py etc. gets no free pass); bare "config.py" is
+# the fixture-root form tests use
+_R002_EXEMPT = ("incubator_mxnet_tpu/config.py", "config.py")
+
+
+@rule("R002", "MXTPU_* env var read outside the typed config registry")
+def r002_env_bypass(ctx):
+    if ctx.relpath in _R002_EXEMPT:
+        return
+    for node in ctx.walk():
+        var = None
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and _is_os_environ(f.value)
+                    and node.args and _mxtpu_literal(node.args[0])):
+                var = node.args[0].value
+            elif (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                    and isinstance(f.value, ast.Name) and f.value.id == "os"
+                    and node.args and _mxtpu_literal(node.args[0])):
+                var = node.args[0].value
+            elif (isinstance(f, ast.Name) and f.id == "getenv"
+                    and node.args and _mxtpu_literal(node.args[0])):
+                var = node.args[0].value
+        elif (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and _is_os_environ(node.value)
+                and _mxtpu_literal(node.slice)):
+            var = node.slice.value
+        if var is not None:
+            yield ctx.finding(
+                node, "R002",
+                "raw os.environ read of %r bypasses config.ENV_VARS — "
+                "register it there and read it via config.get_env(%r) so "
+                "typing/default/docs stay in one place" % (var, var))
+
+
+# --------------------------------------------------------------------- R003
+# lock.acquire() not wrapped in `with` or try/finally: any exception
+# between acquire and release leaves the lock held forever — every other
+# thread that touches it then deadlocks (the failure is in the OTHER
+# thread's stack trace, which is why it ships).
+_LOCKISH_RE = re.compile(r"lock|mutex|sem(aphore)?|cond", re.I)
+
+
+def _lock_vars(ctx):
+    """Terminal names assigned from threading.Lock()/RLock()/Semaphore()."""
+    out = set()
+    for node in ctx.walk(ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call):
+            callee = terminal_name(v.func)
+            if callee in ("Lock", "RLock", "Semaphore", "BoundedSemaphore",
+                          "Condition"):
+                for t in node.targets:
+                    name = terminal_name(t)
+                    if name:
+                        out.add(name)
+    return out
+
+
+def _releases_in(stmts, receiver_dump):
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "release"
+                    and ast.dump(sub.func.value) == receiver_dump):
+                return True
+    return False
+
+
+@rule("R003", "Lock acquired without `with` or try/finally release")
+def r003_bare_acquire(ctx):
+    lock_names = _lock_vars(ctx)
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "acquire"):
+            continue
+        name = terminal_name(f.value)
+        if name not in lock_names and not _LOCKISH_RE.search(name):
+            continue
+        receiver = ast.dump(f.value)
+        # `with lock.acquire():` — THIS call is the context expression.
+        # (A bare acquire merely nested inside `with lock:` is NOT excused:
+        # that's the exception-leak pattern itself, plus a self-deadlock
+        # on a non-reentrant Lock.)
+        if any(isinstance(a, (ast.With, ast.AsyncWith))
+               and any(item.context_expr is node for item in a.items)
+               for a in ctx.ancestors(node)):
+            continue
+        # acquire inside a try whose finally releases the same lock
+        protected = False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try) and _releases_in(anc.finalbody,
+                                                         receiver):
+                protected = True
+                break
+        if protected:
+            continue
+        # canonical `lock.acquire()` immediately followed by
+        # `try: ... finally: lock.release()`
+        stmt = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        parent = ctx.parent(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(parent, field, None)
+            if body and stmt in body:
+                idx = body.index(stmt)
+                if (idx + 1 < len(body) and isinstance(body[idx + 1], ast.Try)
+                        and _releases_in(body[idx + 1].finalbody, receiver)):
+                    protected = True
+                break
+        if protected:
+            continue
+        # conditional acquire: `if lock.acquire(timeout=...):` (or while)
+        # whose body OPENS with try/finally release — the standard
+        # timed/non-blocking form
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.If, ast.While)) \
+                    and any(sub is node for sub in ast.walk(anc.test)):
+                if (anc.body and isinstance(anc.body[0], ast.Try)
+                        and _releases_in(anc.body[0].finalbody, receiver)):
+                    protected = True
+                break
+        if protected:
+            continue
+        yield ctx.finding(
+            node, "R003",
+            "%r.acquire() without `with` or try/finally release — an "
+            "exception before release() leaves the lock held and "
+            "deadlocks every other thread that takes it" % name)
+
+
+# --------------------------------------------------------------------- R004
+# Telemetry labels must be BOUNDED dimensions (model name, store type).
+# An f-string / call-derived / concatenated label value is an unbounded
+# one (request ids, paths, timestamps): the registry can only clamp it to
+# '_other_' at runtime after the damage to series cardinality is done.
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+_METRIC_METHODS = ("inc", "dec", "set", "observe", "set_function")
+
+
+def _metric_vars(ctx):
+    out = set()
+    for node in ctx.walk(ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call) \
+                and terminal_name(v.func) in _METRIC_FACTORIES:
+            for t in node.targets:
+                name = terminal_name(t)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _unbounded_label(value):
+    if isinstance(value, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(value, ast.Call):
+        return "a call result"
+    if isinstance(value, ast.BinOp):
+        return "a computed expression"
+    return None
+
+
+@rule("R004", "telemetry metric labeled with an unbounded value")
+def r004_unbounded_labels(ctx):
+    metric_names = _metric_vars(ctx)
+    if not metric_names:
+        return
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _METRIC_METHODS
+                and terminal_name(f.value) in metric_names):
+            continue
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            why = _unbounded_label(kw.value)
+            if why:
+                yield ctx.finding(
+                    kw.value, "R004",
+                    "label %r of metric %r is %s — label values must be "
+                    "bounded constants/fields or the series cardinality "
+                    "explodes until the registry clamps it to '_other_' "
+                    "(MXTPU_TELEMETRY_MAX_SERIES)"
+                    % (kw.arg, terminal_name(f.value), why))
+
+
+# --------------------------------------------------------------------- R005
+# A thread-run function that swallows exceptions with a body-less handler
+# dies (or skips work) with no log line, no metric, no re-raise: the
+# worker looks alive from the outside while doing nothing — the
+# silent-worker-death mode the serving batcher is explicitly hardened
+# against.
+def _thread_target_names(ctx):
+    out = set()
+    for node in ctx.walk(ast.Call):
+        if terminal_name(node.func) != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                name = terminal_name(kw.value)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _is_silent(handler):
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None or isinstance(stmt.value, ast.Constant)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue          # docstring-style no-op
+        return False
+    return True
+
+
+@rule("R005", "exception swallowed silently in a thread-run function")
+def r005_silent_worker(ctx):
+    targets = _thread_target_names(ctx)
+    if not targets:
+        return
+    for node in ctx.walk(ast.ExceptHandler):
+        if not _is_silent(node):
+            continue
+        in_target = any(fn.name in targets
+                        for fn in ctx.enclosing_functions(node))
+        if not in_target:
+            continue
+        yield ctx.finding(
+            node, "R005",
+            "exception swallowed with no log/metric/re-raise inside a "
+            "thread target — the worker dies or skips work invisibly; "
+            "log it (or fail the owning request) so the death is "
+            "observable")
+
+
+# --------------------------------------------------------------------- R006
+# time.time() is wall-clock: an NTP step mid-run makes a duration
+# negative or wildly wrong (the exact hazard PR 2 fixed in the profiler).
+# Durations must come from time.perf_counter()/monotonic().
+_TIMER_NAME_RE = re.compile(
+    r"(?:^|_)(?:tic|toc|t0|t1|start|started|begin)(?:_|$)", re.I)
+
+
+def _is_walltime_call(ctx, node):
+    """Binding-accurate: `<time-module-alias>.time()` or a name bound via
+    `from time import time [as x]`. `from time import perf_counter as
+    time` binds neither and is NOT flagged."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "time" \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id in ctx.time_module_aliases:
+        return True
+    if isinstance(f, ast.Name) and f.id in ctx.walltime_func_names:
+        return True
+    return False
+
+
+@rule("R006", "time.time() difference used as a duration")
+def r006_walltime_duration(ctx):
+    for node in ctx.walk(ast.BinOp):
+        if isinstance(node.op, ast.Sub) and (
+                _is_walltime_call(ctx, node.left)
+                or _is_walltime_call(ctx, node.right)):
+            yield ctx.finding(
+                node, "R006",
+                "duration computed from time.time() — wall clock is not "
+                "monotonic (NTP step => negative/garbage duration); use "
+                "time.perf_counter()")
+    for node in ctx.walk(ast.Assign):
+        if not _is_walltime_call(ctx, node.value):
+            continue
+        for t in node.targets:
+            name = terminal_name(t)
+            if name and _TIMER_NAME_RE.search(name):
+                yield ctx.finding(
+                    node, "R006",
+                    "timer anchor %r taken from time.time() — the later "
+                    "subtraction is NTP-unsafe; use time.perf_counter() "
+                    "(wall-clock TIMESTAMPS, e.g. log 'ts' fields, are "
+                    "fine and not flagged)" % name)
+                break
+
+
+# --------------------------------------------------------------------- R007
+# A non-daemon thread that nobody joins outlives (or hangs) interpreter
+# shutdown and leaks on every reload; either mark it daemon (and accept
+# hard kill) or own its lifecycle with a join.
+def _join_targets(ctx):
+    out = set()
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "join":
+            name = terminal_name(f.value)
+            if name:
+                out.add(name)
+    return out
+
+
+def _daemonized_names(ctx):
+    """Names whose thread is daemonized post-construction:
+    ``t.daemon = True`` / ``t.setDaemon(True)``."""
+    out = set()
+    for node in ctx.walk(ast.Assign):
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == "daemon"
+                and isinstance(node.value, ast.Constant)
+                and node.value.value):
+            name = terminal_name(node.targets[0].value)
+            if name:
+                out.add(name)
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "setDaemon"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value):
+            name = terminal_name(f.value)
+            if name:
+                out.add(name)
+    return out
+
+
+@rule("R007", "non-daemon Thread without a matching join()")
+def r007_unjoined_thread(ctx):
+    joined = _join_targets(ctx)
+    daemonized = _daemonized_names(ctx)
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        is_thread = (isinstance(f, ast.Attribute) and f.attr == "Thread"
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id == "threading") \
+            or (isinstance(f, ast.Name) and f.id == "Thread")
+        if not is_thread:
+            continue
+        daemon = None
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        if daemon:
+            continue
+        # find what the thread is bound to, then look for name.join() or
+        # a post-construction name.daemon = True
+        bound = None
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Assign):
+            bound = terminal_name(parent.targets[0])
+        elif isinstance(parent, ast.AnnAssign) and parent.target is not None:
+            bound = terminal_name(parent.target)
+        elif isinstance(parent, ast.Attribute) and parent.attr == "start":
+            bound = None              # Thread(...).start() — unjoinable
+        if bound and (bound in joined or bound in daemonized):
+            continue
+        yield ctx.finding(
+            node, "R007",
+            "non-daemon Thread%s has no matching .join() in this file — "
+            "it outlives interpreter shutdown and leaks per reload; pass "
+            "daemon=True or join it in the owner's close/stop path"
+            % (" %r" % bound if bound else ""))

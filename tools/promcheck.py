@@ -18,10 +18,16 @@ Checks:
 
 Usage::
 
-    python tools/promcheck.py metrics.txt     # or stdin with no arg
+    python tools/promcheck.py metrics.txt            # or stdin with no arg
+    python tools/promcheck.py metrics.txt --json     # CI report shape
+
+``--json`` emits the same report shape as ``python -m tools.mxtpulint
+--json`` (tool/ok/findings/counts/baselined), so CI aggregates both lint
+gates with one parser; violations carry rule id ``P001``.
 """
 from __future__ import annotations
 
+import json
 import math
 import re
 import sys
@@ -149,8 +155,36 @@ def validate(text):
     return types
 
 
+_LINE_NO_RE = re.compile(r"line (\d+):")
+
+
+def report(text, path="<stdin>"):
+    """Validate and return the shared CI report shape (see tools/mxtpulint/
+    core.py): {"tool", "ok", "findings", "counts", "baselined"}. The first
+    violation becomes one finding with rule id P001."""
+    findings = []
+    try:
+        validate(text)
+    except ValueError as e:
+        msg = str(e)
+        m = _LINE_NO_RE.search(msg)
+        findings.append({"path": path, "line": int(m.group(1)) if m else 0,
+                         "rule": "P001", "message": msg})
+    return {"tool": "promcheck", "ok": not findings, "findings": findings,
+            "counts": {"P001": len(findings)} if findings else {},
+            "baselined": 0}
+
+
 def main(argv):
-    text = open(argv[1]).read() if len(argv) > 1 else sys.stdin.read()
+    args = [a for a in argv[1:] if a != "--json"]
+    as_json = "--json" in argv[1:]
+    path = args[0] if args else "<stdin>"
+    text = open(args[0]).read() if args else sys.stdin.read()
+    if as_json:
+        rep = report(text, path=path)
+        json.dump(rep, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0 if rep["ok"] else 1
     types = validate(text)
     n_hist = sum(1 for t in types.values() if t == "histogram")
     print("promcheck OK: %d metric families (%d histograms)"
