@@ -21,6 +21,12 @@
 #             GET /metrics over the wire, and validate the Prometheus
 #             exposition with the stdlib parser (tools/promcheck.py);
 #             also exercises the headless periodic-flush file path
+#   diagnostics - the "why is it slow / why is it stuck" layer: span
+#             tracing (nesting, queue-boundary propagation, chrome-trace
+#             parenting, 16-thread race), flight recorder (ring bound,
+#             crash dump), and the stall watchdog (forced-stall e2e:
+#             blocked batcher worker -> one stack dump + tape tail while
+#             /healthz keeps answering)
 #   smoke   - driver contract: entry() jit-compiles on CPU and
 #             dryrun_multichip(8) runs a full sharded train step
 #   large   - int64 large-tensor tier (>2^31 elements; int8/uint8 dtypes
@@ -31,14 +37,14 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving observability smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving observability diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
 if has_stage lint; then
   echo "=== lint: syntax walk + mxtpulint gate ==="
   python -m compileall -q incubator_mxnet_tpu tests tools benchmark bench.py __graft_entry__.py
-  # framework-aware rules R001-R007; exits nonzero on any finding that is
+  # framework-aware rules R001-R008; exits nonzero on any finding that is
   # neither inline-suppressed nor in tools/mxtpulint/baseline.json. One
   # run emits the JSON artifact (shape shared with `tools/promcheck.py
   # --json`) so a downstream aggregator merges both gates with one
@@ -123,6 +129,16 @@ telemetry.flush_to_file(path)
 promcheck.validate(open(path).read())
 print("observability OK: %d families scraped + flushed" % len(types))
 EOF
+fi
+
+if has_stage diagnostics; then
+  echo "=== diagnostics: spans + flight recorder + stall watchdog ==="
+  # focused gate for the two acceptance e2es — the parented span chain
+  # (HTTP -> queue -> batch -> device in one chrome dump) and the forced
+  # stall (blocked worker -> exactly one stack dump while /healthz keeps
+  # answering) — runnable on their own during an incident
+  JAX_PLATFORMS=cpu python -m pytest tests/test_spans.py \
+      tests/test_watchdog.py -q
 fi
 
 if has_stage smoke; then

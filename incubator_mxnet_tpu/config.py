@@ -166,6 +166,56 @@ ENV_VARS = {
         "registry. Past the bound, new label values are clamped onto the "
         "'_other_' series with a one-time RuntimeWarning — unbounded label "
         "cardinality (request ids) must never OOM the process."),
+    "MXTPU_SPANS_BUFFER": (
+        int, 8192,
+        "Bound on the finished-span ring buffer (telemetry/spans.py): "
+        "oldest spans age out past it. The buffer backs GET /debug/spans "
+        "and spans.export_jsonl()/dump_jsonl()."),
+    "MXTPU_SPANS_HISTOGRAM": (
+        bool, False,
+        "Opt-in bridge feeding every finished span's duration into the "
+        "mxtpu_span_seconds{span=<name>} histogram on the shared registry "
+        "(spans.set_histogram_bridge overrides at runtime). Off by "
+        "default: per-span observe() is only worth paying for when "
+        "something scrapes the histogram."),
+    "MXTPU_FLIGHTREC_SIZE": (
+        int, 2048,
+        "Bound on the flight-recorder event ring "
+        "(telemetry/flightrec.py): step/compile/dispatch/io/kvstore phase "
+        "events, oldest aged out — the black-box tape dumped on crashes, "
+        "stalls, and GET /debug/flightrec."),
+    "MXTPU_FLIGHTREC_FILE": (
+        str, "flightrec.jsonl",
+        "Path the flight recorder writes its JSONL tape to on unhandled "
+        "exceptions (install_crash_dump) and flightrec.dump()."),
+    "MXTPU_FLIGHTREC_DUMP_ON_CRASH": (
+        bool, True,
+        "Dump the flight-recorder tape to MXTPU_FLIGHTREC_FILE when an "
+        "unhandled exception kills the main thread or a worker thread "
+        "(sys/threading excepthook chain installed at package import). "
+        "Only fires when the tape is non-empty."),
+    "MXTPU_WATCHDOG": (
+        bool, False,
+        "Autostart the stall watchdog monitor thread at package import "
+        "(telemetry/watchdog.py; watchdog.start()/stop() at runtime). "
+        "Instrumented loops heartbeat regardless — the knob only controls "
+        "the monitor."),
+    "MXTPU_WATCHDOG_QUIET_S": (
+        float, 60.0,
+        "Default quiet period in seconds before a heartbeat channel "
+        "(train step, batcher worker, io prefetch) is declared stalled "
+        "and an all-thread stack + flight-recorder report is emitted — "
+        "once per stall episode, process never killed. Per-channel "
+        "override via watchdog.register(quiet_s=)."),
+    "MXTPU_WATCHDOG_POLL_S": (
+        float, 1.0,
+        "Watchdog monitor poll interval in seconds (stall detection "
+        "latency is quiet period + up to one poll)."),
+    "MXTPU_WATCHDOG_FILE": (
+        str, None,
+        "File the watchdog APPENDS stall reports to (all-thread stacks + "
+        "flight-recorder tail). None: reports go to logging.error and "
+        "stay readable at watchdog.last_report() / GET /debug/stacks."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

@@ -1,7 +1,17 @@
 """TensorBoard logging hook (ref python/mxnet/contrib/tensorboard.py).
 
 Writes scalar summaries via tensorboardX/tensorboard if installed, else
-falls back to a JSONL event log readable by any dashboard.
+falls back to a JSONL event log readable by any dashboard. The JSONL
+schema is STABLE: one ``{"ts": <epoch s>, "step": <int>, "name": <str>,
+"value": <float>}`` object per line — a fixed shape any consumer can
+parse without knowing the metric names in advance (the old
+``{ts, step, <name>: value}`` dynamic-key form forced schema inference
+per line).
+
+Own the handle: call ``close()`` (or use the callback as a context
+manager) so the last buffered lines hit disk deterministically — relying
+on interpreter teardown to flush a half-written epoch is how metric
+tails go missing.
 """
 from __future__ import annotations
 
@@ -28,6 +38,8 @@ class LogMetricsCallback:
     def __call__(self, param):
         if param.eval_metric is None:
             return
+        if self._writer is None and self._jsonl is None:
+            raise ValueError("LogMetricsCallback is closed")
         self.step += 1
         for name, value in param.eval_metric.get_name_value():
             if self.prefix is not None:
@@ -35,6 +47,28 @@ class LogMetricsCallback:
             if self._writer is not None:
                 self._writer.add_scalar(name, value, self.step)
             else:
+                # stable fixed-key schema: ts is a wall-clock TIMESTAMP
+                # (never differenced), name/value are explicit fields
                 self._jsonl.write(json.dumps(
-                    {"ts": time.time(), "step": self.step, name: value}) + "\n")
+                    {"ts": time.time(), "step": self.step, "name": name,
+                     "value": float(value)}) + "\n")
                 self._jsonl.flush()
+
+    def close(self):
+        """Flush and release the sink (idempotent)."""
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            finally:
+                self._writer = None
+        if self._jsonl is not None:
+            try:
+                self._jsonl.close()
+            finally:
+                self._jsonl = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

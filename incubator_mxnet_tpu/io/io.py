@@ -16,6 +16,7 @@ import numpy as onp
 
 from .. import ndarray as nd
 from .. import telemetry
+from ..telemetry import flightrec, watchdog
 from ..ndarray import NDArray
 
 # Input-pipeline stall observability: seconds the CONSUMER (the training
@@ -245,14 +246,25 @@ class PrefetchingIter(DataIter):
 
     def _start(self):
         def run():
-            self._mark_producer_chain(threading.get_ident())
-            while not self._stop.is_set():
-                try:
-                    batch = self.iter.next()
-                except StopIteration:
-                    self._queue.put(None)
-                    return
-                self._queue.put(batch)
+            # watchdog channel per producer thread: silence means the
+            # thread is stuck decoding OR blocked on a full queue — the
+            # latter indicts the CONSUMER (it stopped taking batches),
+            # which is exactly what the stall report's stacks show
+            channel = watchdog.register(
+                "io_prefetch:%x" % threading.get_ident())
+            try:
+                self._mark_producer_chain(threading.get_ident())
+                while not self._stop.is_set():
+                    watchdog.heartbeat(channel)
+                    try:
+                        batch = self.iter.next()
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    self._queue.put(batch)
+            finally:
+                # an exhausted epoch is not a stall
+                watchdog.unregister(channel)
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
 
@@ -275,8 +287,10 @@ class PrefetchingIter(DataIter):
         # lands here and the counter makes it visible
         t0 = _time.perf_counter()
         batch = self._queue.get()
-        _IO_WAIT_SECONDS.inc(_time.perf_counter() - t0,
-                             iter="PrefetchingIter")
+        wait_s = _time.perf_counter() - t0
+        _IO_WAIT_SECONDS.inc(wait_s, iter="PrefetchingIter")
+        flightrec.record("io_wait", iter="PrefetchingIter",
+                         dur_s=round(wait_s, 6))
         if batch is None:
             raise StopIteration
         _IO_BATCHES.inc(iter="PrefetchingIter")

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from . import autograd
 from . import config
 from . import telemetry
+from .telemetry import flightrec, spans, watchdog
 from .gluon import _functional
 from .ndarray import NDArray
 from .ndarray import random as _rnd
@@ -59,6 +60,18 @@ _EXAMPLES = telemetry.counter(
     "mxtpu_train_examples_total",
     "Examples consumed by TrainStep (batch-size sum); rate() of this is "
     "examples/sec.")
+
+
+def _record_compile_span(name, dur_s):
+    """Retroactive span for a just-finished compile window (jax.jit
+    compiles lazily inside the first call, so the window is only
+    measurable after the fact), parented onto the ambient step span."""
+    try:
+        from . import profiler
+        spans.record_span(name, profiler.now_us() - dur_s * 1e6,
+                          dur_s * 1e6, parent=spans.current_span())
+    except Exception:   # tracing must never fail the step
+        pass
 
 
 def _tree_to_data(state):
@@ -108,6 +121,8 @@ class TrainStep:
         # all-gather the updated weights — no hand-written collectives.
         # Params themselves stay replicated (ZeRO-1, not 2/3).
         self.zero = zero
+        # watchdog bookkeeping: counts once this instance starts stepping
+        self._hb_registered = False
 
     # ------------------------------------------------------------------
     def _split_params(self):
@@ -276,9 +291,38 @@ class TrainStep:
         return wrapper
 
     # ------------------------------------------------------------------
+    #: live instances that have stepped at least once — the shared
+    #: "train_step" heartbeat channel is unregistered when the LAST one is
+    #: dropped, so a finished training loop (step object released) does
+    #: not read as a stall forever after
+    _hb_live = 0
+
     def __call__(self, *inputs, batch_size=None, n_net_inputs=1):
         """inputs = (*net_inputs, *loss_extra_args); returns per-sample loss."""
-        arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in inputs]
+        if not self._hb_registered:
+            # register on FIRST step, not construction: a step built long
+            # before training starts must not page while idle
+            self._hb_registered = True
+            TrainStep._hb_live += 1
+        watchdog.heartbeat("train_step")
+        with spans.span("train:step"):
+            return self._call_traced(inputs, batch_size, n_net_inputs)
+
+    def __del__(self):
+        try:
+            if self._hb_registered:
+                TrainStep._hb_live -= 1
+                if TrainStep._hb_live <= 0:
+                    watchdog.unregister("train_step")
+        except Exception:
+            pass          # interpreter-teardown __del__ must never raise
+
+    def _call_traced(self, inputs, batch_size, n_net_inputs):
+        # host-transfer child span: raw host arrays become device arrays
+        # here (a no-op wrap for inputs already on device)
+        with spans.span("train:host_transfer"):
+            arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+                    for a in inputs]
         if batch_size is None:
             batch_size = arrs[0].shape[0]
         trainer = self.trainer
@@ -294,9 +338,20 @@ class TrainStep:
         meta = (n_net_inputs, tuple((a.shape, str(a.dtype)) for a in arrs))
         step_t0 = _time.perf_counter()
         compile_miss = meta not in self._cache
+        flightrec.record("step_begin", step=self._step_count + 1,
+                         compile=compile_miss)
         if compile_miss:
-            self._cache[meta] = self._build(meta, n_net_inputs)
-            config.evict_to_bound(self._cache)
+            flightrec.record("compile_begin", kind="train")
+            # NB jax.jit compiles LAZILY on the first call: this build
+            # span covers only tracing-graph construction; the XLA
+            # compile itself lands inside the first train:dispatch. The
+            # retroactive train:compile span below covers the whole
+            # trace+compile+first-run window (same definition as the
+            # mxtpu_jit_compile_seconds_total counter), which is what
+            # separates "slow step" from "recompiling every step".
+            with spans.span("train:build"):
+                self._cache[meta] = self._build(meta, n_net_inputs)
+                config.evict_to_bound(self._cache)
         jitted, trainable, frozen, t_arrs, f_arrs, aux_box = self._cache[meta]
 
         optimizer = trainer._optimizer
@@ -317,11 +372,12 @@ class TrainStep:
             opt_states.append(_tree_to_data(trainer._states[idx]))
 
         key = _rnd._next_key()
-        loss_full, new_t, new_opt, aux_vals = jitted(
-            [a._data for a in t_arrs], [a._data for a in f_arrs], opt_states,
-            [a._data for a in arrs], key,
-            jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
-            jnp.asarray(t, jnp.int32), jnp.asarray(rescale, jnp.float32))
+        with spans.span("train:dispatch", compile=compile_miss):
+            loss_full, new_t, new_opt, aux_vals = jitted(
+                [a._data for a in t_arrs], [a._data for a in f_arrs],
+                opt_states, [a._data for a in arrs], key,
+                jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
+                jnp.asarray(t, jnp.int32), jnp.asarray(rescale, jnp.float32))
 
         for a, d in zip(t_arrs, new_t):
             a._data = d
@@ -337,6 +393,14 @@ class TrainStep:
         if compile_miss:
             _COMPILES.inc(kind="train")
             _COMPILE_SECONDS.inc(step_dur, kind="train")
+            # retroactive: the compile window IS this whole cache-miss
+            # step (trace + XLA compile + first run — see the lazy-compile
+            # note above), emitted as a child of the open train:step span
+            _record_compile_span("train:compile", step_dur)
+            flightrec.record("compile_end", kind="train",
+                             dur_s=round(step_dur, 6))
+        flightrec.record("step_end", step=self._step_count,
+                         dur_s=round(step_dur, 6))
         return NDArray(loss_full)
 
 
@@ -365,17 +429,29 @@ class EvalStep:
         compile_miss = meta not in self._cache
         t0 = _time.perf_counter() if compile_miss else 0.0
         if compile_miss:
-            params, param_arrs, pure_fn, aux_box = _functional.make_pure_fn(
-                self.net, train_mode=False)
-            jitted = jax.jit(pure_fn)
-            self._cache[meta] = (jitted, param_arrs)
-            config.evict_to_bound(self._cache)
+            flightrec.record("compile_begin", kind="eval")
+            # build only — the XLA compile itself runs lazily inside the
+            # first eval:step call; the retroactive eval:compile span
+            # below covers the full window (matches _COMPILE_SECONDS)
+            with spans.span("eval:build"):
+                params, param_arrs, pure_fn, aux_box = \
+                    _functional.make_pure_fn(self.net, train_mode=False)
+                jitted = jax.jit(pure_fn)
+                self._cache[meta] = (jitted, param_arrs)
+                config.evict_to_bound(self._cache)
         jitted, param_arrs = self._cache[meta]
         key = jax.random.PRNGKey(0)
-        out_datas, _aux = jitted([a._data for a in param_arrs],
-                                 [a._data for a in arrs], key)
+        # the device leg of the serving span chain: under the batcher this
+        # nests inside the worker's serve:batch span (same thread)
+        with spans.span("eval:step", compile=compile_miss):
+            out_datas, _aux = jitted([a._data for a in param_arrs],
+                                     [a._data for a in arrs], key)
         outs = [NDArray(o) for o in out_datas]
         if compile_miss:
+            compile_dur = _time.perf_counter() - t0
             _COMPILES.inc(kind="eval")
-            _COMPILE_SECONDS.inc(_time.perf_counter() - t0, kind="eval")
+            _COMPILE_SECONDS.inc(compile_dur, kind="eval")
+            _record_compile_span("eval:compile", compile_dur)
+            flightrec.record("compile_end", kind="eval",
+                             dur_s=round(compile_dur, 6))
         return outs[0] if len(outs) == 1 else tuple(outs)

@@ -6,6 +6,7 @@ import pickle
 
 from .. import optimizer as opt
 from .. import telemetry
+from ..telemetry import flightrec, spans
 from ..ndarray import NDArray
 from .. import ndarray as nd
 
@@ -81,17 +82,21 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         from ..ndarray.sparse import BaseSparseNDArray
         keys, values = self._normalize(key, value)
-        _PUSH_BYTES.inc(sum(_nbytes(v) for v in values), store=self.name)
-        for k, v in zip(keys, values):
-            agg = self._aggregate(v, k)
-            if self._updater is not None:
-                self._updater(_key_int(k), agg, self._data[k])
-            else:
-                # the store holds dense values (pull invariants); a pushed
-                # sparse aggregate is densified at store time
-                if isinstance(agg, BaseSparseNDArray):
-                    agg = agg.tostype("default")
-                self._data[k] = agg
+        nbytes = sum(_nbytes(v) for v in values)
+        _PUSH_BYTES.inc(nbytes, store=self.name)
+        flightrec.record("kv_push", store=self.name, keys=len(keys),
+                         nbytes=nbytes)
+        with spans.span("kvstore:push", store=self.name, nbytes=nbytes):
+            for k, v in zip(keys, values):
+                agg = self._aggregate(v, k)
+                if self._updater is not None:
+                    self._updater(_key_int(k), agg, self._data[k])
+                else:
+                    # the store holds dense values (pull invariants); a
+                    # pushed sparse aggregate is densified at store time
+                    if isinstance(agg, BaseSparseNDArray):
+                        agg = agg.tostype("default")
+                    self._data[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         # Sharing the jax.Array is snapshot-correct: jax.Arrays are immutable,
@@ -99,14 +104,17 @@ class KVStore(KVStoreBase):
         # the buffer, so neither side can observe the other's later updates
         # (regression-tested in tests/test_parallel.py::test_kvstore_pull_isolation).
         keys, outs = self._normalize(key, out)
-        pulled = 0
-        for k, o in zip(keys, outs):
-            for oo in (o if isinstance(o, (list, tuple)) else [o]):
-                oo._data = self._data[k]._data
-                pulled += _nbytes(oo)
+        with spans.span("kvstore:pull", store=self.name):
+            pulled = 0
+            for k, o in zip(keys, outs):
+                for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                    oo._data = self._data[k]._data
+                    pulled += _nbytes(oo)
         # one inc per pull (not per out tensor): the shared counter lock
         # must not be contended O(keys x devices) in the step hot path
         _PULL_BYTES.inc(pulled, store=self.name)
+        flightrec.record("kv_pull", store=self.name, keys=len(keys),
+                         nbytes=pulled)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -292,9 +300,16 @@ class DistKVStore(KVStore):
     def push(self, key, value, priority=0):
         if self._num_workers <= 1:
             return super().push(key, value, priority)
-        from ..ndarray.sparse import BaseSparseNDArray
         keys, values = self._normalize(key, value)
-        _PUSH_BYTES.inc(sum(_nbytes(v) for v in values), store=self.name)
+        nbytes = sum(_nbytes(v) for v in values)
+        _PUSH_BYTES.inc(nbytes, store=self.name)
+        flightrec.record("kv_push", store=self.name, keys=len(keys),
+                         nbytes=nbytes)
+        with spans.span("kvstore:push", store=self.name, nbytes=nbytes):
+            return self._push_sync(keys, values)
+
+    def _push_sync(self, keys, values):
+        from ..ndarray.sparse import BaseSparseNDArray
         # local (per-process) aggregation + compression first
         local = [KVStore._aggregate(self, v, k)
                  for k, v in zip(keys, values)]

@@ -36,8 +36,15 @@ class Monitor:
                 outs = output if isinstance(output, (list, tuple)) else [output]
                 for i, o in enumerate(outs):
                     if isinstance(o, NDArray):
-                        self.queue.append((self.step, "%s_output%d" % (blk.name, i),
-                                           o))
+                        # copy at enqueue: the live output may sit in a
+                        # donated buffer the next compiled step overwrites
+                        # in place — stats computed at toc() would then
+                        # read the NEXT step's bytes. jax arrays are
+                        # immutable, but o._data is REBOUND by in-place
+                        # ops; NDArray.copy() pins this step's value.
+                        self.queue.append((self.step,
+                                           "%s_output%d" % (blk.name, i),
+                                           o.copy()))
         def walk(b):
             b.register_forward_hook(hook)
             for c in b._children.values():

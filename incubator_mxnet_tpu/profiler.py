@@ -226,8 +226,13 @@ def _sample_memory(op_name):
 
 def device_memory():
     """Per-device memory stats (bytes_in_use/peak) via PJRT
-    (≙ the reference's storage profiler, src/profiler/storage_profiler.h)."""
-    import jax
+    (≙ the reference's storage profiler, src/profiler/storage_profiler.h).
+    Degrades to {} when jax is unavailable (a host-only tool dumping a
+    trace must not die on the memory appendix)."""
+    try:
+        import jax
+    except Exception:
+        return {}
     out = {}
     for d in jax.local_devices():
         try:
@@ -290,12 +295,23 @@ def dumps(reset=False, format="table"):
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON (ref profiler.h EmitEvents). Includes
     device_memory counter events recorded per op and a final per-device
-    snapshot under 'deviceMemory' (storage_profiler.h analog)."""
+    snapshot under 'deviceMemory' (storage_profiler.h analog).
+
+    _LOCK is held only to snapshot the event list: device_memory() is a
+    device sync (plus a jax import) and the file write is arbitrary I/O —
+    holding the lock across either would block every hot-path
+    record_event() for the dump's duration. Events recorded while the
+    file is being written survive into the next dump (only the
+    snapshotted prefix is cleared)."""
     with _LOCK:
-        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms",
-                   "deviceMemory": device_memory(),
-                   "profiledPeakBytes": _STATE["peak_bytes"]}
-        with open(_CONFIG["filename"], "w") as f:
-            json.dump(payload, f)
-        if finished:
-            _EVENTS.clear()
+        events = list(_EVENTS)
+        peak = _STATE["peak_bytes"]
+    payload = {"traceEvents": events, "displayTimeUnit": "ms",
+               "deviceMemory": device_memory(),
+               "profiledPeakBytes": peak}
+    with open(_CONFIG["filename"], "w") as f:
+        json.dump(payload, f)
+    if finished:
+        with _LOCK:
+            # drop exactly what was dumped; concurrent appends stay
+            del _EVENTS[:len(events)]

@@ -33,6 +33,7 @@ import queue as _queue
 import numpy as onp
 
 from .. import config
+from ..telemetry import flightrec, spans, watchdog
 from .metrics import ServingMetrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
@@ -67,12 +68,16 @@ class _Request:
     """One queued inference item + the completion event its client waits on."""
 
     __slots__ = ("inputs", "deadline", "enqueued_at", "request_id",
-                 "_event", "_result", "_error")
+                 "span_ctx", "_event", "_result", "_error")
 
-    def __init__(self, inputs, deadline, request_id=None):
+    def __init__(self, inputs, deadline, request_id=None, span_ctx=None):
         self.inputs = inputs            # tuple of per-input arrays, NO batch dim
         self.deadline = deadline        # absolute time.monotonic() or None
         self.request_id = request_id    # trace id riding queue -> dispatch
+        # captured SpanContext of the submitter's open span (the HTTP
+        # handler's http:predict): the explicit queue-boundary propagation
+        # the worker parents its serve:queue/serve:batch spans onto
+        self.span_ctx = span_ctx
         self.enqueued_at = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -146,6 +151,10 @@ class DynamicBatcher:
         self._queue = _queue.Queue(maxsize=qsize)
         self._closed = False
         self._paused = False
+        # stall-watchdog channel: the worker beats once per gather cycle
+        # (<= 0.25s apart when idle), so silence means a stuck dispatch,
+        # not an empty queue
+        self._hb_channel = watchdog.register("batcher:%s" % name)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="mxtpu-batcher-%s" % name)
         self._worker.start()
@@ -169,7 +178,8 @@ class DynamicBatcher:
         # materialize on the client thread: the worker groups requests by
         # shape/dtype signature, which needs real arrays
         req = _Request(tuple(onp.asarray(x) for x in inputs), deadline,
-                       request_id=request_id)
+                       request_id=request_id,
+                       span_ctx=spans.current_context())
         try:
             self._queue.put_nowait(req)
         except _queue.Full:
@@ -295,7 +305,17 @@ class DynamicBatcher:
         return self.buckets[-1]
 
     def _run(self):
+        try:
+            self._run_loop()
+        finally:
+            # a cleanly-exiting (or dying) worker must not read as a
+            # stall: silence from a gone thread is unregistered, silence
+            # from a live-but-stuck one is the watchdog's signal
+            watchdog.unregister(self._hb_channel)
+
+    def _run_loop(self):
         while True:
+            watchdog.heartbeat(self._hb_channel)
             batch = self._gather()
             if batch is None:
                 if self._closed and self._queue.empty():
@@ -335,6 +355,41 @@ class DynamicBatcher:
         n = len(live)
         bucket = self._bucket_for(n)
         t0 = time.monotonic()
+        self._trace_queue_waits(live, t0)
+        flightrec.record("batch_dispatch", model=self.name, n=n,
+                         bucket=bucket)
+        # live span on the worker thread: the servable (and, for a
+        # BlockServable, EvalStep's eval:step span) nests inside it. A
+        # batch has many logical parents — the span parents onto the
+        # OLDEST request's captured context; the rest stay findable via
+        # args.request_ids.
+        with spans.span("serve:batch", parent=live[0].span_ctx,
+                        model=self.name, bucket=bucket, batch_size=n,
+                        request_ids=[r.request_id for r in live
+                                     if r.request_id is not None]):
+            self._dispatch_batch_traced(live, n, bucket, t0)
+
+    def _trace_queue_waits(self, live, t0):
+        """Retroactive serve:queue child spans, one per request: queue
+        wait is only measurable at dispatch, after the submitting thread
+        has long moved on — the record_span queue-boundary form (no
+        thread-local stack is touched)."""
+        try:
+            from .. import profiler
+            now_us = profiler.now_us()
+            for req in live:
+                wait_s = max(0.0, t0 - req.enqueued_at)
+                spans.record_span("serve:queue", now_us - wait_s * 1e6,
+                                  wait_s * 1e6, parent=req.span_ctx,
+                                  request_id=req.request_id,
+                                  model=self.name)
+        except Exception:
+            # tracing must never take down serving, but a queue-wait
+            # trace that silently stops emitting is undiagnosable (R005
+            # discipline): keep the drop debug-visible
+            _LOG.debug("serve:queue span emission failed", exc_info=True)
+
+    def _dispatch_batch_traced(self, live, n, bucket, t0):
         try:
             # pad by repeating the last row: always shape/dtype-consistent,
             # never introduces out-of-range values. A raising servable must

@@ -23,6 +23,12 @@ Routes (TF-Serving REST-shaped):
   (counters, batch-size histogram, p50/p95/p99 latency), byte-compatible
   with what ``GET /metrics`` returned before the Prometheus move.
 - ``GET /healthz``              — healthy | degraded | unhealthy (503).
+- ``GET /debug/stacks``         — all-thread stacks + heartbeat ages +
+  the newest watchdog stall report (text/plain; the live "why is it
+  stuck" view).
+- ``GET /debug/flightrec``      — the flight-recorder ring as JSONL
+  (newest last).
+- ``GET /debug/spans``          — the finished-span ring as JSONL.
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
@@ -103,6 +109,26 @@ class _Handler(BaseHTTPRequestHandler):
             # legacy JSON snapshot (byte-compatible with the pre-Prometheus
             # GET /metrics payload)
             self._send(200, self.registry.metrics_snapshot())
+        elif self.path == "/debug/stacks":
+            # the on-demand "why is it stuck": all-thread stacks now, the
+            # heartbeat ages, and the watchdog's newest stall report
+            from ..telemetry import watchdog
+            beats = "".join("%-32s %.3fs ago\n" % (n, s) for n, s in
+                            sorted(watchdog.channels().items()))
+            text = ("--- heartbeats ---\n" + (beats or "(none)\n")
+                    + "\n" + watchdog.format_stacks())
+            last = watchdog.last_report()
+            if last:
+                text += "\n--- last stall report ---\n" + last
+            self._send_text(200, text, "text/plain; charset=utf-8")
+        elif self.path == "/debug/flightrec":
+            from ..telemetry import flightrec
+            self._send_text(200, flightrec.format_tail(10_000),
+                            "application/jsonl; charset=utf-8")
+        elif self.path == "/debug/spans":
+            from ..telemetry import spans
+            self._send_text(200, spans.export_jsonl(),
+                            "application/jsonl; charset=utf-8")
         elif self.path.rstrip("/") == _MODELS_PREFIX:
             self._send(200, {"models": self.registry.models()})
         elif self.path.startswith(_MODELS_PREFIX + "/"):
@@ -154,9 +180,16 @@ class _Handler(BaseHTTPRequestHandler):
                        request_id=req_id)
             return
         try:
-            outs = self.registry.predict(name, *inputs,
-                                         deadline_ms=deadline_ms,
-                                         request_id=req_id)
+            # root span of the request's trace chain: submit() captures
+            # this span's context into the queued request, so the worker's
+            # serve:queue / serve:batch spans parent onto it across the
+            # queue boundary (HTTP -> queue -> bucket -> device in one
+            # dump)
+            with telemetry.request_scope(req_id), \
+                    telemetry.span("http:predict", model=name):
+                outs = self.registry.predict(name, *inputs,
+                                             deadline_ms=deadline_ms,
+                                             request_id=req_id)
         except QueueFullError as e:
             self._send(429, {"error": str(e)}, request_id=req_id)
         except DeadlineExceededError as e:

@@ -34,6 +34,11 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
 from .trace import (new_request_id, current_request_id,
                     set_current_request_id, request_scope,
                     REQUEST_ID_HEADER)
+from . import flightrec
+from . import spans
+from . import watchdog
+from .spans import (Span, SpanContext, span, record_span, current_span,
+                    current_context)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -42,6 +47,9 @@ __all__ = [
     "new_request_id", "current_request_id", "set_current_request_id",
     "request_scope", "REQUEST_ID_HEADER",
     "start_periodic_flush", "stop_periodic_flush", "flush_to_file",
+    "flightrec", "spans", "watchdog",
+    "Span", "SpanContext", "span", "record_span", "current_span",
+    "current_context",
 ]
 
 _flush_lock = threading.Lock()
@@ -130,10 +138,22 @@ def stop_periodic_flush():
 
 def _maybe_autostart():
     """Package-import hook: MXTPU_TELEMETRY_FLUSH_S > 0 starts the flusher
-    (headless training jobs get metrics with zero code changes)."""
+    (headless training jobs get metrics with zero code changes), the
+    flight recorder chains its crash-dump excepthooks (gated per-crash by
+    MXTPU_FLIGHTREC_DUMP_ON_CRASH), and MXTPU_WATCHDOG=1 starts the stall
+    watchdog monitor."""
     from .. import config
     try:
         if config.get_env("MXTPU_TELEMETRY_FLUSH_S") > 0:
             start_periodic_flush()
+    except Exception:
+        pass
+    try:
+        flightrec.install_crash_dump()
+    except Exception:
+        pass
+    try:
+        if config.get_env("MXTPU_WATCHDOG"):
+            watchdog.start()
     except Exception:
         pass

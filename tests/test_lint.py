@@ -32,7 +32,7 @@ def rule_ids(findings):
 
 def test_rule_catalog_complete():
     assert {"R001", "R002", "R003", "R004", "R005", "R006",
-            "R007"} <= set(RULES)
+            "R007", "R008"} <= set(RULES)
 
 
 # ------------------------------------------------------------------ R001
@@ -347,6 +347,74 @@ def test_r007_daemon_or_joined_clean(tmp_path):
     assert "R007" not in rule_ids(findings)
 
 
+# ------------------------------------------------------------------ R008
+def test_r008_manual_span_start_positive(tmp_path):
+    findings = run_snippet(tmp_path, "traced.py", """
+        from incubator_mxnet_tpu.telemetry import spans
+
+        def f():
+            sp = spans.span("phase")
+            sp.start()
+            work()                   # raises => span leaks on the stack
+            sp.end()
+    """)
+    assert rule_ids(findings) == ["R008"]
+
+
+def test_r008_chained_enter_positive(tmp_path):
+    findings = run_snippet(tmp_path, "traced.py", """
+        from incubator_mxnet_tpu.telemetry import spans
+
+        def f():
+            spans.span("phase").__enter__()
+            work()
+    """)
+    assert rule_ids(findings) == ["R008"]
+
+
+def test_r008_protected_forms_clean(tmp_path):
+    findings = run_snippet(tmp_path, "traced.py", """
+        from incubator_mxnet_tpu.telemetry import spans
+
+        def ctx_managed():
+            with spans.span("phase"):
+                work()
+
+        def canonical():
+            sp = spans.span("phase")
+            sp.start()
+            try:
+                work()
+            finally:
+                sp.end()
+
+        def start_inside_try():
+            sp = spans.span("phase")
+            try:
+                sp.start()
+                work()
+            finally:
+                sp.end()
+
+        def unrelated_start():
+            server.start()           # not a span: out of scope
+    """)
+    assert "R008" not in rule_ids(findings)
+
+
+def test_r008_conditional_end_still_flagged(tmp_path):
+    findings = run_snippet(tmp_path, "traced.py", """
+        from incubator_mxnet_tpu.telemetry import spans
+
+        def f(ok):
+            sp = spans.span("phase")
+            sp.start()
+            if ok:
+                sp.end()             # error path leaks the span
+    """)
+    assert rule_ids(findings) == ["R008"]
+
+
 # ----------------------------------------------------------- suppression
 def test_per_line_suppression(tmp_path):
     findings = run_snippet(tmp_path, "feature.py", """
@@ -496,5 +564,6 @@ def test_cli_list_rules():
         [sys.executable, "-m", "tools.mxtpulint", "--list-rules"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0
-    for rid in ("R001", "R002", "R003", "R004", "R005", "R006", "R007"):
+    for rid in ("R001", "R002", "R003", "R004", "R005", "R006", "R007",
+                "R008"):
         assert rid in r.stdout

@@ -180,3 +180,58 @@ def test_estimator_fit():
     e.fit(loader, epochs=2)
     metrics = e.evaluate(loader)
     assert metrics[0].get()[1] >= 0.0
+
+
+def test_monitor_copies_on_enqueue():
+    """The hook must pin the enqueued output's VALUE: an in-place update
+    that rebinds the live NDArray's buffer between forward and toc()
+    (donated-buffer overwrite in the compiled step) must not change the
+    queued stat."""
+    from incubator_mxnet_tpu import Monitor, gluon
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    mon = Monitor(interval=1, pattern=".*", stat_func=lambda x: x.asnumpy().sum())
+    mon.install(net)
+    mon.tic()
+    out = net(nd.ones((2, 3)))
+    expected = out.asnumpy().sum()
+    out[:] = 1e9                  # rebinds out._data (the overwrite analog)
+    res = mon.toc()
+    assert res, "hook never fired"
+    for _step, _name, stat in res:
+        assert abs(stat - expected) < 1e-4, (stat, expected)
+
+
+def test_tensorboard_callback_stable_schema_and_close(tmp_path, monkeypatch):
+    """JSONL fallback: stable {ts, step, name, value} lines; close() (and
+    the context-manager form) releases the handle; a closed callback
+    refuses further writes."""
+    import json as _json
+    import sys
+    from incubator_mxnet_tpu.contrib import tensorboard as tb
+    # force the JSONL fallback even where torch is importable
+    monkeypatch.setitem(sys.modules, "torch", None)
+
+    class _Param:
+        def __init__(self):
+            self.eval_metric = mx.metric.Accuracy()
+
+    p = _Param()
+    p.eval_metric.update(nd.array([0, 1]), nd.array([[0.9, 0.1],
+                                                     [0.1, 0.9]]))
+    with tb.LogMetricsCallback(str(tmp_path)) as cb:
+        assert cb._jsonl is not None, "expected JSONL fallback"
+        cb(p)
+        cb(p)
+    assert cb._jsonl is None      # context exit closed the handle
+    lines = [_json.loads(l)
+             for l in open(tmp_path / "metrics.jsonl")]
+    assert len(lines) == 2
+    for i, line in enumerate(lines, 1):
+        assert set(line) == {"ts", "step", "name", "value"}
+        assert line["step"] == i and line["name"] == "accuracy"
+        assert isinstance(line["value"], float)
+    cb.close()                    # idempotent
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        cb(p)

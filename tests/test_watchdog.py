@@ -1,0 +1,222 @@
+"""Flight-recorder + stall-watchdog tier.
+
+Acceptance (ISSUE 5): a deliberately stalled batcher worker produces
+exactly ONE all-thread stack dump + flight-recorder tail within the
+timeout, while the process survives and serving keeps answering
+/healthz."""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.telemetry import flightrec, watchdog
+
+
+@pytest.fixture(autouse=True)
+def _fresh_diagnostics():
+    # channels are process-global: clear strays (e.g. train_step beats
+    # from other test files) so stall detection here is deterministic
+    for name in list(watchdog.channels()):
+        watchdog.unregister(name)
+    flightrec.reset()
+    watchdog._last_report = None    # a stale report must not satisfy waits
+    yield
+    watchdog.stop()
+    for name in list(watchdog.channels()):
+        watchdog.unregister(name)
+    flightrec.reset()
+
+
+# ------------------------------------------------------- flight recorder
+def test_flightrec_records_and_tails():
+    flightrec.record("alpha", n=1)
+    flightrec.record("beta", n=2)
+    evs = flightrec.tail(10)
+    assert [e["event"] for e in evs] == ["alpha", "beta"]
+    assert evs[0]["seq"] < evs[1]["seq"]
+    assert evs[0]["ts_us"] <= evs[1]["ts_us"]
+    assert evs[0]["thread"] == threading.current_thread().name
+    assert evs[1]["n"] == 2
+
+
+def test_flightrec_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_SIZE", "16")
+    flightrec.reset()
+    for i in range(100):
+        flightrec.record("e", i=i)
+    evs = flightrec.snapshot()
+    assert len(evs) == 16
+    assert [e["i"] for e in evs] == list(range(84, 100))   # newest kept
+
+
+def test_flightrec_dump_jsonl(tmp_path):
+    flightrec.record("x", a=1)
+    p = flightrec.dump(str(tmp_path / "rec.jsonl"))
+    lines = [json.loads(l) for l in open(p)]
+    assert lines[-1]["event"] == "x" and lines[-1]["a"] == 1
+    assert flightrec.format_tail(5).endswith("\n")
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_flightrec_crash_dump_from_dying_thread(tmp_path, monkeypatch):
+    """A worker thread dying on an unhandled exception writes the tape
+    (threading.excepthook chain installed at package import)."""
+    out = tmp_path / "crash.jsonl"
+    monkeypatch.setenv("MXTPU_FLIGHTREC_FILE", str(out))
+    flightrec.record("before_crash", step=7)
+
+    def die():
+        raise RuntimeError("synthetic worker death")
+
+    t = threading.Thread(target=die, name="dying-worker", daemon=True)
+    t.start()
+    t.join(10)
+    assert out.exists(), "crash dump not written"
+    lines = [json.loads(l) for l in open(out)]
+    events = [l["event"] for l in lines]
+    assert "before_crash" in events
+    crash = [l for l in lines if l["event"] == "crash"][0]
+    assert crash["origin"] == "dying-worker"
+    assert crash["exc"] == "RuntimeError"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_flightrec_crash_dump_gate_off(tmp_path, monkeypatch):
+    out = tmp_path / "nocrash.jsonl"
+    monkeypatch.setenv("MXTPU_FLIGHTREC_FILE", str(out))
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DUMP_ON_CRASH", "0")
+    flightrec.record("whatever")
+
+    t = threading.Thread(target=lambda: 1 / 0, daemon=True)
+    t.start()
+    t.join(10)
+    assert not out.exists()
+
+
+# ------------------------------------------------------------- watchdog
+def test_heartbeat_ages_and_unregister():
+    watchdog.heartbeat("ch")
+    ages = watchdog.channels()
+    assert "ch" in ages and ages["ch"] < 5.0
+    watchdog.unregister("ch")
+    assert "ch" not in watchdog.channels()
+
+
+def test_format_stacks_contains_this_thread():
+    text = watchdog.format_stacks()
+    assert threading.current_thread().name in text
+    assert "test_format_stacks_contains_this_thread" in text
+
+
+def test_stall_fires_once_then_rearms_on_resume():
+    watchdog.register("loop", quiet_s=0.15)
+    watchdog.start(quiet_s=30.0, poll_s=0.03)   # per-channel bound wins
+    stalls = telemetry.REGISTRY.get("mxtpu_watchdog_stalls_total")
+
+    def count():
+        return stalls.value(channel="loop")
+
+    deadline = time.monotonic() + 10
+    while count() < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert count() == 1
+    time.sleep(0.3)                 # several more polls: still one episode
+    assert count() == 1
+    watchdog.heartbeat("loop")      # resume re-arms...
+    deadline = time.monotonic() + 10
+    while count() < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)            # ...then going quiet again re-fires
+    assert count() == 2
+
+
+def test_stall_report_contents_and_file(tmp_path):
+    path = tmp_path / "stalls.log"
+    flightrec.record("last_thing_done", detail="x")
+    watchdog.register("quiet_worker", quiet_s=0.1)
+    watchdog.start(quiet_s=30.0, poll_s=0.03, path=str(path))
+    deadline = time.monotonic() + 10
+    while watchdog.last_report() is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    report = watchdog.last_report()
+    assert report is not None
+    assert "quiet_worker" in report
+    assert "--- all-thread stacks ---" in report
+    assert threading.current_thread().name in report
+    assert "--- flight recorder tail ---" in report
+    assert "last_thing_done" in report
+    assert path.exists() and "quiet_worker" in path.read_text()
+
+
+def test_watchdog_start_stop_joins():
+    watchdog.start(quiet_s=5.0, poll_s=0.05)
+    assert watchdog.running()
+    watchdog.stop()
+    assert not watchdog.running()
+
+
+# ------------------------------------------------- e2e: forced stall
+def test_forced_stall_one_dump_serving_survives(tmp_path):
+    """Acceptance: block the batcher worker inside a dispatch; the
+    watchdog emits exactly one stack+tail report for that stall within
+    the timeout; /healthz keeps answering; releasing the worker completes
+    the request (nothing was killed)."""
+    from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class BlockingServable:
+        def predict_batch(self, x):
+            entered.set()
+            assert release.wait(60), "test deadlock"
+            return (x + 1.0,)
+
+    reg = ModelRegistry()
+    reg.load("stall", BlockingServable(), max_batch_size=2,
+             batch_timeout_ms=1.0)
+    path = tmp_path / "stalls.log"
+    watchdog.start(quiet_s=0.4, poll_s=0.05, path=str(path))
+    stalls = telemetry.REGISTRY.get("mxtpu_watchdog_stalls_total")
+    try:
+        with ServingServer(reg, port=0) as srv:
+            fut = reg.submit("stall", onp.ones((2,), onp.float32))
+            assert entered.wait(15), "worker never dispatched"
+            # stall detected within quiet + a few polls
+            deadline = time.monotonic() + 15
+            while stalls.value(channel="batcher:stall") < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert stalls.value(channel="batcher:stall") == 1
+            # the report names the stalled channel, shows the worker
+            # thread's stack blocked in the servable, and carries the tape
+            report = watchdog.last_report()
+            assert "batcher:stall" in report
+            assert "mxtpu-batcher-stall" in report
+            assert "predict_batch" in report
+            assert "batch_dispatch" in report      # flight-recorder tail
+            # exactly one dump for this stall episode
+            time.sleep(0.3)
+            assert stalls.value(channel="batcher:stall") == 1
+            assert path.read_text().count("=== mxtpu stall report ===") == 1
+            # serving keeps answering while stalled
+            for _ in range(3):
+                with urllib.request.urlopen(srv.url + "/healthz",
+                                            timeout=10) as r:
+                    assert r.status == 200
+            with urllib.request.urlopen(srv.url + "/debug/stacks",
+                                        timeout=10) as r:
+                dbg = r.read().decode()
+            assert "batcher:stall" in dbg and "last stall report" in dbg
+            # un-wedge: the request completes, nothing was killed
+            release.set()
+            out = fut.result(timeout=30)
+            assert onp.allclose(out[0], 2.0)
+    finally:
+        release.set()
+        watchdog.stop()

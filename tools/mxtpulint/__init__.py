@@ -27,7 +27,7 @@ Exit code 0 iff every finding is suppressed inline or baselined.
 from .core import (Finding, RULES, lint_file, lint_paths, load_baseline,
                    save_baseline, apply_baseline, make_report,
                    DEFAULT_BASELINE)
-from . import rules as _rules          # noqa: F401  (registers R001-R007)
+from . import rules as _rules          # noqa: F401  (registers R001-R008)
 from .rules import HOT_PATH_PATTERNS
 
 __all__ = ["Finding", "RULES", "lint_file", "lint_paths", "load_baseline",

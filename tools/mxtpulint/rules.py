@@ -1,4 +1,4 @@
-"""The framework-aware rule set (R001-R007).
+"""The framework-aware rule set (R001-R008).
 
 Each rule encodes a bug class this codebase has actually hit (or that the
 reference MXNet catches natively with sanitizers / engine dependency
@@ -28,13 +28,16 @@ from .core import rule, terminal_name
 # WaitToRead discipline exists to avoid (PAPER.md §1).
 HOT_PATH_PATTERNS = (
     "*jit:TrainStep.__call__",
+    "*jit:TrainStep._call_traced",  # the __call__ body lives here
     "*jit:TrainStep._build",        # nested inner/step_fn trace under it
     "*jit:EvalStep.__call__",
     "*:*TrainStep.__call__",        # DataParallelTrainStep & friends
     "*:*EvalStep.__call__",
     "*batcher:DynamicBatcher._run",
+    "*batcher:DynamicBatcher._run_loop",
     "*batcher:DynamicBatcher._gather",
     "*batcher:DynamicBatcher._dispatch_batch",
+    "*batcher:DynamicBatcher._dispatch_batch_traced",
 )
 
 _SYNC_ATTRS = ("asnumpy", "item")
@@ -466,3 +469,100 @@ def r007_unjoined_thread(ctx):
             "it outlives interpreter shutdown and leaks per reload; pass "
             "daemon=True or join it in the owner's close/stop path"
             % (" %r" % bound if bound else ""))
+
+
+# --------------------------------------------------------------------- R008
+# A trace span entered manually (`sp.start()` / `sp.__enter__()`) and not
+# guaranteed to end corrupts more than itself: the thread-local parent
+# stack keeps the leaked span on top, so every span that thread opens
+# NEXT is silently parented under a phase that already finished — the
+# trace lies about causality from then on. `with span(...)` is the only
+# form that cannot leak; a manual start is legal ONLY with a try/finally
+# (or immediately-following try whose finally) that ends the same span.
+_SPAN_FACTORIES = ("span", "Span", "start_span")
+_SPAN_END_ATTRS = ("end", "__exit__", "finish")
+
+
+def _span_vars(ctx):
+    """Terminal names assigned from span(...)/Span(...) calls."""
+    out = set()
+    for node in ctx.walk(ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call) \
+                and terminal_name(v.func) in _SPAN_FACTORIES:
+            for t in node.targets:
+                name = terminal_name(t)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _ends_in(stmts, receiver_dump):
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _SPAN_END_ATTRS
+                    and ast.dump(sub.func.value) == receiver_dump):
+                return True
+    return False
+
+
+@rule("R008", "span entered without `with` or try/finally end")
+def r008_leaked_span(ctx):
+    span_names = _span_vars(ctx)
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("start", "__enter__")):
+            continue
+        name = terminal_name(f.value)
+        spanish = name in span_names or (
+            isinstance(f.value, ast.Call)
+            and terminal_name(f.value.func) in _SPAN_FACTORIES)
+        if not spanish:
+            continue
+        receiver = ast.dump(f.value)
+        # `with sp.start():` / `with span(...).__enter__():` — the context
+        # expression form still guarantees __exit__ runs
+        if any(isinstance(a, (ast.With, ast.AsyncWith))
+               and any(item.context_expr is node for item in a.items)
+               for a in ctx.ancestors(node)):
+            continue
+        protected = False
+        # start inside a try whose finally ends the same span
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try) and _ends_in(anc.finalbody,
+                                                     receiver):
+                protected = True
+                break
+        if protected:
+            continue
+        # canonical `sp.start()` immediately followed by
+        # `try: ... finally: sp.end()`
+        stmt = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        parent = ctx.parent(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(parent, field, None)
+            if body and stmt in body:
+                idx = body.index(stmt)
+                if (idx + 1 < len(body)
+                        and isinstance(body[idx + 1], ast.Try)
+                        and _ends_in(body[idx + 1].finalbody, receiver)):
+                    protected = True
+                break
+        if protected:
+            continue
+        yield ctx.finding(
+            node, "R008",
+            "span %s entered without `with` or try/finally %s — a span "
+            "leaked on an exception stays on the thread-local parent "
+            "stack and silently mis-parents every later span on this "
+            "thread; use `with span(...)` or guard start() with "
+            "try/finally end()"
+            % ("%r" % name if name else "(anonymous)",
+               "/".join(_SPAN_END_ATTRS[:2])))
