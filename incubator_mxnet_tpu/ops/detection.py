@@ -146,7 +146,10 @@ def spatial_transformer(data, loc, target_shape, transform_type="affine",
 
 # ------------------------------------------------------------- boxes
 def _iou_matrix(a, b, fmt="corner"):
-    if fmt == "center":
+    # reviewed retrace: fmt is a two-value static config ("corner" |
+    # "center") fixed per call site — at most two trace variants ever,
+    # the CachedOp-style specialization idiom, not a per-call retrace
+    if fmt == "center":  # mxtpulint: disable=R011
         a = jnp.concatenate([a[..., :2] - a[..., 2:] / 2,
                              a[..., :2] + a[..., 2:] / 2], -1)
         b = jnp.concatenate([b[..., :2] - b[..., 2:] / 2,
@@ -182,7 +185,11 @@ def _nms_keep(boxes, scores, iou_threshold, topk, cls=None):
         return keep.at[i].set(~jnp.any(over))
 
     keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
-    if topk is not None and topk > 0:
+    # reviewed retrace: topk is a per-model constant (box_nms config),
+    # so this specializes one trace per deployed topk value — bounded by
+    # construction; a traced cap (cumsum <= topk as an array) would drag
+    # the whole op into dynamic-shape territory for no production gain
+    if topk is not None and topk > 0:  # mxtpulint: disable=R011
         keep_sorted = keep_sorted & (jnp.cumsum(keep_sorted) <= topk)
     keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
     return keep

@@ -56,8 +56,14 @@ class _Channel:
         self.stalled = False
 
 
-_channels = {}                       # name -> _Channel (GIL-atomic ops)
+_channels = {}                       # name -> _Channel
+#: guards _channels MAP mutation + iteration (R010: register runs on
+#: worker threads — io prefetchers register their own channel — while
+#: the monitor iterates). The per-beat fast path stays lock-free: it
+#: mutates the _Channel OBJECT (two attribute stores), never the map.
+_channels_lock = threading.Lock()
 _state_lock = threading.Lock()       # monitor lifecycle only
+_report_lock = threading.Lock()      # guards _last_report (R010)
 _thread = None
 _stop_event = None
 _last_report = None                  # newest stall report text
@@ -67,36 +73,50 @@ def register(name, quiet_s=None):
     """Declare a heartbeat channel (optionally with its own quiet bound —
     an io prefetcher that legally blocks for minutes should not page at a
     train step's threshold). Idempotent; resets the beat."""
-    ch = _channels.get(name)
-    if ch is None or ch.quiet_s != quiet_s:
-        _channels[name] = _Channel(name, quiet_s)
-    else:
-        ch.last = time.perf_counter()
-        ch.stalled = False
+    with _channels_lock:
+        ch = _channels.get(name)
+        if ch is None or ch.quiet_s != quiet_s:
+            _channels[name] = _Channel(name, quiet_s)
+        else:
+            ch.last = time.perf_counter()
+            ch.stalled = False
     return name
 
 
 def unregister(name):
     """Remove a channel (worker exiting cleanly): silence from a gone
     worker is not a stall."""
-    _channels.pop(name, None)
+    with _channels_lock:
+        _channels.pop(name, None)
 
 
 def heartbeat(name):
-    """One beat: a dict lookup and an attribute store — hot-loop cheap.
-    Auto-registers unknown channels with the default quiet bound."""
+    """One beat: a dict lookup and two attribute stores — hot-loop cheap,
+    no lock on the steady-state path. Only the first beat of an unknown
+    channel takes the map lock to auto-register it."""
     ch = _channels.get(name)
     if ch is None:
-        ch = _channels[name] = _Channel(name)
+        with _channels_lock:
+            ch = _channels.get(name)
+            if ch is None:
+                ch = _channels[name] = _Channel(name)
     ch.last = time.perf_counter()
     ch.stalled = False
+
+
+def _channel_snapshot():
+    """Consistent copy of the channel map for iteration (monitor poll,
+    liveness view) — readers never see a half-built map entry."""
+    with _channels_lock:
+        return dict(_channels)
 
 
 def channels():
     """{name: seconds_since_last_beat} — the liveness snapshot
     ``GET /debug/stacks`` includes."""
     now = time.perf_counter()
-    return {name: now - ch.last for name, ch in list(_channels.items())}
+    return {name: now - ch.last
+            for name, ch in _channel_snapshot().items()}
 
 
 # ---------------------------------------------------------------- dumping
@@ -134,12 +154,14 @@ def _build_report(stalled_names, quiet):
 
 def last_report():
     """The newest stall report text, or None if no stall was seen."""
-    return _last_report
+    with _report_lock:
+        return _last_report
 
 
 def _emit_report(report, path):
     global _last_report
-    _last_report = report
+    with _report_lock:
+        _last_report = report
     if path:
         try:
             with open(path, "a") as f:
@@ -156,7 +178,7 @@ def _monitor(stop, quiet_default, poll_s, path):
         try:
             now = time.perf_counter()
             newly_stalled = []
-            for ch in list(_channels.values()):
+            for ch in _channel_snapshot().values():
                 bound = ch.quiet_s if ch.quiet_s is not None \
                     else quiet_default
                 if now - ch.last > bound:

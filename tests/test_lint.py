@@ -14,9 +14,10 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from tools.mxtpulint import (RULES, lint_file, lint_paths,       # noqa: E402
-                             load_baseline, save_baseline, apply_baseline,
-                             make_report, DEFAULT_BASELINE)
+from tools.mxtpulint import (RULES, PROJECT_RULES,               # noqa: E402
+                             lint_file, lint_paths, load_baseline,
+                             save_baseline, apply_baseline, make_report,
+                             DEFAULT_BASELINE)
 from tools import promcheck                                      # noqa: E402
 
 
@@ -33,6 +34,10 @@ def rule_ids(findings):
 def test_rule_catalog_complete():
     assert {"R001", "R002", "R003", "R004", "R005", "R006",
             "R007", "R008"} <= set(RULES)
+    # the whole-program passes live in their own registry (they need the
+    # project index, not one file), R001 appearing in both: the per-file
+    # rule covers inline hot-path syncs, the pass covers helpers
+    assert {"R009", "R010", "R011", "R001"} <= set(PROJECT_RULES)
 
 
 # ------------------------------------------------------------------ R001

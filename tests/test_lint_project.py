@@ -1,0 +1,1290 @@
+"""Whole-program mxtpulint tier: the project indexer (import/alias
+resolution, call-graph cycles, method resolution on self), the
+interprocedural passes R009/R010/R011 + call-graph-aware R001
+(positive/negative fixtures each), the seeded-defect canary ci/run.sh
+also asserts, the AST content-hash cache, path profiles, and the shared
+CI JSON shape extended to the new rules."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mxtpulint import (analyze, build_index, get_context,  # noqa: E402
+                             make_report, rules_for_path, PROJECT_RULES,
+                             RELAXED_RULES)
+from tools import promcheck                                      # noqa: E402
+
+SEEDED = os.path.join(REPO, "tools", "mxtpulint", "testdata")
+
+
+def write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def run_project(tmp_path, files):
+    root = write_tree(tmp_path, files)
+    return analyze([root], root=root)
+
+
+def rule_ids(findings):
+    return sorted(f.rule for f in findings)
+
+
+def test_project_rule_catalog():
+    assert {"R009", "R010", "R011", "R001"} <= set(PROJECT_RULES)
+
+
+# ------------------------------------------------------------- the indexer
+def test_index_aliased_imports(tmp_path):
+    root = write_tree(tmp_path, {
+        "util.py": """
+            def helper():
+                return 1
+        """,
+        "a.py": """
+            import util as u
+            from util import helper as h
+
+            def via_module():
+                return u.helper()
+
+            def via_symbol():
+                return h()
+        """,
+    })
+    idx = build_index([root], root)
+    fns = idx.functions
+    callees = {key: {c for c, _n, _h in fn.calls if c}
+               for key, fn in fns.items()}
+    assert callees["a:via_module"] == {"util:helper"}
+    assert callees["a:via_symbol"] == {"util:helper"}
+
+
+def test_index_relative_imports_resolve(tmp_path):
+    root = write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/sub/__init__.py": "",
+        "pkg/sub/impl.py": """
+            def work():
+                return 1
+        """,
+        "pkg/sub/user.py": """
+            from . import impl
+            from .impl import work as w
+
+            def call_both():
+                impl.work()
+                w()
+        """,
+    })
+    idx = build_index([root], root)
+    callees = {c for c, _n, _h in
+               idx.functions["pkg/sub/user:call_both"].calls if c}
+    assert callees == {"pkg/sub/impl:work"}
+
+
+def test_index_function_level_imports_resolve(tmp_path):
+    # regression: the codebase's import-cycle-avoidance idiom (deferred
+    # `from x import f` INSIDE a function) must feed the call graph —
+    # here a real two-module deadlock is only visible through it
+    findings = run_project(tmp_path, {
+        "a.py": """
+            import threading
+            _la = threading.Lock()
+
+            def fa():
+                from b import fb_inner
+                with _la:
+                    fb_inner()
+
+            def fa_inner():
+                with _la:
+                    pass
+        """,
+        "b.py": """
+            import threading
+            _lb = threading.Lock()
+
+            def fb():
+                from a import fa_inner
+                with _lb:
+                    fa_inner()
+
+            def fb_inner():
+                with _lb:
+                    pass
+        """,
+    })
+    assert rule_ids(findings) == ["R009"]
+
+
+def test_index_local_shadowing_blocks_resolution(tmp_path):
+    # regression: `def run(flush): flush()` calls the PARAMETER — edges
+    # to a same-named module function would fabricate a deadlock here
+    findings = run_project(tmp_path, {"sh.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def flush():
+            with _b:
+                with _a:
+                    pass
+
+        def run(flush):
+            with _a:
+                flush()
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_index_deferred_import_collisions_are_function_scoped(tmp_path):
+    # regression: two functions deferred-importing DIFFERENT symbols
+    # under one local name must not share an alias table — a module-wide
+    # merge resolves b()'s lock-free y.helper to x.helper and fabricates
+    # the _lm->_lx half of a false deadlock cycle
+    findings = run_project(tmp_path, {
+        "x.py": """
+            import threading
+            _lx = threading.Lock()
+
+            def helper():
+                with _lx:
+                    pass
+        """,
+        "y.py": """
+            def helper():
+                return 1
+        """,
+        "m.py": """
+            import threading
+            _lm = threading.Lock()
+
+            def a():
+                from x import helper
+                helper()
+
+            def b():
+                from y import helper
+                with _lm:
+                    helper()
+
+            def real_order():
+                import x
+                with x._lx:
+                    with _lm:
+                        pass
+        """,
+    })
+    assert "R009" not in rule_ids(findings)
+
+
+def test_index_class_level_lock_attr(tmp_path):
+    # regression: `class C: _lock = threading.Lock()` is a real lock —
+    # `with C._lock:` must register as held, and the lock itself must
+    # not be tracked as shared state
+    findings = run_project(tmp_path, {"c.py": """
+        import threading
+
+        class C:
+            _lock = threading.Lock()
+            count = 0
+
+            def bump(self):
+                with C._lock:
+                    C.count += 1
+
+            def read(self):
+                with C._lock:
+                    return C.count
+
+        def start():
+            t = threading.Thread(target=C().bump, daemon=True)
+            t.start()
+    """})
+    assert findings == []
+
+
+def test_index_call_graph_cycle_terminates(tmp_path):
+    # mutual recursion must not hang reachability or transitive-lock
+    # computation, and a thread entry still reaches the whole cycle
+    root = write_tree(tmp_path, {
+        "cyc.py": """
+            import threading
+
+            _lock = threading.Lock()
+
+            def ping(n):
+                with _lock:
+                    pass
+                return pong(n - 1)
+
+            def pong(n):
+                return ping(n - 1)
+
+            def start():
+                t = threading.Thread(target=ping, args=(9,), daemon=True)
+                t.start()
+        """,
+    })
+    idx = build_index([root], root)
+    reach = idx.thread_reach()
+    assert "cyc:ping" in reach and "cyc:pong" in reach
+    assert idx.locks_acquired_transitive("cyc:pong") == {"cyc::_lock"}
+
+
+def test_index_sibling_nested_defs_resolve(tmp_path):
+    # regression: inner1 calling inner2 (both defined in outer — the
+    # worker-closure idiom) resolves through the enclosing chain; a
+    # parameter shadowing the sibling name blocks it
+    findings = run_project(tmp_path, {"sib.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def outer():
+            def inner2():
+                with _b:
+                    pass
+
+            def inner1():
+                with _a:
+                    inner2()
+            return inner1
+
+        def inverts():
+            with _b:
+                with _a:
+                    pass
+    """})
+    assert rule_ids(findings) == ["R009"]
+
+
+def test_index_param_shadows_sibling_nested_def(tmp_path):
+    findings = run_project(tmp_path, {"shsib.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def outer():
+            def inner2():
+                with _b:
+                    pass
+
+            def inner1(inner2):
+                with _a:
+                    inner2()          # the PARAMETER, not the sibling
+            return inner1, inner2
+
+        def inverts():
+            with _b:
+                with _a:
+                    pass
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_index_method_resolution_on_self(tmp_path):
+    root = write_tree(tmp_path, {
+        "cls.py": """
+            class Base:
+                def shared(self):
+                    return 1
+
+            class Worker(Base):
+                def __init__(self):
+                    self.helper = Helper()
+
+                def run(self):
+                    self.step()
+                    self.shared()          # base-class resolution
+                    self.helper.do_it()    # typed-attribute resolution
+
+                def step(self):
+                    return 2
+
+            class Helper:
+                def do_it(self):
+                    return 3
+        """,
+    })
+    idx = build_index([root], root)
+    callees = {c for c, _n, _h in
+               idx.functions["cls:Worker.run"].calls if c}
+    assert callees == {"cls:Worker.step", "cls:Base.shared",
+                       "cls:Helper.do_it"}
+
+
+# ------------------------------------------------------------------ R009
+def test_r009_lock_order_cycle_positive(tmp_path):
+    findings = run_project(tmp_path, {"dead.py": """
+        import threading
+        la = threading.Lock()
+        lb = threading.Lock()
+
+        def ab():
+            with la:
+                with lb:
+                    pass
+
+        def ba():
+            with lb:
+                with la:
+                    pass
+    """})
+    assert rule_ids(findings) == ["R009"]
+    assert "dead::la" in findings[0].message
+    assert "dead::lb" in findings[0].message
+
+
+def test_r009_cycle_through_call_graph(tmp_path):
+    # the inversion hides one call level down: fn holds A and CALLS a
+    # helper that takes B, while another path holds B and calls into A
+    findings = run_project(tmp_path, {"deep.py": """
+        import threading
+        la = threading.Lock()
+        lb = threading.Lock()
+
+        def take_b():
+            with lb:
+                pass
+
+        def take_a():
+            with la:
+                pass
+
+        def holds_a():
+            with la:
+                take_b()
+
+        def holds_b():
+            with lb:
+                take_a()
+    """})
+    assert rule_ids(findings) == ["R009"]
+    assert "via call into" in findings[0].message
+
+
+def test_r009_multi_item_with_inversion(tmp_path):
+    # regression: `with a, b:` acquires left to right — it must produce
+    # the same a->b edge as the nested spelling
+    findings = run_project(tmp_path, {"multi.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f1():
+            with _a, _b:
+                pass
+
+        def f2():
+            with _b, _a:
+                pass
+    """})
+    assert rule_ids(findings) == ["R009"]
+
+
+def test_r009_rlock_reentrant_helper_clean(tmp_path):
+    # regression: re-acquiring a held RLock (the reentrant-helper
+    # pattern RLock exists for) is legal, not a 1-cycle deadlock;
+    # inversions BETWEEN locks stay reported regardless of reentrancy
+    findings = run_project(tmp_path, {"rl.py": """
+        import threading
+        _rlock = threading.RLock()
+
+        def outer():
+            with _rlock:
+                helper()
+
+        def helper():
+            with _rlock:
+                return 1
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_r009_self_deadlock_reacquire(tmp_path):
+    findings = run_project(tmp_path, {"selfd.py": """
+        import threading
+        lk = threading.Lock()
+
+        def again():
+            with lk:
+                with lk:
+                    pass
+    """})
+    # the 1-cycle: re-acquiring a held non-reentrant Lock (+ R003 is not
+    # in play: both acquires are `with` form)
+    assert rule_ids(findings) == ["R009"]
+
+
+def test_r009_cycle_through_mutual_recursion(tmp_path):
+    # regression: the transitive-lock computation is a whole-graph
+    # fixpoint — a recursion-cycle guard's partial result must never be
+    # cached as final, or the lh->{la,lb} edge below goes missing and
+    # the real {lh, lb} inversion is silently dropped
+    findings = run_project(tmp_path, {"rec.py": """
+        import threading
+        la = threading.Lock()
+        lb = threading.Lock()
+        lh = threading.Lock()
+
+        def p_first(n):
+            with la:
+                pass
+            return q_second(n - 1)
+
+        def q_second(n):
+            with lb:
+                pass
+            return p_first(n - 1)
+
+        def holds_h():
+            with lh:
+                p_first(3)
+
+        def inverts():
+            with lb:
+                with lh:
+                    pass
+    """})
+    r9 = [f for f in findings if f.rule == "R009"]
+    assert len(r9) == 1
+    assert "lh" in r9[0].message and "lb" in r9[0].message
+
+
+def test_r009_try_finally_release_propagates(tmp_path):
+    # regression: the R003-sanctioned acquire-then-try/finally-release
+    # form RELEASES across the nesting boundary — the lock must not stay
+    # marked held, or the later with-nesting makes a phantom cycle
+    findings = run_project(tmp_path, {"canon.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def canonical_then_nested():
+            _a.acquire()
+            try:
+                pass
+            finally:
+                _a.release()
+            with _b:
+                with _a:
+                    pass
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_r009_timed_acquire_inversion_caught(tmp_path):
+    # regression: `if lock.acquire(timeout=):` acquires inside the TEST —
+    # the body runs with it held, so the inversion against thread2 is a
+    # real deadlock the analyzer must see
+    findings = run_project(tmp_path, {"timed.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def thread1():
+            if _a.acquire(timeout=1):
+                try:
+                    with _b:
+                        pass
+                finally:
+                    _a.release()
+
+        def thread2():
+            with _b:
+                with _a:
+                    pass
+    """})
+    assert rule_ids(findings) == ["R009"]
+
+
+def test_r009_semaphore_reacquire_clean(tmp_path):
+    # regression: a capacity>1 semaphore legally admits re-acquire —
+    # its self-edge is not a deadlock 1-cycle
+    findings = run_project(tmp_path, {"sem.py": """
+        import threading
+        _sem = threading.BoundedSemaphore(4)
+
+        def outer():
+            with _sem:
+                inner()
+
+        def inner():
+            with _sem:
+                return 1
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_r009_consistent_order_clean(tmp_path):
+    findings = run_project(tmp_path, {"ok.py": """
+        import threading
+        la = threading.Lock()
+        lb = threading.Lock()
+
+        def one():
+            with la:
+                with lb:
+                    pass
+
+        def two():
+            with la:
+                with lb:
+                    pass
+
+        def sequential():
+            with la:
+                pass
+            with lb:
+                pass
+            with la:
+                pass
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_r009_instance_lock_condition_alias_clean(tmp_path):
+    # Condition(self._lock) IS self._lock: holding one then taking the
+    # other in separate methods is NOT an inversion between two locks
+    findings = run_project(tmp_path, {"alias.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def put(self):
+                with self._lock:
+                    pass
+
+            def wait(self):
+                with self._cond:
+                    pass
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------ R010
+def test_r010_unlocked_cross_thread_write_positive(tmp_path):
+    findings = run_project(tmp_path, {"shared.py": """
+        import threading
+
+        _done = 0
+
+        def worker():
+            global _done
+            _done += 1
+
+        def status():
+            return _done
+
+        def start():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+    """})
+    assert rule_ids(findings) == ["R010"]
+    assert "_done" in findings[0].message
+
+
+def test_r010_self_attr_via_method_target(tmp_path):
+    findings = run_project(tmp_path, {"obj.py": """
+        import threading
+
+        class Loop:
+            def __init__(self):
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                while True:
+                    self.count = self.count + 1
+
+            def snapshot(self):
+                return self.count
+    """})
+    assert rule_ids(findings) == ["R010"]
+    assert "'count'" in findings[0].message
+
+
+def test_r010_common_lock_clean(tmp_path):
+    findings = run_project(tmp_path, {"locked.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _done = 0
+
+        def worker():
+            global _done
+            with _lock:
+                _done += 1
+
+        def status():
+            with _lock:
+                return _done
+
+        def start():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+    """})
+    assert "R010" not in rule_ids(findings)
+
+
+def test_r010_init_and_same_thread_clean(tmp_path):
+    findings = run_project(tmp_path, {"benign.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.state = 0        # pre-start write: happens-before
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                # written AND read only on this one worker thread
+                self.state = self.state + 1
+    """})
+    assert "R010" not in rule_ids(findings)
+
+
+def test_r010_worker_helper_also_called_from_main(tmp_path):
+    # regression: the same-single-thread exemption must verify the
+    # reader has no call sites OUTSIDE the worker — peek() is reachable
+    # from the one thread entry, but main() also polls it
+    findings = run_project(tmp_path, {"poll.py": """
+        import threading
+        _x = 0
+
+        def worker():
+            global _x
+            while True:
+                _x += 1
+                peek()
+
+        def peek():
+            return _x
+
+        def main():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            while True:
+                peek()
+    """})
+    assert rule_ids(findings) == ["R010"]
+
+
+def test_r010_entry_also_called_inline_flagged(tmp_path):
+    # regression: `Thread(target=f).start(); f()` runs the entry on BOTH
+    # threads — the single-thread exemption must lift when the entry has
+    # any resolved synchronous call site
+    findings = run_project(tmp_path, {"dual.py": """
+        import threading
+        _x = 0
+
+        def _worker():
+            global _x
+            _x += 1
+            _check()
+
+        def _check():
+            return _x > 10
+
+        def start():
+            t = threading.Thread(target=_worker, daemon=True)
+            t.start()
+            _worker()
+    """})
+    assert rule_ids(findings) == ["R010"]
+
+
+def test_r010_double_checked_read_clean(tmp_path):
+    # an unlocked fast-path read is sound when the same function re-reads
+    # under the writer's lock before acting (the watchdog heartbeat form)
+    findings = run_project(tmp_path, {"dcheck.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def worker(key):
+            v = _cache.get(key)
+            if v is None:
+                with _lock:
+                    v = _cache.get(key)
+                    if v is None:
+                        v = _cache[key] = object()
+            return v
+
+        def start():
+            t = threading.Thread(target=worker, args=("k",), daemon=True)
+            t.start()
+    """})
+    assert "R010" not in rule_ids(findings)
+
+
+def test_r010_suppression_applies_to_project_findings(tmp_path):
+    findings = run_project(tmp_path, {"sup.py": """
+        import threading
+
+        _beat = 0.0
+
+        def worker():
+            global _beat
+            while True:
+                # reviewed: single GIL-atomic float store, monitor only
+                # compares against a stale-tolerant threshold
+                _beat = 1.0  # mxtpulint: disable=R010
+
+        def monitor():
+            return _beat
+
+        def start():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+    """})
+    assert "R010" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------ R011
+def test_r011_dict_literal_and_varying_args(tmp_path):
+    findings = run_project(tmp_path, {"ret.py": """
+        import jax
+        from time import time as now
+
+        def model(x):
+            return x
+
+        def step(x):
+            jitted = jax.jit(model)
+            jitted(x, {"mode": 1})        # dict literal
+            jitted(x, now())              # aliased wall clock
+            stamp = now()
+            jitted(x, stamp)              # varying through a local
+            return jitted(x)              # clean call
+    """})
+    assert rule_ids(findings) == ["R011", "R011", "R011"]
+
+
+def test_r011_step_class_boundary(tmp_path):
+    findings = run_project(tmp_path, {"serve.py": """
+        class EvalStep:
+            def __init__(self, net):
+                self.net = net
+
+            def __call__(self, *inputs):
+                return inputs
+
+        class Servable:
+            def __init__(self, net):
+                self._step = EvalStep(net)
+
+            def predict(self, x):
+                return self._step(x, {"pad": True})
+    """})
+    assert rule_ids(findings) == ["R011"]
+    assert "TrainStep/EvalStep" in findings[0].message
+
+
+def test_r011_deferred_time_import_at_boundary(tmp_path):
+    # regression: `def f(): import time; jitted(x, time.time())` — the
+    # function-scoped import must feed varying-value resolution
+    findings = run_project(tmp_path, {"deft.py": """
+        import jax
+
+        def _model(x):
+            return x
+
+        _jitted = jax.jit(_model)
+
+        def f(x):
+            import time
+            return _jitted(x, time.time())
+    """})
+    assert rule_ids(findings) == ["R011"]
+
+
+def test_r011_decorator_form_boundary(tmp_path):
+    # regression: @jax.jit / @partial(jax.jit, ...) — the most common
+    # jit spelling — must mark the function traced AND its name a
+    # boundary for call-site argument checks
+    findings = run_project(tmp_path, {"dec.py": """
+        import time
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def step(x, n):
+            if n == 1:
+                return x + n
+            return x
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step2(x, mode):
+            return x
+
+        def run(x):
+            step(x, time.time())
+            step2(x, {"k": 1})
+    """})
+    # varying arg + dict arg at the two boundaries, plus step's
+    # data-dependent branch on its own argument
+    assert rule_ids(findings) == ["R011", "R011", "R011"]
+
+
+def test_r011_module_level_jit_boundary(tmp_path):
+    # regression: `_jitted = jax.jit(model)` at MODULE scope (the common
+    # serving idiom) is a boundary too, and the jitted fn is traced
+    findings = run_project(tmp_path, {"serve.py": """
+        import jax
+
+        def _model(x, n):
+            if n == 2:
+                return x + n
+            return x
+
+        _jitted = jax.jit(_model)
+
+        def predict(x):
+            return _jitted(x, {"mode": "fast"})
+    """})
+    # one hazard arg at the boundary + one data-dependent branch in the
+    # module-level-jitted function
+    assert rule_ids(findings) == ["R011", "R011"]
+
+
+def test_r011_traced_branch_positive_and_exemptions(tmp_path):
+    findings = run_project(tmp_path, {"traced.py": """
+        import jax
+
+        def model(x, flag):
+            if flag > 0:                  # data-dependent: one trace per value
+                return x * 2
+            return x
+
+        def clean_model(x, opt):
+            if opt is None:               # identity check: trace-stable
+                return x
+            if isinstance(x, tuple):      # structure check: trace-stable
+                return x[0]
+            if x.shape[0] > 1:            # shape check: static per trace
+                return x
+            return x
+
+        def run(x):
+            jax.jit(model)(x, 1)
+            jax.jit(clean_model)(x, None)
+    """})
+    assert rule_ids(findings) == ["R011"]
+    assert "'flag'" in findings[0].message
+
+
+def test_r011_transitive_trace_and_jitted_param(tmp_path):
+    # model -> helper: the helper is traced only transitively; wrap() jits
+    # its PARAMETER, so callers' arguments become traced interprocedurally
+    findings = run_project(tmp_path, {"deep.py": """
+        import jax
+
+        def helper(x, n):
+            if n == 3:
+                return x + n
+            return x
+
+        def model(x, n):
+            return helper(x, n)
+
+        def wrap(fn):
+            return jax.jit(fn)
+
+        def run(x):
+            return wrap(model)(x, 3)
+    """})
+    assert rule_ids(findings) == ["R011"]
+    assert "deep:helper" in findings[0].message
+
+
+def test_r011_hoisted_dict_literal_flagged(tmp_path):
+    # regression: `cfg = {...}; jitted(x, cfg)` is the same fresh
+    # unhashable object per call as the inline literal the canary seeds
+    findings = run_project(tmp_path, {"hoist.py": """
+        import jax
+
+        def _model(x):
+            return x
+
+        _jitted = jax.jit(_model)
+
+        def predict(x):
+            cfg = {"mode": "fast"}
+            return _jitted(x, cfg)
+    """})
+    assert rule_ids(findings) == ["R011"]
+
+
+def test_r011_asarray_rewrap_clears_taint(tmp_path):
+    # regression: the sanctioned fix — wrapping a varying scalar in
+    # jnp.asarray before the boundary — must CLEAR the taint; the
+    # unwrapped use in the second function stays flagged
+    findings = run_project(tmp_path, {"wrap.py": """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def _model(x):
+            return x
+
+        _jitted = jax.jit(_model)
+
+        def predict():
+            seed = time.time()
+            seed = jnp.asarray(seed)
+            return _jitted(seed)
+
+        def predict_bad():
+            seed = time.time()
+            return _jitted(seed)
+    """})
+    assert rule_ids(findings) == ["R011"]
+    assert findings[0].line > 14          # only predict_bad's use
+
+
+def test_r009_negated_timed_acquire_no_phantom_edge(tmp_path):
+    # regression: `if not lock.acquire(timeout=):` — the BODY is the
+    # failure branch and runs WITHOUT the lock; treating it as held
+    # would fabricate a deadlock edge against logger()'s real order
+    findings = run_project(tmp_path, {"neg.py": """
+        import threading
+        _lock_a = threading.Lock()
+        _log_lock = threading.Lock()
+
+        def f():
+            if not _lock_a.acquire(timeout=1):
+                with _log_lock:
+                    return None
+            try:
+                pass
+            finally:
+                _lock_a.release()
+
+        def logger():
+            with _log_lock:
+                with _lock_a:
+                    pass
+    """})
+    assert "R009" not in rule_ids(findings)
+
+
+def test_r009_negated_guard_early_return_keeps_held(tmp_path):
+    # regression to the regression: when the negated guard's failure
+    # body EXITS (`if not acquire(): return`), everything after runs
+    # with the lock held — the inversion against worker() is real
+    findings = run_project(tmp_path, {"guard.py": """
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f1():
+            if not _a.acquire(timeout=1):
+                return None
+            try:
+                with _b:
+                    pass
+            finally:
+                _a.release()
+
+        def worker():
+            with _b:
+                with _a:
+                    pass
+    """})
+    assert rule_ids(findings) == ["R009"]
+
+
+def test_r011_array_args_clean(tmp_path):
+    findings = run_project(tmp_path, {"ok.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def model(xs, key):
+            return xs
+
+        def run(arrs, t):
+            jitted = jax.jit(model)
+            # pytree-of-arrays args and wrapped scalars are the designed
+            # forms: stable structure, traced values
+            return jitted([a for a in arrs], jnp.asarray(t, jnp.int32))
+    """})
+    assert "R011" not in rule_ids(findings)
+
+
+# --------------------------------------------------- call-graph-aware R001
+def test_r001_interprocedural_helper_sync(tmp_path):
+    findings = run_project(tmp_path, {"jit.py": """
+        def log_loss(loss):
+            return float(loss.asnumpy())
+
+        class TrainStep:
+            def __call__(self, x):
+                return log_loss(x)
+
+        class Exporter:
+            def save(self, x):
+                return cold_helper(x)
+
+        def cold_helper(x):
+            return x.asnumpy()
+    """})
+    assert rule_ids(findings) == ["R001"]
+    assert "log_loss" in findings[0].message \
+        and "TrainStep.__call__" in findings[0].message
+    # anchored at the helper's sync line, where the fix happens
+    assert findings[0].line == 3
+
+
+def test_r001_interprocedural_depth_is_one(tmp_path):
+    # two levels down is out of contract (documented precision bound)
+    findings = run_project(tmp_path, {"jit.py": """
+        def outer(x):
+            return inner(x)
+
+        def inner(x):
+            return x.asnumpy()
+
+        class TrainStep:
+            def __call__(self, x):
+                return outer(x)
+    """})
+    assert "R001" not in rule_ids(findings)
+
+
+# --------------------------------------------------------- seeded defects
+def test_seeded_defects_exactly_three():
+    """The regression canary: the fixture module contains one deadlock
+    cycle, one unlocked cross-thread write, one retrace hazard — the
+    analyzer must report exactly those three (ci/run.sh asserts the same
+    thing in the lint stage)."""
+    findings = analyze([SEEDED], root=SEEDED)
+    assert rule_ids(findings) == ["R009", "R010", "R011"], findings
+
+
+def test_seeded_defects_clean_under_repo_gate_profile():
+    # under the repo gate the fixture sits in tools/ => relaxed profile
+    rel = "tools/mxtpulint/testdata/seeded_defects.py"
+    assert rules_for_path(rel) == RELAXED_RULES
+    findings = analyze([os.path.join(REPO, rel)], root=REPO)
+    assert findings == []
+
+
+# ------------------------------------------------------------ path profiles
+def test_relaxed_profile_empty_rule_intersection_skips(tmp_path):
+    # regression: --rules R001 over a relaxed-profile dir intersects to
+    # the EMPTY set, which must skip the file — a falsy only_rules would
+    # mean "no filter" and run every rule the user excluded
+    root = write_tree(tmp_path, {
+        "tools/h.py": "import os\nX = os.environ.get('MXTPU_FOO')\n",
+    })
+    findings = analyze([root], root=root, only_rules={"R001"})
+    assert findings == []
+
+
+def test_relaxed_profile_for_tools_and_tests(tmp_path):
+    files = {
+        "tools/helper.py": """
+            import os
+            import threading
+            lock = threading.Lock()
+
+            def knobs():
+                return os.environ.get("MXTPU_FOO")   # R002: waived here
+
+            def bad():
+                lock.acquire()                        # R003: still enforced
+                work()
+                lock.release()
+        """,
+        "pkg/runtime.py": """
+            import os
+
+            def knobs():
+                return os.environ.get("MXTPU_FOO")   # R002: full profile
+        """,
+    }
+    root = write_tree(tmp_path, files)
+    findings = analyze([root], root=root)
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f.rule)
+    assert by_path.get("tools/helper.py") == ["R003"]
+    assert by_path.get("pkg/runtime.py") == ["R002"]
+
+
+# ------------------------------------------------------------ AST cache
+def test_context_cache_content_hash(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("X = 1\n")
+    c1 = get_context(str(p), str(tmp_path))
+    c2 = get_context(str(p), str(tmp_path))
+    assert c1 is c2                     # same content: one parse
+    p.write_text("X = 2\n")
+    c3 = get_context(str(p), str(tmp_path))
+    assert c3 is not c1                 # edited content: reparsed
+    p.write_text("X = 1\n")             # mtime changed, content restored
+    c4 = get_context(str(p), str(tmp_path))
+    assert c4.src_lines == c1.src_lines
+
+
+# ---------------------------------------------- shared CI shape (promcheck)
+def test_new_rules_share_the_ci_json_shape(tmp_path):
+    findings = run_project(tmp_path, {"mix.py": """
+        import threading
+        import jax
+        la = threading.Lock()
+        lb = threading.Lock()
+        _n = 0
+
+        def ab():
+            with la:
+                with lb:
+                    pass
+
+        def ba():
+            with lb:
+                with la:
+                    pass
+
+        def worker():
+            global _n
+            _n += 1
+            ba()
+
+        def peek():
+            return _n
+
+        def start():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            ab()
+
+        def model(x):
+            return x
+
+        def run(x):
+            return jax.jit(model)(x, {"k": 1})
+    """})
+    assert rule_ids(findings) == ["R009", "R010", "R011"]
+    rep = make_report("mxtpulint", findings)
+    ok_rep = promcheck.report("# TYPE a counter\na 1\n")
+    keys = {"tool", "ok", "findings", "counts", "baselined"}
+    assert set(rep) == keys and set(ok_rep) == keys
+    f_keys = {"path", "line", "rule", "message"}
+    for entry in rep["findings"]:
+        assert set(entry) == f_keys
+    assert rep["counts"] == {"R009": 1, "R010": 1, "R011": 1}
+    json.dumps(rep)                     # serializable end to end
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_update_baseline_round_trip(tmp_path):
+    bad = tmp_path / "proj"
+    bad.mkdir()
+    (bad / "m.py").write_text(
+        "import os\nX = os.environ.get('MXTPU_LEGACY')\n")
+    bl = tmp_path / "bl.json"
+    # 1) gate fails on the finding
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", str(bad),
+         "--baseline", str(bl)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    # 2) --update-baseline rewrites the file from current findings
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", str(bad),
+         "--baseline", str(bl), "--update-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(bl.read_text())
+    assert len(data["findings"]) == 1
+    assert data["findings"][0]["rule"] == "R002"
+    # 3) gate is green against the regenerated baseline
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", str(bad),
+         "--baseline", str(bl)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_update_baseline_refuses_rule_filter(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "incubator_mxnet_tpu",
+         "--rules", "R006", "--update-baseline",
+         "--baseline", str(tmp_path / "bl.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "cannot be combined" in r.stderr
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_cli_vacuous_rule_profile_combination_exits_2():
+    # a --rules selection every given path's profile masks lints NOTHING
+    # and must fail loudly, same philosophy as the missing-path check
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "--rules", "R007",
+         "tests/test_watchdog.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "do not apply" in r.stderr
+    # a rule the relaxed profile DOES run still works
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "--rules", "R003",
+         "tests/test_watchdog.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_help_documents_exit_codes():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "--help"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    out = r.stdout
+    assert "exit codes" in out
+    for marker in ("0 =", "1 =", "2 ="):
+        assert marker in out, out
+    assert "--update-baseline" in out
+
+
+def test_cli_list_rules_includes_project_passes():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    for rid in ("R009", "R010", "R011"):
+        assert rid in r.stdout
+    assert "whole-program" in r.stdout
+
+
+def test_cli_full_gate_all_paths_empty_baseline():
+    """The PR's acceptance gate: runtime + tools + tests, exit 0, and the
+    committed baseline is EMPTY (fixed or reviewed-suppressed, never
+    grandfathered)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", "incubator_mxnet_tpu",
+         "tools", "tests", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["ok"] and rep["findings"] == [] and rep["baselined"] == 0

@@ -1,7 +1,14 @@
 """CLI: ``python -m tools.mxtpulint [paths...] [options]``.
 
-Exit codes: 0 = clean (all findings suppressed/baselined), 1 = new
-findings, 2 = usage error. ``--json`` emits the shared report shape that
+Exit codes:
+  0  clean — every finding is fixed, inline-suppressed, or baselined
+  1  new findings (printed human-readably, or as --json)
+  2  usage error (unknown rule id, missing path, bad flag combination)
+
+The run is two-phase: per-file rules over every path (tools/ and tests/
+under the relaxed R003/R005/R006 profile), then the whole-program index +
+interprocedural passes (R009-R011 and call-graph-aware R001) over the
+full-profile files. ``--json`` emits the shared report shape that
 ``tools/promcheck.py --json`` also produces, so CI aggregates both lint
 gates with one parser.
 """
@@ -11,19 +18,30 @@ import argparse
 import json
 import os
 import sys
+import time
 
-from .core import (RULES, lint_paths, iter_py_files, load_baseline,
-                   save_baseline, apply_baseline, make_report,
-                   DEFAULT_BASELINE)
+from .core import (RULES, REPO_ROOT, RELAXED_RULES, iter_py_files,
+                   load_baseline, save_baseline, apply_baseline,
+                   make_report, rules_for_path, DEFAULT_BASELINE)
+from .interproc import PROJECT_RULES, analyze
+
+_RELAXED = "/".join(sorted(RELAXED_RULES))
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m tools.mxtpulint",
-        description="framework-aware static analysis for incubator_mxnet_tpu")
+        description="framework-aware static analysis for incubator_mxnet_tpu "
+                    "(per-file rules + whole-program lock-order / "
+                    "thread-safety / jit-retrace passes)",
+        epilog="exit codes: 0 = clean (all findings fixed, suppressed, or "
+               "baselined); 1 = new findings; 2 = usage error "
+               "(unknown rule, missing path, bad flag combination, or a "
+               "--rules selection every given path's profile masks)")
     ap.add_argument("paths", nargs="*", default=["incubator_mxnet_tpu"],
                     help="files/directories to lint "
-                         "(default: incubator_mxnet_tpu)")
+                         "(default: incubator_mxnet_tpu; tools/ and tests/ "
+                         "run the relaxed %s profile)" % _RELAXED)
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the shared CI report shape on stdout")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -31,23 +49,32 @@ def main(argv=None):
                          "baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline: report every finding")
-    ap.add_argument("--write-baseline", action="store_true",
-                    help="write current findings to the baseline and exit 0")
+    ap.add_argument("--update-baseline", "--write-baseline",
+                    action="store_true", dest="update_baseline",
+                    help="rewrite the baseline file from the current "
+                         "findings and exit 0 (no hand-editing; the goal "
+                         "state is an empty baseline)")
     ap.add_argument("--rules", default=None,
                     help="comma list of rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule catalog and exit")
+                    help="print the rule catalog (per-file + "
+                         "whole-program) and exit")
+    ap.add_argument("--timing", action="store_true",
+                    help="print the lint wall time to stderr (the CI "
+                         "stage budget-checks it)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule_id, (title, _fn) in sorted(RULES.items()):
             print("%s  %s" % (rule_id, title))
+        for rule_id, (title, _fn) in sorted(PROJECT_RULES.items()):
+            print("%s  %s  [whole-program]" % (rule_id, title))
         return 0
 
     only = None
     if args.rules:
         only = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = only - set(RULES)
+        unknown = only - set(RULES) - set(PROJECT_RULES)
         if unknown:
             print("unknown rule(s): %s" % ", ".join(sorted(unknown)),
                   file=sys.stderr)
@@ -65,16 +92,36 @@ def main(argv=None):
         print("no .py files found under: %s" % ", ".join(paths),
               file=sys.stderr)
         return 2
-    findings = lint_paths(paths, only_rules=only)
 
-    if args.write_baseline and only:
+    if args.update_baseline and only:
         # a rule-filtered rewrite would silently drop every OTHER rule's
         # grandfathered entries
-        print("--write-baseline cannot be combined with --rules: it "
+        print("--update-baseline cannot be combined with --rules: it "
               "rewrites the whole baseline", file=sys.stderr)
         return 2
 
-    if args.write_baseline:
+    if only:
+        # explicit rule selection where EVERY file's path profile masks
+        # every requested rule would lint nothing — that's the same
+        # vacuous green the missing-path check exists to prevent
+        # (relaxed tools/tests files also never run whole-program rules)
+        def runnable(path):
+            profile = rules_for_path(os.path.relpath(path, REPO_ROOT))
+            return profile is None or bool(profile & only)
+        if not any(runnable(f) for f in files):
+            print("requested rule(s) %s do not apply to any given path: "
+                  "tools/ and tests/ run the relaxed profile (%s) only"
+                  % (", ".join(sorted(only)), _RELAXED), file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    findings = analyze(files, only_rules=only)
+    elapsed = time.perf_counter() - t0
+    if args.timing:
+        print("mxtpulint: %d file(s) in %.2fs" % (len(files), elapsed),
+              file=sys.stderr)
+
+    if args.update_baseline:
         path = save_baseline(args.baseline, findings)
         print("wrote %d finding(s) to %s" % (len(findings), path))
         return 0

@@ -27,13 +27,16 @@ aggregate both gates with one parser)::
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
 
 __all__ = ["Finding", "FileContext", "rule", "RULES", "lint_file",
            "lint_paths", "iter_py_files", "load_baseline", "save_baseline",
-           "apply_baseline", "make_report", "DEFAULT_BASELINE"]
+           "apply_baseline", "make_report", "DEFAULT_BASELINE",
+           "get_context", "rules_for_path", "filter_suppressed",
+           "RELAXED_PREFIXES", "RELAXED_RULES"]
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 # Baseline/report paths are repo-root-relative (two levels above this
@@ -188,6 +191,57 @@ def terminal_name(node):
     return ""
 
 
+# ------------------------------------------------------------- context cache
+# The whole-program index phase (tools/mxtpulint/project.py) and the
+# per-file rule phase both need every file's AST: without a cache each
+# lint run would parse the tree twice (and repeated programmatic calls,
+# e.g. the test suite's gate assertions, many times more). Contexts are
+# cached per (path, root) and validated by CONTENT HASH, not mtime — an
+# edit-and-revert or a copied checkout never serves a stale tree.
+_CTX_CACHE = {}
+_CTX_CACHE_MAX = 4096
+
+
+def get_context(path, root):
+    """Parsed ``FileContext`` for ``path`` (repo-relative to ``root``),
+    served from the content-hash cache. Raises like open()/ast.parse on
+    unreadable/unparseable sources — callers turn that into E000."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    digest = hashlib.sha1(raw).hexdigest()
+    key = (os.path.abspath(path), os.path.abspath(root))
+    hit = _CTX_CACHE.get(key)
+    if hit is not None and hit[0] == digest:
+        return hit[1]
+    src = raw.decode("utf-8")
+    ctx = FileContext(path, os.path.relpath(path, root), src)
+    if len(_CTX_CACHE) >= _CTX_CACHE_MAX:
+        _CTX_CACHE.clear()       # wholesale: simple and bounded
+    _CTX_CACHE[key] = (digest, ctx)
+    return ctx
+
+
+# ------------------------------------------------------------- path profiles
+# The gate covers the runtime package under the FULL rule set, while
+# tools/ and tests/ run a relaxed profile (lock/thread/clock hygiene
+# only): test helpers and the linter itself spawn threads and take locks
+# too, but hot-path/telemetry/jit rules are framework-runtime concepts.
+# The whole-program passes (R009+) likewise only analyze full-profile
+# files.
+RELAXED_PREFIXES = ("tools/", "tests/")
+RELAXED_RULES = frozenset({"R003", "R005", "R006"})
+
+
+def rules_for_path(relpath):
+    """Rule-id set for one repo-relative path, or None meaning ALL rules
+    (full profile)."""
+    rel = relpath.replace(os.sep, "/")
+    for prefix in RELAXED_PREFIXES:
+        if rel == prefix.rstrip("/") or rel.startswith(prefix):
+            return RELAXED_RULES
+    return None
+
+
 # ---------------------------------------------------------------- the runner
 SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules"}
 
@@ -214,9 +268,7 @@ def lint_file(path, root=None, only_rules=None):
     root = root or REPO_ROOT
     relpath = os.path.relpath(path, root)
     try:
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        ctx = FileContext(path, relpath, src)
+        ctx = get_context(path, root)
     except SyntaxError as e:
         return [Finding(relpath.replace(os.sep, "/"), e.lineno or 0, 0,
                         "E000", "syntax error: %s" % e.msg)]
@@ -232,22 +284,47 @@ def lint_file(path, root=None, only_rules=None):
         if only_rules and rule_id not in only_rules:
             continue
         findings.extend(fn(ctx))
-    sup = suppressions(ctx.src_lines)
-    kept = []
-    for f in findings:
-        rules_off = sup.get(f.line, ())
-        if "all" in rules_off or f.rule in rules_off:
-            continue
-        kept.append(f)
+    kept = filter_suppressed(findings, {ctx.relpath: ctx})
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
     return kept
 
 
-def lint_paths(paths, root=None, only_rules=None):
+def lint_paths(paths, root=None, only_rules=None, profiled=False):
+    """Per-file rule phase over ``paths``. With ``profiled=True`` each
+    file runs only its path profile's rules (tools/ and tests/ get the
+    relaxed lock/thread/clock subset — see ``rules_for_path``)."""
+    root = root or REPO_ROOT
     findings = []
     for path in iter_py_files(paths):
-        findings.extend(lint_file(path, root=root, only_rules=only_rules))
+        only = only_rules
+        if profiled:
+            profile = rules_for_path(os.path.relpath(path, root))
+            if profile is not None:
+                only = profile if only_rules is None \
+                    else (profile & set(only_rules))
+                if not only:
+                    # none of the requested rules apply under this
+                    # path's profile — an empty set must SKIP the file
+                    # (a falsy only_rules would mean "no filter" and
+                    # run everything the user excluded)
+                    continue
+        findings.extend(lint_file(path, root=root, only_rules=only))
     return findings
+
+
+def filter_suppressed(findings, ctx_by_relpath):
+    """Drop findings whose line carries a matching per-line suppression —
+    the same check ``lint_file`` applies, exposed for the whole-program
+    passes (their findings are produced outside any one file's run)."""
+    sup_by_path = {rel: suppressions(ctx.src_lines)
+                   for rel, ctx in ctx_by_relpath.items()}
+    kept = []
+    for f in findings:
+        rules_off = sup_by_path.get(f.path, {}).get(f.line, ())
+        if "all" in rules_off or f.rule in rules_off:
+            continue
+        kept.append(f)
+    return kept
 
 
 # ---------------------------------------------------------------- baseline

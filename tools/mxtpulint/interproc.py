@@ -1,0 +1,477 @@
+"""Phase 2 of the whole-program analyzer: interprocedural passes.
+
+These run over the project index (project.py) and see the framework as
+ONE program: every thread entry point, every lock, every path into a jit
+boundary. Four passes:
+
+- **R009 lock-order cycles** — build the held-while-acquiring graph
+  (lock A held when lock B is acquired, directly or anywhere down the
+  resolved call graph) and report strongly-connected components: two
+  threads taking the locks in opposite orders deadlock with both stacks
+  parked inside ``acquire``. Re-acquiring a held non-reentrant lock is
+  the 1-cycle of the same defect.
+- **R010 cross-thread shared state** — an attribute/global written in a
+  function reachable from a ``Thread``/``Timer`` entry and read in some
+  other function with NO common lock across the two sites. Plain stores
+  are GIL-atomic, but the reader still observes torn multi-field state
+  and stale values with no happens-before edge; every real hit is either
+  locked, redesigned, or carries a reviewed suppression explaining why
+  the unlocked read is sound.
+- **R011 jit retrace hazards** — Python values flowing into a
+  ``jax.jit``/``TrainStep``/``EvalStep`` call site that force a silent
+  recompile: dict/set literals (fresh unhashable objects per call) and
+  per-call-varying scalars (``time.*``, ``random.*``, ``next()`` ...).
+  Plus data-dependent ``if``/``while`` on a traced function's own
+  arguments (shape/``is None``/``isinstance`` checks are trace-stable
+  and exempt). Every hit is one more XLA compile the serving p99 pays.
+- **call-graph-aware R001** — host-device syncs one call level deep in
+  helpers invoked from the hot paths rules.py only checks inline.
+
+Findings carry the same shape, suppression mechanism, and baseline
+semantics as the per-file rules.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from .core import (REPO_ROOT, filter_suppressed, lint_paths, terminal_name)
+from .project import build_index
+from .rules import HOT_PATH_PATTERNS
+
+__all__ = ["PROJECT_RULES", "project_rule", "run_project_rules", "analyze"]
+
+PROJECT_RULES = {}          # rule id -> (title, pass_fn(index))
+
+
+def project_rule(rule_id, title):
+    def deco(fn):
+        PROJECT_RULES[rule_id] = (title, fn)
+        return fn
+    return deco
+
+
+def _finding(fn, node, rule_id, message):
+    return fn.module.ctx.finding(node, rule_id, message)
+
+
+# --------------------------------------------------------------------- R009
+def _lock_edges(index):
+    """(held, acquired) -> witness (fn, node, via_callee_or_None); the
+    held-while-acquiring graph over every function, with lock sets
+    acquired by callees folded in transitively. Self-edges on REENTRANT
+    locks (RLock, argless Condition) are legal re-acquisition, not
+    deadlock 1-cycles, and are dropped here; inversions BETWEEN two
+    locks deadlock regardless of reentrancy and stay."""
+    reentrant = index.reentrant_locks()
+    edges = {}
+    for key in sorted(index.functions):
+        fn = index.functions[key]
+        for held, lock, node in fn.acquires:
+            for h in held:
+                if h == lock and lock in reentrant:
+                    continue
+                edges.setdefault((h, lock), (fn, node, None))
+        for callee, node, held in fn.calls:
+            if callee is None or not held:
+                continue
+            for lock in index.locks_acquired_transitive(callee):
+                for h in held:
+                    if h == lock and lock in reentrant:
+                        continue
+                    edges.setdefault((h, lock), (fn, node, callee))
+    return edges
+
+
+def _sccs(nodes, adj):
+    """Tarjan strongly-connected components (iterative)."""
+    idx, low, on, order, stack, out = {}, {}, set(), [0], [], []
+    for start in sorted(nodes):
+        if start in idx:
+            continue
+        work = [(start, iter(sorted(adj.get(start, ()))))]
+        idx[start] = low[start] = order[0]
+        order[0] += 1
+        stack.append(start)
+        on.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in idx:
+                    idx[nxt] = low[nxt] = order[0]
+                    order[0] += 1
+                    stack.append(nxt)
+                    on.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on:
+                    low[node] = min(low[node], idx[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(sorted(comp))
+    return out
+
+
+@project_rule("R009", "lock-order cycle across threads (potential deadlock)")
+def r009_lock_cycles(index):
+    edges = _lock_edges(index)
+    adj = {}
+    for (a, b), _w in edges.items():
+        adj.setdefault(a, set()).add(b)
+    nodes = set(adj)
+    for targets in adj.values():
+        nodes |= targets
+    for comp in _sccs(nodes, adj):
+        cyclic = len(comp) > 1 or (comp and comp[0] in adj.get(comp[0], ()))
+        if not cyclic:
+            continue
+        comp_set = set(comp)
+        witnesses = sorted(
+            ((a, b), w) for (a, b), w in edges.items()
+            if a in comp_set and b in comp_set)
+        parts = []
+        for (a, b), (fn, node, via) in witnesses:
+            hop = "%s -> %s in %s (line %d%s)" % (
+                a, b, fn.key, node.lineno,
+                ", via call into %s" % via if via else "")
+            parts.append(hop)
+        anchor_fn, anchor_node, _ = witnesses[0][1]
+        yield _finding(
+            anchor_fn, anchor_node, "R009",
+            "lock-order cycle over {%s}: two threads taking these locks "
+            "in opposite orders deadlock with both stacks inside "
+            "acquire(). Edges: %s. Impose one global order (or collapse "
+            "to one lock), or document why the orders can never run "
+            "concurrently" % (", ".join(comp), "; ".join(parts)))
+
+
+# --------------------------------------------------------------------- R010
+def _single_thread_only(index, fn, entries, _seen=None):
+    """True iff ``fn`` can ONLY execute on the single spawned thread of
+    ``entries``: it is that entry itself (spawned, never called), or
+    every resolved call site of it sits in a function with the same
+    property. A call site anywhere else — a main-thread poll of a
+    worker-side helper, or the entry function itself ALSO invoked
+    synchronously (``Thread(target=f).start(); f()``) — means the
+    function's reads race the worker's writes after all."""
+    _seen = _seen or set()
+    if fn.key in _seen:
+        return True        # recursion inside the same cluster
+    _seen.add(fn.key)
+    callers = index.callers().get(fn.key)
+    if fn.key in entries:
+        # spawn edges are not call edges; any RESOLVED call site means
+        # the entry also runs synchronously on the caller's thread
+        if not callers:
+            return True
+    elif not callers:
+        return False       # unknown invocation context: assume any thread
+    reach = index.thread_reach()
+    for caller_key in callers:
+        if reach.get(caller_key, frozenset()) != entries:
+            return False
+        caller = index.functions.get(caller_key)
+        if caller is None or not _single_thread_only(index, caller,
+                                                     entries, _seen):
+            return False
+    return True
+
+
+@project_rule("R010", "cross-thread shared state without a common lock")
+def r010_cross_thread_state(index):
+    reach = index.thread_reach()
+    state = {}
+    for key in sorted(index.functions):
+        fn = index.functions[key]
+        for skey, node, held in fn.state_writes:
+            state.setdefault(skey, ([], []))[0].append((fn, node, held))
+        for skey, node, held in fn.state_reads:
+            state.setdefault(skey, ([], []))[1].append((fn, node, held))
+    for skey in sorted(state, key=repr):
+        writes, reads = state[skey]
+        for fn_w, node_w, held_w in writes:
+            if fn_w.is_init:
+                continue    # happens-before the thread start that shares it
+            entries_w = reach.get(fn_w.key)
+            if not entries_w:
+                continue    # only thread-side writers are the hazard here
+            conflict = None
+            for fn_r, node_r, held_r in reads:
+                if fn_r.key == fn_w.key:
+                    continue    # same function: same thread at this site
+                entries_r = reach.get(fn_r.key, frozenset())
+                if entries_r == entries_w and len(entries_w) == 1 \
+                        and _single_thread_only(index, fn_r, entries_w) \
+                        and _single_thread_only(index, fn_w, entries_w):
+                    continue    # both only ever run on that one thread
+                if set(held_w) & set(held_r):
+                    continue    # common lock: properly synchronized pair
+                # double-checked locking: an unlocked fast-path read is
+                # sound when the SAME function re-reads the state under
+                # the writer's lock before acting on a miss
+                if held_w and any(
+                        r2.key == fn_r.key and set(h2) & set(held_w)
+                        for r2, _n2, h2 in reads):
+                    continue
+                conflict = (fn_r, node_r, held_r)
+                break
+            if conflict is None:
+                continue
+            fn_r, node_r, held_r = conflict
+            kind, owner, name = skey
+            what = ("attribute %r of %s" % (name, owner)) \
+                if kind == "self" else ("module global %s::%s"
+                                        % (owner, name))
+            w_lock = ("under %s" % ", ".join(sorted(held_w))) \
+                if held_w else "with no lock"
+            r_lock = ("under a different lock (%s)"
+                      % ", ".join(sorted(held_r))) \
+                if held_r else "with no lock"
+            yield _finding(
+                fn_w, node_w, "R010",
+                "%s is written here on thread entry %s %s, and read in "
+                "%s (line %d) %s — no COMMON lock orders the two sites, "
+                "so the reader can observe stale or torn state with no "
+                "happens-before edge; guard both sides with one lock "
+                "(or document the GIL-atomicity argument in a reviewed "
+                "suppression)"
+                % (what, "/".join(sorted(entries_w)), w_lock, fn_r.key,
+                   node_r.lineno, r_lock))
+
+
+# --------------------------------------------------------------------- R011
+_VARYING_BUILTINS = {"next", "id"}
+_VARYING_PREFIXES = ("time.", "random.", "datetime.", "uuid.")
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_EXEMPT_TEST_CALLS = {"isinstance", "callable", "hasattr", "getattr",
+                      "len", "type"}
+
+
+def _varying_call(index, mod, node, fn=None):
+    """Is this Call a per-call-varying scalar source (wall clock, RNG,
+    counters)? Resolved through import aliases — module-level AND
+    function-scoped deferred ones (``def f(): import time`` counts), so
+    ``import time as t`` and ``from time import time as now`` both
+    count."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in _VARYING_BUILTINS:
+        return "%s()" % f.id
+    ext = index.resolve_external(mod, f, fn)
+    for prefix in _VARYING_PREFIXES:
+        if ext.startswith(prefix):
+            return "%s()" % ext
+    if ext == "os.urandom":
+        return "os.urandom()"
+    return None
+
+
+def _hazard_for_arg(index, mod, arg, varying_locals, fn=None):
+    if isinstance(arg, (ast.Dict, ast.DictComp)):
+        return "a dict literal (a fresh unhashable Python object per call)"
+    if isinstance(arg, (ast.Set, ast.SetComp)):
+        return "a set literal (a fresh unhashable Python object per call)"
+    v = _varying_call(index, mod, arg, fn)
+    if v:
+        return "a per-call-varying %s value" % v
+    if isinstance(arg, ast.Name) and arg.id in varying_locals:
+        return "a per-call-varying value (%s, bound from %s)" \
+            % (arg.id, varying_locals[arg.id])
+    if isinstance(arg, (ast.List, ast.Tuple)):
+        for elt in arg.elts:
+            v = _varying_call(index, mod, elt, fn)
+            if v:
+                return "a container holding a per-call-varying %s value" % v
+    return None
+
+
+def _branch_offender(test, params):
+    """Param name a traced-function branch test depends on, or None.
+    Identity (`is`/`is not`), isinstance/len/shape-style structure checks
+    are trace-stable and exempt."""
+    if isinstance(test, ast.Compare) \
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return None
+    if isinstance(test, ast.Call) \
+            and terminal_name(test.func) in _EXEMPT_TEST_CALLS:
+        return None
+    if isinstance(test, ast.Attribute) and test.attr in _STATIC_ATTRS:
+        return None
+    if isinstance(test, ast.Name):
+        return test.id if test.id in params else None
+    for child in ast.iter_child_nodes(test):
+        hit = _branch_offender(child, params)
+        if hit:
+            return hit
+    return None
+
+
+def _iter_own_nodes(fn_node):
+    """Walk a function body, pruning nested function/class bodies (they
+    are separate FunctionInfos)."""
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@project_rule("R011", "Python value reaching a jit boundary forces retrace")
+def r011_retrace_hazards(index):
+    # (a) hazardous arguments at jit-boundary call sites
+    for key in sorted(index.functions):
+        fn = index.functions[key]
+        if not fn.jit_callsites:
+            continue
+        # source-order scan with rebinding: `seed = time.time()` taints
+        # the name, but the sanctioned `seed = jnp.asarray(seed)` wrap
+        # RE-binds it to an array and must clear the taint
+        assigns = sorted(
+            (n for n in _iter_own_nodes(fn.node)
+             if isinstance(n, ast.Assign) and len(n.targets) == 1
+             and isinstance(n.targets[0], ast.Name)),
+            key=lambda n: (n.lineno, n.col_offset))
+        def varying_at(line):
+            state = {}
+            for node in assigns:
+                if node.lineno >= line:
+                    break
+                v = _varying_call(index, fn.module, node.value, fn)
+                if v is None and isinstance(
+                        node.value, (ast.Dict, ast.DictComp, ast.Set,
+                                     ast.SetComp)):
+                    # the hoisted spelling of the inline-literal hazard:
+                    # `cfg = {...}; jitted(x, cfg)` is the same fresh
+                    # unhashable object per call
+                    v = "dict/set literal built per call"
+                if v:
+                    state[node.targets[0].id] = v
+                else:
+                    state.pop(node.targets[0].id, None)
+            return state
+
+        for call_node, kind in fn.jit_callsites:
+            varying_locals = varying_at(call_node.lineno)
+            args = list(call_node.args) + [kw.value
+                                           for kw in call_node.keywords]
+            for arg in args:
+                why = _hazard_for_arg(index, fn.module, arg,
+                                      varying_locals, fn)
+                if why:
+                    boundary = "jax.jit'd callable" if kind == "jit" \
+                        else "compiled TrainStep/EvalStep"
+                    yield _finding(
+                        fn, arg, "R011",
+                        "argument to a %s is %s — Python-side structure/"
+                        "values at a compiled boundary feed the trace "
+                        "cache key or fail tracing outright: a varying "
+                        "pytree structure re-traces per shape, an "
+                        "unhashable value breaks any static-arg "
+                        "position, non-numeric leaves raise at trace "
+                        "time, and the AOT/export pipeline bakes each "
+                        "distinct value into its own compiled artifact "
+                        "(the compile serving p99 pays); pass arrays "
+                        "(jnp.asarray) or one fixed per-process "
+                        "constant" % (boundary, why))
+    # (b) data-dependent Python branching inside traced functions
+    traced = index.traced_functions()
+    for key in sorted(traced):
+        fn = index.functions.get(key)
+        if fn is None:
+            continue
+        params = set(fn.params_no_self)
+        if not params:
+            continue
+        for node in _iter_own_nodes(fn.node):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            offender = _branch_offender(node.test, params)
+            if offender:
+                yield _finding(
+                    fn, node, "R011",
+                    "%s runs under a jax trace (reached from a jit "
+                    "boundary) but branches on its argument %r in Python "
+                    "— each concrete value traces a new program variant "
+                    "(or raises TracerBoolConversionError); use lax.cond/"
+                    "jnp.where, or hoist the decision out of the traced "
+                    "function" % (fn.key, offender))
+
+
+# ----------------------------------------------------- call-graph-aware R001
+def _is_hot(key):
+    return any(fnmatch.fnmatch(key, pat) for pat in HOT_PATH_PATTERNS)
+
+
+@project_rule("R001", "host-device sync in a helper called from a hot path")
+def r001_interprocedural(index):
+    seen = set()
+    for key in sorted(index.functions):
+        fn = index.functions[key]
+        if not _is_hot(fn.key):
+            continue
+        for callee_key, node, _held in fn.calls:
+            callee = index.functions.get(callee_key) \
+                if callee_key else None
+            if callee is None or _is_hot(callee.key):
+                continue        # inline hits are the per-file rule's job
+            for what, snode in callee.syncs:
+                mark = (callee.key, snode.lineno, snode.col_offset)
+                if mark in seen:
+                    continue
+                seen.add(mark)
+                yield _finding(
+                    callee, snode, "R001",
+                    "%s inside %r, which hot path %r calls (line %d) — "
+                    "the sync hides one call level down but still blocks "
+                    "the dispatching thread on a device transfer; keep "
+                    "the helper lazy or move the materialization off the "
+                    "hot path" % (what, callee.key, fn.key, node.lineno))
+
+
+# ------------------------------------------------------------- orchestration
+def run_project_rules(index, only_rules=None):
+    findings = []
+    for rule_id in sorted(PROJECT_RULES):
+        if only_rules and rule_id not in only_rules:
+            continue
+        _title, pass_fn = PROJECT_RULES[rule_id]
+        findings.extend(pass_fn(index))
+    return findings
+
+
+def analyze(paths, root=None, only_rules=None, profiled=True):
+    """The full two-phase run: per-file rules (path-profiled), then the
+    whole-program index + interprocedural passes over the full-profile
+    files, with per-line suppressions applied to both. Returns the
+    combined, sorted finding list (pre-baseline)."""
+    from .core import iter_py_files
+    root = root or REPO_ROOT
+    # materialize the tree walk ONCE; both phases accept file lists
+    files = list(iter_py_files(paths))
+    findings = lint_paths(files, root=root, only_rules=only_rules,
+                          profiled=profiled)
+    if only_rules is None or (set(only_rules) & set(PROJECT_RULES)):
+        index = build_index(files, root)
+        proj = run_project_rules(index, only_rules=only_rules)
+        ctxs = {m.relpath: m.ctx for m in index.modules.values()}
+        findings.extend(filter_suppressed(proj, ctxs))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

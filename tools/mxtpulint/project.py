@@ -1,0 +1,1223 @@
+"""Phase 1 of the whole-program analyzer: the project index.
+
+Per-file rules (rules.py) see one AST at a time; the properties that
+actually kill an XLA-era framework in production — lock-order inversions
+between threads started in different modules, unlocked state shared with
+a worker loop, Python values that silently retrigger a trace — are
+*whole-program* facts. This module builds the index the interprocedural
+passes (interproc.py) run over:
+
+- **module symbol tables** with import/alias resolution (``import x as
+  y``, ``from .m import f as g``, relative levels) across the package,
+- **a call graph**: calls resolved through imports, module symbols,
+  nested defs, ``self`` method resolution (including base classes and
+  ``self.attr`` instances whose class is known from ``__init__``), and
+  locally-typed variables (``entry = _ModelEntry(...)``),
+- **per-function summaries**: locks acquired (with the set of locks
+  already *held* at each acquisition — the deadlock edge), threads/timers
+  spawned and their resolved targets, attributes read/written on
+  ``self``/classes/module globals (with the locks held at each access),
+  host-device sync sites, and jit-boundary facts (functions handed to
+  ``jax.jit``-family wrappers, names bound to jitted callables or
+  ``TrainStep``/``EvalStep`` instances, and their call sites).
+
+Everything is still stdlib ``ast`` — no imports of the analyzed code, so
+the index phase can run on a box with no jax at all. Precision limits are
+deliberate and documented in docs/STATIC_ANALYSIS.md: no closures-as-data
+tracking, no return-type inference, mutations via method calls
+(``d.pop``, ``l.append``) are not writes. The passes are tuned so those
+limits cost recall, never precision.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import get_context, iter_py_files, rules_for_path, terminal_name
+
+__all__ = ["ProjectIndex", "ModuleInfo", "ClassInfo", "FunctionInfo",
+           "build_index"]
+
+#: jax transforms whose function argument gets TRACED (calling the result
+#: re-traces on new static/shape keys) — the jit-boundary markers.
+JIT_WRAPPERS = {"jit", "checkpoint", "value_and_grad", "grad", "vmap",
+                "pmap"}
+
+#: constructors whose instances are compiled-step callables: calling one
+#: goes through a shape/dtype-keyed executable cache.
+STEP_CLASSES = {"TrainStep", "EvalStep"}
+
+_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
+               "Condition"}
+_EVENT_CTORS = {"Event"}
+
+
+class ModuleInfo:
+    __slots__ = ("relpath", "modkey", "dotted", "ctx", "functions",
+                 "classes", "imports", "global_kinds", "globals_",
+                 "global_lock_aliases", "global_reentrant",
+                 "boundary_globals", "jit_marks_global")
+
+    def __init__(self, relpath, modkey, dotted, ctx):
+        self.relpath = relpath
+        self.modkey = modkey            # relpath minus .py (rule key form)
+        self.dotted = dotted            # import name
+        self.ctx = ctx
+        self.functions = {}             # top-level name -> FunctionInfo
+        self.classes = {}               # name -> ClassInfo
+        self.imports = {}               # local name -> ("module", dotted)
+        #                                 | ("symbol", mod_dotted, symbol)
+        self.global_kinds = {}          # module-level name -> kind string
+        self.globals_ = set()           # every module-level assigned name
+        self.global_lock_aliases = {}   # Condition(_lock) -> root name
+        self.global_reentrant = set()   # RLock()/argless Condition() names
+        self.boundary_globals = {}      # module-level jitted/step names
+        self.jit_marks_global = set()   # fn keys jitted at module scope
+
+
+class ClassInfo:
+    __slots__ = ("name", "key", "module", "node", "base_names", "bases",
+                 "methods", "attr_types", "lock_attrs", "reentrant_attrs",
+                 "sync_attrs", "step_attrs")
+
+    def __init__(self, name, key, module, node):
+        self.name = name
+        self.key = key                  # "modkey:Class"
+        self.module = module
+        self.node = node
+        self.base_names = []            # raw base expressions (dump later)
+        self.bases = []                 # resolved ClassInfo list
+        self.methods = {}               # name -> FunctionInfo
+        self.attr_types = {}            # self.X = ClassName() -> ClassInfo
+        self.lock_attrs = {}            # attr -> canonical root attr
+        self.reentrant_attrs = set()    # RLock()/argless Condition() attrs
+        self.sync_attrs = set()         # Events/locals/queues: not state
+        self.step_attrs = set()         # self.X = TrainStep()/EvalStep()
+
+    def resolve_method(self, name, _seen=None):
+        """Method resolution on ``self``: own methods, then base classes
+        (depth-first over project-resolved bases)."""
+        if name in self.methods:
+            return self.methods[name]
+        _seen = _seen or set()
+        _seen.add(self.key)
+        for base in self.bases:
+            if base.key in _seen:
+                continue
+            m = base.resolve_method(name, _seen)
+            if m is not None:
+                return m
+        return None
+
+    def resolve_attr_type(self, attr):
+        if attr in self.attr_types:
+            return self.attr_types[attr]
+        for base in self.bases:
+            t = base.resolve_attr_type(attr)
+            if t is not None:
+                return t
+        return None
+
+    def lock_root(self, attr):
+        """Canonical attr for a lock attr (Condition(self._lock) aliases
+        back onto _lock); None when ``attr`` is not a lock."""
+        seen = set()
+        while attr in self.lock_attrs and attr not in seen:
+            seen.add(attr)
+            root = self.lock_attrs[attr]
+            if root == attr:
+                return attr
+            attr = root
+        return attr if attr in self.lock_attrs or attr in seen else None
+
+
+class FunctionInfo:
+    __slots__ = ("key", "qualname", "node", "module", "cls", "params",
+                 "is_init", "calls", "acquires", "syncs", "state_writes",
+                 "state_reads", "thread_targets", "jit_param_names",
+                 "jit_marks", "jit_callsites", "nested", "parent",
+                 "imports", "locals_", "global_decls")
+
+    def __init__(self, key, qualname, node, module, cls):
+        self.key = key                  # "modkey:Qual.name"
+        self.qualname = qualname
+        self.node = node
+        self.module = module
+        self.cls = cls                  # ClassInfo or None
+        args = node.args
+        self.params = [a.arg for a in
+                       getattr(args, "posonlyargs", []) + args.args]
+        self.is_init = cls is not None and node.name == "__init__"
+        self.calls = []                 # (callee_key|None, node, held)
+        self.acquires = []              # (held_tuple, lock_id, node)
+        self.syncs = []                 # (what, node)
+        self.state_writes = []          # (state_key, node, held)
+        self.state_reads = []           # (state_key, node, held)
+        self.thread_targets = []        # resolved fn keys
+        self.jit_param_names = set()    # params this fn passes to jax.jit
+        self.jit_marks = set()          # fn keys this fn passes to jax.jit
+        self.jit_callsites = []         # (call_node, kind)
+        self.nested = {}                # name -> fn key (direct children)
+        self.parent = None              # enclosing function's key, if any
+        self.imports = {}               # function-scoped deferred imports
+        self.locals_ = set()
+        self.global_decls = set()
+
+    @property
+    def params_no_self(self):
+        if self.cls is not None and self.params \
+                and self.params[0] in ("self", "cls"):
+            return self.params[1:]
+        return self.params
+
+
+def _module_dotted(relpath):
+    rel = relpath.replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    parts = rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _ctor_kind(value):
+    """Classify a module/attr-level RHS: lock/event/tlocal/call/const."""
+    if isinstance(value, ast.Call):
+        name = terminal_name(value.func)
+        if name in _LOCK_CTORS:
+            return "lock"
+        if name in _EVENT_CTORS:
+            return "event"
+        if name == "local" or (isinstance(value.func, ast.Attribute)
+                               and value.func.attr == "local"):
+            return "tlocal"
+        return "call"
+    if isinstance(value, ast.Constant):
+        return "const"
+    return "other"
+
+
+class ProjectIndex:
+    """The whole-program index: modules + classes + functions + the
+    resolved call graph, ready for the interprocedural passes."""
+
+    def __init__(self, root):
+        self.root = root
+        self.modules = {}               # relpath -> ModuleInfo
+        self.by_dotted = {}             # dotted -> ModuleInfo
+        self.functions = {}             # fn key -> FunctionInfo
+        self.classes = {}               # class key -> ClassInfo
+        self._reach_cache = None
+        self._translock_cache = {}
+        self._callers_cache = None
+
+    # ------------------------------------------------------------ building
+    def add_module(self, ctx):
+        relpath = ctx.relpath
+        mod = ModuleInfo(relpath, ctx.modkey, _module_dotted(relpath), ctx)
+        self.modules[relpath] = mod
+        self.by_dotted[mod.dotted] = mod
+        self._scan_symbols(mod)
+        return mod
+
+    def _scan_symbols(self, mod):
+        tree = mod.ctx.tree
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._scan_import(mod, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pass                    # functions enumerated below
+            elif isinstance(node, ast.ClassDef):
+                key = "%s:%s" % (mod.modkey, node.name)
+                cls = ClassInfo(node.name, key, mod, node)
+                cls.base_names = list(node.bases)
+                mod.classes[node.name] = cls
+                self.classes[key] = cls
+                # class-BODY sync objects: `class C: _lock = Lock()` is
+                # as real a lock as one assigned in __init__
+                for stmt in node.body:
+                    if not (isinstance(stmt, ast.Assign)
+                            and isinstance(stmt.value, ast.Call)):
+                        continue
+                    kind = _ctor_kind(stmt.value)
+                    ctor = terminal_name(stmt.value.func)
+                    for t in stmt.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if kind == "lock":
+                            cls.lock_attrs[t.id] = t.id
+                            if ctor == "RLock" \
+                                    or (ctor == "Condition"
+                                        and not stmt.value.args) \
+                                    or ctor in ("Semaphore",
+                                                "BoundedSemaphore"):
+                                cls.reentrant_attrs.add(t.id)
+                        elif kind in ("event", "tlocal"):
+                            cls.sync_attrs.add(t.id)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                value = getattr(node, "value", None)
+                kind = _ctor_kind(value) if value is not None else "other"
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        mod.globals_.add(t.id)
+                        mod.global_kinds[t.id] = kind
+                        if kind == "lock" and isinstance(value, ast.Call):
+                            ctor = terminal_name(value.func)
+                            if ctor == "Condition" and value.args \
+                                    and isinstance(value.args[0], ast.Name):
+                                mod.global_lock_aliases[t.id] = \
+                                    value.args[0].id
+                            elif ctor == "RLock" \
+                                    or (ctor == "Condition"
+                                        and not value.args) \
+                                    or ctor in ("Semaphore",
+                                                "BoundedSemaphore"):
+                                # reentrant (an argless Condition wraps a
+                                # fresh RLock) — or a semaphore, whose
+                                # capacity legally admits re-acquire
+                                mod.global_reentrant.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for elt in t.elts:
+                            if isinstance(elt, ast.Name):
+                                mod.globals_.add(elt.id)
+                                mod.global_kinds[elt.id] = "other"
+        # every function def in the file becomes a FunctionInfo
+        for fnode, qual in mod.ctx.qualnames.items():
+            cls = None
+            for anc in mod.ctx.ancestors(fnode):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if isinstance(anc, ast.ClassDef):
+                    cls = mod.classes.get(anc.name)
+                    break
+            key = "%s:%s" % (mod.modkey, qual)
+            info = FunctionInfo(key, qual, fnode, mod, cls)
+            self.functions[key] = info
+            if "." not in qual:
+                mod.functions[fnode.name] = info
+            if cls is not None and qual == "%s.%s" % (cls.name, fnode.name):
+                cls.methods[fnode.name] = info
+        # direct nested defs (for name resolution inside the parent)
+        for key, info in list(self.functions.items()):
+            if not key.startswith(mod.modkey + ":"):
+                continue
+            for child in ast.walk(info.node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and child is not info.node:
+                    cqual = mod.ctx.qualnames.get(child)
+                    if cqual == info.qualname + "." + child.name:
+                        ckey = "%s:%s" % (mod.modkey, cqual)
+                        info.nested[child.name] = ckey
+                        if ckey in self.functions:
+                            self.functions[ckey].parent = info.key
+            # function-level (deferred) imports — the codebase's standard
+            # import-cycle-avoidance idiom (`from .. import config`
+            # inside a function) — bind FUNCTION-scoped aliases: merging
+            # them module-wide would let two functions importing
+            # different symbols under one local name mis-resolve each
+            # other's calls (fabricated edges = false R009/R010/R011)
+            stack = list(info.node.body)
+            while stack:
+                child = stack.pop()
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue            # nested fns collect their own
+                if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    info.imports.update(self._import_bindings(mod, child))
+                stack.extend(ast.iter_child_nodes(child))
+
+    def _scan_import(self, mod, node):
+        mod.imports.update(self._import_bindings(mod, node))
+
+    def _import_bindings(self, mod, node):
+        """{local name -> import entry} for one Import/ImportFrom node,
+        with relative levels resolved against the file's package."""
+        out = {}
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                out[local] = ("module", target)
+            return out
+        pkg = mod.dotted.split(".")
+        if not mod.relpath.endswith("__init__.py"):
+            pkg = pkg[:-1]
+        if node.level:
+            base = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 \
+                else pkg
+            prefix = ".".join(base)
+            target_mod = prefix + ("." + node.module if node.module else "")
+        else:
+            target_mod = node.module or ""
+        for alias in node.names:
+            local = alias.asname or alias.name
+            sub = (target_mod + "." + alias.name) if target_mod \
+                else alias.name
+            # `from pkg import sub` where sub is a module of the project
+            # binds the module; otherwise it binds a symbol
+            out[local] = ("maybe_module", target_mod, alias.name, sub)
+        return out
+
+    def _finalize_table(self, table):
+        for local, entry in list(table.items()):
+            if entry[0] != "maybe_module":
+                continue
+            _kind, target_mod, name, sub = entry
+            if sub in self.by_dotted:
+                table[local] = ("module", sub)
+            else:
+                table[local] = ("symbol", target_mod, name)
+
+    def finalize_imports(self):
+        """Second pass once every module is registered: decide whether a
+        ``from pkg import name`` bound a submodule or a symbol (for the
+        module tables AND every function-scoped table), and resolve
+        class bases."""
+        for mod in self.modules.values():
+            self._finalize_table(mod.imports)
+        for fn in self.functions.values():
+            self._finalize_table(fn.imports)
+        for cls in self.classes.values():
+            for base in cls.base_names:
+                resolved = self._resolve_class_expr(cls.module, base)
+                if resolved is not None:
+                    cls.bases.append(resolved)
+
+    def _lookup_fn_import(self, fn, name):
+        """Function-scoped import binding for ``name``, walking the
+        enclosing-function chain (a nested def sees its parents'
+        deferred imports). Module-level imports are NOT consulted here —
+        they sit later in the resolution order, after local shadowing."""
+        cur = fn
+        while cur is not None:
+            if name in cur.imports:
+                return cur.imports[name]
+            cur = self.functions.get(cur.parent) if cur.parent else None
+        return None
+
+    def _resolve_class_expr(self, mod, expr):
+        if isinstance(expr, ast.Name):
+            if expr.id in mod.classes:
+                return mod.classes[expr.id]
+            imp = mod.imports.get(expr.id)
+            if imp and imp[0] == "symbol":
+                m = self.by_dotted.get(imp[1])
+                if m is not None:
+                    return m.classes.get(imp[2])
+        elif isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            imp = mod.imports.get(expr.value.id)
+            if imp and imp[0] == "module":
+                m = self.by_dotted.get(imp[1])
+                if m is not None:
+                    return m.classes.get(expr.attr)
+        return None
+
+    def _jit_decorator(self, mod, fn_info):
+        """Is this function decorated into a jit boundary? Handles
+        ``@jax.jit``, ``@jit`` (imported from jax), and the
+        ``@partial(jax.jit, ...)`` / ``@jax.jit(...)`` call forms —
+        the most common jit spelling of all."""
+        for dec in fn_info.node.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):
+                ext = self.resolve_external(mod, dec.func)
+                if ext.endswith(".partial") and dec.args:
+                    target = dec.args[0]    # partial(jax.jit, ...)
+                else:
+                    target = dec.func       # jax.jit(static_argnums=...)
+            ext = self.resolve_external(mod, target)
+            if ext.startswith("jax.") and ext.split(".")[-1] in JIT_WRAPPERS:
+                return True
+        return False
+
+    def scan_module_boundaries(self):
+        """Module-scope jit boundaries (after imports finalize):
+        ``_jitted = jax.jit(model)`` / ``_step = EvalStep(net)`` at
+        module level, and ``@jax.jit``-decorated functions, make calls
+        through that NAME boundary call sites and the wrapped function
+        traced — the common serving idioms."""
+        for fn in self.functions.values():
+            if self._jit_decorator(fn.module, fn):
+                fn.module.jit_marks_global.add(fn.key)
+                if "." not in fn.qualname:      # module-level name
+                    fn.module.boundary_globals[fn.node.name] = "jit"
+        for mod in self.modules.values():
+            for node in mod.ctx.tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                name = node.targets[0].id
+                value = node.value
+                ext = self.resolve_external(mod, value.func)
+                if ext.startswith("jax.") \
+                        and ext.split(".")[-1] in JIT_WRAPPERS:
+                    mod.boundary_globals[name] = "jit"
+                    if value.args and isinstance(value.args[0], ast.Name):
+                        target = self.resolve_call_target(
+                            mod, None, value.args[0], {})
+                        if isinstance(target, FunctionInfo):
+                            mod.jit_marks_global.add(target.key)
+                    continue
+                target = self.resolve_call_target(mod, None, value.func,
+                                                  {})
+                if isinstance(target, ClassInfo) and (
+                        target.name in STEP_CLASSES
+                        or any(b.name in STEP_CLASSES
+                               for b in target.bases)):
+                    mod.boundary_globals[name] = "step"
+
+    def scan_class_attrs(self):
+        """self.X = <ctor> scans across every method: attribute types,
+        lock/event attrs (with Condition aliasing), step-callable attrs."""
+        for cls in self.classes.values():
+            for info in cls.methods.values():
+                for node in ast.walk(info.node):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    t = node.targets[0]
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    value = node.value
+                    # `self.x = a if cond else b`: classify both arms
+                    values = [value.body, value.orelse] \
+                        if isinstance(value, ast.IfExp) else [value]
+                    for v in values:
+                        self._classify_self_attr(cls, info.module, t.attr, v)
+
+    def _classify_self_attr(self, cls, mod, attr, value):
+        kind = _ctor_kind(value)
+        if kind == "lock":
+            root = attr
+            ctor = terminal_name(value.func) \
+                if isinstance(value, ast.Call) else ""
+            if ctor == "Condition" and value.args \
+                    and isinstance(value.args[0], ast.Attribute) \
+                    and isinstance(value.args[0].value, ast.Name) \
+                    and value.args[0].value.id == "self":
+                root = value.args[0].attr
+            elif ctor == "RLock" \
+                    or (ctor == "Condition" and not value.args) \
+                    or ctor in ("Semaphore", "BoundedSemaphore"):
+                cls.reentrant_attrs.add(attr)
+            cls.lock_attrs[attr] = root
+        elif kind in ("event", "tlocal"):
+            cls.sync_attrs.add(attr)
+        elif isinstance(value, ast.Call):
+            target = self.resolve_call_target(mod, None, value.func, {})
+            if isinstance(target, ClassInfo):
+                cls.attr_types[attr] = target
+                if target.name in STEP_CLASSES or any(
+                        b.name in STEP_CLASSES for b in target.bases):
+                    cls.step_attrs.add(attr)
+            name = terminal_name(value.func)
+            if name in ("Queue", "LifoQueue", "PriorityQueue", "deque"):
+                cls.sync_attrs.add(attr)
+
+    # --------------------------------------------------------- resolution
+    def _resolve_import_entry(self, imp):
+        """Import entry -> FunctionInfo/ClassInfo for a symbol binding
+        (a bare module binding is not callable -> None)."""
+        if imp and imp[0] == "symbol":
+            m = self.by_dotted.get(imp[1])
+            if m is not None:
+                return m.functions.get(imp[2]) or m.classes.get(imp[2])
+        return None
+
+    def resolve_call_target(self, mod, fn, func, local_types):
+        """Resolve a call's func expression to a FunctionInfo, ClassInfo,
+        or None. ``fn`` may be None (class-attr pre-scan)."""
+        if isinstance(func, ast.Name):
+            name = func.id
+            if fn is not None and name in fn.nested:
+                return self.functions.get(fn.nested[name])
+            if name in local_types:
+                t = local_types[name]
+                if isinstance(t, ClassInfo):
+                    return t.resolve_method("__call__")
+            if fn is not None:
+                # function-scoped deferred imports bind tighter than any
+                # module symbol (and than other functions' imports)
+                imp = self._lookup_fn_import(fn, name)
+                if imp is not None:
+                    return self._resolve_import_entry(imp)
+            # a parameter or plain local SHADOWS any sibling/module
+            # symbol of the same name — resolving `def run(flush):
+            # flush()` to a module-level flush() fabricates edges that
+            # poison R009/R010/R011 ("a resolved edge is real" contract)
+            if fn is not None and name in fn.locals_ \
+                    and name not in fn.global_decls:
+                return None
+            if fn is not None:
+                # siblings through the enclosing chain (inner1 calling
+                # inner2, both defined in the same outer — the
+                # worker-closure idiom)
+                cur = self.functions.get(fn.parent) if fn.parent else None
+                while cur is not None:
+                    if name in cur.nested:
+                        return self.functions.get(cur.nested[name])
+                    cur = self.functions.get(cur.parent) \
+                        if cur.parent else None
+            if name in mod.functions:
+                return mod.functions[name]
+            if name in mod.classes:
+                return mod.classes[name]
+            return self._resolve_import_entry(mod.imports.get(name))
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            attr = func.attr
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fn is not None \
+                        and fn.cls is not None:
+                    m = fn.cls.resolve_method(attr)
+                    if m is not None:
+                        return m
+                    t = fn.cls.resolve_attr_type(attr)
+                    if t is not None:   # self.step(...) on a typed attr
+                        return t.resolve_method("__call__")
+                    return None
+                imp = None
+                if fn is not None:
+                    imp = self._lookup_fn_import(fn, base.id)
+                if imp is None:
+                    imp = mod.imports.get(base.id)
+                if imp and imp[0] == "module":
+                    m = self.by_dotted.get(imp[1])
+                    if m is not None:
+                        return m.functions.get(attr) or m.classes.get(attr)
+                t = local_types.get(base.id)
+                if isinstance(t, ClassInfo):
+                    return t.resolve_method(attr)
+                if base.id in mod.classes:
+                    return mod.classes[base.id].resolve_method(attr)
+                return None
+            # self.attr.method(...) via a typed instance attribute
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" \
+                    and fn is not None and fn.cls is not None:
+                t = fn.cls.resolve_attr_type(base.attr)
+                if t is not None:
+                    return t.resolve_method(attr)
+        return None
+
+    def resolve_external(self, mod, func, fn=None):
+        """Dotted EXTERNAL name of a call target through import aliases
+        ('time.time', 'jax.jit', ...), or '' when unknown/project-local.
+        Function-scoped deferred imports bind tighter than module ones."""
+        if isinstance(func, ast.Name):
+            imp = (self._lookup_fn_import(fn, func.id)
+                   if fn is not None else None) \
+                or mod.imports.get(func.id)
+            if imp and imp[0] == "symbol" and imp[1] not in self.by_dotted:
+                return "%s.%s" % (imp[1], imp[2])
+            return ""
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            imp = (self._lookup_fn_import(fn, func.value.id)
+                   if fn is not None else None) \
+                or mod.imports.get(func.value.id)
+            if imp and imp[0] == "module" and imp[1] not in self.by_dotted:
+                return "%s.%s" % (imp[1], func.attr)
+        return ""
+
+    def canonical_lock(self, mod, fn, expr, local_types):
+        """Canonical shared-lock id for an expression, or None.
+        Module-level locks -> 'modkey::name'; instance locks ->
+        'modkey::Class.attr' (type-level: one id per class attr)."""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if fn is not None and name in fn.locals_ \
+                    and name not in fn.global_decls:
+                return None             # function-local lock: not shared
+            seen = set()
+            while name in mod.global_lock_aliases and name not in seen:
+                seen.add(name)
+                name = mod.global_lock_aliases[name]
+            if mod.global_kinds.get(name) == "lock":
+                return "%s::%s" % (mod.modkey, name)
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and fn is not None and fn.cls is not None:
+                cls, cur = fn.cls, fn.cls
+                root = cur.lock_root(attr)
+                if root is None:
+                    for b in cur.bases:
+                        root = b.lock_root(attr)
+                        if root is not None:
+                            cls = b
+                            break
+                if root is not None:
+                    return "%s:%s.%s" % (cls.module.modkey, cls.name, root)
+                return None
+            imp = (self._lookup_fn_import(fn, base)
+                   if fn is not None else None) or mod.imports.get(base)
+            if imp and imp[0] == "module":
+                m = self.by_dotted.get(imp[1])
+                if m is not None and m.global_kinds.get(attr) == "lock":
+                    name, seen = attr, set()
+                    while name in m.global_lock_aliases and name not in seen:
+                        seen.add(name)
+                        name = m.global_lock_aliases[name]
+                    return "%s::%s" % (m.modkey, name)
+            t = local_types.get(base)
+            if isinstance(t, ClassInfo):
+                root = t.lock_root(attr)
+                if root is not None:
+                    return "%s:%s.%s" % (t.module.modkey, t.name, root)
+            # ClassName._lock: a class-level lock taken through the class
+            cls = mod.classes.get(base)
+            if cls is None and imp and imp[0] == "symbol":
+                m = self.by_dotted.get(imp[1])
+                if m is not None:
+                    cls = m.classes.get(imp[2])
+            if cls is not None:
+                root = cls.lock_root(attr)
+                if root is not None:
+                    return "%s:%s.%s" % (cls.module.modkey, cls.name, root)
+        return None
+
+    # ---------------------------------------------------------- reachability
+    def thread_entries(self):
+        """fn keys spawned as Thread targets / Timer callbacks anywhere."""
+        out = set()
+        for fn in self.functions.values():
+            out.update(fn.thread_targets)
+        return out
+
+    def thread_reach(self):
+        """{fn_key: frozenset(entry keys that can reach it on a spawned
+        thread)} over the resolved call graph."""
+        if self._reach_cache is not None:
+            return self._reach_cache
+        edges = {}
+        for fn in self.functions.values():
+            edges[fn.key] = {c for c, _n, _h in fn.calls if c is not None}
+        reach = {}
+        for entry in sorted(self.thread_entries()):
+            stack, seen = [entry], set()
+            while stack:
+                cur = stack.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                reach.setdefault(cur, set()).add(entry)
+                stack.extend(edges.get(cur, ()))
+        self._reach_cache = {k: frozenset(v) for k, v in reach.items()}
+        return self._reach_cache
+
+    def locks_acquired_transitive(self, fn_key):
+        """Every canonical lock acquired by ``fn_key`` or (resolved)
+        callees, any depth — the RHS of a held-while-calling deadlock
+        edge. Computed as a whole-graph fixpoint (lock sets only grow,
+        so it converges), NOT per-function memoized recursion: a cycle
+        guard's partial result must never be cached as final, or mutual
+        recursion silently under-approximates and R009 misses real
+        deadlocks."""
+        if not self._translock_cache:
+            sets = {}
+            callees = {}
+            for key, fn in self.functions.items():
+                sets[key] = {lock for _held, lock, _n in fn.acquires}
+                callees[key] = {c for c, _n, _h in fn.calls
+                                if c is not None and c in self.functions}
+            changed = True
+            while changed:
+                changed = False
+                for key in sets:
+                    merged = sets[key]
+                    for c in callees[key]:
+                        extra = sets[c] - merged
+                        if extra:
+                            merged |= extra
+                            changed = True
+            self._translock_cache = {k: frozenset(v)
+                                     for k, v in sets.items()}
+        return self._translock_cache.get(fn_key, frozenset())
+
+    def reentrant_locks(self):
+        """Canonical ids of REENTRANT locks (RLock, argless Condition):
+        re-acquiring one while held is legal, so R009 must not report
+        their self-edges as 1-cycle deadlocks. Order inversions between
+        two locks deadlock regardless of reentrancy and stay reported."""
+        out = set()
+        for mod in self.modules.values():
+            for name in mod.global_reentrant:
+                out.add("%s::%s" % (mod.modkey, name))
+        for cls in self.classes.values():
+            for attr in cls.reentrant_attrs:
+                if cls.lock_root(attr) == attr:
+                    out.add("%s:%s.%s" % (cls.module.modkey, cls.name,
+                                          attr))
+        return out
+
+    def callers(self):
+        """{fn_key: set(keys of functions with a resolved call to it)} —
+        the reverse call graph (Thread spawns are NOT call edges: the
+        spawner runs on its own thread, the target on the new one)."""
+        if self._callers_cache is None:
+            out = {}
+            for key, fn in self.functions.items():
+                for callee, _n, _h in fn.calls:
+                    if callee is not None:
+                        out.setdefault(callee, set()).add(key)
+            self._callers_cache = out
+        return self._callers_cache
+
+    def traced_functions(self):
+        """fn keys whose bodies run under a jax trace: passed to a
+        jax.jit-family wrapper directly, via a callee's jitted parameter,
+        or (transitively) called from such a function."""
+        traced = set()
+        for mod in self.modules.values():
+            traced |= mod.jit_marks_global
+        for fn in self.functions.values():
+            traced |= fn.jit_marks
+            # interprocedural: an argument passed into a callee's
+            # jit-wrapped parameter position gets traced too
+            for callee, node, _h in fn.calls:
+                cal = self.functions.get(callee) if callee else None
+                if cal is None or not cal.jit_param_names:
+                    continue
+                pns = cal.params_no_self
+                for i, arg in enumerate(node.args):
+                    if i < len(pns) and pns[i] in cal.jit_param_names \
+                            and isinstance(arg, ast.Name):
+                        target = self.resolve_call_target(
+                            fn.module, fn, arg, {})
+                        if isinstance(target, FunctionInfo):
+                            traced.add(target.key)
+        # close over calls made from traced functions
+        stack = list(traced)
+        while stack:
+            cur = stack.pop()
+            fn = self.functions.get(cur)
+            if fn is None:
+                continue
+            for callee, _n, _h in fn.calls:
+                if callee is not None and callee not in traced:
+                    target = self.functions.get(callee)
+                    if isinstance(target, FunctionInfo):
+                        traced.add(callee)
+                        stack.append(callee)
+        return traced
+
+
+def _terminates(body):
+    """Does this block end by leaving the enclosing flow (return/raise/
+    break/continue)? Used for guard-style early exits."""
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise,
+                                                ast.Break, ast.Continue))
+
+
+# --------------------------------------------------------------- body walk
+class _FunctionWalker:
+    """One pass over a function body: held-lock tracking + summary
+    collection + call resolution."""
+
+    def __init__(self, index, fn):
+        self.index = index
+        self.fn = fn
+        self.mod = fn.module
+        self.local_types = {}           # name -> ClassInfo
+        self._collect_locals()
+
+    @staticmethod
+    def _binding_names(target):
+        """Names a target expression BINDS: plain names and tuple/star
+        unpacks only — a Subscript/Attribute store mutates an object, it
+        does not create a local."""
+        if isinstance(target, ast.Name):
+            yield target.id
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                yield from _FunctionWalker._binding_names(elt)
+        elif isinstance(target, ast.Starred):
+            yield from _FunctionWalker._binding_names(target.value)
+
+    def _collect_locals(self):
+        fn = self.fn
+        fn.locals_.update(fn.params)
+        args = fn.node.args
+        fn.locals_.update(a.arg for a in args.kwonlyargs)
+        if args.vararg:
+            fn.locals_.add(args.vararg.arg)
+        if args.kwarg:
+            fn.locals_.add(args.kwarg.arg)
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Global):
+                fn.global_decls.update(node.names)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn.node:
+                fn.locals_.add(node.name)
+            elif isinstance(node, ast.comprehension):
+                fn.locals_.update(self._binding_names(node.target))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                   ast.For, ast.AsyncFor)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    fn.locals_.update(self._binding_names(t))
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        fn.locals_.update(
+                            self._binding_names(item.optional_vars))
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                fn.locals_.add(node.name)
+        fn.locals_ -= fn.global_decls
+
+    # ---------------------------------------------------------------- run
+    def run(self):
+        self.visit_block(self.fn.node.body, [])
+
+    @staticmethod
+    def _apply_transitions(transitions, held):
+        """Fold '+lock'/'-lock' transitions from bare acquire()/release()
+        calls into the MUTABLE held list."""
+        for t in transitions:
+            if t.startswith("-"):
+                try:
+                    held.remove(t[1:])
+                except ValueError:
+                    pass
+            elif t not in held:
+                held.append(t)
+
+    def visit_block(self, stmts, held):
+        """``held`` is a MUTABLE list shared with the enclosing linear
+        control flow: bare acquire()/release() transitions must
+        propagate across If/For/While/Try nesting — the canonical
+        `lock.acquire(); try: ... finally: lock.release()` form spans
+        three nesting levels, and the timed `if lock.acquire(timeout=):`
+        form acquires inside a test. Only `with`-scoped locks are
+        block-local (the with-exit releases them)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue                # separate FunctionInfo / scope
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # items acquire LEFT TO RIGHT: `with a, b:` holds a while
+                # acquiring b, exactly like the nested spelling — each
+                # item records the ACCUMULATED held set, not the
+                # pre-statement one, or the a->b deadlock edge vanishes
+                body_held = list(held)
+                with_locks = []
+                for item in stmt.items:
+                    self._apply_transitions(
+                        self.scan_expr(item.context_expr,
+                                       tuple(body_held)), body_held)
+                    lock = self.index.canonical_lock(
+                        self.mod, self.fn, item.context_expr,
+                        self.local_types)
+                    if lock is not None:
+                        self.fn.acquires.append(
+                            (tuple(body_held), lock, item.context_expr))
+                        body_held.append(lock)
+                        with_locks.append(lock)
+                self.visit_block(stmt.body, body_held)
+                # sync bare transitions made inside the with body back to
+                # the parent flow — minus the with-scoped locks, which
+                # the with-exit releases
+                held[:] = [l for l in held if l in body_held]
+                for l in body_held:
+                    if l not in held and l not in with_locks:
+                        held.append(l)
+            elif isinstance(stmt, ast.If):
+                # the timed `if lock.acquire(timeout=):` form holds the
+                # lock ONLY on the success branch: the plain spelling
+                # guards the body, `if not lock.acquire(...):` guards the
+                # orelse — the failure branch runs WITHOUT the lock, and
+                # treating it as held fabricates deadlock edges
+                trans = self.scan_expr(stmt.test, tuple(held))
+                acq = [t for t in trans if not t.startswith("-")]
+                self._apply_transitions(
+                    [t for t in trans if t.startswith("-")], held)
+                if acq:
+                    succ_held = list(held)
+                    self._apply_transitions(acq, succ_held)
+                    negated = isinstance(stmt.test, ast.UnaryOp) \
+                        and isinstance(stmt.test.op, ast.Not)
+                    if negated:
+                        self.visit_block(stmt.body, list(held))
+                        self.visit_block(stmt.orelse, succ_held)
+                        if _terminates(stmt.body):
+                            # `if not lock.acquire(...): return` — the
+                            # failure path exits, so everything AFTER
+                            # the guard runs with the lock held
+                            self._apply_transitions(acq, held)
+                    else:
+                        self.visit_block(stmt.body, succ_held)
+                        self.visit_block(stmt.orelse, list(held))
+                        if _terminates(stmt.orelse):
+                            self._apply_transitions(acq, held)
+                    # otherwise after the if: not held (the canonical
+                    # timed form releases inside the success branch)
+                else:
+                    self.visit_block(stmt.body, held)
+                    self.visit_block(stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._apply_transitions(
+                    self.scan_expr(stmt.iter, tuple(held)), held)
+                self.scan_expr(stmt.target, tuple(held))
+                self.visit_block(stmt.body, held)
+                self.visit_block(stmt.orelse, held)
+            elif isinstance(stmt, ast.While):
+                self._apply_transitions(
+                    self.scan_expr(stmt.test, tuple(held)), held)
+                self.visit_block(stmt.body, held)
+                self.visit_block(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                self.visit_block(stmt.body, held)
+                for h in stmt.handlers:
+                    self.visit_block(h.body, held)
+                self.visit_block(stmt.orelse, held)
+                self.visit_block(stmt.finalbody, held)
+            else:
+                self._apply_transitions(
+                    self.scan_expr(stmt, tuple(held)), held)
+
+    # ------------------------------------------------------------- scanning
+    def scan_expr(self, root, held):
+        """Scan one statement/expression subtree (nested function and
+        lambda bodies pruned). Returns lock transitions from bare
+        acquire()/release() calls ('+id' appended plain, release as
+        '-id')."""
+        transitions = []
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Call):
+                t = self.handle_call(node, held)
+                if t:
+                    transitions.append(t)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                self.handle_assign(node, held)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                self.handle_name_load(node, held)
+            elif isinstance(node, ast.Attribute):
+                self.handle_attr(node, held)
+            stack.extend(ast.iter_child_nodes(node))
+        return transitions
+
+    def handle_call(self, node, held):
+        func = node.func
+        name = terminal_name(func)
+        # lock transitions for bare acquire/release in straight-line code
+        if isinstance(func, ast.Attribute) and name in ("acquire",
+                                                        "release"):
+            lock = self.index.canonical_lock(self.mod, self.fn, func.value,
+                                             self.local_types)
+            if lock is not None:
+                if name == "acquire":
+                    self.fn.acquires.append((held, lock, node))
+                    return lock
+                return "-" + lock
+        # host-device sync sites (shared definition with per-file R001)
+        if isinstance(func, ast.Attribute) and name in ("asnumpy", "item"):
+            self.fn.syncs.append((".%s()" % name, node))
+        elif isinstance(func, ast.Attribute) and name == "asarray" \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("np", "onp", "numpy"):
+            self.fn.syncs.append(("%s.asarray()" % func.value.id, node))
+        # thread / timer spawns
+        if name in ("Thread", "Timer"):
+            target = None
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    target = kw.value
+            if target is None and name == "Timer" and len(node.args) >= 2:
+                target = node.args[1]
+            if target is not None:
+                resolved = self.index.resolve_call_target(
+                    self.mod, self.fn, target, self.local_types)
+                if isinstance(resolved, FunctionInfo):
+                    self.fn.thread_targets.append(resolved.key)
+        # jax.jit-family wrapper?
+        ext = self.index.resolve_external(self.mod, func, self.fn)
+        if ext.startswith("jax.") and ext.split(".")[-1] in JIT_WRAPPERS \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                if arg.id in self.fn.params:
+                    self.fn.jit_param_names.add(arg.id)
+                else:
+                    target = self.index.resolve_call_target(
+                        self.mod, self.fn, arg, self.local_types)
+                    if isinstance(target, FunctionInfo):
+                        self.fn.jit_marks.add(target.key)
+            # jax.jit(f)(...) immediate-call form: the parent Call is a
+            # boundary site (caught below when the parent is visited)
+        # call-graph edge + boundary call sites
+        callee = self.index.resolve_call_target(self.mod, self.fn, func,
+                                                self.local_types)
+        if isinstance(callee, ClassInfo):
+            init = callee.resolve_method("__init__")
+            self.fn.calls.append((init.key if init else None, node, held))
+        elif isinstance(callee, FunctionInfo):
+            self.fn.calls.append((callee.key, node, held))
+        else:
+            self.fn.calls.append((None, node, held))
+        self._maybe_boundary_callsite(node)
+        return None
+
+    def _maybe_boundary_callsite(self, node):
+        """Is THIS call a jit-boundary invocation (R011's subject)?"""
+        func = node.func
+        kind = None
+        if isinstance(func, ast.Name) \
+                and self.local_types.get(func.id) in ("jit", "step"):
+            kind = self.local_types[func.id]
+        elif isinstance(func, ast.Name) \
+                and func.id not in self.fn.locals_ \
+                and func.id in self.mod.boundary_globals:
+            kind = self.mod.boundary_globals[func.id]
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self" and self.fn.cls is not None \
+                and func.attr in self.fn.cls.step_attrs:
+            kind = "step"
+        elif isinstance(func, ast.Call):
+            ext = self.index.resolve_external(self.mod, func.func, self.fn)
+            if ext.startswith("jax.") \
+                    and ext.split(".")[-1] in JIT_WRAPPERS:
+                kind = "jit"
+        if kind:
+            self.fn.jit_callsites.append((node, kind))
+
+    def handle_assign(self, node, held):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        value = getattr(node, "value", None)
+        aug = isinstance(node, ast.AugAssign)
+        # local type binding: x = ClassName(...) / x = jax.jit(...) /
+        # x = TrainStep(...)-family
+        if isinstance(node, ast.Assign) and isinstance(value, ast.Call) \
+                and len(targets) == 1 and isinstance(targets[0], ast.Name):
+            tname = targets[0].id
+            resolved = self.index.resolve_call_target(
+                self.mod, self.fn, value.func, self.local_types)
+            ext = self.index.resolve_external(self.mod, value.func,
+                                              self.fn)
+            if ext.startswith("jax.") \
+                    and ext.split(".")[-1] in JIT_WRAPPERS:
+                self.local_types[tname] = "jit"
+            elif isinstance(resolved, ClassInfo):
+                if resolved.name in STEP_CLASSES or any(
+                        b.name in STEP_CLASSES for b in resolved.bases):
+                    self.local_types[tname] = "step"
+                else:
+                    self.local_types[tname] = resolved
+        for t in targets:
+            self.handle_store_target(t, node, held, aug)
+
+    def handle_store_target(self, t, node, held, aug):
+        key = self.state_key(t, store=True)
+        if key is not None:
+            self.fn.state_writes.append((key, node, held))
+            if aug:
+                self.fn.state_reads.append((key, node, held))
+
+    def handle_name_load(self, node, held):
+        name = node.id
+        if name in self.fn.locals_:
+            return
+        if name in self.mod.globals_ \
+                and self.mod.global_kinds.get(name) not in ("lock", "event",
+                                                            "tlocal"):
+            self.fn.state_reads.append(
+                (("global", self.mod.modkey, name), node, held))
+
+    def handle_attr(self, node, held):
+        if isinstance(node.ctx, ast.Load):
+            key = self.state_key(node, store=False)
+            if key is not None:
+                self.fn.state_reads.append((key, node, held))
+
+    def state_key(self, t, store):
+        """Shared-state key for a store/load target, or None.
+        ('self', class_key, attr) | ('global', modkey, name)."""
+        fn, mod = self.fn, self.mod
+        if isinstance(t, ast.Name):
+            if not store and t.id in fn.locals_:
+                return None
+            if t.id in fn.global_decls or (not store
+                                           and t.id in mod.globals_):
+                if mod.global_kinds.get(t.id) in ("lock", "event", "tlocal"):
+                    return None
+                if store and t.id not in fn.global_decls:
+                    return None
+                return ("global", mod.modkey, t.id)
+            return None
+        if isinstance(t, ast.Subscript):
+            base = t.value
+            if isinstance(base, ast.Name):
+                if base.id in fn.locals_ and base.id not in fn.global_decls:
+                    return None
+                if base.id in mod.globals_ \
+                        and mod.global_kinds.get(base.id) not in (
+                            "lock", "event", "tlocal"):
+                    return ("global", mod.modkey, base.id)
+                return None
+            if isinstance(base, ast.Attribute):
+                return self.state_key(base, store)
+            return None
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+            base, attr = t.value.id, t.attr
+            if base == "self" and fn.cls is not None:
+                if attr in fn.cls.lock_attrs or attr in fn.cls.sync_attrs:
+                    return None
+                owner = fn.cls
+                for b in fn.cls.bases:
+                    if b.lock_root(attr) is not None \
+                            or attr in b.sync_attrs:
+                        return None
+                return ("self", owner.key, attr)
+            if base in mod.classes:       # ClassName.attr class state
+                cls = mod.classes[base]
+                if cls.lock_root(attr) is not None \
+                        or attr in cls.sync_attrs:
+                    return None           # sync object, not shared state
+                return ("self", cls.key, attr)
+            return None
+        return None
+
+
+# ------------------------------------------------------------------ driver
+def build_index(paths, root):
+    """Build the whole-program index for every FULL-profile .py file under
+    ``paths`` (tools/ and tests/ run the relaxed per-file profile only and
+    are excluded from whole-program analysis). Unparseable files are
+    skipped here — the per-file phase already reports them as E000."""
+    import os as _os
+    index = ProjectIndex(root)
+    for path in iter_py_files(paths):
+        rel = _os.path.relpath(path, root)
+        if rules_for_path(rel) is not None:
+            continue                    # relaxed profile: per-file only
+        try:
+            ctx = get_context(path, root)
+        except (SyntaxError, ValueError, OSError):
+            continue
+        index.add_module(ctx)
+    index.finalize_imports()
+    index.scan_module_boundaries()
+    index.scan_class_attrs()
+    for fn in index.functions.values():
+        _FunctionWalker(index, fn).run()
+    return index

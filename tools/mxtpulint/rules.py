@@ -38,6 +38,12 @@ HOT_PATH_PATTERNS = (
     "*batcher:DynamicBatcher._gather",
     "*batcher:DynamicBatcher._dispatch_batch",
     "*batcher:DynamicBatcher._dispatch_batch_traced",
+    # the registry's version-resolving dispatch closure: it IS the
+    # batcher's _dispatch_fn, but the indirection (a bound method passed
+    # as a callable) is beyond static call-graph resolution — declare it
+    # a hot path explicitly so syncs there are caught inline and one
+    # call level down
+    "*serving/registry:_ModelEntry._dispatch",
 )
 
 _SYNC_ATTRS = ("asnumpy", "item")

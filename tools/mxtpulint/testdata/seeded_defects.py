@@ -1,0 +1,65 @@
+"""Seeded-defect fixture for the whole-program passes — DO NOT FIX.
+
+Three known defects, one per interprocedural pass, kept alive on purpose
+so CI can assert the analyzer still catches them (a lint whose passes
+silently stop firing is worse than no lint):
+
+- a lock-order cycle (R009): ``_locked_ab`` takes A then B, the worker's
+  ``_locked_ba`` takes B then A,
+- an unlocked cross-thread write (R010): the worker bumps ``_progress``
+  while ``read_progress`` reads it with no common lock,
+- a jit retrace hazard (R011): a dict literal argument at a ``jax.jit``
+  call site.
+
+This file lives under tools/, so the REPO gate lints it only under the
+relaxed R003/R005/R006 profile (under which it is clean); the regression
+test and ci/run.sh analyze it with the FULL profile rooted at this
+directory and assert exactly these three findings.
+"""
+import threading
+
+import jax
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+_progress = 0
+
+
+def _locked_ab():
+    with _lock_a:
+        with _lock_b:
+            return 1
+
+
+def _locked_ba():
+    with _lock_b:
+        with _lock_a:       # R009: inverts _locked_ab's order -> deadlock
+            return 2
+
+
+def _worker():
+    global _progress
+    while True:
+        _progress += 1      # R010: unlocked write, read in read_progress
+        _locked_ba()
+
+
+def read_progress():
+    return _progress
+
+
+def start():
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+    _locked_ab()
+    return t
+
+
+def _model(x):
+    return x * 2.0
+
+
+def predict(x):
+    jitted = jax.jit(_model)
+    return jitted(x, {"mode": "fast"})   # R011: fresh dict per call
