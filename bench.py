@@ -174,6 +174,7 @@ def main():
     lc_tok_s = bench_long_context()
     int8_res = bench_int8()
     int8_e2e = bench_quantized_inference()
+    serving_aot = bench_serving_aot()
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(img_s, 2),
@@ -205,6 +206,7 @@ def main():
         },
         "int8": int8_res,
         "int8_e2e": int8_e2e,
+        "serving_aot": serving_aot,
     }))
 
 
@@ -355,6 +357,101 @@ def bench_quantized_inference(batch=256, steps=20):
                     "host+tunnel dispatch on both legs; logit_cos + argmax "
                     "agreement vs the bf16 net are the numeric-sanity "
                     "fields"}
+
+
+def bench_serving_aot():
+    """Serving-latency legs for the AOT executable cache (docs/AOT.md):
+    cold-start-to-first-byte with and without prewarm, and hot-reload p99
+    under concurrent traffic vs steady-state p99 — the numbers BENCH_r06
+    claims (a compile window that moved out of the request path shows up
+    as hot_reload p99 ≈ steady p99 instead of ≈ the compile time)."""
+    import threading
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.serving import ModelRegistry
+    from incubator_mxnet_tpu.serving.metrics import percentile
+
+    def mlp(units):
+        net = gluon.nn.Dense(units, in_units=64)
+        net.initialize(mx.init.Xavier())
+        return net
+
+    item = [((64,), "float32")]
+    x = onp.ones((64,), "float32")
+
+    # cold start, lazy: the first request pays trace+compile
+    reg = ModelRegistry()
+    reg.load("aot-cold", mlp(32), max_batch_size=8, batch_timeout_ms=2.0,
+             prewarm=False)
+    t0 = time.perf_counter()
+    reg.predict("aot-cold", x)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    reg.close()
+
+    # cold start, prewarmed: load(warm_spec=) compiles pre-traffic
+    reg = ModelRegistry()
+    t0 = time.perf_counter()
+    v1 = reg.load("aot-bench", mlp(48), max_batch_size=8,
+                  batch_timeout_ms=2.0, warm_spec=item)
+    warm_load_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reg.predict("aot-bench", x)
+    warm_first_ms = (time.perf_counter() - t0) * 1e3
+
+    # steady-state p99, then hot-reload p99 under the same traffic
+    lat, aborts, lock, stop = [], [], threading.Lock(), threading.Event()
+
+    def client():
+        while not stop.is_set():
+            t = time.perf_counter()
+            try:
+                reg.predict("aot-bench", x, timeout=30.0)
+            except Exception as e:  # noqa: BLE001 — reported, not hidden
+                # a dead client thins the measured load: record the abort
+                # so the p99 comparison is made on known traffic
+                with lock:
+                    aborts.append(repr(e))
+                return
+            with lock:
+                lat.append((time.perf_counter() - t) * 1e3)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    with lock:
+        steady = sorted(lat)
+        lat[:] = []
+    t0 = time.perf_counter()
+    reg.load("aot-bench", mlp(56))         # new arch: a REAL warm happens
+    reg.unload("aot-bench", version=v1)
+    reload_s = time.perf_counter() - t0
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(30.0)
+    with lock:
+        during = sorted(lat)
+    reg.close()
+    return {
+        "metric": "serving_aot_latency",
+        "cold_start_first_byte_ms": round(cold_ms, 1),
+        "prewarmed_first_byte_ms": round(warm_first_ms, 1),
+        "prewarm_load_s": round(warm_load_s, 3),
+        "steady_p99_ms": round(percentile(steady, 99) or 0.0, 1),
+        "hot_reload_p99_ms": round(percentile(during, 99) or 0.0, 1),
+        "hot_reload_wall_s": round(reload_s, 3),
+        "requests": {"steady": len(steady), "during_reload": len(during),
+                     "client_aborts": len(aborts)},
+        "client_abort_errors": aborts[:4],
+        "note": "4 closed-loop clients, 64-wide MLP servables, buckets "
+                "1..8. cold vs prewarmed first byte isolates the lazy "
+                "trace+compile window; hot_reload_p99 covers the window "
+                "from swap-begin through drain + 1s — with prewarm it "
+                "should sit near steady_p99 instead of near the compile "
+                "time (docs/AOT.md contract)",
+    }
 
 
 def bench_int8():

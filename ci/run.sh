@@ -22,6 +22,12 @@
 #   suite   - quick test suite on the 8-device virtual CPU mesh
 #   serving - inference serving subsystem end-to-end on CPU (dynamic
 #             batching, hot reload, backpressure, HTTP front-end)
+#   aot     - zero-recompile hot path: prewarm every batcher bucket
+#             through the shared AOT executable cache, then replay a
+#             traffic sweep across all buckets and HARD-FAIL if
+#             mxtpu_jit_compiles_total moves (or any compile span lands)
+#             during the post-warm window — the ROADMAP item 3 "p99 must
+#             not see a compile" contract, gated
 #   observability - boot the serving server, drive traffic, scrape
 #             GET /metrics over the wire, and validate the Prometheus
 #             exposition with the stdlib parser (tools/promcheck.py);
@@ -42,7 +48,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving observability diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -70,13 +76,14 @@ print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
   [ "$lint_dt" -lt 30 ] || { echo "lint stage took ${lint_dt}s (budget 30s)"; exit 1; }
   # Seeded-defect canary: the whole-program passes must still FIRE. The
   # fixture holds one known deadlock cycle, one unlocked cross-thread
-  # write, and one retrace hazard; full-profile analysis rooted at the
-  # fixture dir must report exactly those three.
+  # write, one jax.jit retrace hazard, and one AOT-boundary retrace
+  # hazard (aot.compile_cached); full-profile analysis rooted at the
+  # fixture dir must report exactly those four.
   python - <<'EOF'
 from tools.mxtpulint import analyze
 found = sorted(f.rule for f in analyze(["tools/mxtpulint/testdata"],
                                        root="tools/mxtpulint/testdata"))
-assert found == ["R009", "R010", "R011"], found
+assert found == ["R009", "R010", "R011", "R011"], found
 print("seeded-defect canary OK: %s" % ", ".join(found))
 EOF
 fi
@@ -109,6 +116,55 @@ fi
 if has_stage serving; then
   echo "=== serving: inference serving subsystem e2e on CPU ==="
   python -m pytest tests/test_serving.py -q
+fi
+
+if has_stage aot; then
+  echo "=== aot: zero-recompile post-warm serving sweep ==="
+  # Warm a model (every configured bucket, via load(warm_spec=...)), then
+  # replay a traffic sweep that exercises each bucket size and hard-fail
+  # if the compile counter moves or any compile span lands after warm —
+  # the executable-cache contract a perf PR must never silently lose.
+  # Budgeted like the lint stage: a blowup here means the cache stopped
+  # hitting, not noise.
+  aot_t0=$SECONDS
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+import numpy as onp
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, jit
+from incubator_mxnet_tpu.serving import ModelRegistry
+from incubator_mxnet_tpu.telemetry import spans
+
+mx.random.seed(0)
+net = gluon.nn.Dense(4, in_units=8)
+net.initialize(mx.init.Xavier())
+reg = ModelRegistry()
+reg.load("aot-ci", net, max_batch_size=4, batch_timeout_ms=3.0,
+         warm_spec=[((8,), "float32")])
+warmed = reg.metrics("aot-ci").prewarm_count
+assert warmed == 3, "expected buckets 1,2,4 warmed, got %d" % warmed
+c0 = (jit._COMPILES.value(kind="eval") + jit._COMPILES.value(kind="train"))
+mark = len(spans.snapshot())
+# sweep: concurrent bursts sized to land in every bucket
+for burst in (1, 2, 3, 4, 1, 4):
+    reqs = [reg.submit("aot-ci", onp.full((8,), i, "float32"))
+            for i in range(burst)]
+    for r in reqs:
+        r.result(60.0)
+c1 = (jit._COMPILES.value(kind="eval") + jit._COMPILES.value(kind="train"))
+assert c1 == c0, "mxtpu_jit_compiles_total moved post-warm: %s -> %s" % (c0, c1)
+bad = [s["name"] for s in spans.snapshot()[mark:]
+       if s["name"] in ("eval:compile", "train:compile", "eval:build",
+                        "train:build")]
+assert not bad, "compile spans landed in the post-warm window: %s" % bad
+ok = reg.metrics("aot-ci").ok_count
+reg.close()
+print("aot OK: %d buckets prewarmed, %d post-warm requests, 0 compiles"
+      % (warmed, ok))
+EOF
+  aot_dt=$(( SECONDS - aot_t0 ))
+  echo "aot stage wall time: ${aot_dt}s (budget 60s)"
+  [ "$aot_dt" -lt 60 ] || { echo "aot stage took ${aot_dt}s (budget 60s)"; exit 1; }
 fi
 
 if has_stage observability; then

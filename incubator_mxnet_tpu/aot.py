@@ -1,0 +1,455 @@
+"""Process-wide AOT compiled-executable cache (ROADMAP item 3: p99 must
+not see a compile).
+
+Before this module, three independent lazy caches each paid their own
+trace+compile+first-run window inside the first hot-path call: TrainStep
+``self._cache``, EvalStep ``self._cache``, and the per-ServedModel
+``Exported.call`` path (which re-built its call wrapper every chunk).
+Under bucketed serving that is one full compile *per bucket, per model
+version, per component, per process* — and a registry hot-reload put that
+window straight into user-visible p99.
+
+This module replaces them with ONE shared cache:
+
+- **Key**: ``(model_id, kind, input signature, mesh, extra)`` — the
+  shape-bucket × dtype × mesh identity of a compiled program
+  (``cache_key()``). ``model_id`` is a stable digest (``model_id_for()``)
+  so two components serving the same architecture share executables
+  instead of recompiling per component.
+- **Compilation**: JAX's explicit AOT pipeline —
+  ``jit(fn).lower(*args).compile()`` — instead of first-call lazy
+  compilation, so the compile lands where the caller schedules it
+  (a prewarm thread, a build span), never inside a later dispatch.
+- **Artifacts** (``MXTPU_AOT_CACHE_DIR``): exportable programs (the
+  eval/serve forward paths) are serialized via ``jax.export`` (StableHLO)
+  per cache key. A fresh process pointed at a populated cache dir LOADS
+  the program instead of re-tracing the Python model — the first request
+  pays zero trace time and records an artifact hit, and with registry
+  prewarm the XLA compile of the loaded module also lands pre-traffic.
+  Train-kind entries (donated-buffer programs, instance-bound state) stay
+  in-memory only.
+- **Eviction**: LRU by last-dispatch time, bounded by
+  ``MXTPU_AOT_CACHE_SIZE``, with every eviction counted on
+  ``mxtpu_aot_evictions_total`` so silent thrash is visible (dict-order
+  eviction could silently drop the hottest bucket).
+
+Observability: ``mxtpu_aot_{hits,misses,evictions,artifact_hits,
+artifact_writes}_total`` counters, the ``mxtpu_aot_entries`` gauge, and
+``aot:load`` spans around artifact deserialization (prewarm emits
+``aot:warm`` spans from serving/registry.py). See docs/AOT.md.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time as _time
+from collections import namedtuple
+
+from . import config
+from . import telemetry
+from .telemetry import spans
+
+__all__ = ["CacheKey", "cache_key", "AOTCache", "CACHE", "compile_cached",
+           "model_id_for", "input_signature", "mesh_sig", "artifact_path",
+           "ARTIFACT_MAGIC", "FORMAT_VERSION"]
+
+_LOG = logging.getLogger(__name__)
+
+#: bump when the artifact payload layout changes — old files are ignored,
+#: never misparsed (the version participates in the file digest)
+FORMAT_VERSION = 1
+ARTIFACT_MAGIC = b"MXTPUAOT\x001"
+
+_HITS = telemetry.counter(
+    "mxtpu_aot_hits_total",
+    "Shared executable-cache hits (dispatch found a compiled program).",
+    ("kind",))
+_MISSES = telemetry.counter(
+    "mxtpu_aot_misses_total",
+    "Shared executable-cache misses (artifact load or fresh build).",
+    ("kind",))
+_EVICTIONS = telemetry.counter(
+    "mxtpu_aot_evictions_total",
+    "LRU evictions from the shared executable cache past "
+    "MXTPU_AOT_CACHE_SIZE — a climbing rate under steady traffic means "
+    "the bound is too small for the live bucket set (cache thrash).",
+    ("kind",))
+_ARTIFACT_HITS = telemetry.counter(
+    "mxtpu_aot_artifact_hits_total",
+    "Cache misses satisfied by a persisted jax.export artifact "
+    "(MXTPU_AOT_CACHE_DIR) instead of re-tracing the model.", ("kind",))
+_ARTIFACT_WRITES = telemetry.counter(
+    "mxtpu_aot_artifact_writes_total",
+    "Serialized executables written to MXTPU_AOT_CACHE_DIR.", ("kind",))
+_ENTRIES = telemetry.gauge(
+    "mxtpu_aot_entries",
+    "Live entries in the process-wide AOT executable cache.")
+
+#: (model_id, kind, input_sig, mesh, extra) — the full identity of one
+#: compiled program. kind is 'train' | 'eval' | 'serve'; input_sig is a
+#: tuple of (shape tuple, dtype string) per input; mesh is mesh_sig();
+#: extra carries caller-specific statics (e.g. TrainStep's n_net_inputs).
+CacheKey = namedtuple("CacheKey", ("model_id", "kind", "input_sig", "mesh",
+                                   "extra"))
+
+
+def input_signature(arrs):
+    """(shape, dtype) tuple per input — accepts NDArrays, jax or numpy
+    arrays (anything with .shape/.dtype)."""
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+
+
+def mesh_sig(mesh):
+    """Hashable identity of a mesh (None for single-device): axis sizes +
+    device count, enough to distinguish programs compiled for different
+    layouts."""
+    if mesh is None:
+        return None
+    return (tuple(sorted(mesh.shape.items())), len(mesh.devices.flat))
+
+
+def cache_key(model_id, input_sig, kind="eval", mesh=None, extra=()):
+    """Build the canonical CacheKey. ``input_sig`` comes from
+    ``input_signature()`` (already normalized) or any iterable of
+    (shape, dtype) pairs."""
+    sig = tuple((tuple(s), str(d)) for s, d in input_sig)
+    return CacheKey(str(model_id), str(kind), sig,
+                    mesh if (mesh is None or isinstance(mesh, tuple))
+                    else mesh_sig(mesh), tuple(extra))
+
+
+def _iter_blocks(net, path="net", seen=None):
+    """Depth-first (path, block) walk over a Gluon block tree."""
+    if seen is None:
+        seen = set()
+    if id(net) in seen:
+        return
+    seen.add(id(net))
+    yield path, net
+    children = getattr(net, "_children", None)
+    if isinstance(children, dict):
+        for name, child in sorted(children.items()):
+            yield from _iter_blocks(child, "%s.%s" % (path, name), seen)
+
+
+def _is_array(val):
+    return hasattr(val, "shape") and hasattr(val, "dtype") \
+        and hasattr(val, "__array__")
+
+
+def _baked_state_tokens(net):
+    """Digest tokens for TRACE-TIME-BAKED block state: instance attributes
+    that are Python scalars or raw arrays (NOT registered Parameters —
+    those stay runtime inputs). A quantized wrapper's int8 weights and
+    calibration ranges live here; two differently-calibrated instances of
+    one architecture must NOT share a compiled program, and a reloaded
+    identical one must."""
+    import numpy as onp
+    scalars = (bool, int, float, str, bytes, type(None))
+    skip = ("_children", "_reg_params", "_forward_hooks", "_cached_fn",
+            "_forward_pre_hooks", "_prefix", "_name", "_scope")
+    for path, block in _iter_blocks(net):
+        try:
+            items = sorted(vars(block).items())
+        except TypeError:
+            continue
+        for name, val in items:
+            if name in skip or type(val).__name__ == "Parameter" \
+                    or hasattr(val, "_children"):
+                continue
+            if isinstance(val, dict):
+                # sort by repr: mixed-type keys (int vs str) make the
+                # natural sort raise mid-generator, which would silently
+                # truncate the digest and merge differently-baked models
+                items = tuple(sorted(
+                    ((k, v) for k, v in val.items()
+                     if isinstance(v, scalars)),
+                    key=repr))
+                yield "%s.%s=%r" % (path, name, items)
+                continue
+            if isinstance(val, (tuple, list)) \
+                    and all(isinstance(v, scalars) for v in val):
+                yield "%s.%s=%r" % (path, name, tuple(val))
+            elif isinstance(val, scalars):
+                yield "%s.%s=%r" % (path, name, val)
+            elif _is_array(val) or hasattr(val, "_data"):
+                try:
+                    arr = onp.asarray(getattr(val, "_data", val))
+                    yield "%s.%s@%s" % (path, name, hashlib.sha256(
+                        arr.tobytes()).hexdigest()[:16])
+                except Exception:
+                    yield "%s.%s@<unhashable>" % (path, name)
+
+
+def model_id_for(net, extra=()):
+    """Stable content digest of a Gluon block: class, repr (layer
+    hyperparameters), the parameter (name, shape, dtype) list, and a hash
+    of any trace-time-baked instance state (raw arrays / scalars that are
+    not Parameters), plus caller ``extra`` tokens. Components
+    (EvalStep/BlockServable) built on an identical model produce the same
+    id and SHARE compiled executables — and a fresh process reconstructing
+    the same model resolves the same persisted artifact. Registered
+    Parameters stay runtime inputs, so sharing is weight-safe.
+
+    The digest cannot see forward() semantics hidden from repr, the
+    parameter structure, and the baked-state walk (e.g. state tucked in
+    nested custom containers) — pass an explicit ``model_id`` to the
+    caller (EvalStep/TrainStep/export) when such models must not share
+    (docs/AOT.md invalidation rules).
+    """
+    import jax
+    parts = [jax.__version__, type(net).__qualname__]
+    try:
+        parts.append(repr(net))
+    except Exception:
+        parts.append("<repr-failed>")
+    try:
+        # POSITIONAL (index, shape, dtype) — never the parameter names:
+        # gluon auto-naming makes every instance's prefix unique
+        # (dense0_ vs dense1_), and two instances of one architecture
+        # must produce the same id; collect_params() walk order is
+        # structure-deterministic, which is what make_pure_fn's input
+        # ordering relies on too
+        for i, p in enumerate(net.collect_params().values()):
+            shape = getattr(p, "shape", None)
+            dtype = getattr(p, "dtype", None)
+            parts.append("p%d:%s:%s" % (i, shape, dtype))
+    except Exception:
+        parts.append("<params-unavailable>")
+    try:
+        parts.extend(_baked_state_tokens(net))
+    except Exception:
+        parts.append("<baked-state-unavailable>")
+    parts.extend(str(e) for e in extra)
+    return "g" + hashlib.sha256("\x00".join(parts).encode()).hexdigest()[:20]
+
+
+class _Entry:
+    """One compiled program + its caller extras and LRU bookkeeping."""
+
+    __slots__ = ("key", "fn", "extras", "last_used", "source", "created")
+
+    def __init__(self, key, fn, extras, source):
+        self.key = key
+        self.fn = fn
+        self.extras = extras
+        self.source = source            # 'build' | 'artifact'
+        self.created = _time.monotonic()
+        self.last_used = self.created
+
+
+class AOTCache:
+    """Thread-safe LRU map CacheKey -> _Entry (the process-wide instance
+    is ``aot.CACHE``). Lookups touch last_used; inserts evict
+    least-recently-DISPATCHED entries past MXTPU_AOT_CACHE_SIZE and count
+    each eviction."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries = {}
+        self._building = {}   # key -> Event (single-flight build guard)
+
+    # ------------------------------------------------------------------
+    def lookup(self, key):
+        """Hit -> entry (last_used touched, hit counted); miss -> None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.last_used = _time.monotonic()
+        if entry is not None:
+            _HITS.inc(kind=key.kind)
+        return entry
+
+    def peek(self, key):
+        """lookup() without touching LRU order or counters (tests,
+        inspection)."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def insert(self, key, fn, extras=None, source="build"):
+        entry = _Entry(key, fn, extras, source)
+        with self._lock:
+            self._entries[key] = entry
+            self._evict_locked()
+            _ENTRIES.set(len(self._entries))
+        return entry
+
+    def _evict_locked(self):
+        bound = max(1, config.get_env("MXTPU_AOT_CACHE_SIZE"))
+        while len(self._entries) > bound:
+            victim = min(self._entries.values(),
+                         key=lambda e: e.last_used)
+            self._entries.pop(victim.key)
+            _EVICTIONS.inc(kind=victim.key.kind)
+
+    def discard(self, key):
+        with self._lock:
+            gone = self._entries.pop(key, None) is not None
+            _ENTRIES.set(len(self._entries))
+        return gone
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            _ENTRIES.set(0)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries)
+
+    def snapshot(self):
+        """JSON-able view (GET /debug/aot): one record per entry, most
+        recently dispatched first."""
+        now = _time.monotonic()
+        with self._lock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: -e.last_used)
+            return [{"model_id": e.key.model_id, "kind": e.key.kind,
+                     "input_sig": [[list(s), d] for s, d in e.key.input_sig],
+                     "mesh": e.key.mesh if e.key.mesh is None
+                     else list(e.key.mesh),
+                     "source": e.source,
+                     "age_s": round(now - e.created, 3),
+                     "idle_s": round(now - e.last_used, 3)}
+                    for e in entries]
+
+    # ------------------------------------------------------------------
+    def get_or_build(self, key, build, exportable=False, arg_specs=None):
+        """Single-flight miss path: at most one thread builds a given key;
+        the rest wait on its completion event and then hit. ``build()``
+        returns ``(fn, extras, exported_or_None)``; the exported program
+        (when present and ``exportable``) is persisted to
+        MXTPU_AOT_CACHE_DIR. A persisted artifact, when present, is
+        loaded INSTEAD of calling build() — no Python tracing."""
+        while True:
+            entry = self.lookup(key)
+            if entry is not None:
+                return entry
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    entry.last_used = _time.monotonic()
+                    _HITS.inc(kind=key.kind)
+                    return entry
+                event = self._building.get(key)
+                if event is None:
+                    event = self._building[key] = threading.Event()
+                    builder = True
+                else:
+                    builder = False
+            if not builder:
+                # another thread owns the build — wait, then re-lookup
+                # (bounded so a crashed builder cannot strand waiters)
+                event.wait(timeout=600.0)
+                continue
+            try:
+                _MISSES.inc(kind=key.kind)
+                if exportable:
+                    fn = _load_artifact(key, arg_specs)
+                    if fn is not None:
+                        _ARTIFACT_HITS.inc(kind=key.kind)
+                        return self.insert(key, fn, source="artifact")
+                fn, extras, exported = build()
+                entry = self.insert(key, fn, extras, source="build")
+                if exportable and exported is not None:
+                    _write_artifact(key, exported)
+                return entry
+            finally:
+                with self._lock:
+                    self._building.pop(key, None)
+                event.set()
+
+
+CACHE = AOTCache()
+
+
+def compile_cached(key, build, exportable=False, arg_specs=None):
+    """THE module entry point every hot path dispatches through (jit.py
+    TrainStep/EvalStep, contrib.serving.ServedModel, serving prewarm).
+    ``build()`` is traced/compiled on a miss — the same retrace-hazard
+    surface as a direct ``jax.jit`` call site (mxtpulint R011 models this
+    boundary). Returns the cache entry (``entry.fn`` is the compiled
+    program, ``entry.source`` says whether it came from a build or a
+    persisted artifact)."""
+    return CACHE.get_or_build(key, build, exportable=exportable,
+                              arg_specs=arg_specs)
+
+
+# --------------------------------------------------------------------------
+# Persistent artifact layer (MXTPU_AOT_CACHE_DIR)
+def _key_digest(key):
+    raw = repr((FORMAT_VERSION, key.model_id, key.kind, key.input_sig,
+                key.mesh, key.extra))
+    return hashlib.sha256(raw.encode()).hexdigest()[:32]
+
+
+def artifact_path(key, cache_dir=None):
+    """Artifact file for a key, or None when the layer is disabled
+    (no MXTPU_AOT_CACHE_DIR) or the key is not persistable (mesh-sharded
+    and train programs stay in-memory)."""
+    if cache_dir is None:
+        cache_dir = config.get_env("MXTPU_AOT_CACHE_DIR")
+    # train programs are NEVER persisted (donated buffers + instance-bound
+    # state) — enforced here, not just at today's call sites
+    if not cache_dir or key.mesh is not None or key.kind == "train":
+        return None
+    import jax
+    return os.path.join(cache_dir, "jax-%s" % jax.__version__,
+                        "%s-%s.mxtpu-aot" % (key.kind, _key_digest(key)))
+
+
+def _load_artifact(key, arg_specs):
+    """Deserialize the persisted StableHLO for ``key`` and AOT-compile it
+    (``aot:load`` span). Returns the compiled callable, or None (missing /
+    corrupt / unloadable — the caller falls back to a fresh build; the
+    drop is debug-logged, never raised into a hot path)."""
+    path = artifact_path(key)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        import jax
+        import jax.export  # jax>=0.4.30 does not re-export lazily
+        with open(path, "rb") as f:
+            buf = f.read()
+        if not buf.startswith(ARTIFACT_MAGIC):
+            raise ValueError("bad magic in %s" % path)
+        with spans.span("aot:load", kind=key.kind,
+                        model_id=key.model_id):
+            exported = jax.export.deserialize(buf[len(ARTIFACT_MAGIC):])
+            fn = jax.jit(exported.call)
+            if arg_specs is not None:
+                # explicit AOT: XLA-compile the loaded module NOW (inside
+                # the aot:load span / prewarm window) — never lazily
+                # inside a later dispatch
+                fn = fn.lower(*arg_specs).compile()
+        return fn
+    except Exception:
+        _LOG.debug("aot artifact load failed for %s", path, exc_info=True)
+        return None
+
+
+def _write_artifact(key, exported):
+    """Persist a jax.export program atomically (tmp + rename; pid+tid in
+    the tmp name so concurrent writers never interleave). Failures are
+    debug-logged and swallowed — a full disk must not fail the dispatch
+    that just compiled successfully."""
+    path = artifact_path(key)
+    if path is None:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = "%s.%d.%d.tmp" % (path, os.getpid(), threading.get_ident())
+        with open(tmp, "wb") as f:
+            f.write(ARTIFACT_MAGIC + exported.serialize())
+        os.replace(tmp, path)
+        _ARTIFACT_WRITES.inc(kind=key.kind)
+        return path
+    except Exception:
+        _LOG.debug("aot artifact write failed for %s", path, exc_info=True)
+        return None

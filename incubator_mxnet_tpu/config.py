@@ -80,10 +80,41 @@ ENV_VARS = {
         "launcher compatibility)."),
     "MXTPU_EXEC_CACHE_SIZE": (
         int, 16,
-        "Bound on each compiled-executable cache (TrainStep/EvalStep/"
-        "hybridize shape-keyed caches — the CachedOp analog). Oldest entry "
-        "is evicted past the bound; raise for bucketed variable-shape "
-        "workloads (ref MXNET_EXEC_... executor caching)."),
+        "Bound on each per-block hybridize() shape-keyed jit cache (the "
+        "CachedOp analog); least-recently-dispatched entry is evicted "
+        "past the bound. TrainStep/EvalStep/ServedModel executables "
+        "moved to the shared AOT cache — size THAT with "
+        "MXTPU_AOT_CACHE_SIZE (docs/AOT.md)."),
+    "MXTPU_AOT_CACHE_SIZE": (
+        int, 64,
+        "Bound on the process-wide AOT compiled-executable cache "
+        "(aot.CACHE — the shared replacement for the per-instance "
+        "TrainStep/EvalStep/ServedModel caches). Eviction is LRU by "
+        "last-dispatch time and each eviction increments "
+        "mxtpu_aot_evictions_total; size it to hold every live "
+        "(model, bucket, dtype) combination or post-warm traffic "
+        "recompiles (docs/AOT.md)."),
+    "MXTPU_AOT_CACHE_DIR": (
+        str, None,
+        "Directory for persisted jax.export (StableHLO) executables, one "
+        "artifact per AOT cache key. A fresh process pointed here loads "
+        "programs instead of re-tracing the Python model (artifact hit); "
+        "unset disables the persistent layer. Artifacts are versioned by "
+        "jax version + format version; train-kind programs are never "
+        "persisted (docs/AOT.md)."),
+    "MXTPU_AOT_PREWARM": (
+        bool, True,
+        "Pre-warm every configured batcher bucket of an incoming model "
+        "version during ModelRegistry.load() hot-reloads (background "
+        "thread, smallest bucket first so traffic cuts over early) so the "
+        "swap never puts a compile window into request p99. Per-call "
+        "override via load(prewarm=)."),
+    "MXTPU_AOT_WARM_TIMEOUT_S": (
+        float, 60.0,
+        "Bound on how long ModelRegistry.load() blocks for the prewarm "
+        "thread to finish compiling all buckets before returning anyway "
+        "(the warm continues in the background; remaining buckets "
+        "compile-on-first-dispatch as before)."),
     "MXTPU_NO_DONATE": (
         bool, False,
         "Disable input-buffer donation in the fused train/eval steps "
@@ -260,12 +291,25 @@ def get_env(name):
     return typ(raw)
 
 
-def evict_to_bound(cache):
-    """Drop oldest entries of an insertion-ordered executable cache until it
-    fits MXTPU_EXEC_CACHE_SIZE (call after inserting)."""
+def evict_to_bound(cache, on_evict=None):
+    """Drop least-recently-USED entries of an executable cache until it
+    fits MXTPU_EXEC_CACHE_SIZE (call after inserting).
+
+    LRU contract: python dicts iterate in insertion order, so a caller
+    marking a hit must move the entry to the end (``cache[k] =
+    cache.pop(k)``) — then insertion order IS recency order and the front
+    entry is the least-recently-dispatched one. Pure insert-only callers
+    degrade to the old FIFO behavior. ``on_evict(key, value)`` runs per
+    victim (metrics hooks); the shared AOT cache (aot.AOTCache) has its
+    own timestamped LRU + mxtpu_aot_evictions_total counter and does not
+    route through here.
+    """
     bound = max(1, get_env("MXTPU_EXEC_CACHE_SIZE"))
     while len(cache) > bound:
-        cache.pop(next(iter(cache)))
+        key = next(iter(cache))
+        value = cache.pop(key)
+        if on_evict is not None:
+            on_evict(key, value)
 
 
 def describe():

@@ -339,9 +339,24 @@ class HybridBlock(Block):
             pass
 
         meta = (train_mode, tuple((a.shape, str(a.dtype)) for a in arg_arrays))
+        # per-net trace-lock discipline (jit._net_trace_lock): this path's
+        # lazy first call TRACES pure_fn inside nd._apply — swapping
+        # tracers into the live param NDArrays — and its hit path reads
+        # a._data; either concurrent with an EvalStep/TrainStep/prewarm
+        # trace of the same net would capture tracers mid-swap. Held for
+        # the whole lookup+apply (dispatch is async; sub-µs uncontended).
+        from .. import jit as _jit
+        with _jit._net_trace_lock(self):
+            return self._call_cached_locked(meta, train_mode, arg_arrays)
+
+    def _call_cached_locked(self, meta, train_mode, arg_arrays):
         if self._cached_fn is None:
             self._cached_fn = {}
-        if meta not in self._cached_fn:
+        if meta in self._cached_fn:
+            # LRU touch (evict_to_bound contract): move-to-end so the
+            # bound drops the coldest shape, never the one dispatching now
+            self._cached_fn[meta] = self._cached_fn.pop(meta)
+        else:
             params, param_arrs, pure_fn, aux_box = _functional.make_pure_fn(
                 self, train_mode)
             jitted = jax.jit(lambda pd, xd, key: pure_fn(pd, xd, key))

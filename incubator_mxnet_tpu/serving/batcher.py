@@ -151,6 +151,12 @@ class DynamicBatcher:
         self._queue = _queue.Queue(maxsize=qsize)
         self._closed = False
         self._paused = False
+        # per-item (shape, dtype) signature of the most recently dispatched
+        # request — what a hot-reload prewarm synthesizes warm batches
+        # from (registry.load); written by the worker, read by warm/load
+        # threads, hence its own lock
+        self._sig_lock = threading.Lock()
+        self._last_item_sig = None
         # stall-watchdog channel: the worker beats once per gather cycle
         # (<= 0.25s apart when idle), so silence means a stuck dispatch,
         # not an empty queue
@@ -228,6 +234,14 @@ class DynamicBatcher:
 
     def queue_depth(self):
         return self._queue.qsize()
+
+    @property
+    def last_item_sig(self):
+        """Per-item ((shape, dtype), ...) of the newest dispatched request,
+        or None before any dispatch — the observed signature hot-reload
+        prewarm builds synthetic warm batches from."""
+        with self._sig_lock:
+            return self._last_item_sig
 
     def pause_intake(self):
         """Reject new submits (ServingClosedError) while the worker keeps
@@ -355,6 +369,9 @@ class DynamicBatcher:
         n = len(live)
         bucket = self._bucket_for(n)
         t0 = time.monotonic()
+        with self._sig_lock:
+            self._last_item_sig = tuple((x.shape, x.dtype.str)
+                                        for x in live[0].inputs)
         self._trace_queue_waits(live, t0)
         flightrec.record("batch_dispatch", model=self.name, n=n,
                          bucket=bucket)

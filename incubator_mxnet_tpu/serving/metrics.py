@@ -60,6 +60,10 @@ _REJECTED = telemetry.counter(
 _EXPIRED = telemetry.counter(
     "mxtpu_serving_expired_total",
     "Requests whose deadline passed while queued.", ("model",))
+_PREWARMS = telemetry.counter(
+    "mxtpu_aot_prewarms_total",
+    "Batcher buckets warmed ahead of traffic (hot-reload / warm_spec "
+    "prewarm through the shared AOT executable cache).", ("model",))
 _BATCHES = telemetry.counter(
     "mxtpu_serving_batches_total", "Dispatched batches.", ("model",))
 _BATCHED_ITEMS = telemetry.counter(
@@ -87,6 +91,7 @@ _COUNTER_MAP = {
     "error_count": _ERRORS,
     "rejected_count": _REJECTED,
     "expired_count": _EXPIRED,
+    "prewarm_count": _PREWARMS,
 }
 
 
@@ -107,6 +112,7 @@ class ServingMetrics:
         self.error_count = 0          # dispatch raised
         self.rejected_count = 0       # queue full (backpressure)
         self.expired_count = 0        # deadline passed while queued
+        self.prewarm_count = 0        # buckets warmed ahead of traffic
         self.batch_count = 0          # dispatches
         self.batched_items = 0        # real (non-padding) items dispatched
         self.padded_items = 0         # padding rows added to reach a bucket
@@ -184,6 +190,7 @@ class ServingMetrics:
                 "error_count": self.error_count,
                 "rejected_count": self.rejected_count,
                 "expired_count": self.expired_count,
+                "prewarm_count": self.prewarm_count,
                 "batch_count": self.batch_count,
                 "batched_items": self.batched_items,
                 "padded_items": self.padded_items,

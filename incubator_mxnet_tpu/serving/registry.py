@@ -11,20 +11,37 @@ stacked outputs``:
 - any user object with that method (e.g. a quantized net wrapper).
 
 Hot reload: ``load()`` on an existing name installs a NEW version and
-atomically repoints dispatch at it; batches already in flight hold a
-reference to the old servable and finish on it (connection draining).
+repoints dispatch at it; batches already in flight hold a reference to
+the old servable and finish on it (connection draining).
 ``unload(..., drain=True)`` blocks until that version's in-flight count
 hits zero before dropping it.
+
+Zero-recompile hot reload (docs/AOT.md): by default (``MXTPU_AOT_PREWARM``)
+a reload PRE-WARMS every configured batcher bucket of the incoming
+version through the shared AOT executable cache BEFORE dispatch is
+repointed — a background warm thread compiles smallest bucket first, so
+traffic cuts over as soon as the most latency-sensitive shape is ready,
+while the old version keeps serving. The warm batches are synthesized
+from the batcher's observed per-item signature (or an explicit
+``warm_spec``); each warmed bucket emits an ``aot:warm`` span and a
+``mxtpu_aot_prewarms_total`` increment. The subsequent
+``unload(old, drain=True)`` therefore never leaves a compile window
+inside any request's span chain.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
+from .. import config
+from ..telemetry import spans
 from .batcher import DynamicBatcher
 from .metrics import ServingMetrics
 
 __all__ = ["ModelRegistry", "BlockServable", "ModelNotFoundError"]
+
+_LOG = logging.getLogger(__name__)
 
 
 class ModelNotFoundError(KeyError):
@@ -36,9 +53,9 @@ class BlockServable:
     jit.EvalStep, so each padded bucket shape compiles exactly once and is
     reused (the CachedOp-style executable cache the batcher relies on)."""
 
-    def __init__(self, net):
+    def __init__(self, net, model_id=None):
         from .. import jit
-        self._step = jit.EvalStep(net)
+        self._step = jit.EvalStep(net, model_id=model_id)
 
     def predict_batch(self, *stacked_inputs):
         from ..ndarray import NDArray
@@ -69,6 +86,8 @@ class _ModelEntry:
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._inflight = {}             # version -> dispatched-batch count
+        self._warming = 0               # active prewarm threads (describe)
+        self._warm_target = None        # only THIS version may repoint()
         self.batcher = DynamicBatcher(self._dispatch, name=name,
                                       metrics=self.metrics, **batcher_kw)
 
@@ -102,7 +121,82 @@ class _ModelEntry:
                 version = (max(self.versions) + 1) if self.versions else 1
             self.versions[version] = servable
             self.current_version = version
+            # a direct install supersedes any in-flight warm: its stale
+            # repoint()s must not drag dispatch back to an older version
+            self._warm_target = version
             return version
+
+    def add_version(self, servable, version):
+        """install() WITHOUT the repoint: the version becomes routable
+        only via an explicit repoint() — the prewarm path registers the
+        incoming version here, warms it, then cuts dispatch over. Marks
+        the version as the warm target: overlapping hot-reloads each
+        register here, and only the NEWEST registration's warm thread may
+        repoint (a slower older warm finishing last must not pin dispatch
+        to a stale model). On a FIRST load (nothing routable yet) the
+        version is made current immediately — a model whose load() is
+        still warming must answer with a lazy compile, not a 404."""
+        with self._lock:
+            if version is None:
+                version = (max(self.versions) + 1) if self.versions else 1
+            self.versions[version] = servable
+            self._warm_target = version
+            if self.current_version is None:
+                self.current_version = version
+            return version
+
+    def repoint(self, version):
+        """Cut dispatch over to ``version`` — only honored while it is
+        still the newest warm target (idempotent; no-op once a newer
+        load()/install() superseded it, or the version was dropped)."""
+        with self._lock:
+            if version in self.versions and version == self._warm_target:
+                self.current_version = version
+
+    def warm(self, servable, version, item_sig):
+        """Pre-warm every configured bucket of ``servable`` through the
+        shared AOT executable cache, SMALLEST bucket first; dispatch is
+        repointed at ``version`` right after the first bucket compiles so
+        traffic cuts over early while bigger buckets keep warming. Runs on
+        the prewarm thread; after the early cutover the batcher worker can
+        dispatch (and even compile-miss) the same model concurrently —
+        safe because every trace window holds the net's trace lock
+        exclusively, dispatches capture their argument snapshots under the
+        same lock (jit._net_trace_lock), and cache misses are
+        single-flight per key. Always leaves dispatch
+        repointed — a warm failure degrades to the old lazy-compile
+        behavior, never to an unroutable model."""
+        import numpy as onp
+        with self._lock:
+            self._warming += 1
+        try:
+            for b in sorted(set(self.batcher.buckets)):
+                try:
+                    synth = [onp.zeros((b,) + tuple(shape),
+                                       dtype=onp.dtype(dt))
+                             for shape, dt in item_sig]
+                    with spans.span("aot:warm", model=self.name,
+                                    version=version, bucket=b):
+                        servable.predict_batch(*synth)
+                except Exception:
+                    # the incoming model may not accept the observed
+                    # signature at all (input shape changed): stop warming
+                    # but still swap — first dispatch compiles lazily,
+                    # exactly the pre-AOT behavior
+                    _LOG.warning(
+                        "prewarm of model %r v%s bucket %d failed; "
+                        "remaining buckets will compile on first dispatch",
+                        self.name, version, b, exc_info=True)
+                    break
+                try:
+                    self.metrics.inc("prewarm_count")
+                except Exception:
+                    _LOG.debug("prewarm_count update failed", exc_info=True)
+                self.repoint(version)
+        finally:
+            self.repoint(version)
+            with self._lock:
+                self._warming -= 1
 
     def drop(self, version, drain, timeout, wait_queue_empty=False):
         """Remove one version. With a successor available, dispatch is
@@ -145,6 +239,7 @@ class _ModelEntry:
             return {"name": self.name,
                     "versions": sorted(self.versions),
                     "current_version": self.current_version,
+                    "warming": self._warming > 0,
                     "queue_depth": self.batcher.queue_depth(),
                     "queue_size": self.batcher.queue_size,
                     "max_batch_size": self.batcher.max_batch_size,
@@ -160,7 +255,8 @@ class ModelRegistry:
         self._closed = False
 
     # ------------------------------------------------------------ lifecycle
-    def load(self, name, servable, version=None, **batcher_kw):
+    def load(self, name, servable, version=None, prewarm=None,
+             warm_spec=None, warm_timeout=None, **batcher_kw):
         """Register (or hot-reload) ``name``. Returns the installed version.
 
         First load creates the entry + its batcher (batcher_kw:
@@ -168,13 +264,29 @@ class ModelRegistry:
         default_deadline_ms — defaults come from MXTPU_SERVE_*). A load on
         an existing name installs the next version and repoints dispatch;
         in-flight batches finish on the old servable.
+
+        Prewarm (``prewarm`` default: MXTPU_AOT_PREWARM): when a per-item
+        input signature is known — ``warm_spec`` (a list of
+        ``(shape, dtype)`` per model input, no batch dim) or the batcher's
+        observed signature from prior traffic — the incoming version is
+        registered un-routed and every configured bucket is compiled
+        through the shared AOT cache on a background thread, smallest
+        bucket first; dispatch cuts over right after the first bucket and
+        this call returns once all buckets are warm (bounded by
+        ``warm_timeout`` / MXTPU_AOT_WARM_TIMEOUT_S — on timeout the warm
+        keeps going in the background and dispatch still cuts over as soon
+        as one bucket is ready). With no signature available (first load,
+        no warm_spec) or prewarm=False, dispatch repoints immediately and
+        buckets compile lazily on first dispatch.
         """
         servable = _as_servable(servable)
-        # install happens INSIDE the registry lock: paired with unload()'s
-        # locked entry-removal check this makes load-vs-unload-of-the-last-
-        # version atomic (never installs into an entry whose batcher a
-        # concurrent unload is closing), and concurrent hot-reloads
-        # serialize on the entry lock inside install()
+        # install/add_version happens INSIDE the registry lock: paired
+        # with unload()'s locked entry-removal check this makes
+        # load-vs-unload-of-the-last-version atomic (never installs into
+        # an entry whose batcher a concurrent unload is closing), and
+        # concurrent hot-reloads serialize on the entry lock inside
+        # install()/add_version()
+        warm_thread = None
         with self._lock:
             if self._closed:
                 raise RuntimeError("registry is shut down")
@@ -185,7 +297,28 @@ class ModelRegistry:
             elif batcher_kw:
                 raise ValueError("batcher options are fixed at first load "
                                  "of %r" % name)
-            return entry.install(servable, version)
+            if prewarm is None:
+                prewarm = config.get_env("MXTPU_AOT_PREWARM")
+            item_sig = warm_spec if warm_spec is not None \
+                else entry.batcher.last_item_sig
+            if prewarm and item_sig:
+                version = entry.add_version(servable, version)
+                warm_thread = threading.Thread(
+                    target=entry.warm, args=(servable, version, item_sig),
+                    daemon=True, name="mxtpu-aot-warm-%s" % name)
+                warm_thread.start()
+            else:
+                version = entry.install(servable, version)
+        if warm_thread is not None:
+            if warm_timeout is None:
+                warm_timeout = config.get_env("MXTPU_AOT_WARM_TIMEOUT_S")
+            warm_thread.join(warm_timeout)
+            if warm_thread.is_alive():
+                _LOG.warning(
+                    "prewarm of model %r v%s still running after %.1fs — "
+                    "returning; remaining buckets finish in the background",
+                    name, version, warm_timeout)
+        return version
 
     def unload(self, name, version=None, drain=True, timeout=30.0):
         """Drop one version (default: current). Dropping the last version

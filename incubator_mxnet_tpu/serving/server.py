@@ -29,6 +29,11 @@ Routes (TF-Serving REST-shaped):
 - ``GET /debug/flightrec``      — the flight-recorder ring as JSONL
   (newest last).
 - ``GET /debug/spans``          — the finished-span ring as JSONL.
+- ``GET /debug/aot``            — the process-wide AOT executable cache:
+  one JSON record per compiled entry (model id, kind, input signature,
+  build vs artifact provenance, idle time) — the live "what is compiled
+  right now" view behind the zero-recompile serving contract
+  (docs/AOT.md).
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
@@ -129,6 +134,9 @@ class _Handler(BaseHTTPRequestHandler):
             from ..telemetry import spans
             self._send_text(200, spans.export_jsonl(),
                             "application/jsonl; charset=utf-8")
+        elif self.path == "/debug/aot":
+            from .. import aot
+            self._send(200, {"entries": aot.CACHE.snapshot()})
         elif self.path.rstrip("/") == _MODELS_PREFIX:
             self._send(200, {"models": self.registry.models()})
         elif self.path.startswith(_MODELS_PREFIX + "/"):

@@ -32,15 +32,38 @@ def test_exec_cache_bound(monkeypatch):
     assert list(cache) == [3, 4]
 
 
-def test_eval_step_cache_evicts(monkeypatch):
-    from incubator_mxnet_tpu import gluon, jit
+def test_exec_cache_bound_lru_and_on_evict(monkeypatch):
+    """evict_to_bound is LRU when callers move-to-end on hit, and the
+    on_evict hook sees every victim."""
     monkeypatch.setenv("MXTPU_EXEC_CACHE_SIZE", "2")
+    cache = {1: "a", 2: "b"}
+    cache[1] = cache.pop(1)          # touch 1: recency order is 2, 1
+    cache[3] = "c"
+    evicted = []
+    config.evict_to_bound(cache, on_evict=lambda k, v: evicted.append(k))
+    assert list(cache) == [1, 3] and evicted == [2]
+
+
+def test_eval_step_cache_evicts(monkeypatch):
+    """EvalStep entries live in the shared AOT cache, bounded by
+    MXTPU_AOT_CACHE_SIZE with LRU-by-last-dispatch eviction."""
+    from incubator_mxnet_tpu import aot, gluon, jit
+    monkeypatch.setenv("MXTPU_AOT_CACHE_SIZE", "2")
     net = gluon.nn.Dense(3, in_units=4)
     net.initialize()
     step = jit.EvalStep(net)
     for n in (2, 3, 4, 5):
         step(nd.ones((n, 4)))
-    assert len(step._cache) == 2
+    keys = aot.CACHE.keys()
+    assert len(keys) == 2
+    shapes = sorted(k.input_sig[0][0] for k in keys)
+    assert shapes == [(4, 4), (5, 4)]
+    # LRU, not dict order: re-dispatching the older survivor then adding
+    # a new shape must evict the untouched one, never the hot one
+    step(nd.ones((4, 4)))
+    step(nd.ones((6, 4)))
+    shapes = sorted(k.input_sig[0][0] for k in aot.CACHE.keys())
+    assert shapes == [(4, 4), (6, 4)]
 
 
 def test_no_donate_env(monkeypatch):

@@ -782,6 +782,53 @@ def test_r011_dict_literal_and_varying_args(tmp_path):
     assert rule_ids(findings) == ["R011", "R011", "R011"]
 
 
+def test_r011_aot_boundary_symbol_import(tmp_path):
+    # the AOT executable-cache entry point is a jit boundary: a dict
+    # literal in its args defeats/rekeys the shared cache per call
+    findings = run_project(tmp_path, {"warm.py": """
+        from incubator_mxnet_tpu.aot import compile_cached
+
+        def model(x):
+            return x
+
+        def warm(key):
+            compile_cached(key, lambda: (model, None, None), {"opt": 1})
+            return compile_cached(key, lambda: (model, None, None))  # clean
+    """})
+    assert rule_ids(findings) == ["R011"]
+
+
+def test_r011_aot_boundary_project_local_module(tmp_path):
+    findings = run_project(tmp_path, {
+        "aot.py": """
+            def compile_cached(key, build, extra=None):
+                return build()
+        """,
+        "serve.py": """
+            from aot import compile_cached
+
+            def dispatch(key, build):
+                return compile_cached(key, build, {"device": 0})
+        """})
+    assert rule_ids(findings) == ["R011"]
+
+
+def test_r011_aot_boundary_negative_same_name_elsewhere(tmp_path):
+    # a compile_cached defined in a NON-aot module is not the boundary
+    findings = run_project(tmp_path, {
+        "helpers.py": """
+            def compile_cached(key, build, extra=None):
+                return build()
+        """,
+        "serve.py": """
+            from helpers import compile_cached
+
+            def dispatch(key, build):
+                return compile_cached(key, build, {"device": 0})
+        """})
+    assert "R011" not in rule_ids(findings)
+
+
 def test_r011_step_class_boundary(tmp_path):
     findings = run_project(tmp_path, {"serve.py": """
         class EvalStep:
@@ -1074,13 +1121,14 @@ def test_r001_interprocedural_depth_is_one(tmp_path):
 
 
 # --------------------------------------------------------- seeded defects
-def test_seeded_defects_exactly_three():
+def test_seeded_defects_exactly_four():
     """The regression canary: the fixture module contains one deadlock
-    cycle, one unlocked cross-thread write, one retrace hazard — the
-    analyzer must report exactly those three (ci/run.sh asserts the same
+    cycle, one unlocked cross-thread write, one jax.jit retrace hazard,
+    and one AOT-boundary (aot.compile_cached) retrace hazard — the
+    analyzer must report exactly those four (ci/run.sh asserts the same
     thing in the lint stage)."""
     findings = analyze([SEEDED], root=SEEDED)
-    assert rule_ids(findings) == ["R009", "R010", "R011"], findings
+    assert rule_ids(findings) == ["R009", "R010", "R011", "R011"], findings
 
 
 def test_seeded_defects_clean_under_repo_gate_profile():

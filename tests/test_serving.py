@@ -440,7 +440,9 @@ def test_metrics_snapshot_counters_and_percentiles():
 
 def test_block_servable_buckets_hit_executable_cache():
     """A live Gluon block behind the batcher compiles once per bucket
-    (EvalStep's shape-keyed cache), not once per batch size."""
+    (the shared AOT executable cache), not once per batch size."""
+    from incubator_mxnet_tpu import aot
+
     net = gluon.nn.Dense(3, in_units=4)
     net.initialize()
     sv = BlockServable(net)
@@ -449,8 +451,13 @@ def test_block_servable_buckets_hit_executable_cache():
     for _ in range(3):
         out = reg.predict("dense", onp.ones((4,), "float32"))
         assert out[0].shape == (3,)
-    # every dispatch was a 1-item batch padded to bucket 1 -> ONE cache entry
-    assert len(sv._step._cache) == 1
+    # every dispatch was a 1-item batch padded to bucket 1 -> ONE shared-
+    # cache entry at the bucket-1 signature for this model id (other
+    # suites may have compiled the same architecture at other shapes)
+    mid = sv._step._model_id
+    entries = [k for k in aot.CACHE.keys()
+               if k.model_id == mid and k.input_sig == (((1, 4), "float32"),)]
+    assert len(entries) == 1
     reg.close()
 
 

@@ -46,6 +46,18 @@ JIT_WRAPPERS = {"jit", "checkpoint", "value_and_grad", "grad", "vmap",
 #: goes through a shape/dtype-keyed executable cache.
 STEP_CLASSES = {"TrainStep", "EvalStep"}
 
+#: AOT executable-cache entry point (incubator_mxnet_tpu/aot.py): a call
+#: site hands a builder to the shared compiled-executable cache, keyed by
+#: the CacheKey argument — the same retrace-hazard surface as a direct
+#: jax.jit call (an unhashable/varying argument here defeats the cache or
+#: forces a rebuild per call), so R011 treats it as a jit boundary.
+#: Covers the module-level facade only: the AOTCache.get_or_build METHOD
+#: is reached through the CACHE instance global, which the indexer cannot
+#: type (no instance typing for module-level objects) — callers are
+#: expected to go through compile_cached.
+AOT_BOUNDARY_FUNCS = {"compile_cached"}
+AOT_MODULE_NAME = "aot"
+
 _LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
                "Condition"}
 _EVENT_CTORS = {"Event"}
@@ -1097,8 +1109,32 @@ class _FunctionWalker:
             if ext.startswith("jax.") \
                     and ext.split(".")[-1] in JIT_WRAPPERS:
                 kind = "jit"
+        if kind is None and self._is_aot_boundary(func):
+            kind = "jit"
         if kind:
             self.fn.jit_callsites.append((node, kind))
+
+    def _is_aot_boundary(self, func):
+        """aot.compile_cached(...)-family call? Resolved project-locally
+        (the callee is a function named in AOT_BOUNDARY_FUNCS defined in
+        an ``aot`` module) or through import aliases when the aot module
+        is outside the analysis root (``from incubator_mxnet_tpu.aot
+        import compile_cached``)."""
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name not in AOT_BOUNDARY_FUNCS:
+            return False
+        resolved = self.index.resolve_call_target(self.mod, self.fn, func,
+                                                  self.local_types)
+        if isinstance(resolved, FunctionInfo):
+            return resolved.module.dotted.split(".")[-1] == AOT_MODULE_NAME
+        ext = self.index.resolve_external(self.mod, func, self.fn)
+        parts = ext.split(".")
+        return len(parts) >= 2 and parts[-1] in AOT_BOUNDARY_FUNCS \
+            and parts[-2] == AOT_MODULE_NAME
 
     def handle_assign(self, node, held):
         targets = node.targets if isinstance(node, ast.Assign) \

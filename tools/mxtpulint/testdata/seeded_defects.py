@@ -9,12 +9,16 @@ silently stop firing is worse than no lint):
 - an unlocked cross-thread write (R010): the worker bumps ``_progress``
   while ``read_progress`` reads it with no common lock,
 - a jit retrace hazard (R011): a dict literal argument at a ``jax.jit``
-  call site.
+  call site,
+- an AOT-path retrace hazard (R011): a dict literal argument at an
+  ``aot.compile_cached`` boundary (the shared executable cache keys on
+  its arguments the same way jax.jit keys on statics — an unhashable
+  per-call object defeats the cache).
 
 This file lives under tools/, so the REPO gate lints it only under the
 relaxed R003/R005/R006 profile (under which it is clean); the regression
 test and ci/run.sh analyze it with the FULL profile rooted at this
-directory and assert exactly these three findings.
+directory and assert exactly these four findings.
 """
 import threading
 
@@ -63,3 +67,10 @@ def _model(x):
 def predict(x):
     jitted = jax.jit(_model)
     return jitted(x, {"mode": "fast"})   # R011: fresh dict per call
+
+
+def warm(x):
+    from incubator_mxnet_tpu.aot import compile_cached
+    # R011: dict literal flowing into the AOT executable-cache boundary
+    return compile_cached(("m", "eval", ((4,), "float32")),
+                          lambda: (_model, None, None), {"device": 0})
