@@ -122,7 +122,66 @@ def bench_with_pipeline(batch=256, steps=10):
     }))
 
 
+def bench_gate(steps=30):
+    """``python bench.py --gate``: the quick deterministic tiny-model CPU
+    run that emits ONE perfgate metrics dict (mxtpu-perfgate-metrics-v1,
+    tools/perfgate.py) on stdout — the machine-comparable form of a
+    BENCH_r* trajectory point. Metrics are per-call MINIMA over ``steps``
+    timed calls (co-tenant noise only ever adds — docs/LOADGEN.md), with
+    compiles paid OUTSIDE the timed loops, so two runs of the same code
+    on the same machine agree to the timer floor instead of to prose."""
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, jit
+    from incubator_mxnet_tpu.serving import ModelRegistry
+
+    def min_ms(fn, n=steps):
+        best = None
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            dt = (time.perf_counter() - t0) * 1e3
+            best = dt if best is None or dt < best else best
+        return best
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(16, in_units=32)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(8, 32))
+    y = nd.array(onp.zeros((8,), "float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = jit.TrainStep(net, loss_fn, trainer)
+    float(step(x, y).mean().asscalar())          # compile outside the clock
+    train_ms = min_ms(lambda: float(step(x, y).mean().asscalar()))
+    del step, trainer
+
+    eval_step = jit.EvalStep(net)
+    eval_step(x).asnumpy()
+    eval_ms = min_ms(lambda: eval_step(x).asnumpy())
+
+    # end-to-end serving round trip through the batcher (bucket 1): the
+    # request-path overhead every serving perf PR rides on
+    reg = ModelRegistry()
+    reg.load("gate", net, max_batch_size=4, batch_timeout_ms=1.0)
+    item = onp.zeros((32,), "float32")
+    reg.predict("gate", item)                    # bucket-1 compile
+    serve_ms = min_ms(lambda: reg.predict("gate", item), n=min(steps, 20))
+    reg.close()
+
+    out = {"schema": "mxtpu-perfgate-metrics-v1",
+           "metrics": {"bench_tiny_train_step_ms": round(train_ms, 3),
+                       "bench_tiny_eval_step_ms": round(eval_ms, 3),
+                       "bench_tiny_serve_roundtrip_ms": round(serve_ms, 3)}}
+    print(json.dumps(out))
+    return out
+
+
 def main():
+    if "--gate" in sys.argv:
+        return bench_gate()
+
     import numpy as onp
     import jax
 

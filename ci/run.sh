@@ -32,6 +32,14 @@
 #             GET /metrics over the wire, and validate the Prometheus
 #             exposition with the stdlib parser (tools/promcheck.py);
 #             also exercises the headless periodic-flush file path
+#   loadgen - open-loop load harness + perf regression gate: three
+#             interleaved CPU soak repeats (tools/loadgen.py: Poisson
+#             ramp over a timer-bound servable, per-stage p50/95/99,
+#             X-Request-Id span join, detected saturation point), then
+#             tools/perfgate.py aggregates per-metric minima across the
+#             repeats and HARD-FAILS outside PERF_BASELINE.json's
+#             tolerance bands — plus the injected-2x-regression canary
+#             proving the gate can still fire (docs/LOADGEN.md)
 #   diagnostics - the "why is it slow / why is it stuck" layer: span
 #             tracing (nesting, queue-boundary propagation, chrome-trace
 #             parenting, 16-thread race), flight recorder (ring bound,
@@ -48,7 +56,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability loadgen diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -209,6 +217,81 @@ telemetry.flush_to_file(path)
 promcheck.validate(open(path).read())
 print("observability OK: %d families scraped + flushed" % len(types))
 EOF
+fi
+
+if has_stage loadgen; then
+  echo "=== loadgen: open-loop soak + noise-robust perf gate ==="
+  # Three interleaved soak repeats against a TIMER-bound servable (fixed
+  # 5 ms per dispatched batch), so capacity — and therefore the detected
+  # saturation stage and stage-0 latency — is set by clocks, not by host
+  # speed: the committed PERF_BASELINE.json holds across machines.
+  # Co-tenant noise only ever inflates a repeat, so perfgate's
+  # per-metric minima across the repeats recover the clean numbers.
+  lg_t0=$SECONDS
+  LG_DIR=$(mktemp -d -t mxtpu_loadgen.XXXXXX)
+  JAX_PLATFORMS=cpu python - "$LG_DIR" <<'EOF'
+import json, sys, time
+from tools import loadgen
+from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+
+class SlowEcho:
+    """Deterministic capacity: 5 ms per dispatched batch of <= 8, which
+    with the 2 ms gather window and worker cycle overhead puts the knee
+    at ~550 rps goodput on every machine (timer-bound, not host-bound —
+    the PERF_BASELINE.json loadgen_saturation_goodput_rps anchor)."""
+    def predict_batch(self, x):
+        time.sleep(0.005)
+        return (x,)
+
+out_dir = sys.argv[1]
+reg = ModelRegistry()
+reg.load("soak", SlowEcho(), max_batch_size=8, batch_timeout_ms=2.0,
+         queue_size=16)
+with ServingServer(reg, port=0) as srv:
+    for rep in range(3):
+        tr = loadgen.HttpTransport(srv.url, "soak", [0.0, 0.0, 0.0, 0.0])
+        lg = loadgen.LoadGen(tr, stages=[{"rps": 100, "duration_s": 1.2},
+                                         {"rps": 400, "duration_s": 1.2},
+                                         {"rps": 2000, "duration_s": 1.2}],
+                             arrival="poisson", seed=rep, max_clients=128)
+        report = lg.run()
+        path = "%s/report_%d.json" % (out_dir, rep)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        ci = loadgen.report_ci(report, path, max_error_rate=0.0,
+                               require_saturation=True)
+        sat = report["saturation"]
+        print("repeat %d: stage0 p50 %.1f ms, saturation at stage %s "
+              "(%.0f rps goodput), join coverage %.2f"
+              % (rep, report["gate_metrics"]["metrics"]
+                 ["loadgen_stage0_p50_ms"],
+                 sat["stage"] if sat else "-",
+                 sat["goodput_rps"] if sat else -1,
+                 report["gate_metrics"]["metrics"]
+                 ["loadgen_join_coverage"]))
+        assert ci["ok"], json.dumps(ci, indent=1)
+print("loadgen OK: 3 reports in %s (schema %s)"
+      % (out_dir, loadgen.REPORT_SCHEMA))
+EOF
+  # the gate proper: minima across the repeats vs the committed baseline
+  # (same one-parser JSON shape as mxtpulint/promcheck/loadgen)
+  python tools/perfgate.py --input "$LG_DIR"/report_*.json --json \
+      > "$LG_DIR/perfgate.json" \
+    || { python tools/perfgate.py --input "$LG_DIR"/report_*.json || true
+         exit 1; }
+  python -c "import json,sys; r=json.load(open(sys.argv[1])); \
+print('perfgate OK: gate artifact %s' % sys.argv[1])" "$LG_DIR/perfgate.json"
+  # seeded-regression canary: a synthetic 2x latency regression MUST
+  # fail the same baseline, or the gate has silently stopped firing
+  if python tools/perfgate.py --input "$LG_DIR"/report_*.json \
+      --selftest-inject 2.0 --json > "$LG_DIR/perfgate_inject.json"; then
+    echo "perfgate canary FAILED: injected 2x regression passed the gate"
+    exit 1
+  fi
+  echo "perfgate canary OK: injected 2x regression fires"
+  lg_dt=$(( SECONDS - lg_t0 ))
+  echo "loadgen stage wall time: ${lg_dt}s (budget 120s)"
+  [ "$lg_dt" -lt 120 ] || { echo "loadgen stage took ${lg_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage diagnostics; then

@@ -247,6 +247,40 @@ ENV_VARS = {
         "File the watchdog APPENDS stall reports to (all-thread stacks + "
         "flight-recorder tail). None: reports go to logging.error and "
         "stay readable at watchdog.last_report() / GET /debug/stacks."),
+    "MXTPU_LOADGEN_SEED": (
+        int, 0,
+        "Arrival-process RNG seed for the open-loop load generator "
+        "(tools/loadgen.py): Poisson inter-arrival draws are fully "
+        "deterministic given it, so two soaks offer byte-identical "
+        "schedules. Read stdlib-side by the tool (it must drive a remote "
+        "server without the framework importable); registered here for "
+        "docs and env hygiene (docs/LOADGEN.md)."),
+    "MXTPU_LOADGEN_TIMEOUT_S": (
+        float, 30.0,
+        "Per-request HTTP timeout for the load generator's clients; a "
+        "request past it records a transport error (status 599), never "
+        "a hang. Read stdlib-side by tools/loadgen.py."),
+    "MXTPU_LOADGEN_MAX_CLIENTS": (
+        int, 256,
+        "Bound on the load generator's concurrent in-flight requests. "
+        "Arrivals past the bound are recorded as client-dropped (the "
+        "offered-load accounting stays exact) instead of silently "
+        "unsent or queued client-side — client-side queueing would "
+        "re-introduce the coordinated-omission bias the open-loop "
+        "design exists to avoid. Read stdlib-side by tools/loadgen.py."),
+    "MXTPU_PERFGATE_REPEATS": (
+        int, 3,
+        "Default repeat count for tools/perfgate.py --cmd runs: repeats "
+        "interleave in time and the gate aggregates per-metric minima "
+        "(maxima for higher-is-better), so co-tenant noise — which only "
+        "ever inflates a latency or deflates a throughput — is absorbed "
+        "instead of widening tolerance bands (docs/LOADGEN.md)."),
+    "MXTPU_PERFGATE_TOLERANCE": (
+        float, 0.5,
+        "Default relative tolerance band for perfgate metrics whose "
+        "PERF_BASELINE.json entry doesn't pin its own: lower-is-better "
+        "fails past baseline*(1+tol), higher-is-better below "
+        "baseline*(1-tol). Read stdlib-side by tools/perfgate.py."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

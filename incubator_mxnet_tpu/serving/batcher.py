@@ -149,6 +149,14 @@ class DynamicBatcher:
             else ServingMetrics(model=name)
         self.metrics.queue_depth_fn = lambda: self._queue.qsize()
         self._queue = _queue.Queue(maxsize=qsize)
+        # per-bucket dispatch-stage depth: requests gathered into a bucket
+        # and not yet completed (padding + servable + slicing). Written by
+        # the worker, sampled by scrape threads at exposition time — its
+        # own leaf lock, never held while acquiring anything else
+        self._depth_lock = threading.Lock()
+        self._bucket_depth = dict.fromkeys(self.buckets, 0)
+        for b in self.buckets:
+            self.metrics.bind_bucket_depth(b, self._bucket_depth_reader(b))
         self._closed = False
         self._paused = False
         # per-item (shape, dtype) signature of the most recently dispatched
@@ -234,6 +242,19 @@ class DynamicBatcher:
 
     def queue_depth(self):
         return self._queue.qsize()
+
+    def _bucket_depth_reader(self, bucket):
+        """Sampler closure for one bucket's dispatch-stage depth gauge."""
+        def read():
+            with self._depth_lock:
+                return self._bucket_depth.get(bucket, 0)
+        return read
+
+    def bucket_depths(self):
+        """{bucket -> in-dispatch request count} snapshot (test hook; the
+        scrape surface is the mxtpu_serving_bucket_queue_depth gauge)."""
+        with self._depth_lock:
+            return dict(self._bucket_depth)
 
     @property
     def last_item_sig(self):
@@ -369,6 +390,15 @@ class DynamicBatcher:
         n = len(live)
         bucket = self._bucket_for(n)
         t0 = time.monotonic()
+        with self._depth_lock:
+            self._bucket_depth[bucket] = self._bucket_depth.get(bucket, 0) + n
+        try:
+            self._dispatch_bucketed(live, n, bucket, t0)
+        finally:
+            with self._depth_lock:
+                self._bucket_depth[bucket] -= n
+
+    def _dispatch_bucketed(self, live, n, bucket, t0):
         with self._sig_lock:
             self._last_item_sig = tuple((x.shape, x.dtype.str)
                                         for x in live[0].inputs)

@@ -84,6 +84,28 @@ _LATENCY_MS = telemetry.histogram(
 _QUEUE_DEPTH = telemetry.gauge(
     "mxtpu_serving_queue_depth",
     "Requests currently waiting in the model's bounded queue.", ("model",))
+_BUCKET_DEPTH = telemetry.gauge(
+    "mxtpu_serving_bucket_queue_depth",
+    "Requests gathered into this batch bucket and not yet completed "
+    "(padding + servable dispatch + result slicing). Together with "
+    "mxtpu_serving_queue_depth this splits waiting time into queue vs "
+    "dispatch — the per-bucket saturation signal the load harness joins "
+    "against client latency (docs/LOADGEN.md).", ("model", "bucket"))
+_HTTP_INFLIGHT = telemetry.gauge(
+    "mxtpu_http_inflight_requests",
+    "Predict requests currently held by the HTTP front-end (body read "
+    "through response written). Tracks client-side concurrency pressure: "
+    "rising inflight with flat queue depth means time is spent outside "
+    "the batcher (docs/LOADGEN.md).")
+
+def http_request_started():
+    """One predict request entered the HTTP front-end (server.py)."""
+    _HTTP_INFLIGHT.inc()
+
+
+def http_request_finished():
+    _HTTP_INFLIGHT.dec()
+
 
 _COUNTER_MAP = {
     "request_count": _REQS,
@@ -119,6 +141,7 @@ class ServingMetrics:
         self.batch_size_hist = {}     # real batch size -> count
         self._latencies_ms = deque(maxlen=latency_window)
         self._queue_depth_fn = None   # injected by the batcher
+        self._bucket_depth_fns = []   # per-bucket samplers, ditto
 
     # ------------------------------------------------------------------
     @property
@@ -132,6 +155,13 @@ class ServingMetrics:
             # sampled at scrape time — depth is a point-in-time gauge
             _QUEUE_DEPTH.set_function(fn, model=self.model)
 
+    def bind_bucket_depth(self, bucket, fn):
+        """Register ``fn() -> depth`` as the sampler for one batch bucket
+        (batcher init — buckets are known up front, so cardinality is
+        bounded by the bucket list, not by traffic)."""
+        self._bucket_depth_fns.append(fn)
+        _BUCKET_DEPTH.set_function(fn, model=self.model, bucket=bucket)
+
     def detach_telemetry(self):
         """Drop this instance's gauge-callback series from the shared
         registry (batcher close/unload): a dead model must not keep
@@ -142,6 +172,8 @@ class ServingMetrics:
         found. Counters/histograms stay — they are process-lifetime
         cumulative by Prometheus convention."""
         _QUEUE_DEPTH.remove_function(self._queue_depth_fn)
+        for fn in self._bucket_depth_fns:
+            _BUCKET_DEPTH.remove_function(fn)
 
     # ------------------------------------------------------------------
     def inc(self, counter, n=1):

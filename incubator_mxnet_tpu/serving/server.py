@@ -59,6 +59,7 @@ from .. import config
 from .. import telemetry
 from .batcher import (DeadlineExceededError, QueueFullError,
                       ServingClosedError)
+from .metrics import http_request_finished, http_request_started
 from .registry import ModelNotFoundError, ModelRegistry
 
 __all__ = ["ServingServer", "serve"]
@@ -158,13 +159,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": "no route %r (POST "
                              "/v1/models/<name>:predict)" % self.path})
             return
-        import numpy as onp
         name = self._model_name()
         # request-scoped trace id: a client-supplied X-Request-Id wins (the
         # caller's trace context survives), else assign one here — this is
         # the id the batcher carries queue -> dispatch -> profiler event
         req_id = self.headers.get(telemetry.REQUEST_ID_HEADER) \
             or telemetry.new_request_id()
+        # inflight gauge covers body read through response written — the
+        # front-end concurrency signal the load harness reads per stage
+        http_request_started()
+        try:
+            self._do_predict(name, req_id)
+        finally:
+            http_request_finished()
+
+    def _do_predict(self, name, req_id):
+        import numpy as onp
         try:
             length = int(self.headers.get("Content-Length") or 0)
             req = json.loads(self.rfile.read(length) or b"{}")

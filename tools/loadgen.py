@@ -1,0 +1,697 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serving HTTP front-end — stdlib only.
+
+Closed-loop clients (N threads in a request/response loop) measure a
+fiction under overload: a saturated server slows the *offered* load down,
+so the reported latency quietly excludes the requests that would have
+been sent — the coordinated-omission trap. This harness is OPEN-LOOP
+(MLPerf inference "server" scenario, arxiv 1909.09756): arrivals are
+scheduled by the clock from a defined arrival process (Poisson or
+constant), never by completions, so overload shows up where it belongs —
+in p99, in 429/504 shed rates, and in the goodput-vs-offered gap.
+
+A run is a RAMP of stages (``[{"rps": r, "duration_s": d}, ...]``). Per
+stage the report carries client-observed p50/95/99 latency, offered vs
+goodput RPS, shed/error rates — and, because every request carries a
+generated ``X-Request-Id`` that the server echoes into its spans, a
+server-side JOIN: between stages the harness scrapes ``GET /metrics``
+and ``GET /debug/spans`` and attributes each stage's time to queue wait
+(``serve:queue``), batch dispatch (``serve:batch``), and device step
+(``eval:step``) — *where* the time went, not just that it grew.
+
+Saturation point (detect_saturation): the first stage where offered load
+rose but goodput plateaued (less than ``goodput_frac`` of the added
+offered load converted) while the tail diverged (p99 grew past
+``p99_ratio``× the previous stage's, or the shed rate crossed
+``shed_min`` while rising).
+
+Usage::
+
+    python tools/loadgen.py --url http://host:8080 --model m \\
+        --item '[0.0, 0.0, 0.0, 0.0]' --stages 100x2,400x2,1600x2 \\
+        --out report.json [--json] [--arrival poisson|constant]
+
+``--json`` additionally emits the shared CI report shape (``tool`` /
+``ok`` / ``findings`` / ``counts`` / ``baselined`` — the same parser
+that reads ``python -m tools.mxtpulint --json`` and ``tools/promcheck.py
+--json`` reads this; violations carry rule id L001). The report's
+``gate_metrics`` section is in the perfgate metrics schema, so
+``tools/perfgate.py --input report.json`` gates a run directly
+(docs/LOADGEN.md).
+
+The module is import-light on purpose: driving a remote server must not
+require the framework (or jax) to be importable. The MXTPU_LOADGEN_*
+knobs are therefore read from the environment here but REGISTERED in
+incubator_mxnet_tpu/config.py (docs/ENV_VARS.md); tests pin the two
+default tables in sync.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import re
+import sys
+import threading
+import time
+import queue as _queue
+import urllib.error
+import urllib.request
+
+__all__ = ["LoadGen", "HttpTransport", "arrival_offsets", "percentile",
+           "parse_prom", "summarize_stage", "detect_saturation",
+           "gate_metrics", "report_ci", "REPORT_SCHEMA", "METRICS_SCHEMA"]
+
+REPORT_SCHEMA = "mxtpu-loadgen-report-v1"
+METRICS_SCHEMA = "mxtpu-perfgate-metrics-v1"
+
+# Mirrors config.ENV_VARS (registered there for docs/ENV_VARS.md and env
+# hygiene); tests/test_loadgen.py asserts the two tables agree.
+ENV_DEFAULTS = {
+    "MXTPU_LOADGEN_SEED": 0,
+    "MXTPU_LOADGEN_TIMEOUT_S": 30.0,
+    "MXTPU_LOADGEN_MAX_CLIENTS": 256,
+}
+
+#: status code recorded for transport-level failures (refused/reset/
+#: timeout) — outside the HTTP space so it can't collide with a server code
+TRANSPORT_ERROR = 599
+#: status code recorded for arrivals shed CLIENT-side because the
+#: in-flight bound (MXTPU_LOADGEN_MAX_CLIENTS) was hit
+CLIENT_DROPPED = 0
+
+
+def _env(name):
+    default = ENV_DEFAULTS[name]
+    raw = os.environ.get(name)
+    return type(default)(raw) if raw is not None else default
+
+
+# ------------------------------------------------------------------ arrivals
+def arrival_offsets(mode, rps, duration_s, rng=None):
+    """Send offsets in seconds from stage start, ascending.
+
+    ``constant``: exactly ``round(rps * duration_s)`` arrivals on a fixed
+    grid. ``poisson``: exponential inter-arrivals at rate ``rps`` (the
+    memoryless open-loop process real traffic approximates) — fully
+    deterministic given the seeded ``rng``.
+    """
+    if rps <= 0 or duration_s <= 0:
+        return []
+    if mode == "constant":
+        return [i / float(rps) for i in range(int(round(rps * duration_s)))]
+    if mode != "poisson":
+        raise ValueError("unknown arrival mode %r (poisson|constant)" % mode)
+    if rng is None:
+        rng = random.Random(_env("MXTPU_LOADGEN_SEED"))
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(float(rps))
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def percentile(sorted_values, q):
+    """Nearest-rank percentile of an ascending-sorted list (same epsilon
+    semantics as serving.metrics.percentile; duplicated so the tool stays
+    framework-import-free)."""
+    if not sorted_values:
+        return None
+    n = len(sorted_values)
+    q = min(max(float(q), 0.0), 100.0)
+    rank = int(math.ceil(n * q / 100.0 - 1e-9))
+    return sorted_values[min(max(rank, 1), n) - 1]
+
+
+def _pctls(values):
+    ordered = sorted(values)
+    return {"p50": percentile(ordered, 50), "p95": percentile(ordered, 95),
+            "p99": percentile(ordered, 99)}
+
+
+# ------------------------------------------------------- Prometheus parsing
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom(text):
+    """{(name, ((label, value), ...)) -> float} for every sample line —
+    the minimal scrape reader (tools/promcheck.py is the format
+    VALIDATOR; this only needs the values)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            v = float(raw)
+        except ValueError:
+            v = {"+Inf": math.inf, "-Inf": -math.inf}.get(raw, math.nan)
+        out[(name, tuple(sorted(_LABEL_RE.findall(labels))))] = v
+    return out
+
+
+def _prom_sum(snapshot, name):
+    return sum(v for (n, _l), v in snapshot.items() if n == name)
+
+
+def _prom_series(snapshot, name):
+    return {lbls: v for (n, lbls), v in snapshot.items() if n == name}
+
+
+# ----------------------------------------------------------------- transport
+class HttpTransport:
+    """The real client: one ``POST /v1/models/<model>:predict`` per
+    ``send()``, plus the scrape endpoints the per-stage join reads.
+    ``item`` is ONE input item (no batch dim) — cross-request batching is
+    the server's job."""
+
+    def __init__(self, url, model, item, deadline_ms=None, timeout_s=None):
+        self.url = url.rstrip("/")
+        self._predict_url = "%s/v1/models/%s:predict" % (self.url, model)
+        body = {"inputs": [item]}
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        self._body = json.dumps(body).encode("utf-8")
+        self._timeout = (float(timeout_s) if timeout_s is not None
+                         else _env("MXTPU_LOADGEN_TIMEOUT_S"))
+
+    def send(self, request_id):
+        """Fire one predict; returns the HTTP status (TRANSPORT_ERROR for
+        refused/reset/timeout)."""
+        req = urllib.request.Request(
+            self._predict_url, data=self._body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": request_id})
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                r.read()
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.close()
+            return e.code
+        except Exception:  # refused / reset / timeout
+            return TRANSPORT_ERROR
+
+    def _get(self, path):
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=self._timeout) as r:
+            return r.read().decode("utf-8")
+
+    def scrape(self):
+        """GET /metrics text, or '' when unreachable (the join degrades,
+        the soak itself keeps its client-side numbers)."""
+        try:
+            return self._get("/metrics")
+        except Exception:
+            return ""
+
+    def spans(self):
+        """GET /debug/spans JSONL, or ''."""
+        try:
+            return self._get("/debug/spans")
+        except Exception:
+            return ""
+
+
+class _MonotonicClock:
+    """The real clock: monotonic now() + time.sleep."""
+
+    def now(self):
+        return time.monotonic()
+
+    def sleep(self, s):
+        time.sleep(s)
+
+
+# --------------------------------------------------------------- summarizing
+def summarize_stage(stage_cfg, n_offered, results, span_text="",
+                    prom_before=None, prom_after=None):
+    """One stage's report entry from raw per-request results.
+
+    ``results``: [{"rid", "status", "latency_ms"}, ...] for every arrival
+    (CLIENT_DROPPED status for arrivals shed by the in-flight bound).
+    ``span_text``: /debug/spans JSONL scraped AFTER the stage — spans are
+    joined by the request ids this stage generated.
+    """
+    duration = float(stage_cfg["duration_s"])
+    by_status = {}
+    ok_lat, all_lat = [], []
+    rids, ok_rids = set(), set()
+    for r in results:
+        rids.add(r["rid"])
+        s = r["status"]
+        by_status[s] = by_status.get(s, 0) + 1
+        if s != CLIENT_DROPPED:
+            all_lat.append(r["latency_ms"])
+        if s == 200:
+            ok_lat.append(r["latency_ms"])
+            ok_rids.add(r["rid"])
+    ok = by_status.get(200, 0)
+    shed = by_status.get(429, 0) + by_status.get(504, 0)
+    dropped = by_status.get(CLIENT_DROPPED, 0)
+    errors = sum(c for s, c in by_status.items()
+                 if s not in (200, 429, 504, CLIENT_DROPPED))
+    out = {
+        "rps": stage_cfg["rps"],
+        "duration_s": duration,
+        "offered": n_offered,
+        "offered_rps": n_offered / duration if duration else 0.0,
+        "completed": len(results) - dropped,
+        "ok": ok,
+        "goodput_rps": ok / duration if duration else 0.0,
+        "shed": shed,
+        "shed_rate": shed / n_offered if n_offered else 0.0,
+        "errors": errors,
+        # server/transport failures only: a client-side drop (in-flight
+        # bound hit) is harness capacity, not a server regression — it
+        # gets its own rate so the two can't mask each other
+        "error_rate": errors / n_offered if n_offered else 0.0,
+        "client_dropped": dropped,
+        "client_drop_rate": dropped / n_offered if n_offered else 0.0,
+        "status_counts": {str(s): c for s, c in sorted(by_status.items())},
+        "latency_ms": _pctls(ok_lat),
+        "latency_all_ms": _pctls(all_lat),
+    }
+    out["server"] = _join_spans(rids, ok_rids, span_text)
+    if prom_before is not None and prom_after is not None:
+        out["server"]["metrics"] = _metrics_delta(prom_before, prom_after)
+    return out
+
+
+def _join_spans(rids, ok_rids, span_text):
+    """Attribute the stage's server-side time by span kind, joined on the
+    X-Request-Id each request carried: queue wait (serve:queue), batch
+    dispatch (serve:batch), device step (eval:step), and the server's own
+    view of the request (http:predict)."""
+    kinds = {"serve:queue": "queue_ms", "serve:batch": "batch_ms",
+             "eval:step": "device_ms", "http:predict": "http_ms"}
+    durs = {v: [] for v in kinds.values()}
+    joined_rids = set()
+    for line in span_text.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        rid = rec.get("request_id")
+        hit = rid in rids
+        if not hit:
+            # a batch span parents onto ONE request but carries every
+            # rider's id in args.request_ids — credit those too
+            riders = (rec.get("args") or {}).get("request_ids") or ()
+            hit = any(r in rids for r in riders)
+        if not hit:
+            continue
+        key = kinds.get(rec.get("name"))
+        if key is None:
+            continue
+        durs[key].append(rec.get("dur_us", 0.0) / 1e3)
+        if rec.get("name") == "serve:queue" and rid in ok_rids:
+            joined_rids.add(rid)
+    out = {}
+    for key, vals in durs.items():
+        out[key] = dict(_pctls(vals), count=len(vals),
+                        mean=(sum(vals) / len(vals)) if vals else None)
+    # coverage over OK responses only: a dispatched-then-504'd request
+    # also leaves a serve:queue span, and counting it against the OK
+    # denominator would push coverage past 1.0 under overload (masking a
+    # real join regression at the max-aggregating gate)
+    out["join_coverage"] = (len(joined_rids & ok_rids) / len(ok_rids)
+                            if ok_rids else None)
+    return out
+
+
+_DELTA_COUNTERS = (
+    "mxtpu_serving_requests_total", "mxtpu_serving_ok_total",
+    "mxtpu_serving_rejected_total", "mxtpu_serving_expired_total",
+    "mxtpu_serving_errors_total", "mxtpu_serving_batches_total",
+    "mxtpu_serving_batched_items_total", "mxtpu_jit_compiles_total",
+)
+_SNAP_GAUGES = (
+    "mxtpu_serving_queue_depth", "mxtpu_http_inflight_requests",
+)
+
+
+def _metrics_delta(before, after):
+    """Per-stage server-side counter deltas + end-of-stage gauge snapshot
+    from two /metrics scrapes (label sets summed per family)."""
+    out = {"delta": {}, "gauges": {}}
+    for name in _DELTA_COUNTERS:
+        d = _prom_sum(after, name) - _prom_sum(before, name)
+        if d or _prom_series(after, name):
+            out["delta"][name] = d
+    batches = out["delta"].get("mxtpu_serving_batches_total", 0)
+    items = out["delta"].get("mxtpu_serving_batched_items_total", 0)
+    out["mean_batch_size"] = (items / batches) if batches else None
+    for name in _SNAP_GAUGES:
+        series = _prom_series(after, name)
+        if series:
+            out["gauges"][name] = _prom_sum(after, name)
+    bucket = _prom_series(after, "mxtpu_serving_bucket_queue_depth")
+    if bucket:
+        out["gauges"]["mxtpu_serving_bucket_queue_depth"] = {
+            dict(lbls).get("bucket", "?"): v for lbls, v in bucket.items()}
+    return out
+
+
+def detect_saturation(stages, goodput_frac=0.5, p99_ratio=1.2,
+                      shed_min=0.01):
+    """First stage where goodput plateaus while the tail diverges.
+
+    A stage ``i`` saturates when offered load rose over stage ``i-1`` but
+    (a) less than ``goodput_frac`` of the ADDED offered load converted to
+    goodput, AND (b) p99 grew past ``p99_ratio`` × the previous stage's,
+    or the shed rate crossed ``max(shed_min, 2 × previous)``. Both legs
+    are required: a plateau alone can be a measurement floor; a p99 bump
+    alone can be one slow batch. Returns the stage's summary slice or
+    None (docs/LOADGEN.md has the worked example).
+    """
+    for i in range(1, len(stages)):
+        prev, cur = stages[i - 1], stages[i]
+        d_off = cur["offered_rps"] - prev["offered_rps"]
+        if d_off <= 0:
+            continue
+        if (cur["goodput_rps"] - prev["goodput_rps"]) >= goodput_frac * d_off:
+            continue
+        p99p = prev["latency_ms"].get("p99")
+        p99c = cur["latency_ms"].get("p99")
+        tail = (p99p is not None and p99c is not None
+                and p99c > p99_ratio * p99p)
+        shed = cur["shed_rate"] > max(shed_min, 2.0 * prev["shed_rate"])
+        if tail or shed:
+            return {"stage": i, "offered_rps": cur["offered_rps"],
+                    "goodput_rps": cur["goodput_rps"], "p99_ms": p99c,
+                    "shed_rate": cur["shed_rate"],
+                    "reason": ("tail" if tail else "")
+                              + ("+" if tail and shed else "")
+                              + ("shed" if shed else "")}
+    return None
+
+
+# -------------------------------------------------------------------- engine
+class LoadGen:
+    """Open-loop driver over an injectable transport + clock.
+
+    The real run uses ``HttpTransport`` and the monotonic clock with a
+    bounded worker pool (``max_clients`` in-flight; arrivals beyond the
+    bound are recorded as client_dropped, never silently unsent — the
+    offered-load accounting stays exact). Tests inject a fake clock and a
+    synchronous fake transport (``run(sync=True)``): the identical
+    scheduling/summarizing code runs with zero real sleeps.
+    """
+
+    def __init__(self, transport, stages, arrival="poisson", seed=None,
+                 max_clients=None, clock=None, settle_s=0.25, run_id=None,
+                 deadline_ms=None):
+        self.transport = transport
+        self.stages = [{"rps": float(s["rps"]),
+                        "duration_s": float(s["duration_s"])}
+                       for s in stages]
+        if not self.stages:
+            raise ValueError("need at least one stage")
+        self.arrival = arrival
+        self.seed = int(seed if seed is not None
+                        else _env("MXTPU_LOADGEN_SEED"))
+        self.max_clients = int(max_clients if max_clients is not None
+                               else _env("MXTPU_LOADGEN_MAX_CLIENTS"))
+        self.clock = clock if clock is not None else _MonotonicClock()
+        self.settle_s = settle_s
+        self.deadline_ms = deadline_ms
+        if run_id is None:
+            run_id = os.urandom(4).hex()
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._results = []            # per-request dicts, all stages
+
+    # ------------------------------------------------------------- workers
+    def _worker(self, q):
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            stage_idx, rid = item
+            t0 = self.clock.now()
+            try:
+                status = self.transport.send(rid)
+            except Exception:  # a raising transport is a transport error
+                status = TRANSPORT_ERROR
+            lat = (self.clock.now() - t0) * 1e3
+            with self._lock:
+                self._inflight -= 1
+                self._results.append({"stage": stage_idx, "rid": rid,
+                                      "status": status, "latency_ms": lat})
+
+    def _record_sync(self, stage_idx, rid):
+        t0 = self.clock.now()
+        try:
+            status = self.transport.send(rid)
+        except Exception:
+            status = TRANSPORT_ERROR
+        lat = (self.clock.now() - t0) * 1e3
+        self._results.append({"stage": stage_idx, "rid": rid,
+                              "status": status, "latency_ms": lat})
+
+    # -------------------------------------------------------------- driving
+    def _drive_stage(self, idx, stage, q, sync):
+        rng = random.Random(self.seed * 1000003 + idx)
+        offsets = arrival_offsets(self.arrival, stage["rps"],
+                                  stage["duration_s"], rng)
+        t0 = self.clock.now()
+        for seq, off in enumerate(offsets):
+            delay = t0 + off - self.clock.now()
+            if delay > 0:
+                self.clock.sleep(delay)
+            rid = "lg-%s-s%d-%d" % (self.run_id, idx, seq)
+            if sync:
+                self._record_sync(idx, rid)
+                continue
+            with self._lock:
+                admit = self._inflight < self.max_clients
+                if admit:
+                    self._inflight += 1
+            if admit:
+                q.put((idx, rid))
+            else:
+                # open-loop honesty: the arrival happened; the client
+                # could not carry it — recorded, not silently skipped
+                with self._lock:
+                    self._results.append(
+                        {"stage": idx, "rid": rid,
+                         "status": CLIENT_DROPPED, "latency_ms": 0.0})
+        return len(offsets)
+
+    def _drain(self, budget_s=30.0):
+        """Wait for in-flight requests to finish (bounded)."""
+        deadline = self.clock.now() + budget_s
+        while self.clock.now() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    return True
+            self.clock.sleep(0.02)
+        return False
+
+    def run(self, sync=False):
+        """Execute every stage; returns the report dict (REPORT_SCHEMA)."""
+        q = None
+        workers = []
+        if not sync:
+            q = _queue.SimpleQueue()
+            # exactly max_clients workers: every ADMITTED request has a
+            # thread to run on immediately. A pool smaller than the
+            # admission bound would queue admitted requests client-side
+            # with the wait excluded from latency — the coordinated-
+            # omission bias this tool exists to avoid.
+            workers = [threading.Thread(target=self._worker, args=(q,),
+                                        daemon=True,
+                                        name="loadgen-client-%d" % i)
+                       for i in range(self.max_clients)]
+            for w in workers:
+                w.start()
+        summaries = []
+        t_run0 = self.clock.now()
+        try:
+            prom_before = parse_prom(self.transport.scrape())
+            for idx, stage in enumerate(self.stages):
+                n_offered = self._drive_stage(idx, stage, q, sync)
+                if not sync:
+                    self._drain()
+                if self.settle_s:
+                    # let worker-side telemetry of the final batch land
+                    self.clock.sleep(self.settle_s)
+                span_text = self.transport.spans()
+                prom_after = parse_prom(self.transport.scrape())
+                with self._lock:
+                    mine = [r for r in self._results if r["stage"] == idx]
+                summaries.append(summarize_stage(
+                    stage, n_offered, mine, span_text,
+                    prom_before, prom_after))
+                prom_before = prom_after
+        finally:
+            for _w in workers:
+                q.put(None)
+            for w in workers:
+                w.join(5.0)
+        wall_s = self.clock.now() - t_run0
+        report = {
+            "schema": REPORT_SCHEMA,
+            "run_id": self.run_id,
+            "config": {"arrival": self.arrival, "seed": self.seed,
+                       "max_clients": self.max_clients,
+                       "deadline_ms": self.deadline_ms,
+                       "stages": self.stages},
+            "wall_s": wall_s,
+            "stages": summaries,
+            "saturation": detect_saturation(summaries),
+        }
+        report["gate_metrics"] = gate_metrics(report)
+        return report
+
+
+# ------------------------------------------------------------- gate bridging
+def gate_metrics(report):
+    """The run reduced to the flat perfgate metrics schema
+    (tools/perfgate.py): stage-0 (lowest-load) latency and conversion,
+    whole-run error rate and span-join coverage, and the saturation
+    verdict — the machine-comparable facts a perf PR is judged on."""
+    stages = report["stages"]
+    st0 = stages[0]
+    covs = [s["server"]["join_coverage"] for s in stages
+            if s["server"].get("join_coverage") is not None]
+    total_offered = sum(s["offered"] for s in stages)
+    total_bad = sum(s["errors"] for s in stages)
+    m = {
+        "loadgen_stage0_p50_ms": st0["latency_ms"]["p50"],
+        "loadgen_stage0_p99_ms": st0["latency_ms"]["p99"],
+        "loadgen_stage0_goodput_frac":
+            (st0["goodput_rps"] / st0["offered_rps"])
+            if st0["offered_rps"] else 0.0,
+        "loadgen_error_rate":
+            (total_bad / total_offered) if total_offered else 0.0,
+        "loadgen_join_coverage":
+            (sum(covs) / len(covs)) if covs else 0.0,
+        "loadgen_saturation_detected":
+            1.0 if report.get("saturation") else 0.0,
+    }
+    sat = report.get("saturation")
+    if sat:
+        m["loadgen_saturation_goodput_rps"] = sat["goodput_rps"]
+    # a stage-0 with no OK responses has no percentiles — drop the Nones
+    # rather than emit unparseable metrics
+    return {"schema": METRICS_SCHEMA,
+            "metrics": {k: v for k, v in m.items() if v is not None}}
+
+
+def report_ci(report, path="<report>", max_error_rate=0.0,
+              require_saturation=False):
+    """The shared CI report shape (one parser for mxtpulint / promcheck /
+    loadgen / perfgate): rule L001 per stage whose hard-error rate
+    exceeds ``max_error_rate``, plus one L001 when ``require_saturation``
+    and the ramp never saturated (a gate that can't find the knee isn't
+    measuring capacity)."""
+    findings = []
+    for i, s in enumerate(report["stages"]):
+        if s["error_rate"] > max_error_rate:
+            findings.append({
+                "path": path, "line": 0, "rule": "L001",
+                "message": "stage %d: server-error rate %.4f > %.4f "
+                           "(%d errors of %d offered; %d client-dropped "
+                           "reported separately)"
+                           % (i, s["error_rate"], max_error_rate,
+                              s["errors"], s["offered"],
+                              s["client_dropped"])})
+    if require_saturation and not report.get("saturation"):
+        findings.append({
+            "path": path, "line": 0, "rule": "L001",
+            "message": "no saturation point detected across %d stages — "
+                       "the ramp never found the knee (raise the top "
+                       "stage's rps)" % len(report["stages"])})
+    return {"tool": "loadgen", "ok": not findings, "findings": findings,
+            "counts": {"L001": len(findings)} if findings else {},
+            "baselined": 0}
+
+
+# ----------------------------------------------------------------------- CLI
+def _parse_stages(text):
+    """'100x2,400x2,1600x2' -> [{"rps": 100, "duration_s": 2}, ...]"""
+    stages = []
+    for part in text.split(","):
+        rps, _x, dur = part.strip().partition("x")
+        if not _x:
+            raise ValueError("bad stage %r (want RPSxSECONDS)" % part)
+        stages.append({"rps": float(rps), "duration_s": float(dur)})
+    return stages
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python tools/loadgen.py",
+        description="open-loop load generator for the serving HTTP "
+                    "front-end (Poisson/constant arrivals, ramp stages, "
+                    "server-side span join, saturation detection)")
+    ap.add_argument("--url", required=True, help="server base URL")
+    ap.add_argument("--model", required=True, help="served model name")
+    ap.add_argument("--item", default="[0.0]",
+                    help="JSON for ONE input item, no batch dim "
+                         "(default: [0.0])")
+    ap.add_argument("--stages", default="50x2,200x2,800x2",
+                    help="ramp as RPSxSECONDS comma list "
+                         "(default: 50x2,200x2,800x2)")
+    ap.add_argument("--arrival", default="poisson",
+                    choices=("poisson", "constant"))
+    ap.add_argument("--seed", type=int, default=None,
+                    help="arrival RNG seed (default: MXTPU_LOADGEN_SEED)")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--max-clients", type=int, default=None,
+                    help="in-flight bound (default: "
+                         "MXTPU_LOADGEN_MAX_CLIENTS)")
+    ap.add_argument("--out", default=None, help="write the report here")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the shared CI report shape on stdout "
+                         "(rule L001) instead of the human summary")
+    ap.add_argument("--max-error-rate", type=float, default=0.0)
+    ap.add_argument("--require-saturation", action="store_true")
+    args = ap.parse_args(argv)
+
+    transport = HttpTransport(args.url, args.model, json.loads(args.item),
+                              deadline_ms=args.deadline_ms)
+    lg = LoadGen(transport, _parse_stages(args.stages),
+                 arrival=args.arrival, seed=args.seed,
+                 max_clients=args.max_clients, deadline_ms=args.deadline_ms)
+    report = lg.run()
+    out_path = args.out or "<stdout>"
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    ci = report_ci(report, path=out_path,
+                   max_error_rate=args.max_error_rate,
+                   require_saturation=args.require_saturation)
+    if args.as_json:
+        json.dump(ci, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for i, s in enumerate(report["stages"]):
+            print("stage %d: offered %.0f rps -> goodput %.0f rps, "
+                  "p50/p99 %s/%s ms, shed %.1f%%, errors %d"
+                  % (i, s["offered_rps"], s["goodput_rps"],
+                     s["latency_ms"]["p50"], s["latency_ms"]["p99"],
+                     100 * s["shed_rate"], s["errors"]))
+        sat = report["saturation"]
+        print("saturation: %s" % (
+            "stage %d (%.0f rps offered, %.0f goodput, %s)"
+            % (sat["stage"], sat["offered_rps"], sat["goodput_rps"],
+               sat["reason"]) if sat else "not reached"))
+        if args.out:
+            print("report: %s" % args.out)
+    return 0 if ci["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Noise-robust perf regression gate — stdlib only.
+
+BENCH numbers on a time-shared chip swing ~10% with co-tenant noise, so a
+naive before/after comparison either cries wolf or needs bands so wide a
+real regression hides inside them. The fix the int8 bench proved
+(bench.py bench_int8, VERDICT r4): contention only ever ADDS to a
+latency and SUBTRACTS from a throughput, so across N repeats the
+per-metric MINIMUM (resp. maximum) is the least-contaminated estimate —
+repeats interleave in time, noise hits different repeats differently,
+and the min/max converges on the machine's clean number while means and
+medians stay contaminated.
+
+The gate:
+
+1. collects N metric dicts — ``--input`` files (pre-collected repeats;
+   loadgen reports are unwrapped via their ``gate_metrics`` section) or
+   ``--cmd`` run ``--repeats`` times (last stdout line = one JSON dict in
+   the ``mxtpu-perfgate-metrics-v1`` schema, e.g. ``python bench.py
+   --gate``);
+2. aggregates per metric by DIRECTION: min for lower-is-better (``_ms``,
+   latency, error rates), max for higher-is-better (goodput, coverage,
+   throughput);
+3. compares against the committed baseline (``PERF_BASELINE.json``) with
+   a per-metric relative tolerance band, and exits non-zero on any
+   regression — the CI contract that turns "probably faster" into a
+   number the pipeline can reject.
+
+``--update-baseline`` rewrites the baseline from the current aggregates
+(existing per-metric tolerances and directions are preserved; new
+metrics get inferred directions and the default tolerance).
+``--selftest-inject F`` multiplies every lower-is-better aggregate (and
+divides every higher-is-better one) by F before comparing — the seeded
+canary CI uses to prove the gate can still FAIL (a regression gate that
+cannot fire is indistinguishable from no gate).
+
+``--json`` emits the shared CI report shape (tool/ok/findings/counts/
+baselined — one parser with ``python -m tools.mxtpulint --json``,
+``tools/promcheck.py --json`` and ``tools/loadgen.py --json``): rule
+G001 = metric regressed, G002 = baselined metric missing from the runs.
+
+Baseline schema::
+
+    {"schema": "mxtpu-perf-baseline-v1",
+     "default_tolerance": 0.5,
+     "metrics": {"<name>": {"value": 8.1, "direction": "lower",
+                            "tolerance": 0.75}, ...}}
+
+See docs/LOADGEN.md for the end-to-end workflow.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+__all__ = ["aggregate", "compare", "infer_direction", "load_metrics",
+           "load_baseline", "make_baseline", "report",
+           "BASELINE_SCHEMA", "METRICS_SCHEMA"]
+
+BASELINE_SCHEMA = "mxtpu-perf-baseline-v1"
+METRICS_SCHEMA = "mxtpu-perfgate-metrics-v1"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "PERF_BASELINE.json")
+
+# Mirrors config.ENV_VARS (registered there for docs/ENV_VARS.md);
+# tests/test_loadgen.py pins the two tables in sync.
+ENV_DEFAULTS = {
+    "MXTPU_PERFGATE_REPEATS": 3,
+    "MXTPU_PERFGATE_TOLERANCE": 0.5,
+}
+
+
+def _env(name):
+    default = ENV_DEFAULTS[name]
+    raw = os.environ.get(name)
+    return type(default)(raw) if raw is not None else default
+
+
+# ------------------------------------------------------------------ metrics
+_LOWER_HINTS = ("latency", "error", "shed", "dropped", "evictions",
+                "stall", "compile")
+_LOWER_SUFFIXES = ("_ms", "_s", "_seconds", "_ns", "_us", "_bytes")
+_HIGHER_HINTS = ("goodput", "throughput", "coverage", "frac", "detected",
+                 "speedup", "mfu", "per_sec", "img_s", "tok_s", "rps",
+                 "agreement")
+
+
+def infer_direction(name):
+    """'lower' or 'higher' from naming convention — only consulted when
+    the baseline entry doesn't pin it explicitly."""
+    low = name.lower()
+    if any(h in low for h in _HIGHER_HINTS):
+        return "higher"
+    if low.endswith(_LOWER_SUFFIXES) or any(h in low for h in _LOWER_HINTS):
+        return "lower"
+    return "lower"
+
+
+def load_metrics(path):
+    """One run's flat {metric -> value}: accepts a bare perfgate metrics
+    dict ({"metrics": {...}}) or a loadgen report (its ``gate_metrics``
+    section is unwrapped)."""
+    with open(path) as f:
+        data = json.load(f)
+    if "gate_metrics" in data:
+        data = data["gate_metrics"]
+    metrics = data.get("metrics")
+    if not isinstance(metrics, dict):
+        raise ValueError(
+            "%s: no 'metrics' dict (want the %s schema, a loadgen report, "
+            "or `python bench.py --gate` output)" % (path, METRICS_SCHEMA))
+    return {str(k): float(v) for k, v in metrics.items()}
+
+
+def aggregate(runs, directions=None):
+    """Per-metric minima/maxima across repeats: min for lower-is-better,
+    max for higher-is-better (noise only ever pushes the wrong way, so
+    the extreme toward 'better' is the clean estimate)."""
+    directions = directions or {}
+    out = {}
+    for run in runs:
+        for name, v in run.items():
+            d = directions.get(name) or infer_direction(name)
+            if name not in out:
+                out[name] = v
+            else:
+                out[name] = min(out[name], v) if d == "lower" \
+                    else max(out[name], v)
+    return out
+
+
+# ----------------------------------------------------------------- baseline
+def load_baseline(path):
+    with open(path) as f:
+        base = json.load(f)
+    if base.get("schema") != BASELINE_SCHEMA:
+        raise ValueError("%s: schema %r, want %r"
+                         % (path, base.get("schema"), BASELINE_SCHEMA))
+    return base
+
+
+def make_baseline(agg, old=None, default_tolerance=None):
+    """Baseline dict from aggregated values, preserving the old entries'
+    directions/tolerances (the reviewed knobs survive an --update)."""
+    old_metrics = (old or {}).get("metrics", {})
+    tol = default_tolerance if default_tolerance is not None \
+        else (old or {}).get("default_tolerance",
+                             _env("MXTPU_PERFGATE_TOLERANCE"))
+    metrics = {}
+    for name in sorted(agg):
+        prev = old_metrics.get(name, {})
+        entry = {"value": agg[name],
+                 "direction": prev.get("direction", infer_direction(name))}
+        if "tolerance" in prev:
+            entry["tolerance"] = prev["tolerance"]
+        metrics[name] = entry
+    # extra top-level keys (e.g. the committed baseline's "note") survive
+    # an --update — the documented workflow must not strip documentation
+    out = dict(old or {})
+    out.update({"schema": BASELINE_SCHEMA, "default_tolerance": tol,
+                "metrics": metrics})
+    return out
+
+
+def compare(agg, baseline):
+    """[(rule, metric, message), ...] — empty means the gate passes.
+
+    lower-is-better regresses past ``base * (1 + tolerance)``;
+    higher-is-better below ``base * (1 - tolerance)``. A metric in the
+    baseline but missing from every run is G002 (a silently vanished
+    metric must not read as a pass); a new un-baselined metric is
+    reported informationally by main() but never fails the gate — adding
+    coverage shouldn't require passing it in the same commit.
+    """
+    default_tol = baseline.get("default_tolerance",
+                               _env("MXTPU_PERFGATE_TOLERANCE"))
+    findings = []
+    for name, entry in sorted(baseline.get("metrics", {}).items()):
+        base = float(entry["value"])
+        direction = entry.get("direction", infer_direction(name))
+        tol = float(entry.get("tolerance", default_tol))
+        if name not in agg:
+            findings.append(("G002", name,
+                             "baselined metric %r missing from every run "
+                             "(was %.6g)" % (name, base)))
+            continue
+        v = agg[name]
+        if direction == "lower":
+            bound = base * (1.0 + tol)
+            if v > bound:
+                findings.append((
+                    "G001", name,
+                    "%s regressed: %.6g > %.6g (baseline %.6g +%d%% "
+                    "tolerance, lower is better)"
+                    % (name, v, bound, base, round(tol * 100))))
+        else:
+            bound = base * (1.0 - tol)
+            if v < bound:
+                findings.append((
+                    "G001", name,
+                    "%s regressed: %.6g < %.6g (baseline %.6g -%d%% "
+                    "tolerance, higher is better)"
+                    % (name, v, bound, base, round(tol * 100))))
+    return findings
+
+
+def report(findings, baseline_path):
+    """The shared CI report shape (one parser with mxtpulint / promcheck /
+    loadgen)."""
+    recs = [{"path": baseline_path, "line": 0, "rule": rule,
+             "message": msg} for rule, _name, msg in findings]
+    counts = {}
+    for rule, _n, _m in findings:
+        counts[rule] = counts.get(rule, 0) + 1
+    return {"tool": "perfgate", "ok": not recs, "findings": recs,
+            "counts": counts, "baselined": 0}
+
+
+# ---------------------------------------------------------------------- CLI
+def _run_cmd(cmd):
+    """One repeat of ``--cmd``: last non-empty stdout line must be a JSON
+    metrics dict (the `python bench.py --gate` contract)."""
+    proc = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError("--cmd failed (%d): %s"
+                           % (proc.returncode, proc.stderr.strip()[-500:]))
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError("--cmd emitted no output")
+    data = json.loads(lines[-1])
+    if "gate_metrics" in data:
+        data = data["gate_metrics"]
+    return {str(k): float(v) for k, v in data["metrics"].items()}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python tools/perfgate.py",
+        description="noise-robust perf regression gate: N repeats, "
+                    "per-metric minima aggregation, tolerance-band "
+                    "comparison against a committed baseline",
+        epilog="exit codes: 0 = within tolerance; 1 = regression (or "
+               "baselined metric missing); 2 = usage error")
+    ap.add_argument("--input", nargs="+", default=None, metavar="FILE",
+                    help="metric files, one per repeat (perfgate metrics "
+                         "dicts or loadgen reports)")
+    ap.add_argument("--cmd", default=None,
+                    help="shell command emitting one metrics dict on its "
+                         "last stdout line; run --repeats times")
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="repeats for --cmd (default: "
+                         "MXTPU_PERFGATE_REPEATS)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: PERF_BASELINE.json at "
+                         "the repo root)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current "
+                         "aggregates and exit 0")
+    ap.add_argument("--default-tolerance", type=float, default=None,
+                    help="relative band for metrics without their own "
+                         "(default: baseline's, else "
+                         "MXTPU_PERFGATE_TOLERANCE)")
+    ap.add_argument("--selftest-inject", type=float, default=None,
+                    metavar="FACTOR",
+                    help="multiply lower-is-better aggregates (divide "
+                         "higher-is-better) by FACTOR before comparing — "
+                         "the canary proving the gate still fires")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the shared CI report shape on stdout")
+    args = ap.parse_args(argv)
+
+    if bool(args.input) == bool(args.cmd):
+        print("need exactly one of --input or --cmd", file=sys.stderr)
+        return 2
+    try:
+        if args.input:
+            runs = [load_metrics(p) for p in args.input]
+        else:
+            n = args.repeats if args.repeats is not None \
+                else _env("MXTPU_PERFGATE_REPEATS")
+            runs = [_run_cmd(args.cmd) for _ in range(max(1, n))]
+    except (OSError, ValueError, RuntimeError, KeyError) as e:
+        print("perfgate: %s" % e, file=sys.stderr)
+        return 2
+
+    old = None
+    if os.path.exists(args.baseline):
+        try:
+            old = load_baseline(args.baseline)
+        except ValueError as e:
+            print("perfgate: %s" % e, file=sys.stderr)
+            return 2
+    directions = {n: e.get("direction")
+                  for n, e in (old or {}).get("metrics", {}).items()}
+    agg = aggregate(runs, directions)
+
+    if args.update_baseline:
+        base = make_baseline(agg, old,
+                             default_tolerance=args.default_tolerance)
+        with open(args.baseline, "w") as f:
+            json.dump(base, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print("perfgate: baseline %s updated (%d metrics, %d repeats)"
+              % (args.baseline, len(agg), len(runs)))
+        return 0
+
+    if old is None:
+        print("perfgate: no baseline at %s — run --update-baseline first"
+              % args.baseline, file=sys.stderr)
+        return 2
+    if args.default_tolerance is not None:
+        old = dict(old, default_tolerance=args.default_tolerance)
+
+    if args.selftest_inject:
+        f = float(args.selftest_inject)
+        inj = {}
+        for name, v in agg.items():
+            d = directions.get(name) or infer_direction(name)
+            inj[name] = v * f if d == "lower" else v / f
+        agg = inj
+
+    findings = compare(agg, old)
+    rep = report(findings, args.baseline)
+    if args.as_json:
+        json.dump(rep, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for _rule, _name, msg in findings:
+            print("perfgate FAIL: %s" % msg)
+        extra = sorted(set(agg) - set(old.get("metrics", {})))
+        if extra:
+            print("perfgate note: %d un-baselined metric(s) ignored: %s"
+                  % (len(extra), ", ".join(extra)))
+        if not findings:
+            print("perfgate OK: %d metrics within tolerance "
+                  "(%d repeats, minima aggregation)"
+                  % (len(old.get("metrics", {})), len(runs)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,8 +22,9 @@ Usage::
     python tools/promcheck.py metrics.txt --json     # CI report shape
 
 ``--json`` emits the same report shape as ``python -m tools.mxtpulint
---json`` (tool/ok/findings/counts/baselined), so CI aggregates both lint
-gates with one parser; violations carry rule id ``P001``.
+--json``, ``tools/loadgen.py --json`` and ``tools/perfgate.py --json``
+(tool/ok/findings/counts/baselined), so CI aggregates every gate with
+one parser; violations carry rule id ``P001``.
 """
 from __future__ import annotations
 
