@@ -40,6 +40,16 @@
 #             repeats and HARD-FAILS outside PERF_BASELINE.json's
 #             tolerance bands — plus the injected-2x-regression canary
 #             proving the gate can still fire (docs/LOADGEN.md)
+#   sharded - mesh-sharded serving gate on a forced-8-device CPU host:
+#             two interleaved 1-replica vs 8-replica loadgen soaks of a
+#             timer-bound servable driven through the in-process
+#             transport (the stdlib HTTP front-end tops out an order of
+#             magnitude below 8 replica workers, so HTTP would measure
+#             the web server, not serving), saturation detected on BOTH
+#             ramps, per-replica dispatch balance asserted, and the
+#             goodput scaling ratio perfgate-compared against the
+#             committed sharded_goodput_scaling baseline — the hard
+#             >=3x 1->8 contract of the replica router (docs/SERVING.md)
 #   diagnostics - the "why is it slow / why is it stuck" layer: span
 #             tracing (nesting, queue-boundary propagation, chrome-trace
 #             parenting, 16-thread race), flight recorder (ring bound,
@@ -56,7 +66,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability loadgen diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability loadgen sharded diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -83,15 +93,17 @@ print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
 % (r['baselined'], sys.argv[2], sys.argv[1]))" "$LINT_JSON" "$lint_dt"
   [ "$lint_dt" -lt 30 ] || { echo "lint stage took ${lint_dt}s (budget 30s)"; exit 1; }
   # Seeded-defect canary: the whole-program passes must still FIRE. The
-  # fixture holds one known deadlock cycle, one unlocked cross-thread
-  # write, one jax.jit retrace hazard, and one AOT-boundary retrace
-  # hazard (aot.compile_cached); full-profile analysis rooted at the
-  # fixture dir must report exactly those four.
+  # fixtures hold one known deadlock cycle, one unlocked cross-thread
+  # write, one jax.jit retrace hazard, one AOT-boundary retrace hazard
+  # (aot.compile_cached), and one host-device sync in the replica
+  # dispatch hot path (seeded_batcher.py, HOT_PATH_PATTERNS replica
+  # coverage); full-profile analysis rooted at the fixture dir must
+  # report exactly those five.
   python - <<'EOF'
 from tools.mxtpulint import analyze
 found = sorted(f.rule for f in analyze(["tools/mxtpulint/testdata"],
                                        root="tools/mxtpulint/testdata"))
-assert found == ["R009", "R010", "R011", "R011"], found
+assert found == ["R001", "R009", "R010", "R011", "R011"], found
 print("seeded-defect canary OK: %s" % ", ".join(found))
 EOF
 fi
@@ -275,16 +287,18 @@ print("loadgen OK: 3 reports in %s (schema %s)"
 EOF
   # the gate proper: minima across the repeats vs the committed baseline
   # (same one-parser JSON shape as mxtpulint/promcheck/loadgen)
-  python tools/perfgate.py --input "$LG_DIR"/report_*.json --json \
-      > "$LG_DIR/perfgate.json" \
-    || { python tools/perfgate.py --input "$LG_DIR"/report_*.json || true
+  python tools/perfgate.py --input "$LG_DIR"/report_*.json \
+      --only 'loadgen_*' --json > "$LG_DIR/perfgate.json" \
+    || { python tools/perfgate.py --input "$LG_DIR"/report_*.json \
+           --only 'loadgen_*' || true
          exit 1; }
   python -c "import json,sys; r=json.load(open(sys.argv[1])); \
 print('perfgate OK: gate artifact %s' % sys.argv[1])" "$LG_DIR/perfgate.json"
   # seeded-regression canary: a synthetic 2x latency regression MUST
   # fail the same baseline, or the gate has silently stopped firing
   if python tools/perfgate.py --input "$LG_DIR"/report_*.json \
-      --selftest-inject 2.0 --json > "$LG_DIR/perfgate_inject.json"; then
+      --only 'loadgen_*' --selftest-inject 2.0 --json \
+      > "$LG_DIR/perfgate_inject.json"; then
     echo "perfgate canary FAILED: injected 2x regression passed the gate"
     exit 1
   fi
@@ -292,6 +306,95 @@ print('perfgate OK: gate artifact %s' % sys.argv[1])" "$LG_DIR/perfgate.json"
   lg_dt=$(( SECONDS - lg_t0 ))
   echo "loadgen stage wall time: ${lg_dt}s (budget 120s)"
   [ "$lg_dt" -lt 120 ] || { echo "loadgen stage took ${lg_dt}s (budget 120s)"; exit 1; }
+fi
+
+if has_stage sharded; then
+  echo "=== sharded: 1-vs-8 replica goodput scaling gate (8-device CPU) ==="
+  # Two interleaved repeats of (1 replica, 8 replicas) saturation soaks
+  # against a TIMER-bound servable (20 ms per dispatched batch of <= 8):
+  # each replica's capacity is set by clocks (~395 rps), so 8 replicas
+  # land ~8x that and the committed scaling baseline holds across
+  # machines. Driven through loadgen's InProcessTransport — the serving
+  # core (router -> replica queues -> workers), not the stdlib HTTP
+  # loop, is what this stage measures. perfgate aggregates maxima across
+  # the repeats and hard-fails below the sharded_goodput_scaling band
+  # (>= 3x); the injected canary proves the gate still fires.
+  sh_t0=$SECONDS
+  SH_DIR=$(mktemp -d -t mxtpu_sharded.XXXXXX)
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - "$SH_DIR" <<'EOF'
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tools import loadgen
+from incubator_mxnet_tpu.serving import ModelRegistry
+
+assert len(jax.devices()) == 8, jax.devices()
+
+
+class SlowEcho:
+    """Deterministic per-replica capacity: 20 ms per dispatched batch of
+    <= 8 => ~395 rps per replica worker, timer-bound on every host."""
+
+    def predict_batch(self, x):
+        time.sleep(0.02)
+        return (x,)
+
+
+def soak(tag, replicas, stages, seed):
+    reg = ModelRegistry()
+    reg.load(tag, SlowEcho(), max_batch_size=8, batch_timeout_ms=2.0,
+             queue_size=16, replicas=replicas)
+    tr = loadgen.InProcessTransport(reg, tag, [0.0, 0.0, 0.0, 0.0],
+                                    timeout_s=5.0)
+    lg = loadgen.LoadGen(tr, stages=stages, arrival="poisson", seed=seed,
+                         max_clients=512)
+    report = lg.run()
+    counts = reg._entry(tag).batcher.replica_dispatch_counts()
+    reg.close()
+    sat = report["saturation"]
+    assert sat is not None, "no saturation at %d replica(s): %s" % (
+        replicas, [s["goodput_rps"] for s in report["stages"]])
+    assert all(s["error_rate"] == 0.0 for s in report["stages"]), report
+    return sat["goodput_rps"], counts
+
+
+out_dir = sys.argv[1]
+RAMP1 = [{"rps": r, "duration_s": 1.0} for r in (100, 200, 400, 800)]
+RAMP8 = [{"rps": r, "duration_s": 1.0} for r in (800, 1600, 3200, 6400)]
+for rep in range(2):
+    g1, _ = soak("shard1-%d" % rep, 1, RAMP1, rep)
+    g8, counts = soak("shard8-%d" % rep, 8, RAMP8, rep)
+    # router balance at saturation: every replica worked, none hogged
+    assert min(counts) > 0 and max(counts) <= 2 * min(counts), counts
+    scaling = g8 / g1
+    metrics = {"schema": loadgen.METRICS_SCHEMA,
+               "metrics": {"sharded_goodput_scaling": scaling,
+                           "sharded_goodput_1rep_rps": g1,
+                           "sharded_goodput_8rep_rps": g8}}
+    with open("%s/sharded_%d.json" % (out_dir, rep), "w") as f:
+        json.dump(metrics, f, indent=1)
+    print("repeat %d: 1-rep %.0f rps -> 8-rep %.0f rps = %.2fx, "
+          "dispatch balance %s" % (rep, g1, g8, scaling, counts))
+print("sharded soaks OK")
+EOF
+  python tools/perfgate.py --input "$SH_DIR"/sharded_*.json \
+      --only 'sharded_*' --json > "$SH_DIR/perfgate.json" \
+    || { python tools/perfgate.py --input "$SH_DIR"/sharded_*.json \
+           --only 'sharded_*' || true
+         exit 1; }
+  echo "sharded perfgate OK: gate artifact $SH_DIR/perfgate.json"
+  # canary: a synthetic 3x scaling collapse MUST fail the same baseline
+  if python tools/perfgate.py --input "$SH_DIR"/sharded_*.json \
+      --only 'sharded_*' --selftest-inject 3.0 --json \
+      > "$SH_DIR/perfgate_inject.json"; then
+    echo "sharded canary FAILED: injected 3x collapse passed the gate"
+    exit 1
+  fi
+  echo "sharded canary OK: injected 3x collapse fires"
+  sh_dt=$(( SECONDS - sh_t0 ))
+  echo "sharded stage wall time: ${sh_dt}s (budget 120s)"
+  [ "$sh_dt" -lt 120 ] || { echo "sharded stage took ${sh_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage diagnostics; then

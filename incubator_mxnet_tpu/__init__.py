@@ -24,7 +24,8 @@ from . import config as _config
 if _config.get_env("MXTPU_NUM_PROC") > 1 and \
         _config.get_env("MXTPU_COORD_ADDR"):
     import jax as _jax
-    if not _jax.distributed.is_initialized():  # user may have done it already
+    from .base import distributed_is_initialized as _dist_up
+    if not _dist_up():  # user may have done it already
         _jax.distributed.initialize(_config.get_env("MXTPU_COORD_ADDR"),
                                     _config.get_env("MXTPU_NUM_PROC"),
                                     _config.get_env("MXTPU_PROC_ID"))

@@ -22,12 +22,15 @@ This module replaces them with ONE shared cache:
   (a prewarm thread, a build span), never inside a later dispatch.
 - **Artifacts** (``MXTPU_AOT_CACHE_DIR``): exportable programs (the
   eval/serve forward paths) are serialized via ``jax.export`` (StableHLO)
-  per cache key. A fresh process pointed at a populated cache dir LOADS
-  the program instead of re-tracing the Python model — the first request
-  pays zero trace time and records an artifact hit, and with registry
-  prewarm the XLA compile of the loaded module also lands pre-traffic.
-  Train-kind entries (donated-buffer programs, instance-bound state) stay
-  in-memory only.
+  per cache key — including MESH-SHARDED serving programs, whose
+  partitioned module jax.export records with its GSPMD shardings (the
+  key's mesh signature is in the file digest, so topology mismatches
+  miss instead of misload). A fresh process pointed at a populated cache
+  dir LOADS the program instead of re-tracing the Python model — the
+  first request pays zero trace time and records an artifact hit, and
+  with registry prewarm the XLA compile of the loaded module also lands
+  pre-traffic. Train-kind entries (donated-buffer programs,
+  instance-bound state) stay in-memory only.
 - **Eviction**: LRU by last-dispatch time, bounded by
   ``MXTPU_AOT_CACHE_SIZE``, with every eviction counted on
   ``mxtpu_aot_evictions_total`` so silent thrash is visible (dict-order
@@ -391,13 +394,21 @@ def _key_digest(key):
 
 def artifact_path(key, cache_dir=None):
     """Artifact file for a key, or None when the layer is disabled
-    (no MXTPU_AOT_CACHE_DIR) or the key is not persistable (mesh-sharded
-    and train programs stay in-memory)."""
+    (no MXTPU_AOT_CACHE_DIR) or the key is not persistable (train
+    programs stay in-memory).
+
+    Mesh-sharded eval/serve programs ARE persisted: jax.export records
+    the partitioned module (GSPMD shardings included), and the key's
+    ``mesh`` signature — axis layout + device count — participates in the
+    file digest, so a process with a different topology can never load a
+    mismatched partitioning (it misses and rebuilds). This is the
+    sharded-serving counterpart of the single-device zero-retrace
+    cold start (docs/AOT.md "Sharded artifacts")."""
     if cache_dir is None:
         cache_dir = config.get_env("MXTPU_AOT_CACHE_DIR")
     # train programs are NEVER persisted (donated buffers + instance-bound
     # state) — enforced here, not just at today's call sites
-    if not cache_dir or key.mesh is not None or key.kind == "train":
+    if not cache_dir or key.kind == "train":
         return None
     import jax
     return os.path.join(cache_dir, "jax-%s" % jax.__version__,

@@ -166,10 +166,29 @@ ENV_VARS = {
         "batch_timeout_micros analog)."),
     "MXTPU_SERVE_QUEUE_SIZE": (
         int, 64,
-        "Bound on each model's serving request queue (serving/batcher.py). "
-        "A full queue rejects submits with QueueFullError (HTTP 429) — "
-        "explicit backpressure instead of unbounded latency; /healthz "
-        "reports degraded at >= 80% occupancy."),
+        "PER-REPLICA bound on each model's serving dispatch queues "
+        "(serving/batcher.py; total capacity = this x MXTPU_SERVE_REPLICAS)."
+        " When every live replica's queue is full, submits reject with "
+        "QueueFullError (HTTP 429) — explicit backpressure instead of "
+        "unbounded latency; /healthz reports degraded at >= 80% aggregate "
+        "occupancy."),
+    "MXTPU_SERVE_REPLICAS": (
+        int, 1,
+        "Data-parallel replica executors per served model "
+        "(serving/batcher.py): each replica owns a bounded dispatch queue "
+        "and worker thread, fed by a least-depth router in submit(), so "
+        "aggregate goodput scales with chips. Replica-aware servables "
+        "(ServedModel, MeshServable) pin each replica's executable to its "
+        "own device; a dead replica worker drains back through the router "
+        "and /healthz reports degraded. Per-model override via "
+        "load(replicas=) at first load (docs/SERVING.md)."),
+    "MXTPU_SERVE_TP": (
+        int, 1,
+        "Default tensor-parallel degree for serving.sharded.MeshServable "
+        "when no mesh is passed: weights shard over a 'tp' mesh axis of "
+        "this size via jax.sharding.NamedSharding (GSPMD inserts the "
+        "collectives), for models too big for one chip. 1 = single-device "
+        "predict (docs/SERVING.md)."),
     "MXTPU_SERVE_DEADLINE_MS": (
         float, None,
         "Default per-request serving deadline in milliseconds: requests "

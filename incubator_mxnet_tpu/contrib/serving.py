@@ -122,18 +122,59 @@ class ServedModel:
             model_id = "x" + hashlib.sha256(payload).hexdigest()[:20]
         self._model_id = model_id
 
-    def _run(self, *datas):
+    def _replica_device(self, replica):
+        """The device data-parallel replica ``replica`` executes on
+        (round-robin over the local device list), or None for replica 0 —
+        replica 0 keeps the classic uncommitted single-device path, so a
+        replicas=1 deployment is byte-identical to the pre-replica one.
+        More replicas than devices warns ONCE: the wrap double-subscribes
+        chips and duplicates executables (distinct cache keys per replica
+        index), which is oversubscription the operator should see."""
+        if not replica:
+            return None
+        devices = jax.devices()
+        if int(replica) >= len(devices) and not getattr(
+                self, "_wrap_warned", False):
+            self._wrap_warned = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "ServedModel %s: replica index %d wraps onto the %d local "
+                "device(s) — more batcher replicas than chips "
+                "double-subscribes devices and duplicates compiled "
+                "executables; lower MXTPU_SERVE_REPLICAS",
+                self._model_id, int(replica), len(devices))
+        return devices[int(replica) % len(devices)]
+
+    def _run(self, *datas, replica=0):
         """One compiled execution at the exact signature of ``datas``,
-        through the shared executable cache."""
+        through the shared executable cache. ``replica`` pins the
+        executable (and the inputs) to that replica's device, so N
+        batcher replicas drive N chips concurrently — each (signature,
+        device) pair is its own cache entry, all prewarmed by the
+        registry's (bucket x replica) warm loop."""
+        dev = self._replica_device(replica)
+        extra = () if dev is None else ("dev", dev.id)
         key = aot.cache_key(self._model_id, aot.input_signature(datas),
-                            kind="serve")
+                            kind="serve", extra=extra)
         exp = self._exp
 
         def build():
-            specs = [jax.ShapeDtypeStruct(d.shape, d.dtype) for d in datas]
+            if dev is None:
+                specs = [jax.ShapeDtypeStruct(d.shape, d.dtype)
+                         for d in datas]
+            else:
+                from jax.sharding import SingleDeviceSharding
+                sh = SingleDeviceSharding(dev)
+                specs = [jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=sh)
+                         for d in datas]
             return (jax.jit(exp.call).lower(*specs).compile(),
                     None, None)       # the .mxtpu file IS the artifact
 
+        if dev is not None:
+            # the compiled program is committed to the replica's device;
+            # inputs must arrive on it (host numpy from the batcher pays
+            # the same one copy it paid to device 0 before)
+            datas = [jax.device_put(d, dev) for d in datas]
         return aot.compile_cached(key, build).fn(*datas)
 
     @property
@@ -172,7 +213,7 @@ class ServedModel:
                              "batch axis to serve over")
         return int(shp[0])
 
-    def predict_batch(self, *stacked_inputs):
+    def predict_batch(self, *stacked_inputs, replica=0):
         """Serving-batcher entry point: run ``n`` stacked items (dim 0)
         through the FIXED exported batch shape by re-chunking.
 
@@ -183,6 +224,10 @@ class ServedModel:
         are concatenated with the padding rows dropped — so callers see a
         true dim-0 batch axis whatever ``B`` was. Returns a tuple of
         numpy arrays (host-side: results go straight onto the wire).
+
+        ``replica`` (declared, so the batcher and registry forward it)
+        pins this dispatch to the replica's device — N data-parallel
+        batcher workers drive N chips concurrently (docs/SERVING.md).
         """
         import numpy as onp
 
@@ -199,7 +244,7 @@ class ServedModel:
             if pad:
                 chunk = [onp.concatenate([c, onp.repeat(c[-1:], pad, axis=0)])
                          for c in chunk]
-            out = self._run(*chunk)
+            out = self._run(*chunk, replica=replica)
             outs = out if isinstance(out, (list, tuple)) else (out,)
             out_chunks.append([onp.asarray(o)[:B - pad] for o in outs])
         return tuple(onp.concatenate([ch[i] for ch in out_chunks])

@@ -553,7 +553,8 @@ class DistAsyncKVStore(DistKVStore):
             # additionally need the x64 scope or jnp truncates them anyway
             dt = str(self._data[k].dtype) if isinstance(self._data[k], NDArray) \
                 else str(onp.asarray(self._data[k]).dtype)
-            with _jax.enable_x64(dt in ("float64", "int64", "uint64")):
+            from ..base import enable_x64
+            with enable_x64(dt in ("float64", "int64", "uint64")):
                 self._data[k] = nd.array(
                     out[k].reshape(self._data[k].shape), dtype=dt)
 

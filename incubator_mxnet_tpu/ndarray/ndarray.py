@@ -22,6 +22,7 @@ from jax import lax
 
 from .. import autograd
 from ..context import Context, current_context
+from .. import base as _base
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "eye", "concat",
            "concatenate", "stack", "dot", "batch_dot", "waitall"]
@@ -232,7 +233,7 @@ class NDArray:
     def __getitem__(self, key):
         key = _index_fixup(key)
         if self._key_past_int32(key):
-            with jax.enable_x64(True):
+            with _base.enable_x64(True):
                 return _apply(lambda x: x[key], self)
         return _apply(lambda x: x[key], self)
 
@@ -241,7 +242,7 @@ class NDArray:
         if isinstance(value, NDArray):
             value = value._data
         if self._key_past_int32(key):
-            with jax.enable_x64(True):
+            with _base.enable_x64(True):
                 self._data = self._data.at[key].set(value)
         else:
             self._data = self._data.at[key].set(value)
@@ -384,7 +385,7 @@ class NDArray:
         # ref USE_INT64_TENSOR_SIZE / tests/nightly/test_large_vector.py)
         extent = self.size if axis is None else self.shape[axis]
         if extent > (1 << 24):
-            with jax.enable_x64(True):
+            with _base.enable_x64(True):
                 return _apply(lambda x: jfn(x, axis=axis, keepdims=keepdims)
                               .astype(onp.float64), self)
         return _apply(lambda x: jfn(x, axis=axis, keepdims=keepdims)
@@ -1818,14 +1819,14 @@ def shape_array(data):
     """Shape as a TRUE int64 array (ref tensor/matrix_op.cc shape_array) —
     created under a scoped x64 enable so dims past 2^31 don't truncate to
     int32 (jax's default without jax_enable_x64)."""
-    with jax.enable_x64(True):
+    with _base.enable_x64(True):
         return NDArray(jnp.asarray(data.shape, jnp.int64))
 
 
 def size_array(data):
     """Element count as a (1,) TRUE int64 array (ref size_array; see
     shape_array for the x64 scoping)."""
-    with jax.enable_x64(True):
+    with _base.enable_x64(True):
         return NDArray(jnp.asarray([data.size], jnp.int64))
 
 

@@ -23,7 +23,8 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
     """
     if _STATE["initialized"]:
         return
-    if jax.distributed.is_initialized():  # already up (package import)
+    from ..base import distributed_is_initialized
+    if distributed_is_initialized():  # already up (package import)
         _STATE["initialized"] = True
         return
     from ..config import get_env

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .compat import axis_size, pcast, shard_map
+
 __all__ = ["PipelineParallel", "pipeline_spmd", "pipeline_1f1b_grads"]
 
 
@@ -34,7 +36,7 @@ def _pipeline_sharded(x_mb, stacked_params, key, stage_fn, axis_name,
     x_mb: (n_micro, mb, ...) — full microbatch stream, replicated.
     Returns (n_micro, mb, ...) outputs (valid on the last stage; all-gathered).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
     mb_shape = x_mb.shape[1:]
@@ -62,9 +64,9 @@ def _pipeline_sharded(x_mb, stacked_params, key, stage_fn, axis_name,
         return state, outputs
 
     axes = vary_axes or (axis_name,)
-    out0 = lax.pcast(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype),
+    out0 = pcast(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype),
                      axes, to="varying")
-    state0 = lax.pcast(jnp.zeros(mb_shape, x_mb.dtype), axes, to="varying")
+    state0 = pcast(jnp.zeros(mb_shape, x_mb.dtype), axes, to="varying")
     _, outputs = lax.fori_loop(0, total_ticks, tick, (state0, out0))
     # only the last stage holds real outputs; broadcast them to all stages
     return _bcast_from_last(outputs, axis_name, n_stages)
@@ -118,12 +120,12 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, n_microbatches, axis="pp",
     if key is not None:
         key = jax.device_put(key, NamedSharding(mesh, P()))
     if key is None:
-        out = jax.shard_map(
+        out = shard_map(
             lambda xm, sp: fn(xm, sp, None), mesh=mesh,
             in_specs=(io_spec, param_specs),
             out_specs=io_spec)(x_mb, stacked_params)
     else:
-        out = jax.shard_map(
+        out = shard_map(
             fn, mesh=mesh,
             in_specs=(io_spec, param_specs, P()),
             out_specs=io_spec)(x_mb, stacked_params, key)
@@ -174,7 +176,7 @@ def _pipeline_1f1b_sharded(x_mb, y_mb, stacked_params, stage_fn, loss_fn,
     microbatches (each stage holds its own slice), dx per microbatch for
     composing with an upstream embedding).
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     s = lax.axis_index(axis_name)
     params = jax.tree_util.tree_map(lambda q: q[0], stacked_params)
     m = x_mb.shape[0]
@@ -247,16 +249,16 @@ def _pipeline_1f1b_sharded(x_mb, y_mb, stacked_params, stage_fn, loss_fn,
 
     zeros_mb = jnp.zeros(mb_shape, x_mb.dtype)
     carry0 = (
-        lax.pcast(zeros_mb, (axis_name,), to="varying"),
-        lax.pcast(zeros_mb, (axis_name,), to="varying"),
-        lax.pcast(jnp.zeros((K,) + mb_shape, x_mb.dtype), (axis_name,),
+        pcast(zeros_mb, (axis_name,), to="varying"),
+        pcast(zeros_mb, (axis_name,), to="varying"),
+        pcast(jnp.zeros((K,) + mb_shape, x_mb.dtype), (axis_name,),
                   to="varying"),
         jax.tree_util.tree_map(
-            lambda q: lax.pcast(jnp.zeros_like(q, jnp.float32),
+            lambda q: pcast(jnp.zeros_like(q, jnp.float32),
                                 (axis_name,), to="varying"), params),
-        lax.pcast(jnp.zeros((m,) + mb_shape, x_mb.dtype), (axis_name,),
+        pcast(jnp.zeros((m,) + mb_shape, x_mb.dtype), (axis_name,),
                   to="varying"),
-        lax.pcast(jnp.float32(0.0), (axis_name,), to="varying"),
+        pcast(jnp.float32(0.0), (axis_name,), to="varying"),
     )
     _, _, _, pgrads, dx_buf, loss_acc = lax.fori_loop(
         0, total_ticks, tick, carry0)
@@ -302,7 +304,7 @@ def pipeline_1f1b_grads(stage_fn, loss_fn, stacked_params, x, y, mesh,
         stacked_params, param_specs)
     fn = functools.partial(_pipeline_1f1b_sharded, stage_fn=stage_fn,
                            loss_fn=loss_fn, axis_name=axis)
-    loss, pgrads, dx = jax.shard_map(
+    loss, pgrads, dx = shard_map(
         fn, mesh=mesh, in_specs=(P(), P(), param_specs),
         out_specs=(P(), param_specs, P()), check_vma=False)(
             x_mb, y_mb, stacked_params)

@@ -31,6 +31,8 @@ import numpy as onp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .compat import axis_size, pcast, shard_map
+
 __all__ = ["interleaved_schedule", "schedule_stats",
            "pipeline_interleaved_grads", "schedule_1f1b", "schedule_gpipe"]
 
@@ -236,7 +238,7 @@ def _tables(ticks, p, v, m):
 def _interleaved_sharded(x_mb, y_mb, stacked_params, tables, stage_fn,
                          loss_fn, axis_name, v, m, kslots):
     """SPMD body: execute the static tick tables on the pp ring."""
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     d = lax.axis_index(axis_name)
     V = v * p
     # local params: (v, 1, ...) -> per-chunk pytree list indexed by c
@@ -317,7 +319,7 @@ def _interleaved_sharded(x_mb, y_mb, stacked_params, tables, stage_fn,
     zeros_mb = jnp.zeros(mb_shape, x_mb.dtype)
 
     def vary(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return pcast(x, (axis_name,), to="varying")
 
     carry0 = (
         vary(zeros_mb), vary(zeros_mb),
@@ -375,7 +377,7 @@ def pipeline_interleaved_grads(stage_fn, loss_fn, stacked_params, x, y,
     fn = functools.partial(_interleaved_sharded, stage_fn=stage_fn,
                            loss_fn=loss_fn, axis_name=axis, v=v, m=m,
                            kslots=kslots)
-    loss, pgrads, dx = jax.shard_map(
+    loss, pgrads, dx = shard_map(
         lambda a, b, c: fn(a, b, c, tables), mesh=mesh,
         in_specs=(P(), P(), param_specs),
         out_specs=(P(), param_specs, P()), check_vma=False)(

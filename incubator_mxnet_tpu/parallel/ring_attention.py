@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 __all__ = ["ring_attention", "local_attention"]
 
 
@@ -77,7 +79,7 @@ def _ring_attention_sharded(q, k, v, axis_name, causal, scale):
     earlier shards (src < idx) are fully visible (dense step), later shards
     contribute nothing (skipped partial) — the standard ring-attention
     causal decomposition."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -121,5 +123,5 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     spec = P(None, None, axis, None)
     # check_vma=False: pallas_call out_shapes carry no vma annotation, and
     # the local flash kernel runs inside this shard_map
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .compat import axis_size, shard_map
 from .ring_attention import local_attention
 
 __all__ = ["ulysses_attention"]
@@ -30,7 +31,7 @@ __all__ = ["ulysses_attention"]
 
 def _ulysses_sharded(q, k, v, axis_name, causal, scale):
     """Inside shard_map: q/k/v local shapes (B, H, S/n, D)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
 
     def seq_to_heads(x):
         # (B, H, s, D) -> (B, H/n, S, D): split heads across devices,
@@ -71,5 +72,5 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
                            scale=scale)
     spec = P(None, None, axis, None)
     # check_vma=False: the local flash pallas_call carries no vma annotation
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -7,10 +7,17 @@ saturates an accelerator under many small requests.
 
 Layers (each importable alone):
 
-- ``batcher``  — DynamicBatcher: bounded queue + size-or-deadline
-  coalescing into bucketed batch shapes (each bucket compiles once).
+- ``batcher``  — DynamicBatcher: N data-parallel replica workers
+  (MXTPU_SERVE_REPLICAS), each with a bounded queue + size-or-deadline
+  coalescing into bucketed batch shapes (each bucket compiles once),
+  fed by a least-depth router; dead replicas drain back to survivors.
 - ``registry`` — ModelRegistry: named, versioned models, hot reload with
-  connection draining, one batcher per model.
+  connection draining and (bucket x replica) AOT prewarm, one batcher
+  per model.
+- ``sharded``  — MeshServable: tensor-parallel predict over a device
+  mesh (weights follow parallel.tensor_parallel annotations via
+  jax.sharding.NamedSharding), composable with replica groups
+  (docs/SERVING.md "Sharded serving").
 - ``metrics``  — ServingMetrics: counters, batch-size histogram,
   p50/p95/p99 latency from a ring buffer; every update is mirrored onto
   the process-wide telemetry registry (docs/OBSERVABILITY.md).
@@ -40,6 +47,7 @@ from .batcher import (DynamicBatcher, QueueFullError, DeadlineExceededError,
 from .metrics import ServingMetrics, percentile
 from .registry import ModelRegistry, BlockServable, ModelNotFoundError
 from .server import ServingServer, serve
+from .sharded import MeshServable, serving_mesh
 
 __all__ = [
     "DynamicBatcher", "QueueFullError", "DeadlineExceededError",
@@ -47,4 +55,5 @@ __all__ = [
     "ServingMetrics", "percentile",
     "ModelRegistry", "BlockServable", "ModelNotFoundError",
     "ServingServer", "serve",
+    "MeshServable", "serving_mesh",
 ]

@@ -2,29 +2,48 @@
 arXiv:1605.08695 §4.3: cross-request batching in front of a compiled
 executable is how many small requests saturate an accelerator).
 
-One worker thread per model pulls single-item requests off a BOUNDED
-queue and dispatches a stacked batch when either ``max_batch_size``
-requests are waiting or ``batch_timeout_ms`` has passed since the first
-one — classic size-or-deadline coalescing. Batches are padded up to a
-small set of bucket sizes (powers of two by default) so the servable
-underneath sees only a handful of shapes: a live Gluon block compiles
-once per bucket through jit.EvalStep's shape-keyed executable cache, and
-an exported .mxtpu artifact re-chunks every bucket onto its one compiled
+``replicas`` worker threads (default 1, ``MXTPU_SERVE_REPLICAS``) each own
+a BOUNDED dispatch queue and pull single-item requests off it, dispatching
+a stacked batch when either ``max_batch_size`` requests are waiting or
+``batch_timeout_ms`` has passed since the first one — classic
+size-or-deadline coalescing, times N data-parallel executors. A
+least-depth router in ``submit()`` picks the replica with the fewest
+requests queued-plus-in-dispatch (ties rotate), so aggregate goodput
+scales with replicas while no replica piles up behind a slow batch
+(docs/SERVING.md "Sharded serving"). Batches are padded up to a small set
+of bucket sizes (powers of two by default) so the servable underneath
+sees only a handful of shapes: a live Gluon block compiles once per
+bucket through jit.EvalStep's shape-keyed executable cache, and an
+exported .mxtpu artifact re-chunks every bucket onto its one compiled
 batch shape (contrib/serving.ServedModel.predict_batch).
 
+Replica-aware servables: when the dispatch callable accepts a ``replica``
+keyword (the registry's dispatch closure does, forwarding to servables
+whose ``predict_batch`` takes it — e.g. a ServedModel pinning each
+replica's executable to its own mesh device), the worker passes its
+replica index so each replica runs on its own chip. Plain servables are
+called positionally, exactly as before.
+
 Robustness contract:
-- full queue  -> ``QueueFullError`` raised at submit time (explicit
-  backpressure; HTTP maps it to 429 — never unbounded latency),
+- full queues -> ``QueueFullError`` raised at submit time after every
+  live replica was tried (explicit backpressure; HTTP maps it to 429 —
+  never unbounded latency),
 - per-request deadline -> ``DeadlineExceededError`` for requests still
   queued when it passes (they are dropped BEFORE padding/dispatch),
+- a DYING replica worker drains its queue back through the router:
+  queued requests are re-routed to live replicas (or failed loudly when
+  none remain), its depth gauge is detached, and the model keeps serving
+  on the survivors — a dead replica must never strand requests until
+  their deadline,
 - ``close(drain=True)`` -> stops intake, finishes everything queued,
-  then joins the worker.
+  then joins every worker.
 
-Only the worker thread touches the servable (and therefore JAX), so
-arbitrary many client threads can submit concurrently.
+Only worker threads touch the servable (and therefore JAX), so arbitrary
+many client threads can submit concurrently.
 """
 from __future__ import annotations
 
+import inspect
 import logging
 import threading
 import time
@@ -43,7 +62,8 @@ _LOG = logging.getLogger(__name__)
 
 
 class QueueFullError(RuntimeError):
-    """Overload rejection: the bounded request queue is at capacity."""
+    """Overload rejection: every live replica's bounded queue is at
+    capacity."""
 
 
 class DeadlineExceededError(TimeoutError):
@@ -62,6 +82,19 @@ def default_buckets(max_batch_size):
         b *= 2
     buckets.append(max_batch_size)
     return buckets
+
+
+def _accepts_replica(fn):
+    """True when ``fn`` declares an explicit ``replica`` parameter (a bare
+    **kwargs does NOT count — passing replica= to a servable that merely
+    swallows it would silently drop the placement contract)."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    p = sig.parameters.get("replica")
+    return p is not None and p.kind in (p.POSITIONAL_OR_KEYWORD,
+                                        p.KEYWORD_ONLY)
 
 
 class _Request:
@@ -108,20 +141,24 @@ class _Request:
 
 
 class DynamicBatcher:
-    """Coalesce concurrent single-item requests into bucketed batches.
+    """Coalesce concurrent single-item requests into bucketed batches over
+    ``replicas`` data-parallel dispatch queues.
 
     ``servable`` is either an object with ``predict_batch(*stacked) ->
     tuple of stacked outputs`` or a bare callable with that signature
     (the registry passes its version-resolving dispatch closure here, so
-    hot-reload swaps take effect at batch granularity).
+    hot-reload swaps take effect at batch granularity). A dispatch
+    callable declaring a ``replica`` keyword receives the dispatching
+    worker's replica index (device placement hook).
     """
 
     def __init__(self, servable, max_batch_size=None, batch_timeout_ms=None,
                  queue_size=None, buckets=None, default_deadline_ms=None,
-                 metrics=None, name="model"):
+                 metrics=None, name="model", replicas=None):
         self._dispatch_fn = (servable.predict_batch
                              if hasattr(servable, "predict_batch")
                              else servable)
+        self._replica_aware = _accepts_replica(self._dispatch_fn)
         self.name = name
         self.max_batch_size = int(max_batch_size
                                   if max_batch_size is not None
@@ -137,7 +174,13 @@ class DynamicBatcher:
             raise ValueError(
                 "queue_size must be >= 1 (got %d): the bounded queue IS "
                 "the backpressure contract (MXTPU_SERVE_QUEUE_SIZE)" % qsize)
-        self.queue_size = qsize
+        self.queue_size = qsize         # per-replica bound
+        n_rep = int(replicas if replicas is not None
+                    else config.get_env("MXTPU_SERVE_REPLICAS"))
+        if n_rep < 1:
+            raise ValueError("replicas must be >= 1 (got %d) "
+                             "(MXTPU_SERVE_REPLICAS)" % n_rep)
+        self.replicas = n_rep
         self.default_deadline_ms = (
             default_deadline_ms if default_deadline_ms is not None
             else config.get_env("MXTPU_SERVE_DEADLINE_MS"))
@@ -147,11 +190,25 @@ class DynamicBatcher:
             self.buckets.append(self.max_batch_size)
         self.metrics = metrics if metrics is not None \
             else ServingMetrics(model=name)
-        self.metrics.queue_depth_fn = lambda: self._queue.qsize()
-        self._queue = _queue.Queue(maxsize=qsize)
+        self._queues = [_queue.Queue(maxsize=qsize) for _ in range(n_rep)]
+        self.metrics.queue_depth_fn = \
+            lambda: sum(q.qsize() for q in self._queues)
+        # router state: per-replica in-dispatch counts, dispatch totals,
+        # the dead set, and the tie-break rotation — one leaf lock, never
+        # held while acquiring anything else
+        self._route_lock = threading.Lock()
+        self._inflight = [0] * n_rep        # handed to worker, not done
+        self._dispatched = [0] * n_rep      # requests dispatched, ever
+        self._dead = set()
+        self._rr = 0
+        self._replica_depth_fns = []
+        for r in range(n_rep):
+            fn = self._replica_depth_reader(r)
+            self._replica_depth_fns.append(fn)
+            self.metrics.bind_replica_depth(r, fn)
         # per-bucket dispatch-stage depth: requests gathered into a bucket
         # and not yet completed (padding + servable + slicing). Written by
-        # the worker, sampled by scrape threads at exposition time — its
+        # workers, sampled by scrape threads at exposition time — its
         # own leaf lock, never held while acquiring anything else
         self._depth_lock = threading.Lock()
         self._bucket_depth = dict.fromkeys(self.buckets, 0)
@@ -161,19 +218,37 @@ class DynamicBatcher:
         self._paused = False
         # per-item (shape, dtype) signature of the most recently dispatched
         # request — what a hot-reload prewarm synthesizes warm batches
-        # from (registry.load); written by the worker, read by warm/load
+        # from (registry.load); written by workers, read by warm/load
         # threads, hence its own lock
         self._sig_lock = threading.Lock()
         self._last_item_sig = None
-        # stall-watchdog channel: the worker beats once per gather cycle
+        # stall-watchdog channels: each worker beats once per gather cycle
         # (<= 0.25s apart when idle), so silence means a stuck dispatch,
         # not an empty queue
-        self._hb_channel = watchdog.register("batcher:%s" % name)
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name="mxtpu-batcher-%s" % name)
-        self._worker.start()
+        self._hb_channels = [
+            watchdog.register("batcher:%s" % name if n_rep == 1
+                              else "batcher:%s:r%d" % (name, r))
+            for r in range(n_rep)]
+        self._workers = [
+            threading.Thread(target=self._run, args=(r,), daemon=True,
+                             name="mxtpu-batcher-%s-r%d" % (name, r))
+            for r in range(n_rep)]
+        for w in self._workers:
+            w.start()
 
     # ------------------------------------------------------------ client side
+    def _route(self):
+        """Live replica indices, least-depth first (depth = queued +
+        in-dispatch), ties rotated so equal-depth replicas share evenly."""
+        with self._route_lock:
+            live = [r for r in range(self.replicas) if r not in self._dead]
+            inflight = {r: self._inflight[r] for r in live}
+            rr = self._rr
+            self._rr += 1
+        live.sort(key=lambda r: (self._queues[r].qsize() + inflight[r],
+                                 (r - rr) % self.replicas))
+        return live
+
     def submit(self, *inputs, deadline_ms=None, request_id=None):
         """Enqueue one item (arrays WITHOUT the batch dim); returns a future-
         like _Request. Raises QueueFullError/ServingClosedError immediately
@@ -194,21 +269,42 @@ class DynamicBatcher:
         req = _Request(tuple(onp.asarray(x) for x in inputs), deadline,
                        request_id=request_id,
                        span_ctx=spans.current_context())
-        try:
-            self._queue.put_nowait(req)
-        except _queue.Full:
+        order = self._route()
+        if not order:
+            # every replica worker died: nobody will ever service this
+            raise ServingClosedError(
+                "batcher %r has no live replica workers" % self.name)
+        routed = None
+        for r in order:
+            try:
+                self._queues[r].put_nowait(req)
+                routed = r
+                break
+            except _queue.Full:
+                continue
+        if routed is None:
             try:
                 self.metrics.inc("rejected_count")
             except Exception:
                 pass
             raise QueueFullError(
-                "model %r queue full (%d pending): rejecting — raise "
-                "MXTPU_SERVE_QUEUE_SIZE or add capacity"
-                % (self.name, self.queue_size)) from None
+                "model %r: all %d live replica queue(s) full "
+                "(%d-deep each, %d replica(s) configured): rejecting — "
+                "raise MXTPU_SERVE_QUEUE_SIZE, add replicas "
+                "(MXTPU_SERVE_REPLICAS), or add capacity"
+                % (self.name, len(order), self.queue_size,
+                   self.replicas)) from None
+        # the routed replica can die between _route() and the put — its
+        # worker's drain may already have swept the queue, so sweep again
+        # ourselves (idempotent; re-routes to survivors or fails loudly)
+        with self._route_lock:
+            landed_dead = routed in self._dead
+        if landed_dead:
+            self._reroute_queue(routed)
         # close() can win the race between the _closed check above and the
-        # enqueue; if the worker is already gone nobody will ever service
+        # enqueue; if the workers are already gone nobody will ever service
         # this request — fail it instead of letting the client hang
-        if self._closed and not self._worker.is_alive():
+        if self._closed and not self.alive:
             err = ServingClosedError("batcher %r is shut down" % self.name)
             req.fail(err)
             raise err
@@ -241,7 +337,14 @@ class DynamicBatcher:
         return req.result(timeout)
 
     def queue_depth(self):
-        return self._queue.qsize()
+        """Requests waiting across every replica queue (not yet gathered)."""
+        return sum(q.qsize() for q in self._queues)
+
+    @property
+    def total_queue_size(self):
+        """Aggregate queue capacity (per-replica bound x replicas) — the
+        denominator /healthz's >=80% occupancy check uses."""
+        return self.queue_size * self.replicas
 
     def _bucket_depth_reader(self, bucket):
         """Sampler closure for one bucket's dispatch-stage depth gauge."""
@@ -250,11 +353,36 @@ class DynamicBatcher:
                 return self._bucket_depth.get(bucket, 0)
         return read
 
+    def _replica_depth_reader(self, replica):
+        """Sampler closure for one replica's depth gauge: queued + handed
+        to its worker and not yet completed — the router's signal, so the
+        scrape shows exactly what routing decisions are made on."""
+        def read():
+            with self._route_lock:
+                inflight = self._inflight[replica]
+            return self._queues[replica].qsize() + inflight
+        return read
+
     def bucket_depths(self):
         """{bucket -> in-dispatch request count} snapshot (test hook; the
         scrape surface is the mxtpu_serving_bucket_queue_depth gauge)."""
         with self._depth_lock:
             return dict(self._bucket_depth)
+
+    def replica_depths(self):
+        """[queued + in-dispatch per replica] snapshot (test hook; the
+        scrape surface is mxtpu_serving_replica_queue_depth)."""
+        return [fn() for fn in self._replica_depth_fns]
+
+    def replica_dispatch_counts(self):
+        """[requests dispatched per replica, cumulative] — the balance
+        proof (mirrored on mxtpu_serving_replica_dispatch_total)."""
+        with self._route_lock:
+            return list(self._dispatched)
+
+    def dead_replicas(self):
+        with self._route_lock:
+            return sorted(self._dead)
 
     @property
     def last_item_sig(self):
@@ -265,7 +393,7 @@ class DynamicBatcher:
             return self._last_item_sig
 
     def pause_intake(self):
-        """Reject new submits (ServingClosedError) while the worker keeps
+        """Reject new submits (ServingClosedError) while the workers keep
         draining what's queued — the unload-last-version drain uses this.
         Unlike close(), fully reversible via resume_intake()."""
         self._paused = True
@@ -275,7 +403,8 @@ class DynamicBatcher:
 
     @property
     def alive(self):
-        return self._worker.is_alive()
+        """True while at least one replica worker can still dispatch."""
+        return any(w.is_alive() for w in self._workers)
 
     @property
     def closed(self):
@@ -283,42 +412,45 @@ class DynamicBatcher:
 
     def close(self, drain=True, timeout=30.0):
         """Graceful shutdown: refuse new requests, optionally finish the
-        queued ones, join the worker. With drain=False queued requests fail
-        with ServingClosedError."""
+        queued ones, join every worker. With drain=False queued requests
+        fail with ServingClosedError."""
         self._closed = True
         if not drain:
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except _queue.Empty:
-                    break
-                req.fail(ServingClosedError("server shutting down"))
-        self._worker.join(timeout)
-        # a submit racing this close can slip a request in after the
+            self._fail_queued(ServingClosedError("server shutting down"))
+        deadline = time.monotonic() + timeout
+        for w in self._workers:
+            w.join(max(0.0, deadline - time.monotonic()))
+        # a submit racing this close can slip a request in after a
         # worker's final empty-queue check; fail any such leftovers so no
         # client waits on a queue nobody services
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except _queue.Empty:
-                break
-            req.fail(ServingClosedError("server shutting down"))
-        # unbind the queue-depth gauge callback from the shared telemetry
-        # registry (it would otherwise pin this batcher's queue forever
-        # and export a stale series for an unloaded model)
+        self._fail_queued(ServingClosedError("server shutting down"))
+        # unbind the queue-depth gauge callbacks from the shared telemetry
+        # registry (they would otherwise pin this batcher's queues forever
+        # and export stale series for an unloaded model)
         try:
             self.metrics.detach_telemetry()
         except Exception:
             pass
 
+    def _fail_queued(self, err):
+        for q in self._queues:
+            while True:
+                try:
+                    req = q.get_nowait()
+                except _queue.Empty:
+                    break
+                req.fail(err)
+
     # ------------------------------------------------------------ worker side
-    def _gather(self):
-        """Collect the next batch: block for the first request, then keep
-        taking until max_batch_size or the batch window elapses."""
+    def _gather(self, replica):
+        """Collect the next batch off this replica's queue: block for the
+        first request, then keep taking until max_batch_size or the batch
+        window elapses."""
+        q = self._queues[replica]
         try:
             # the poll period only bounds close() latency — keep it coarse
-            # so idle models cost ~4 wakeups/s, not 20
-            first = self._queue.get(timeout=0.25)
+            # so idle models cost ~4 wakeups/s per replica, not 20
+            first = q.get(timeout=0.25)
         except _queue.Empty:
             return None
         batch = [first]
@@ -328,7 +460,7 @@ class DynamicBatcher:
             if remaining <= 0:
                 break
             try:
-                batch.append(self._queue.get(timeout=remaining))
+                batch.append(q.get(timeout=remaining))
             except _queue.Empty:
                 break
         return batch
@@ -339,72 +471,150 @@ class DynamicBatcher:
                 return b
         return self.buckets[-1]
 
-    def _run(self):
+    def _run(self, replica):
+        died = True
         try:
-            self._run_loop()
+            self._run_loop(replica)
+            died = False
+        except BaseException:
+            # the loop body already contains the request-failing guards;
+            # anything escaping it is a worker-killing defect — log it,
+            # then hand this replica's queue back to the router below
+            _LOG.error("batcher %r replica %d worker died",
+                       self.name, replica, exc_info=True)
         finally:
             # a cleanly-exiting (or dying) worker must not read as a
             # stall: silence from a gone thread is unregistered, silence
             # from a live-but-stuck one is the watchdog's signal
-            watchdog.unregister(self._hb_channel)
+            watchdog.unregister(self._hb_channels[replica])
+            if died and not self._closed:
+                self._drain_dead_replica(replica)
 
-    def _run_loop(self):
+    def _drain_dead_replica(self, replica):
+        """Death path: mark the replica dead so the router skips it,
+        detach its depth gauge (a dead replica must not export a frozen
+        depth), and re-route everything sitting in its queue — mirror of
+        the detach-on-close contract, at replica granularity."""
+        with self._route_lock:
+            self._dead.add(replica)
+        flightrec.record("replica_died", model=self.name, replica=replica)
+        try:
+            self.metrics.detach_replica_depth(
+                self._replica_depth_fns[replica])
+        except Exception:
+            _LOG.debug("replica depth gauge detach failed", exc_info=True)
+        self._reroute_queue(replica)
+
+    def _reroute_queue(self, replica):
+        """Drain one (dead) replica's queue back through the router."""
+        q = self._queues[replica]
         while True:
-            watchdog.heartbeat(self._hb_channel)
-            batch = self._gather()
+            try:
+                req = q.get_nowait()
+            except _queue.Empty:
+                break
+            rerouted = False
+            for r in self._route():
+                try:
+                    self._queues[r].put_nowait(req)
+                    rerouted = True
+                    break
+                except _queue.Full:
+                    continue
+            if not rerouted:
+                # no live replica (or all full): fail loudly NOW — a
+                # request must never sit in a dead replica's queue until
+                # its deadline expires it
+                req.fail(ServingClosedError(
+                    "model %r replica %d worker died and no live replica "
+                    "could absorb its queue" % (self.name, replica)))
+
+    def _run_loop(self, replica):
+        while True:
+            watchdog.heartbeat(self._hb_channels[replica])
+            batch = self._gather(replica)
             if batch is None:
-                if self._closed and self._queue.empty():
+                if self._closed and self._queues[replica].empty():
                     return
                 continue
-            now = time.monotonic()
-            live = []
-            for req in batch:
-                if req.deadline is not None and now >= req.deadline:
-                    try:
-                        self.metrics.inc("expired_count")
-                    except Exception:
-                        # telemetry failure must not fail the request path,
-                        # but the dropped increment is debug-visible (R005)
-                        _LOG.debug("expired_count update failed",
-                                   exc_info=True)
-                    req.fail(DeadlineExceededError(
-                        "deadline passed while queued (model %r)" % self.name))
-                else:
-                    live.append(req)
-            if not live:
-                continue
-            # group by per-input shape/dtype signature: one client's
-            # malformed request must not fail well-formed requests that
-            # happened to share its gather window (cross-client isolation);
-            # homogeneous traffic stays one group = one dispatch
-            groups = {}
-            for req in live:
-                sig = tuple((x.shape, x.dtype.str) for x in req.inputs)
-                groups.setdefault(sig, []).append(req)
-            for group in groups.values():
-                self._dispatch_batch(group)
+            with self._route_lock:
+                self._inflight[replica] += len(batch)
+            try:
+                self._process_batch(batch, replica)
+            except BaseException as e:
+                # a worker-killing defect (BaseException escaping the
+                # per-batch Exception guards) must still answer the batch
+                # it was holding — clients of a dying replica get the
+                # error now, not a timeout at their deadline
+                for req in batch:
+                    if not req._event.is_set():
+                        req.fail(e)
+                raise
+            finally:
+                with self._route_lock:
+                    self._inflight[replica] -= len(batch)
 
-    def _dispatch_batch(self, live):
-        """Pad one shape-homogeneous group to its bucket, dispatch, and
-        deliver results (or one shared error) to every waiter."""
+    def _process_batch(self, batch, replica):
+        now = time.monotonic()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now >= req.deadline:
+                try:
+                    self.metrics.inc("expired_count")
+                except Exception:
+                    # telemetry failure must not fail the request path,
+                    # but the dropped increment is debug-visible (R005)
+                    _LOG.debug("expired_count update failed",
+                               exc_info=True)
+                req.fail(DeadlineExceededError(
+                    "deadline passed while queued (model %r)" % self.name))
+            else:
+                live.append(req)
+        if not live:
+            return
+        # group by per-input shape/dtype signature: one client's
+        # malformed request must not fail well-formed requests that
+        # happened to share its gather window (cross-client isolation);
+        # homogeneous traffic stays one group = one dispatch
+        groups = {}
+        for req in live:
+            sig = tuple((x.shape, x.dtype.str) for x in req.inputs)
+            groups.setdefault(sig, []).append(req)
+        for group in groups.values():
+            self._dispatch_replica(group, replica)
+
+    def _dispatch_replica(self, live, replica):
+        """Pad one shape-homogeneous group to its bucket, dispatch it on
+        this replica, and deliver results (or one shared error) to every
+        waiter — the per-replica dispatch hot path (mxtpulint
+        HOT_PATH_PATTERNS covers it)."""
         n = len(live)
         bucket = self._bucket_for(n)
         t0 = time.monotonic()
+        request_ids = [r.request_id for r in live
+                       if r.request_id is not None]
         with self._depth_lock:
             self._bucket_depth[bucket] = self._bucket_depth.get(bucket, 0) + n
+        with self._route_lock:
+            self._dispatched[replica] += n
         try:
-            self._dispatch_bucketed(live, n, bucket, t0)
+            self.metrics.inc_replica_dispatch(replica, n)
+        except Exception:
+            pass
+        try:
+            self._dispatch_bucketed(live, n, bucket, t0, replica,
+                                    request_ids)
         finally:
             with self._depth_lock:
                 self._bucket_depth[bucket] -= n
 
-    def _dispatch_bucketed(self, live, n, bucket, t0):
+    def _dispatch_bucketed(self, live, n, bucket, t0, replica, request_ids):
         with self._sig_lock:
             self._last_item_sig = tuple((x.shape, x.dtype.str)
                                         for x in live[0].inputs)
         self._trace_queue_waits(live, t0)
         flightrec.record("batch_dispatch", model=self.name, n=n,
-                         bucket=bucket)
+                         bucket=bucket, replica=replica)
         # live span on the worker thread: the servable (and, for a
         # BlockServable, EvalStep's eval:step span) nests inside it. A
         # batch has many logical parents — the span parents onto the
@@ -412,9 +622,9 @@ class DynamicBatcher:
         # args.request_ids.
         with spans.span("serve:batch", parent=live[0].span_ctx,
                         model=self.name, bucket=bucket, batch_size=n,
-                        request_ids=[r.request_id for r in live
-                                     if r.request_id is not None]):
-            self._dispatch_batch_traced(live, n, bucket, t0)
+                        replica=replica, request_ids=request_ids):
+            self._dispatch_batch_traced(live, n, bucket, t0, replica,
+                                        request_ids)
 
     def _trace_queue_waits(self, live, t0):
         """Retroactive serve:queue child spans, one per request: queue
@@ -436,7 +646,20 @@ class DynamicBatcher:
             # discipline): keep the drop debug-visible
             _LOG.debug("serve:queue span emission failed", exc_info=True)
 
-    def _dispatch_batch_traced(self, live, n, bucket, t0):
+    def _call_servable(self, stacked, replica, request_ids):
+        """The one servable call site: per-replica ``serve:dispatch`` span
+        (the loadgen span-join attributes device time per replica off its
+        ``replica`` arg; ``request_ids`` make it joinable per request),
+        replica kwarg forwarded when the servable declares it."""
+        with spans.span("serve:dispatch", model=self.name, replica=replica,
+                        batch=int(stacked[0].shape[0]) if stacked else 0,
+                        request_ids=request_ids):
+            if self._replica_aware:
+                return self._dispatch_fn(*stacked, replica=replica)
+            return self._dispatch_fn(*stacked)
+
+    def _dispatch_batch_traced(self, live, n, bucket, t0, replica,
+                               request_ids):
         try:
             # pad by repeating the last row: always shape/dtype-consistent,
             # never introduces out-of-range values. A raising servable must
@@ -445,7 +668,7 @@ class DynamicBatcher:
                 onp.stack([r.inputs[i] for r in live]
                           + [live[-1].inputs[i]] * (bucket - n))
                 for i in range(len(live[0].inputs)))
-            outs = self._dispatch_fn(*stacked)
+            outs = self._call_servable(stacked, replica, request_ids)
         except Exception as e:  # noqa: BLE001 — forwarded to every waiter
             try:
                 self.metrics.inc("error_count", n)
@@ -491,9 +714,7 @@ class DynamicBatcher:
             self.metrics.observe_batch(n, bucket)
         except Exception:
             pass
-        self._profile_batch(n, bucket, dur,
-                            [r.request_id for r in live
-                             if r.request_id is not None])
+        self._profile_batch(n, bucket, dur, request_ids)
         for j, req in enumerate(live):
             req.succeed(results[j])
 

@@ -91,6 +91,20 @@ _BUCKET_DEPTH = telemetry.gauge(
     "mxtpu_serving_queue_depth this splits waiting time into queue vs "
     "dispatch — the per-bucket saturation signal the load harness joins "
     "against client latency (docs/LOADGEN.md).", ("model", "bucket"))
+_REPLICA_DEPTH = telemetry.gauge(
+    "mxtpu_serving_replica_queue_depth",
+    "Requests routed to this data-parallel replica and not yet completed "
+    "(queued on its dispatch queue + handed to its worker). This is the "
+    "exact signal the batcher's least-depth router balances on, so a "
+    "persistently deeper replica means a slower executor (bad device, "
+    "noisy neighbor) — the per-replica saturation view the load harness "
+    "joins against serve:dispatch spans (docs/SERVING.md, docs/LOADGEN.md).",
+    ("model", "replica"))
+_REPLICA_DISPATCH = telemetry.counter(
+    "mxtpu_serving_replica_dispatch_total",
+    "Requests dispatched by this data-parallel replica (cumulative) — "
+    "compare across replicas to verify the router is balancing "
+    "(docs/SERVING.md).", ("model", "replica"))
 _HTTP_INFLIGHT = telemetry.gauge(
     "mxtpu_http_inflight_requests",
     "Predict requests currently held by the HTTP front-end (body read "
@@ -139,9 +153,11 @@ class ServingMetrics:
         self.batched_items = 0        # real (non-padding) items dispatched
         self.padded_items = 0         # padding rows added to reach a bucket
         self.batch_size_hist = {}     # real batch size -> count
+        self.replica_dispatch = {}    # replica -> requests dispatched
         self._latencies_ms = deque(maxlen=latency_window)
         self._queue_depth_fn = None   # injected by the batcher
         self._bucket_depth_fns = []   # per-bucket samplers, ditto
+        self._replica_depth_fns = []  # per-replica samplers, ditto
 
     # ------------------------------------------------------------------
     @property
@@ -162,6 +178,33 @@ class ServingMetrics:
         self._bucket_depth_fns.append(fn)
         _BUCKET_DEPTH.set_function(fn, model=self.model, bucket=bucket)
 
+    def bind_replica_depth(self, replica, fn):
+        """Register ``fn() -> depth`` as the sampler for one data-parallel
+        replica (batcher init — replica count is fixed up front, so
+        cardinality is bounded by configuration, not traffic)."""
+        with self._lock:
+            self._replica_depth_fns.append(fn)
+        _REPLICA_DEPTH.set_function(fn, model=self.model, replica=replica)
+
+    def detach_replica_depth(self, fn):
+        """Drop ONE replica's depth series (dead-replica path, called from
+        the dying worker thread): removal is by callback identity,
+        mirroring detach_telemetry, so the other replicas' series keep
+        exporting."""
+        _REPLICA_DEPTH.remove_function(fn)
+        with self._lock:
+            try:
+                self._replica_depth_fns.remove(fn)
+            except ValueError:
+                pass
+
+    def inc_replica_dispatch(self, replica, n=1):
+        """Count ``n`` requests dispatched by one replica (worker side)."""
+        with self._lock:
+            self.replica_dispatch[replica] = \
+                self.replica_dispatch.get(replica, 0) + n
+        _REPLICA_DISPATCH.inc(n, model=self.model, replica=replica)
+
     def detach_telemetry(self):
         """Drop this instance's gauge-callback series from the shared
         registry (batcher close/unload): a dead model must not keep
@@ -174,6 +217,11 @@ class ServingMetrics:
         _QUEUE_DEPTH.remove_function(self._queue_depth_fn)
         for fn in self._bucket_depth_fns:
             _BUCKET_DEPTH.remove_function(fn)
+        with self._lock:
+            replica_fns = list(self._replica_depth_fns)
+            self._replica_depth_fns = []
+        for fn in replica_fns:
+            _REPLICA_DEPTH.remove_function(fn)
 
     # ------------------------------------------------------------------
     def inc(self, counter, n=1):
@@ -227,6 +275,8 @@ class ServingMetrics:
                 "batched_items": self.batched_items,
                 "padded_items": self.padded_items,
                 "batch_size_hist": dict(self.batch_size_hist),
+                "replica_dispatch": {str(r): c for r, c in
+                                     sorted(self.replica_dispatch.items())},
                 "mean_batch_size": (self.batched_items / self.batch_count
                                     if self.batch_count else 0.0),
                 "latency_window": len(self._latencies_ms),

@@ -36,7 +36,7 @@ import time
 
 from .. import config
 from ..telemetry import spans
-from .batcher import DynamicBatcher
+from .batcher import DynamicBatcher, _accepts_replica
 from .metrics import ServingMetrics
 
 __all__ = ["ModelRegistry", "BlockServable", "ModelNotFoundError"]
@@ -86,22 +86,29 @@ class _ModelEntry:
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._inflight = {}             # version -> dispatched-batch count
+        self._replica_aware = {}        # version -> predict_batch(replica=)?
         self._warming = 0               # active prewarm threads (describe)
         self._warm_target = None        # only THIS version may repoint()
         self.batcher = DynamicBatcher(self._dispatch, name=name,
                                       metrics=self.metrics, **batcher_kw)
 
-    def _dispatch(self, *stacked_inputs):
+    def _dispatch(self, *stacked_inputs, replica=0):
         """Resolve the CURRENT version at dispatch time (batch granularity),
-        pin it with an in-flight count so unload can drain."""
+        pin it with an in-flight count so unload can drain. ``replica`` —
+        the batcher worker's data-parallel replica index — is forwarded to
+        servables whose predict_batch declares it (device placement)."""
         with self._lock:
             version = self.current_version
             if version is None:
                 raise ModelNotFoundError(
                     "model %r has no loaded version" % self.name)
             servable = self.versions[version]
+            aware = self._replica_aware.get(version, False)
             self._inflight[version] = self._inflight.get(version, 0) + 1
         try:
+            if aware:
+                return servable.predict_batch(*stacked_inputs,
+                                              replica=replica)
             return servable.predict_batch(*stacked_inputs)
         finally:
             with self._drained:
@@ -112,14 +119,37 @@ class _ModelEntry:
                     self._inflight[version] -= 1
                 self._drained.notify_all()
 
+    def _check_replica_topology(self, servable):
+        """A servable that carves its own replica groups (MeshServable)
+        must agree with the batcher's worker count: fewer workers than
+        groups means some groups' weight copies sit resident but are
+        NEVER dispatched or prewarmed (replica index -> group is modulo),
+        silently losing the intended dp capacity. Loud warning, not an
+        error — a deliberate partial rollout stays possible."""
+        groups = getattr(servable, "replicas", None)
+        if isinstance(groups, int) and groups != self.batcher.replicas:
+            _LOG.warning(
+                "model %r: servable has %d replica group(s) but the "
+                "batcher runs %d replica worker(s) — dispatch covers "
+                "groups modulo the worker count, so %s (load with "
+                "replicas=%d to match)",
+                self.name, groups, self.batcher.replicas,
+                "some groups will never be dispatched or prewarmed"
+                if groups > self.batcher.replicas
+                else "several workers will share each group",
+                groups)
+
     def install(self, servable, version):
         """Install (version=None: the next one) and repoint dispatch.
         Version choice and installation are one atomic step so concurrent
         hot-reloads cannot pick the same number."""
+        self._check_replica_topology(servable)
         with self._lock:
             if version is None:
                 version = (max(self.versions) + 1) if self.versions else 1
             self.versions[version] = servable
+            self._replica_aware[version] = \
+                _accepts_replica(servable.predict_batch)
             self.current_version = version
             # a direct install supersedes any in-flight warm: its stale
             # repoint()s must not drag dispatch back to an older version
@@ -136,10 +166,13 @@ class _ModelEntry:
         to a stale model). On a FIRST load (nothing routable yet) the
         version is made current immediately — a model whose load() is
         still warming must answer with a lazy compile, not a 404."""
+        self._check_replica_topology(servable)
         with self._lock:
             if version is None:
                 version = (max(self.versions) + 1) if self.versions else 1
             self.versions[version] = servable
+            self._replica_aware[version] = \
+                _accepts_replica(servable.predict_batch)
             self._warm_target = version
             if self.current_version is None:
                 self.current_version = version
@@ -154,19 +187,26 @@ class _ModelEntry:
                 self.current_version = version
 
     def warm(self, servable, version, item_sig):
-        """Pre-warm every configured bucket of ``servable`` through the
-        shared AOT executable cache, SMALLEST bucket first; dispatch is
-        repointed at ``version`` right after the first bucket compiles so
-        traffic cuts over early while bigger buckets keep warming. Runs on
-        the prewarm thread; after the early cutover the batcher worker can
-        dispatch (and even compile-miss) the same model concurrently —
-        safe because every trace window holds the net's trace lock
-        exclusively, dispatches capture their argument snapshots under the
-        same lock (jit._net_trace_lock), and cache misses are
-        single-flight per key. Always leaves dispatch
-        repointed — a warm failure degrades to the old lazy-compile
-        behavior, never to an unroutable model."""
+        """Pre-warm every configured (bucket x replica) pair of
+        ``servable`` through the shared AOT executable cache, SMALLEST
+        bucket first (all its replicas, then the next bucket); dispatch is
+        repointed at ``version`` right after the first bucket's replicas
+        compile, so traffic cuts over early while bigger buckets keep
+        warming. For a replica-aware servable each warm call carries the
+        replica index — a device-pinned executor compiles one executable
+        per replica, and missing any pair would put that compile into the
+        post-cutover window; replica-unaware servables share one
+        executable, so each bucket warms once. Runs on the prewarm thread;
+        after the early cutover the batcher workers can dispatch (and even
+        compile-miss) the same model concurrently — safe because every
+        trace window holds the net's trace lock exclusively, dispatches
+        capture their argument snapshots under the same lock
+        (jit._net_trace_lock), and cache misses are single-flight per key.
+        Always leaves dispatch repointed — a warm failure degrades to the
+        old lazy-compile behavior, never to an unroutable model."""
         import numpy as onp
+        aware = _accepts_replica(servable.predict_batch)
+        n_rep = self.batcher.replicas if aware else 1
         with self._lock:
             self._warming += 1
         try:
@@ -175,9 +215,19 @@ class _ModelEntry:
                     synth = [onp.zeros((b,) + tuple(shape),
                                        dtype=onp.dtype(dt))
                              for shape, dt in item_sig]
-                    with spans.span("aot:warm", model=self.name,
-                                    version=version, bucket=b):
-                        servable.predict_batch(*synth)
+                    for r in range(n_rep):
+                        with spans.span("aot:warm", model=self.name,
+                                        version=version, bucket=b,
+                                        replica=r):
+                            if aware:
+                                servable.predict_batch(*synth, replica=r)
+                            else:
+                                servable.predict_batch(*synth)
+                        try:
+                            self.metrics.inc("prewarm_count")
+                        except Exception:
+                            _LOG.debug("prewarm_count update failed",
+                                       exc_info=True)
                 except Exception:
                     # the incoming model may not accept the observed
                     # signature at all (input shape changed): stop warming
@@ -188,10 +238,6 @@ class _ModelEntry:
                         "remaining buckets will compile on first dispatch",
                         self.name, version, b, exc_info=True)
                     break
-                try:
-                    self.metrics.inc("prewarm_count")
-                except Exception:
-                    _LOG.debug("prewarm_count update failed", exc_info=True)
                 self.repoint(version)
         finally:
             self.repoint(version)
@@ -230,6 +276,7 @@ class _ModelEntry:
                     self._drained.wait(min(remaining, 0.05))
             self.versions.pop(version, None)
             self._inflight.pop(version, None)
+            self._replica_aware.pop(version, None)
             if version == self.current_version:
                 self.current_version = (max(self.versions)
                                         if self.versions else None)
@@ -242,6 +289,9 @@ class _ModelEntry:
                     "warming": self._warming > 0,
                     "queue_depth": self.batcher.queue_depth(),
                     "queue_size": self.batcher.queue_size,
+                    "replicas": self.batcher.replicas,
+                    "dead_replicas": self.batcher.dead_replicas(),
+                    "replica_depths": self.batcher.replica_depths(),
                     "max_batch_size": self.batcher.max_batch_size,
                     "batch_timeout_ms": self.batcher.batch_timeout_ms}
 
@@ -412,8 +462,16 @@ class ModelRegistry:
                 return {"status": "unhealthy",
                         "reason": "worker thread dead for model %r" % e.name}
         for e in entries:
-            if e.batcher.queue_depth() >= 0.8 * e.batcher.queue_size:
+            if e.batcher.queue_depth() >= 0.8 * e.batcher.total_queue_size:
                 return {"status": "degraded",
                         "reason": "queue >= 80%% for model %r" % e.name,
                         "queue_depth": e.batcher.queue_depth()}
+        for e in entries:
+            dead = e.batcher.dead_replicas()
+            if dead:
+                # survivors still serve (the router skips the dead set),
+                # but capacity shrank — the load balancer should know
+                return {"status": "degraded",
+                        "reason": "model %r lost replica worker(s) %s"
+                                  % (e.name, dead)}
         return {"status": "healthy", "models": len(entries)}

@@ -13,8 +13,11 @@ Routes (TF-Serving REST-shaped):
   ...], "deadline_ms": <optional>, "dtype": <optional, default float32>}``;
   response ``{"outputs": [<nested list>, ...]}``. Each input is ONE item,
   WITHOUT the batch dim — cross-request batching is the server's job.
-- ``GET /v1/models``            — registered models + queue/batch config.
-- ``GET /v1/models/<name>``     — one model + its metrics snapshot.
+- ``GET /v1/models``            — registered models + queue/batch config
+  (incl. per-model ``replicas`` / ``replica_depths`` / ``dead_replicas``
+  — the data-parallel serving topology, docs/SERVING.md).
+- ``GET /v1/models/<name>``     — one model + its metrics snapshot
+  (``replica_dispatch`` shows the router's per-replica balance).
 - ``GET /metrics``              — Prometheus text exposition of the
   process-wide telemetry registry (serving counters, batch-size
   histogram, latency histogram, plus training/compile/kvstore/io

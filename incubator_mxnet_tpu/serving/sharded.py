@@ -1,0 +1,220 @@
+"""Mesh-sharded servables: tensor-parallel predict behind the batcher.
+
+``serving/`` executes requests through servables; this module provides the
+one that runs a live Gluon block ACROSS a device mesh instead of on one
+chip (ROADMAP item 1, GSPMD sharding per arXiv 2004.13336 / MLPerf
+TPU-pod serving per arXiv 1909.09756):
+
+- **Tensor parallelism** — parameters annotated by
+  ``parallel.tensor_parallel`` (ColParallelDense / RowParallelDense /
+  shard_params) carry a ``PartitionSpec`` over the ``tp`` mesh axis;
+  :class:`MeshServable` lays each parameter out with the matching
+  ``jax.sharding.NamedSharding`` and compiles ONE partitioned program —
+  XLA inserts the all-reduce/all-gather on ICI. Un-annotated parameters
+  replicate. This is how a model too big for one chip serves.
+- **Data-parallel replica groups** — ``replicas=N`` carves the device
+  list into N disjoint tp-sized groups, one mesh each, and
+  ``predict_batch(..., replica=r)`` dispatches on group ``r`` — the
+  batcher's per-replica workers each drive their own chips, so dp x tp
+  compose on one host (8 devices = 4 replicas x tp=2).
+
+Executables go through the process-wide ``aot.CACHE`` keyed with the
+mesh signature (plus the replica group), so prewarm covers every
+(bucket x replica) pair and hot-reloads of an identical model never
+recompile; with ``MXTPU_AOT_CACHE_DIR`` set the partitioned StableHLO is
+persisted per key (sharded-artifact residue of ROADMAP item 3) and a
+fresh process with the same device topology loads instead of re-tracing.
+
+Inputs arrive replicated (every chip sees the whole batch; the tp
+collectives operate on weights/activations), outputs are replicated back
+and returned as device arrays — the batcher's one reviewed sync point
+materializes them host-side.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import aot
+from .. import config
+from ..telemetry import spans
+
+__all__ = ["MeshServable", "serving_mesh"]
+
+_LOG = logging.getLogger(__name__)
+
+
+def serving_mesh(tp=None, devices=None, tp_axis="tp"):
+    """A 1-axis tp mesh over the first ``tp`` devices (the
+    :class:`MeshServable` default when no mesh is passed;
+    tp default: MXTPU_SERVE_TP)."""
+    import jax
+    if tp is None:
+        tp = int(config.get_env("MXTPU_SERVE_TP"))
+    if devices is None:
+        devices = jax.devices()
+    if tp < 1 or tp > len(devices):
+        raise ValueError("tp=%d needs 1..%d devices" % (tp, len(devices)))
+    import numpy as onp
+    from jax.sharding import Mesh
+    return Mesh(onp.array(devices[:tp]), axis_names=(tp_axis,))
+
+
+class MeshServable:
+    """Serve a live, initialized Gluon block tensor-parallel over a mesh
+    (optionally in data-parallel replica groups).
+
+    ``predict_batch(*stacked[, replica=r])`` is the batcher entry point;
+    declaring ``replica`` makes the batcher (and the registry dispatch
+    closure) pass each worker's replica index through, and the prewarm
+    path warm every (bucket x replica) pair.
+    """
+
+    def __init__(self, net, mesh=None, tp=None, tp_axis="tp", replicas=1,
+                 model_id=None):
+        import jax
+        from ..gluon import _functional
+        self.tp_axis = tp_axis
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1 (got %d)" % replicas)
+        if mesh is not None:
+            meshes = [mesh]
+            if replicas > 1:
+                raise ValueError(
+                    "pass either an explicit mesh (one group) or "
+                    "replicas=N (N auto-carved tp groups), not both")
+        else:
+            if tp is None:
+                tp = int(config.get_env("MXTPU_SERVE_TP"))
+            devices = jax.devices()
+            if replicas * tp > len(devices):
+                raise ValueError(
+                    "replicas=%d x tp=%d needs %d devices, have %d"
+                    % (replicas, tp, replicas * tp, len(devices)))
+            meshes = [serving_mesh(tp, devices[g * tp:(g + 1) * tp],
+                                   tp_axis)
+                      for g in range(replicas)]
+        self.meshes = meshes
+        self.mesh = meshes[0]
+        from .. import jit as _jit
+        params, param_arrs, pure_fn, _aux = _functional.make_pure_fn(
+            net, train_mode=False)
+        self._pure_fn = pure_fn
+        self._params = params
+        # traces of pure_fn swap the live net's param NDArray._data; two
+        # replica-group workers compile-missing concurrently (distinct
+        # cache keys, so single-flight does not serialize them) must not
+        # interleave their trace windows — same contract as EvalStep
+        self._trace_lock = _jit._net_trace_lock(net)
+        # one replicated-or-tp-sharded copy of the weights per group —
+        # each replica group owns its chips outright (true data
+        # parallelism: no cross-group communication ever)
+        self._group_params = [
+            [jax.device_put(a._data, self._param_sharding(p, m))
+             for p, a in zip(params, param_arrs)]
+            for m in meshes]
+        if model_id is None:
+            model_id = aot.model_id_for(net, extra=("mesh-serve",))
+        self._model_id = model_id
+
+    def _param_sharding(self, p, mesh):
+        """p.sharding (a PartitionSpec from tensor_parallel annotations)
+        on this group's mesh; un-annotated params replicate — the same
+        rule DataParallelTrainStep applies (parallel/data_parallel.py)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = getattr(p, "sharding", None)
+        if spec is None:
+            return NamedSharding(mesh, PartitionSpec())
+        if isinstance(spec, NamedSharding):
+            return NamedSharding(mesh, spec.spec)
+        return NamedSharding(mesh, spec)
+
+    @property
+    def replicas(self):
+        return len(self.meshes)
+
+    # ------------------------------------------------------------------
+    def _compiled(self, datas, group):
+        """The partitioned executable for this input signature on replica
+        group ``group``, through the shared AOT cache (mesh signature +
+        group index in the key: two groups hold the same program compiled
+        against DIFFERENT devices, so they must not share an entry)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self.meshes[group]
+        gparams = self._group_params[group]
+        key = aot.cache_key(self._model_id, aot.input_signature(datas),
+                            kind="serve", mesh=mesh,
+                            extra=("rep", group))
+        pure_fn = self._pure_fn
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def fwd(param_datas, *xs):
+            import jax as _jax
+            outs, _aux = pure_fn(param_datas, list(xs),
+                                 _jax.random.PRNGKey(0))
+            return tuple(outs)
+
+        def build():
+            param_shardings = [d.sharding for d in gparams]
+            jitted = jax.jit(fwd,
+                             in_shardings=(param_shardings,)
+                             + (repl,) * len(datas),
+                             out_shardings=repl)
+            param_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
+                                                sharding=d.sharding)
+                           for d in gparams]
+            in_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
+                                             sharding=repl)
+                        for d in datas]
+            exported = None
+            with spans.span("eval:build", model_id=self._model_id,
+                            mesh=str(aot.mesh_sig(mesh)), replica=group), \
+                    self._trace_lock:
+                try:
+                    import jax.export as jax_export
+                    exported = jax_export.export(jitted)(param_specs,
+                                                         *in_specs)
+                    fn = jax.jit(exported.call).lower(
+                        param_specs, *in_specs).compile()
+                except Exception:
+                    # non-exportable partitioned program: direct AOT
+                    # compile, in-memory only (no persisted artifact)
+                    _LOG.debug("mesh-serve export failed; direct AOT",
+                               exc_info=True)
+                    exported = None
+                    fn = jitted.lower(param_specs, *in_specs).compile()
+            return fn, None, exported
+
+        param_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
+                                            sharding=d.sharding)
+                       for d in gparams]
+        in_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=repl)
+                    for d in datas]
+        return aot.compile_cached(key, build, exportable=True,
+                                  arg_specs=(param_specs,) + tuple(in_specs))
+
+    def predict_batch(self, *stacked_inputs, replica=0):
+        """Batcher entry point: run one stacked batch tensor-parallel on
+        replica group ``replica % self.replicas``. Returns device arrays
+        (replicated on the group's mesh) — the batcher materializes them
+        host-side at its one reviewed sync point."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        group = int(replica) % len(self.meshes)
+        mesh = self.meshes[group]
+        repl = NamedSharding(mesh, PartitionSpec())
+        import numpy as onp
+        # reviewed host->device point: the batcher hands this path host
+        # numpy already (its padding stacks on host); asarray only
+        # materializes list/scalar payloads from direct callers — never a
+        # device->host transfer of a live device array
+        datas = [jax.device_put(
+                     x if hasattr(x, "shape") and hasattr(x, "dtype")
+                     else onp.asarray(x), repl)  # mxtpulint: disable=R001
+                 for x in stacked_inputs]
+        entry = self._compiled(datas, group)
+        out = entry.fn(self._group_params[group], *datas)
+        if isinstance(out, (list, tuple)) and len(out) == 1:
+            return (out[0],)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)

@@ -55,7 +55,7 @@ def test_r001_batcher_dispatch_positive_client_side_clean(tmp_path):
         import numpy as onp
 
         class DynamicBatcher:
-            def _dispatch_batch(self, live):
+            def _dispatch_replica(self, live, replica):
                 return onp.asarray(live[0].item())
 
             def submit(self, x):

@@ -1121,22 +1121,36 @@ def test_r001_interprocedural_depth_is_one(tmp_path):
 
 
 # --------------------------------------------------------- seeded defects
-def test_seeded_defects_exactly_four():
-    """The regression canary: the fixture module contains one deadlock
-    cycle, one unlocked cross-thread write, one jax.jit retrace hazard,
-    and one AOT-boundary (aot.compile_cached) retrace hazard — the
-    analyzer must report exactly those four (ci/run.sh asserts the same
-    thing in the lint stage)."""
+def test_seeded_defects_exactly_five():
+    """The regression canary: the fixtures contain one deadlock cycle,
+    one unlocked cross-thread write, one jax.jit retrace hazard, one
+    AOT-boundary (aot.compile_cached) retrace hazard, and one host-device
+    sync in the replica dispatch hot path (seeded_batcher.py anchors the
+    ``*batcher:DynamicBatcher._dispatch_replica`` pattern) — the analyzer
+    must report exactly those five (ci/run.sh asserts the same thing in
+    the lint stage)."""
     findings = analyze([SEEDED], root=SEEDED)
-    assert rule_ids(findings) == ["R009", "R010", "R011", "R011"], findings
+    assert rule_ids(findings) == \
+        ["R001", "R009", "R010", "R011", "R011"], findings
+
+
+def test_seeded_replica_defect_is_the_r001(tmp_path):
+    # the R001 comes from the replica-dispatch fixture specifically,
+    # anchored at the _dispatch_replica hot path
+    findings = analyze([SEEDED], root=SEEDED)
+    r001 = [f for f in findings if f.rule == "R001"]
+    assert len(r001) == 1
+    assert r001[0].path.endswith("seeded_batcher.py")
+    assert "_dispatch_replica" in r001[0].message
 
 
 def test_seeded_defects_clean_under_repo_gate_profile():
-    # under the repo gate the fixture sits in tools/ => relaxed profile
-    rel = "tools/mxtpulint/testdata/seeded_defects.py"
-    assert rules_for_path(rel) == RELAXED_RULES
-    findings = analyze([os.path.join(REPO, rel)], root=REPO)
-    assert findings == []
+    # under the repo gate the fixtures sit in tools/ => relaxed profile
+    for rel in ("tools/mxtpulint/testdata/seeded_defects.py",
+                "tools/mxtpulint/testdata/seeded_batcher.py"):
+        assert rules_for_path(rel) == RELAXED_RULES
+        findings = analyze([os.path.join(REPO, rel)], root=REPO)
+        assert findings == []
 
 
 # ------------------------------------------------------------ path profiles

@@ -181,6 +181,27 @@ def test_summarize_stage_joins_request_ids_to_spans():
     assert srv["join_coverage"] == pytest.approx(2 / 3)
 
 
+def test_summarize_stage_breaks_out_dispatch_time_per_replica():
+    rids = ["lg-r-s0-%d" % i for i in range(4)]
+    results = [{"rid": r, "status": 200, "latency_ms": 5.0} for r in rids]
+    lines = []
+    # two replicas: replica 0 fast (2 ms), replica 1 slow (20 ms) — the
+    # per-replica breakout must attribute the skew to replica 1 alone
+    for r, rep, dur in ((rids[0], 0, 2000.0), (rids[1], 0, 2000.0),
+                        (rids[2], 1, 20000.0), (rids[3], 1, 20000.0)):
+        lines.append(json.dumps({"name": "serve:dispatch",
+                                 "dur_us": dur,
+                                 "args": {"replica": rep,
+                                          "request_ids": [r]}}))
+    s = loadgen.summarize_stage({"rps": 4, "duration_s": 1.0}, 4, results,
+                                span_text="\n".join(lines))
+    srv = s["server"]
+    assert srv["dispatch_ms"]["count"] == 4
+    assert srv["replica_ms"]["0"]["p50"] == pytest.approx(2.0)
+    assert srv["replica_ms"]["1"]["p50"] == pytest.approx(20.0)
+    assert srv["replica_ms"]["0"]["count"] == 2
+
+
 def test_parse_prom_values_and_labels():
     text = ('# TYPE x counter\nx{model="m"} 3\nx{model="n"} 4\n'
             '# TYPE g gauge\ng 2.5\nh_bucket{le="+Inf"} 7\n')
@@ -254,6 +275,39 @@ def test_perfgate_missing_baselined_metric_fails(tmp_path):
                                 perfgate.load_baseline(str(bp)))
     assert [f[0] for f in findings] == ["G002"]
     assert perfgate.main(["--input", str(run), "--baseline", str(bp)]) == 1
+
+
+def test_perfgate_only_filter_scopes_gate_to_a_stage_subset(tmp_path):
+    # one committed baseline holds multiple CI stages' metrics; each
+    # stage gates its own glob without G002-failing on its siblings'
+    base = {"schema": perfgate.BASELINE_SCHEMA, "default_tolerance": 0.5,
+            "metrics": {
+                "loadgen_stage0_p50_ms": {"value": 10.0,
+                                          "direction": "lower"},
+                "sharded_goodput_scaling": {"value": 7.5,
+                                            "direction": "higher",
+                                            "tolerance": 0.6}}}
+    sharded_run = {"sharded_goodput_scaling": 7.9}
+    # unfiltered: the loadgen metric is missing from the run -> G002
+    assert [f[0] for f in perfgate.compare(sharded_run, base)] == ["G002"]
+    # scoped to the stage's glob: clean
+    assert perfgate.compare(sharded_run, base, only="sharded_*") == []
+    # the >=3x floor still fires inside the scope (7.5 * (1-0.6) = 3.0)
+    bad = perfgate.compare({"sharded_goodput_scaling": 2.9}, base,
+                           only="sharded_*")
+    assert [f[0] for f in bad] == ["G001"]
+    # --only on the CLI; combining with --update-baseline is refused
+    bp = tmp_path / "base.json"
+    bp.write_text(json.dumps(base))
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps({"schema": perfgate.METRICS_SCHEMA,
+                               "metrics": sharded_run}))
+    assert perfgate.main(["--input", str(run), "--baseline", str(bp),
+                          "--only", "sharded_*"]) == 0
+    assert perfgate.main(["--input", str(run), "--baseline", str(bp)]) == 1
+    assert perfgate.main(["--input", str(run), "--baseline", str(bp),
+                          "--only", "sharded_*",
+                          "--update-baseline"]) == 2
 
 
 def test_perfgate_tolerance_bands_both_directions(tmp_path):

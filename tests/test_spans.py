@@ -276,19 +276,26 @@ def test_e2e_request_span_chain_in_one_chrome_dump(tmp_path):
     for e in trace:
         if e.get("cat") == "span":
             ev.setdefault(e["name"], e)
-    chain = ["http:predict", "serve:queue", "serve:batch", "eval:step"]
+    chain = ["http:predict", "serve:queue", "serve:batch",
+             "serve:dispatch", "eval:step"]
     assert set(chain) <= set(ev), sorted(ev)
     root_id = ev["http:predict"]["args"]["span_id"]
     # HTTP -> queue and HTTP -> batch are direct parent links
     assert ev["serve:queue"]["args"]["parent_id"] == root_id
     assert ev["serve:batch"]["args"]["parent_id"] == root_id
-    # batch -> device: eval:step nests under the worker's serve:batch
-    assert ev["eval:step"]["args"]["parent_id"] \
+    # batch -> replica dispatch -> device: the servable call runs inside
+    # the per-replica serve:dispatch span, and the compiled eval step
+    # nests under THAT (the replica link the loadgen join reads)
+    assert ev["serve:dispatch"]["args"]["parent_id"] \
         == ev["serve:batch"]["args"]["span_id"]
+    assert ev["serve:dispatch"]["args"]["replica"] == 0
+    assert ev["eval:step"]["args"]["parent_id"] \
+        == ev["serve:dispatch"]["args"]["span_id"]
     # the request id rides the whole chain
     assert ev["http:predict"]["args"]["request_id"] == "feedc0de"
     assert ev["serve:queue"]["args"]["request_id"] == "feedc0de"
     assert "feedc0de" in ev["serve:batch"]["args"]["request_ids"]
+    assert "feedc0de" in ev["serve:dispatch"]["args"]["request_ids"]
     # and the HTTP debug export shows the same parented chain
     sv = {}
     for r in served:

@@ -60,7 +60,8 @@ import queue as _queue
 import urllib.error
 import urllib.request
 
-__all__ = ["LoadGen", "HttpTransport", "arrival_offsets", "percentile",
+__all__ = ["LoadGen", "HttpTransport", "InProcessTransport",
+           "arrival_offsets", "percentile",
            "parse_prom", "summarize_stage", "detect_saturation",
            "gate_metrics", "report_ci", "REPORT_SCHEMA", "METRICS_SCHEMA"]
 
@@ -221,6 +222,71 @@ class HttpTransport:
             return ""
 
 
+class InProcessTransport:
+    """Drive a live ``ModelRegistry`` directly — no HTTP, no sockets.
+
+    The stdlib thread-per-connection front-end tops out around a few
+    hundred requests/s of Python HTTP handling on one host, which is an
+    order of magnitude BELOW what 8 data-parallel replica workers can
+    dispatch — measured through HTTP, replica scaling saturates on the
+    web server, not on serving. This transport submits straight into the
+    registry (router -> replica queues -> workers), so a soak measures
+    the serving core itself; the scrape endpoints read the same
+    process-wide telemetry registry and span ring the HTTP routes serve,
+    so the X-Request-Id join works unchanged. Status mapping mirrors
+    server.py's error contract (429/504/503/500). Used by
+    ``ci/run.sh sharded`` for the 1-vs-8-replica goodput-scaling gate
+    (docs/SERVING.md, docs/LOADGEN.md).
+
+    Imports of the framework happen lazily at construction, keeping this
+    module import-light for the remote-HTTP use case.
+    """
+
+    def __init__(self, registry, model, item, deadline_ms=None,
+                 timeout_s=None, dtype="float32"):
+        import numpy as onp
+        self._registry = registry
+        self._model = model
+        self._item = onp.asarray(item, dtype=onp.dtype(dtype))
+        self._deadline_ms = deadline_ms
+        self._timeout = (float(timeout_s) if timeout_s is not None
+                         else _env("MXTPU_LOADGEN_TIMEOUT_S"))
+
+    def send(self, request_id):
+        from incubator_mxnet_tpu.serving import batcher as _batcher
+        from incubator_mxnet_tpu.serving.registry import ModelNotFoundError
+        try:
+            self._registry.predict(self._model, self._item,
+                                   deadline_ms=self._deadline_ms,
+                                   timeout=self._timeout,
+                                   request_id=request_id)
+            return 200
+        except _batcher.QueueFullError:
+            return 429
+        except (_batcher.DeadlineExceededError, TimeoutError):
+            return 504
+        except _batcher.ServingClosedError:
+            return 503
+        except ModelNotFoundError:
+            return 404
+        except Exception:  # servable failure — server.py maps this to 500
+            return 500
+
+    def scrape(self):
+        from incubator_mxnet_tpu import telemetry
+        try:
+            return telemetry.export_text()
+        except Exception:
+            return ""
+
+    def spans(self):
+        from incubator_mxnet_tpu.telemetry import spans as _spans
+        try:
+            return _spans.export_jsonl()
+        except Exception:
+            return ""
+
+
 class _MonotonicClock:
     """The real clock: monotonic now() + time.sleep."""
 
@@ -289,11 +355,16 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
 def _join_spans(rids, ok_rids, span_text):
     """Attribute the stage's server-side time by span kind, joined on the
     X-Request-Id each request carried: queue wait (serve:queue), batch
-    dispatch (serve:batch), device step (eval:step), and the server's own
-    view of the request (http:predict)."""
+    dispatch (serve:batch), the per-replica servable call
+    (serve:dispatch — additionally broken out by its ``replica`` arg, so
+    a slow chip shows up as ONE replica's latency, not a fleet-wide
+    blur), device step (eval:step), and the server's own view of the
+    request (http:predict)."""
     kinds = {"serve:queue": "queue_ms", "serve:batch": "batch_ms",
+             "serve:dispatch": "dispatch_ms",
              "eval:step": "device_ms", "http:predict": "http_ms"}
     durs = {v: [] for v in kinds.values()}
+    replica_durs = {}
     joined_rids = set()
     for line in span_text.splitlines():
         try:
@@ -312,13 +383,23 @@ def _join_spans(rids, ok_rids, span_text):
         key = kinds.get(rec.get("name"))
         if key is None:
             continue
-        durs[key].append(rec.get("dur_us", 0.0) / 1e3)
+        ms = rec.get("dur_us", 0.0) / 1e3
+        durs[key].append(ms)
+        if rec.get("name") == "serve:dispatch":
+            rep = (rec.get("args") or {}).get("replica")
+            if rep is not None:
+                replica_durs.setdefault(str(rep), []).append(ms)
         if rec.get("name") == "serve:queue" and rid in ok_rids:
             joined_rids.add(rid)
     out = {}
     for key, vals in durs.items():
         out[key] = dict(_pctls(vals), count=len(vals),
                         mean=(sum(vals) / len(vals)) if vals else None)
+    if replica_durs:
+        out["replica_ms"] = {
+            rep: dict(_pctls(vals), count=len(vals),
+                      mean=sum(vals) / len(vals))
+            for rep, vals in sorted(replica_durs.items())}
     # coverage over OK responses only: a dispatched-then-504'd request
     # also leaves a serve:queue span, and counting it against the OK
     # denominator would push coverage past 1.0 under overload (masking a

@@ -36,14 +36,28 @@ HOT_PATH_PATTERNS = (
     "*batcher:DynamicBatcher._run",
     "*batcher:DynamicBatcher._run_loop",
     "*batcher:DynamicBatcher._gather",
-    "*batcher:DynamicBatcher._dispatch_batch",
+    "*batcher:DynamicBatcher._process_batch",
+    # the per-replica dispatch path (data-parallel serving): group
+    # bucketing, the serve:dispatch servable call, and the dead-replica
+    # drain-back all run on replica worker threads — a hidden sync in any
+    # of them serializes that replica's whole stream
+    "*batcher:DynamicBatcher._dispatch_replica",
+    "*batcher:DynamicBatcher._dispatch_bucketed",
+    "*batcher:DynamicBatcher._call_servable",
     "*batcher:DynamicBatcher._dispatch_batch_traced",
+    "*batcher:DynamicBatcher._drain_dead_replica",
+    "*batcher:DynamicBatcher._reroute_queue",
     # the registry's version-resolving dispatch closure: it IS the
     # batcher's _dispatch_fn, but the indirection (a bound method passed
     # as a callable) is beyond static call-graph resolution — declare it
     # a hot path explicitly so syncs there are caught inline and one
     # call level down
     "*serving/registry:_ModelEntry._dispatch",
+    # tensor-parallel predict: the mesh-sharded servable's dispatch runs
+    # inside serve:dispatch on a replica worker; a host sync here stalls
+    # every chip in the tp group at once
+    "*serving/sharded:MeshServable.predict_batch",
+    "*serving/sharded:MeshServable._compiled",
 )
 
 _SYNC_ATTRS = ("asnumpy", "item")

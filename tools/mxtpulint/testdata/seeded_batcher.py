@@ -1,0 +1,34 @@
+"""Seeded replica-dispatch defect — DO NOT FIX.
+
+The file name ends in ``batcher`` on purpose: the module key matches the
+``*batcher:DynamicBatcher._dispatch_replica`` HOT_PATH_PATTERNS entry, so
+the R001 hot-path-sync rule treats this class exactly like the real
+serving batcher's per-replica dispatch path. One known defect is kept
+alive so CI can assert the replica-path coverage still FIRES (a lint
+whose replica rules silently stop firing would wave through the exact
+sync-in-dispatch bug class sharded serving makes N times worse — every
+replica worker that syncs stalls its whole queue):
+
+- a host-device sync inside the replica dispatch hot path (R001):
+  ``.asnumpy()`` on the servable's output inside ``_dispatch_replica``.
+
+This file lives under tools/, so the REPO gate lints it only under the
+relaxed R003/R005/R006 profile (under which it is clean); the regression
+test and ci/run.sh analyze this directory with the FULL profile and
+assert exactly the five seeded findings (one here, four in
+seeded_defects.py).
+"""
+
+
+class DynamicBatcher:
+    """Shape mirror of serving/batcher.DynamicBatcher — just enough for
+    the hot-path pattern to anchor on the real method name."""
+
+    def __init__(self, servable):
+        self._dispatch_fn = servable
+
+    def _dispatch_replica(self, live, replica):
+        outs = self._dispatch_fn(*live)
+        # R001: the replica worker blocks on a device->host transfer for
+        # every batch — the defect class the pattern exists to catch
+        return [o.asnumpy() for o in outs]

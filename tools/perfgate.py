@@ -166,7 +166,7 @@ def make_baseline(agg, old=None, default_tolerance=None):
     return out
 
 
-def compare(agg, baseline):
+def compare(agg, baseline, only=None):
     """[(rule, metric, message), ...] — empty means the gate passes.
 
     lower-is-better regresses past ``base * (1 + tolerance)``;
@@ -175,11 +175,20 @@ def compare(agg, baseline):
     metric must not read as a pass); a new un-baselined metric is
     reported informationally by main() but never fails the gate — adding
     coverage shouldn't require passing it in the same commit.
+
+    ``only`` (an fnmatch glob, CLI ``--only``) restricts the comparison —
+    including the G002 missing-metric check — to baselined metrics
+    matching it: one committed PERF_BASELINE.json holds every CI stage's
+    metrics (loadgen_*, sharded_*, ...), and each stage gates its own
+    subset without G002-failing on its siblings'.
     """
+    import fnmatch
     default_tol = baseline.get("default_tolerance",
                                _env("MXTPU_PERFGATE_TOLERANCE"))
     findings = []
     for name, entry in sorted(baseline.get("metrics", {}).items()):
+        if only is not None and not fnmatch.fnmatch(name, only):
+            continue
         base = float(entry["value"])
         direction = entry.get("direction", infer_direction(name))
         tol = float(entry.get("tolerance", default_tol))
@@ -264,6 +273,11 @@ def main(argv=None):
                     help="relative band for metrics without their own "
                          "(default: baseline's, else "
                          "MXTPU_PERFGATE_TOLERANCE)")
+    ap.add_argument("--only", default=None, metavar="GLOB",
+                    help="gate only baselined metrics matching this "
+                         "fnmatch glob (e.g. 'sharded_*') — per-stage "
+                         "subsets of one committed baseline; G002 "
+                         "missing-metric checks follow the same filter")
     ap.add_argument("--selftest-inject", type=float, default=None,
                     metavar="FACTOR",
                     help="multiply lower-is-better aggregates (divide "
@@ -299,6 +313,12 @@ def main(argv=None):
     agg = aggregate(runs, directions)
 
     if args.update_baseline:
+        if args.only:
+            print("perfgate: --only is a compare-time filter; refusing to "
+                  "combine with --update-baseline (a partial rewrite "
+                  "would silently drop the other stages' entries)",
+                  file=sys.stderr)
+            return 2
         base = make_baseline(agg, old,
                              default_tolerance=args.default_tolerance)
         with open(args.baseline, "w") as f:
@@ -323,7 +343,7 @@ def main(argv=None):
             inj[name] = v * f if d == "lower" else v / f
         agg = inj
 
-    findings = compare(agg, old)
+    findings = compare(agg, old, only=args.only)
     rep = report(findings, args.baseline)
     if args.as_json:
         json.dump(rep, sys.stdout, indent=1)
