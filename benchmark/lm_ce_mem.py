@@ -43,11 +43,19 @@ def run_variant(name):
     ma = g.lower(h, w, y).compile().memory_analysis()
     out = g(h, w, y)
     jax.block_until_ready(out)
-    stats = jax.devices()[0].memory_stats() or {}
+    # runtime peak via a LIVE devstats sample (sample_now polls the
+    # devices right here, after block_until_ready — device_memory()
+    # would return the daemon's cached snapshot when a sampler runs,
+    # which can predate this variant's high-water mark); it degrades to
+    # host-RSS report-only samples on backends with no PJRT memory_stats
+    # instead of this script hand-rolling a fallback
+    from incubator_mxnet_tpu.telemetry import devstats
+    peak = max((s.get("peak_bytes_in_use", 0)
+                for s in devstats.sample_now().values()), default=0)
     print(json.dumps({
         "variant": name, "loss": float(out[0]),
         "temp_gb": round(ma.temp_size_in_bytes / 2 ** 30, 2),
-        "peak_gb": round(stats.get("peak_bytes_in_use", 0) / 2 ** 30, 2)}))
+        "peak_gb": round(peak / 2 ** 30, 2)}))
 
 
 def main():

@@ -30,8 +30,17 @@
 #             not see a compile" contract, gated
 #   observability - boot the serving server, drive traffic, scrape
 #             GET /metrics over the wire, and validate the Prometheus
-#             exposition with the stdlib parser (tools/promcheck.py);
+#             exposition with the stdlib parser (tools/promcheck.py,
+#             incl. the P002 HELP/TYPE hygiene rule);
 #             also exercises the headless periodic-flush file path
+#   devstats - device-truth gate (telemetry/devstats.py): a short
+#             in-process soak through the serving registry asserting
+#             nonzero mxtpu_device_mfu / mxtpu_aot_program_flops /
+#             mxtpu_device_memory_bytes in the exposition, a fresh-
+#             subprocess artifact-only load whose /metrics still
+#             reports nonzero program FLOPs (device truth survives
+#             zero-compile loads), and /debug/profile single-flight
+#             (concurrent capture -> 409); wall budget 60s
 #   loadgen - open-loop load harness + perf regression gate: three
 #             interleaved CPU soak repeats (tools/loadgen.py: Poisson
 #             ramp over a timer-bound servable, per-stage p50/95/99,
@@ -66,7 +75,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability loadgen sharded diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability devstats loadgen sharded diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -95,15 +104,16 @@ print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
   # Seeded-defect canary: the whole-program passes must still FIRE. The
   # fixtures hold one known deadlock cycle, one unlocked cross-thread
   # write, one jax.jit retrace hazard, one AOT-boundary retrace hazard
-  # (aot.compile_cached), and one host-device sync in the replica
-  # dispatch hot path (seeded_batcher.py, HOT_PATH_PATTERNS replica
-  # coverage); full-profile analysis rooted at the fixture dir must
-  # report exactly those five.
+  # (aot.compile_cached), one host-device sync in the replica dispatch
+  # hot path, and one per-dispatch XLA cost_analysis walk in the
+  # servable-call hot path (seeded_batcher.py, HOT_PATH_PATTERNS +
+  # device-truth R001 sub-rule coverage); full-profile analysis rooted
+  # at the fixture dir must report exactly those six.
   python - <<'EOF'
 from tools.mxtpulint import analyze
 found = sorted(f.rule for f in analyze(["tools/mxtpulint/testdata"],
                                        root="tools/mxtpulint/testdata"))
-assert found == ["R001", "R009", "R010", "R011", "R011"], found
+assert found == ["R001", "R001", "R009", "R010", "R011", "R011"], found
 print("seeded-defect canary OK: %s" % ", ".join(found))
 EOF
 fi
@@ -219,6 +229,7 @@ with ServingServer(reg, port=0) as srv:
     with urllib.request.urlopen(srv.url + "/metrics.json", timeout=30) as r:
         legacy = json.loads(r.read())
 types = promcheck.validate(text)
+assert not promcheck.validate_metadata(text), promcheck.validate_metadata(text)
 assert types["mxtpu_serving_requests_total"] == "counter", types
 assert types["mxtpu_serving_batch_size"] == "histogram", types
 assert 'mxtpu_serving_ok_total{model="ci"} 16' in text
@@ -229,6 +240,128 @@ telemetry.flush_to_file(path)
 promcheck.validate(open(path).read())
 print("observability OK: %d families scraped + flushed" % len(types))
 EOF
+fi
+
+if has_stage devstats; then
+  echo "=== devstats: device-truth gate (MFU + HBM + zero-compile survival) ==="
+  # The attribution chain end-to-end: a soak drives the serving registry
+  # and the exposition must carry nonzero per-dispatch MFU and program
+  # FLOPs; the sampler must export memory series; /debug/profile must be
+  # single-flight; and a FRESH process doing an artifact-only load (zero
+  # compiles) must still report nonzero mxtpu_aot_program_flops — device
+  # truth survives the zero-recompile path it exists to judge.
+  dv_t0=$SECONDS
+  DV_CACHE=$(mktemp -d -t mxtpu_devstats.XXXXXX)
+  JAX_PLATFORMS=cpu MXTPU_AOT_CACHE_DIR="$DV_CACHE" python - <<'EOF'
+import json, os, re, subprocess, sys, threading, time, urllib.request, urllib.error
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, telemetry
+from incubator_mxnet_tpu.telemetry import devstats
+from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+from tools import loadgen, promcheck
+import numpy as onp
+
+def series(text, name):
+    return [l for l in text.splitlines()
+            if l.startswith(name) and not l.startswith("#")
+            and not l.startswith(name + "_")]
+
+def nonzero(text, name):
+    vals = [float(l.rsplit(None, 1)[1]) for l in series(text, name)]
+    assert vals and any(v > 0 for v in vals), (name, vals)
+
+mx.random.seed(0)
+net = gluon.nn.Dense(8, in_units=16)
+net.initialize(mx.init.Xavier())
+reg = ModelRegistry()
+reg.load("devci", net, max_batch_size=4, batch_timeout_ms=1.0)
+
+# HBM sampler up for the whole soak (heartbeat-registered daemon)
+devstats.start(poll_s=0.05)
+
+with ServingServer(reg, port=0) as srv:
+    # HTTP loadgen soak: the stage report must attribute queue/batch/
+    # device time AND carry the scrape-derived mfu/device_s columns
+    tr = loadgen.HttpTransport(srv.url, "devci", [0.0] * 16)
+    lg = loadgen.LoadGen(tr, stages=[{"rps": 120, "duration_s": 1.0}],
+                         arrival="poisson", seed=0, max_clients=64)
+    report = lg.run()
+    st = report["stages"][0]
+    assert st["ok"] > 0 and st["errors"] == 0, st
+    srv_side = st["server"]
+    for leg in ("queue_ms", "batch_ms", "device_ms"):
+        assert srv_side[leg]["count"] > 0, (leg, srv_side[leg])
+    m = srv_side["metrics"]
+    assert m["device_s"] and m["device_s"] > 0, m
+    assert m["mfu"] and m["mfu"] > 0, m
+    print("loadgen attribution OK: queue/batch/device joined, "
+          "stage mfu %.2e, device_s %.4fs" % (m["mfu"], m["device_s"]))
+
+    with urllib.request.urlopen(srv.url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    nonzero(text, "mxtpu_device_mfu")
+    nonzero(text, "mxtpu_device_hbm_bw_util")
+    nonzero(text, "mxtpu_aot_program_flops")
+    nonzero(text, "mxtpu_device_flops_total")
+    nonzero(text, "mxtpu_device_memory_bytes")
+    nonzero(text, "mxtpu_device_peak_flops")
+    promcheck.validate(text)
+    assert not promcheck.validate_metadata(text)
+
+    # /debug/profile single-flight: concurrent captures -> 200 + 409.
+    # Deterministic overlap: fire the second request only once the first
+    # capture HOLDS the single-flight lock (polling, not a fixed sleep —
+    # a loaded box could otherwise serialize the two captures).
+    codes = []
+    def cap():
+        try:
+            with urllib.request.urlopen(
+                    srv.url + "/debug/profile?seconds=1.5", timeout=30) as r:
+                codes.append(r.status)
+        except urllib.error.HTTPError as e:
+            e.close()
+            codes.append(e.code)
+    threads = [threading.Thread(target=cap) for _ in range(2)]
+    threads[0].start()
+    deadline = time.monotonic() + 10.0
+    while not devstats.capture_in_progress():
+        assert time.monotonic() < deadline, "first capture never started"
+        time.sleep(0.01)
+    threads[1].start()
+    for t in threads:
+        t.join(30)
+    assert sorted(codes) == [200, 409], codes
+    print("profile single-flight OK: %s" % sorted(codes))
+
+devstats.stop()
+# detach-on-close: a stopped sampler must not export frozen bytes
+assert not series(telemetry.export_text(), "mxtpu_device_memory_bytes")
+print("devstats soak OK")
+EOF
+  # fresh process, artifact-only load: zero compiles, nonzero program flops
+  JAX_PLATFORMS=cpu MXTPU_AOT_CACHE_DIR="$DV_CACHE" python - <<'EOF'
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import aot, gluon, jit, nd, telemetry
+
+mx.random.seed(0)
+net = gluon.nn.Dense(8, in_units=16)
+net.initialize(mx.init.Xavier())
+out = jit.EvalStep(net)(nd.ones((4, 16)))
+hits = aot._ARTIFACT_HITS.value(kind="eval")
+compiles = jit._COMPILES.value(kind="eval")
+assert hits >= 1 and compiles == 0, (hits, compiles)
+flops = [float(l.rsplit(None, 1)[1]) for l in telemetry.export_text().splitlines()
+         if l.startswith("mxtpu_aot_program_flops{")]
+assert flops and max(flops) > 0, flops
+print("zero-compile survival OK: artifact_hits=%d compiles=%d "
+      "program_flops=%s" % (hits, compiles, max(flops)))
+EOF
+  dv_dt=$(( SECONDS - dv_t0 ))
+  echo "devstats stage wall time: ${dv_dt}s (budget 60s)"
+  [ "$dv_dt" -lt 60 ] || { echo "devstats stage took ${dv_dt}s (budget 60s)"; exit 1; }
 fi
 
 if has_stage loadgen; then

@@ -36,6 +36,18 @@ This module replaces them with ONE shared cache:
   ``mxtpu_aot_evictions_total`` so silent thrash is visible (dict-order
   eviction could silently drop the hottest bucket).
 
+- **Device truth** (telemetry/devstats.py): every executable entering the
+  cache — fresh build OR artifact load — has its XLA ``cost_analysis()``
+  + ``memory_analysis()`` harvested ONCE into ``entry.stats``
+  (``{flops, bytes_accessed, peak_bytes, output_bytes}``), persisted in
+  the artifact header (format v2) so a zero-compile load in a fresh
+  process still knows its program's FLOPs, and published on
+  ``mxtpu_aot_program_flops`` / ``mxtpu_aot_program_peak_bytes``
+  ``{model,kind,bucket}``. The hot paths divide these FLOPs by measured
+  dispatch spans for MFU attribution — analysis happens here, at
+  build/load time, never per dispatch (mxtpulint R001 models the
+  per-dispatch form as a defect).
+
 Observability: ``mxtpu_aot_{hits,misses,evictions,artifact_hits,
 artifact_writes}_total`` counters, the ``mxtpu_aot_entries`` gauge, and
 ``aot:load`` spans around artifact deserialization (prewarm emits
@@ -44,15 +56,17 @@ artifact_writes}_total`` counters, the ``mxtpu_aot_entries`` gauge, and
 from __future__ import annotations
 
 import hashlib
+import json as _json
 import logging
 import os
+import struct
 import threading
 import time as _time
 from collections import namedtuple
 
 from . import config
 from . import telemetry
-from .telemetry import spans
+from .telemetry import devstats, spans
 
 __all__ = ["CacheKey", "cache_key", "AOTCache", "CACHE", "compile_cached",
            "model_id_for", "input_signature", "mesh_sig", "artifact_path",
@@ -61,9 +75,13 @@ __all__ = ["CacheKey", "cache_key", "AOTCache", "CACHE", "compile_cached",
 _LOG = logging.getLogger(__name__)
 
 #: bump when the artifact payload layout changes — old files are ignored,
-#: never misparsed (the version participates in the file digest)
-FORMAT_VERSION = 1
-ARTIFACT_MAGIC = b"MXTPUAOT\x001"
+#: never misparsed (the version participates in the file digest AND the
+#: magic, so a stale same-named file is rejected at the magic check).
+#: v2: a length-prefixed JSON header (program stats from cost/memory
+#: analysis) sits between the magic and the jax.export payload, so a
+#: zero-compile artifact load still carries device truth.
+FORMAT_VERSION = 2
+ARTIFACT_MAGIC = b"MXTPUAOT\x002"
 
 _HITS = telemetry.counter(
     "mxtpu_aot_hits_total",
@@ -89,6 +107,20 @@ _ARTIFACT_WRITES = telemetry.counter(
 _ENTRIES = telemetry.gauge(
     "mxtpu_aot_entries",
     "Live entries in the process-wide AOT executable cache.")
+_PROG_FLOPS = telemetry.gauge(
+    "mxtpu_aot_program_flops",
+    "XLA cost_analysis FLOPs of one execution of a cached program, "
+    "harvested at build/load time (artifact loads carry it in the v2 "
+    "header). The numerator of every mxtpu_device_mfu observation — "
+    "nonzero after a zero-compile artifact-only load is the device-truth "
+    "survival contract (docs/AOT.md).", ("model", "kind", "bucket"))
+_PROG_PEAK_BYTES = telemetry.gauge(
+    "mxtpu_aot_program_peak_bytes",
+    "memory_analysis peak live bytes of one execution of a cached "
+    "program (arguments + outputs + XLA temp buffers, donated/aliased "
+    "bytes deducted) — compare against mxtpu_device_memory_bytes "
+    "bytes_limit before sizing batch buckets.", ("model", "kind",
+                                                 "bucket"))
 
 #: (model_id, kind, input_sig, mesh, extra) — the full identity of one
 #: compiled program. kind is 'train' | 'eval' | 'serve'; input_sig is a
@@ -230,15 +262,20 @@ def model_id_for(net, extra=()):
 
 
 class _Entry:
-    """One compiled program + its caller extras and LRU bookkeeping."""
+    """One compiled program + its caller extras and LRU bookkeeping.
+    ``stats`` is the program's device truth (devstats.program_stats dict:
+    flops / bytes_accessed / peak_bytes / output_bytes) or None when the
+    program is not analyzable (a lazily-jitted or wrapped callable)."""
 
-    __slots__ = ("key", "fn", "extras", "last_used", "source", "created")
+    __slots__ = ("key", "fn", "extras", "last_used", "source", "created",
+                 "stats")
 
-    def __init__(self, key, fn, extras, source):
+    def __init__(self, key, fn, extras, source, stats=None):
         self.key = key
         self.fn = fn
         self.extras = extras
         self.source = source            # 'build' | 'artifact'
+        self.stats = stats
         self.created = _time.monotonic()
         self.last_used = self.created
 
@@ -271,12 +308,23 @@ class AOTCache:
         with self._lock:
             return self._entries.get(key)
 
-    def insert(self, key, fn, extras=None, source="build"):
-        entry = _Entry(key, fn, extras, source)
+    def insert(self, key, fn, extras=None, source="build", stats=None):
+        if stats is None:
+            # device truth is harvested HERE, once per cache entry — the
+            # one place every executable (train/eval/serve, build or
+            # artifact) passes through on its way to a dispatch
+            stats = devstats.program_stats(fn)
+        entry = _Entry(key, fn, extras, source, stats)
         with self._lock:
             self._entries[key] = entry
             self._evict_locked()
             _ENTRIES.set(len(self._entries))
+            # publish INSIDE the lock: outside it, a concurrent
+            # clear()/discard() could unpublish first and this late
+            # publish would resurrect a series with no backing entry
+            # (lock order cache->gauge matches _unpublish_locked)
+            if stats:
+                _publish_program_stats(key, stats)
         return entry
 
     def _evict_locked(self):
@@ -286,16 +334,46 @@ class AOTCache:
                          key=lambda e: e.last_used)
             self._entries.pop(victim.key)
             _EVICTIONS.inc(kind=victim.key.kind)
+            self._unpublish_locked(victim.key)
+
+    def _unpublish_locked(self, key):
+        """Drop the departed entry's program-stats gauge series — a dead
+        program must not export frozen FLOPs forever (same discipline as
+        serving's detach_telemetry). Several entries can share one
+        (model, kind, bucket) label set (per-replica device pins, dtype
+        variants): when a live entry still maps onto it, the gauges are
+        RE-published from that survivor's stats (the departed entry may
+        have published last, and the label must describe a program that
+        is actually in the cache). Caller holds self._lock."""
+        label = (key.model_id, key.kind, _bucket_of(key))
+        for other_key, other in self._entries.items():
+            if (other_key.model_id, other_key.kind,
+                    _bucket_of(other_key)) == label and other.stats:
+                _publish_program_stats(other_key, other.stats)
+                return
+        try:
+            _PROG_FLOPS.remove(model=label[0], kind=label[1],
+                               bucket=label[2])
+            _PROG_PEAK_BYTES.remove(model=label[0], kind=label[1],
+                                    bucket=label[2])
+        except Exception:
+            _LOG.debug("program stats gauge removal dropped",
+                       exc_info=True)
 
     def discard(self, key):
         with self._lock:
             gone = self._entries.pop(key, None) is not None
+            if gone:
+                self._unpublish_locked(key)
             _ENTRIES.set(len(self._entries))
         return gone
 
     def clear(self):
         with self._lock:
+            keys = list(self._entries)
             self._entries.clear()
+            for key in keys:
+                self._unpublish_locked(key)
             _ENTRIES.set(0)
 
     def __len__(self):
@@ -318,6 +396,7 @@ class AOTCache:
                      "mesh": e.key.mesh if e.key.mesh is None
                      else list(e.key.mesh),
                      "source": e.source,
+                     "stats": dict(e.stats) if e.stats else None,
                      "age_s": round(now - e.created, 3),
                      "idle_s": round(now - e.last_used, 3)}
                     for e in entries]
@@ -354,14 +433,19 @@ class AOTCache:
             try:
                 _MISSES.inc(kind=key.kind)
                 if exportable:
-                    fn = _load_artifact(key, arg_specs)
-                    if fn is not None:
+                    loaded = _load_artifact(key, arg_specs)
+                    if loaded is not None:
+                        fn, stats = loaded
                         _ARTIFACT_HITS.inc(kind=key.kind)
-                        return self.insert(key, fn, source="artifact")
+                        # header stats win (they survive even when the
+                        # loaded module was not XLA-compiled yet); insert
+                        # re-analyzes only when the header carried none
+                        return self.insert(key, fn, source="artifact",
+                                           stats=stats)
                 fn, extras, exported = build()
                 entry = self.insert(key, fn, extras, source="build")
                 if exportable and exported is not None:
-                    _write_artifact(key, exported)
+                    _write_artifact(key, exported, stats=entry.stats)
                 return entry
             finally:
                 with self._lock:
@@ -382,6 +466,30 @@ def compile_cached(key, build, exportable=False, arg_specs=None):
     persisted artifact)."""
     return CACHE.get_or_build(key, build, exportable=exportable,
                               arg_specs=arg_specs)
+
+
+def _bucket_of(key):
+    """Batch-bucket label for the program gauges: dim 0 of the first
+    input (the batcher's bucket axis), '-' for rank-0/inputless keys."""
+    try:
+        return int(key.input_sig[0][0][0])
+    except Exception:
+        return "-"
+
+
+def _publish_program_stats(key, stats):
+    """Mirror one entry's device truth onto the program gauges. Guarded:
+    a telemetry failure must not fail the build/load that produced the
+    executable."""
+    try:
+        bucket = _bucket_of(key)
+        _PROG_FLOPS.set(stats.get("flops", 0.0), model=key.model_id,
+                        kind=key.kind, bucket=bucket)
+        _PROG_PEAK_BYTES.set(stats.get("peak_bytes", 0.0),
+                             model=key.model_id, kind=key.kind,
+                             bucket=bucket)
+    except Exception:
+        _LOG.debug("program stats gauge update dropped", exc_info=True)
 
 
 # --------------------------------------------------------------------------
@@ -415,11 +523,39 @@ def artifact_path(key, cache_dir=None):
                         "%s-%s.mxtpu-aot" % (key.kind, _key_digest(key)))
 
 
+def _pack_header(stats):
+    """v2 header: 4-byte big-endian length + JSON metadata. The metadata
+    carries the program's device truth so a fresh process's artifact load
+    never needs to re-run XLA analysis to know its FLOPs."""
+    meta = _json.dumps({"format": FORMAT_VERSION,
+                        "stats": stats if stats else None},
+                       sort_keys=True).encode("utf-8")
+    return struct.pack(">I", len(meta)) + meta
+
+
+def _unpack_header(buf):
+    """(stats_or_None, payload_offset) for a v2 body (magic stripped).
+    Raises on truncation/garbage — the caller treats that as a corrupt
+    artifact and rebuilds."""
+    if len(buf) < 4:
+        raise ValueError("truncated artifact header")
+    (n,) = struct.unpack(">I", buf[:4])
+    if n > len(buf) - 4:
+        raise ValueError("artifact header length %d overruns file" % n)
+    meta = _json.loads(buf[4:4 + n].decode("utf-8"))
+    stats = meta.get("stats") if isinstance(meta, dict) else None
+    if stats is not None and not isinstance(stats, dict):
+        stats = None
+    return stats, 4 + n
+
+
 def _load_artifact(key, arg_specs):
     """Deserialize the persisted StableHLO for ``key`` and AOT-compile it
-    (``aot:load`` span). Returns the compiled callable, or None (missing /
-    corrupt / unloadable — the caller falls back to a fresh build; the
-    drop is debug-logged, never raised into a hot path)."""
+    (``aot:load`` span). Returns ``(compiled, stats)`` — the header's
+    device truth rides along — or None (missing / corrupt / wrong-version
+    magic / unloadable: the caller falls back to a fresh build WITH
+    re-analysis; the drop is debug-logged, never raised into a hot
+    path)."""
     path = artifact_path(key)
     if path is None or not os.path.exists(path):
         return None
@@ -429,27 +565,32 @@ def _load_artifact(key, arg_specs):
         with open(path, "rb") as f:
             buf = f.read()
         if not buf.startswith(ARTIFACT_MAGIC):
-            raise ValueError("bad magic in %s" % path)
+            # wrong magic OR an old format version (the version byte is
+            # part of the magic): rebuild + re-analyze, never misparse
+            raise ValueError("bad magic/version in %s" % path)
+        stats, off = _unpack_header(buf[len(ARTIFACT_MAGIC):])
         with spans.span("aot:load", kind=key.kind,
                         model_id=key.model_id):
-            exported = jax.export.deserialize(buf[len(ARTIFACT_MAGIC):])
+            exported = jax.export.deserialize(
+                buf[len(ARTIFACT_MAGIC) + off:])
             fn = jax.jit(exported.call)
             if arg_specs is not None:
                 # explicit AOT: XLA-compile the loaded module NOW (inside
                 # the aot:load span / prewarm window) — never lazily
                 # inside a later dispatch
                 fn = fn.lower(*arg_specs).compile()
-        return fn
+        return fn, stats
     except Exception:
         _LOG.debug("aot artifact load failed for %s", path, exc_info=True)
         return None
 
 
-def _write_artifact(key, exported):
+def _write_artifact(key, exported, stats=None):
     """Persist a jax.export program atomically (tmp + rename; pid+tid in
-    the tmp name so concurrent writers never interleave). Failures are
-    debug-logged and swallowed — a full disk must not fail the dispatch
-    that just compiled successfully."""
+    the tmp name so concurrent writers never interleave), with the
+    program's device truth in the v2 header. Failures are debug-logged
+    and swallowed — a full disk must not fail the dispatch that just
+    compiled successfully."""
     path = artifact_path(key)
     if path is None:
         return None
@@ -457,7 +598,8 @@ def _write_artifact(key, exported):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = "%s.%d.%d.tmp" % (path, os.getpid(), threading.get_ident())
         with open(tmp, "wb") as f:
-            f.write(ARTIFACT_MAGIC + exported.serialize())
+            f.write(ARTIFACT_MAGIC + _pack_header(stats)
+                    + exported.serialize())
         os.replace(tmp, path)
         _ARTIFACT_WRITES.inc(kind=key.kind)
         return path

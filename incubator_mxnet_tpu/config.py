@@ -266,6 +266,62 @@ ENV_VARS = {
         "File the watchdog APPENDS stall reports to (all-thread stacks + "
         "flight-recorder tail). None: reports go to logging.error and "
         "stay readable at watchdog.last_report() / GET /debug/stacks."),
+    "MXTPU_DEVICE_PEAK_FLOPS": (
+        float, None,
+        "Override the per-chip peak FLOP/s the device-truth MFU gauges "
+        "(mxtpu_device_mfu, telemetry/devstats.py) divide by. Unset: "
+        "resolved from jax.devices()[0].device_kind via the built-in "
+        "peak table; unknown kinds (CPU) fall back to a report-only "
+        "nominal peak (docs/OBSERVABILITY.md 'Device truth')."),
+    "MXTPU_DEVICE_PEAK_HBM_BPS": (
+        float, None,
+        "Override the per-chip peak HBM bytes/s the "
+        "mxtpu_device_hbm_bw_util gauge divides by. Unset: device_kind "
+        "table, else report-only fallback (telemetry/devstats.py)."),
+    "MXTPU_DEVSTATS": (
+        bool, False,
+        "Autostart the device-memory sampler daemon at package import "
+        "(telemetry/devstats.py; devstats.start()/stop() at runtime): "
+        "polls device.memory_stats() into "
+        "mxtpu_device_memory_bytes{device,stat} and files a flightrec "
+        "hbm_pressure event at >90% of bytes_limit. Per-dispatch MFU "
+        "gauges are driven by the hot paths regardless — the knob only "
+        "controls the sampler."),
+    "MXTPU_DEVSTATS_POLL_S": (
+        float, 1.0,
+        "Device-memory sampler poll interval in seconds "
+        "(telemetry/devstats.py)."),
+    "MXTPU_DEVSTATS_EVAL_SYNC": (
+        bool, False,
+        "Block-until-ready inside STANDALONE EvalStep dispatches so the "
+        "eval mxtpu_device_mfu observation measures exact device time. "
+        "Off by default: a direct eval loop overlaps host prep with "
+        "device execution and the sync would serialize it. Serving "
+        "dispatches (under the batcher's devstats dispatch context) "
+        "always observe — there the next step is a host materialization "
+        "anyway (docs/OBSERVABILITY.md 'Device truth')."),
+    "MXTPU_DEVSTATS_TRAIN_SYNC": (
+        bool, False,
+        "Block-until-ready inside the TrainStep dispatch window so the "
+        "train mxtpu_device_mfu observation measures exact device time. "
+        "Off by default: the sync defeats donated-buffer step chaining "
+        "(steps serialize on the host), so unsynced train MFU can read "
+        "HIGH when steps pipeline — turn on when attributing a training "
+        "regression, off for peak throughput (docs/OBSERVABILITY.md)."),
+    "MXTPU_PROFILE_DIR": (
+        str, None,
+        "Directory for on-demand jax.profiler captures "
+        "(GET /debug/profile?seconds=N, devstats.capture_profile). "
+        "Unset: <tmpdir>/mxtpu_profile. Bounded: only the newest "
+        "MXTPU_PROFILE_KEEP captures are kept."),
+    "MXTPU_PROFILE_KEEP": (
+        int, 4,
+        "How many on-demand profiler captures survive in "
+        "MXTPU_PROFILE_DIR (oldest pruned after each capture)."),
+    "MXTPU_PROFILE_MAX_S": (
+        float, 60.0,
+        "Upper clamp on GET /debug/profile?seconds=N capture length — an "
+        "operator typo must not leave the profiler tracing for an hour."),
     "MXTPU_LOADGEN_SEED": (
         int, 0,
         "Arrival-process RNG seed for the open-loop load generator "

@@ -26,13 +26,16 @@ Format: 8-byte magic "MXTPU\\x00v1" + jax.export bytes.
 from __future__ import annotations
 
 import hashlib
+import time as _time
 
 import jax
 import jax.export  # jax>=0.4.30 does not re-export the submodule lazily
 
 from .. import aot
+from .. import config
 from ..gluon import _functional
 from ..ndarray import NDArray
+from ..telemetry import devstats
 
 __all__ = ["export_model", "load", "export_mlir", "export_pjrt_bundle",
            "ServedModel"]
@@ -175,7 +178,28 @@ class ServedModel:
             # inputs must arrive on it (host numpy from the batcher pays
             # the same one copy it paid to device 0 before)
             datas = [jax.device_put(d, dev) for d in datas]
-        return aot.compile_cached(key, build).fn(*datas)
+        entry = aot.compile_cached(key, build)
+        t0 = _time.perf_counter()
+        out = entry.fn(*datas)
+        # device-truth MFU needs a block-until-ready span. Under the
+        # batcher (an ambient dispatch context, which also provides the
+        # serving labels) the outputs are materialized host-side
+        # immediately after, so the sync moves cost rather than adding
+        # any — always observe there. A DIRECT predict() caller keeps
+        # async dispatch unless MXTPU_DEVSTATS_EVAL_SYNC opts in (the
+        # same overlap contract as jit.EvalStep).
+        if entry.stats is not None and (
+                devstats.in_dispatch_context()
+                or config.get_env("MXTPU_DEVSTATS_EVAL_SYNC")):
+            try:
+                jax.block_until_ready(out)
+            except Exception:
+                pass
+            devstats.observe_dispatch("serve", entry.stats,
+                                      _time.perf_counter() - t0,
+                                      model=self._model_id,
+                                      replica=int(replica))
+        return out
 
     @property
     def input_shapes(self):

@@ -27,7 +27,7 @@ from . import aot
 from . import autograd
 from . import config
 from . import telemetry
-from .telemetry import flightrec, spans, watchdog
+from .telemetry import devstats, flightrec, spans, watchdog
 from .gluon import _functional
 from .ndarray import NDArray
 from .ndarray import random as _rnd
@@ -72,12 +72,13 @@ __all__ = ["TrainStep", "EvalStep"]
 
 # Compile observability: each shared-cache (aot.CACHE) miss that cannot be
 # satisfied by a persisted artifact is one model trace + XLA compile.
-# Train programs still compile lazily on the first dispatch (donated
-# buffers are not AOT-exported), so a train miss's FIRST step — trace +
-# compile + run — is what gets attributed to compile time; eval programs
-# compile eagerly inside the build via jit().lower().compile(). Watching
-# compiles_total climb under bucketed variable-shape traffic is how an
-# undersized MXTPU_AOT_CACHE_SIZE shows itself (so is
+# Single-device train programs AOT-compile inside the build (jit().lower()
+# .compile() with the step's arg specs — which also hands devstats the
+# compiled program's cost/memory analysis); mesh-train wrappers still
+# compile lazily on the first dispatch. Either way the miss's whole
+# first step — trace + compile + run — is what gets attributed to compile
+# time. Watching compiles_total climb under bucketed variable-shape
+# traffic is how an undersized MXTPU_AOT_CACHE_SIZE shows itself (so is
 # mxtpu_aot_evictions_total, its direct cause).
 _COMPILES = telemetry.counter(
     "mxtpu_jit_compiles_total",
@@ -165,6 +166,11 @@ class TrainStep:
         # all-gather the updated weights — no hand-written collectives.
         # Params themselves stay replicated (ZeRO-1, not 2/3).
         self.zero = zero
+        # device truth of the most recently dispatched program (aot entry
+        # stats: flops / bytes_accessed / peak_bytes / output_bytes), or
+        # None pre-dispatch / on the lazy mesh path — what bench.py's
+        # cost-analysis-derived MFU reads
+        self._last_stats = None
         # watchdog bookkeeping: counts once this instance starts stepping
         self._hb_registered = False
 
@@ -259,12 +265,62 @@ class TrainStep:
             jitted = jax.jit(step_fn, donate_argnums=_donate((0, 2)))
         return jitted, trainable, frozen, t_arrs, f_arrs, aux_box
 
-    def _build_entry(self, n_inputs):
+    def _build_entry(self, n_inputs, arg_specs=None):
         """aot.compile_cached build hook: (compiled callable, instance
-        extras, no exported artifact — train programs stay in-memory)."""
+        extras, no exported artifact — train programs stay in-memory).
+
+        With ``arg_specs`` (the single-device path), the program is
+        AOT-compiled HERE — ``jit().lower(specs).compile()`` under the
+        net's trace lock, the same explicit pipeline EvalStep uses — so
+        the XLA compile lands inside the train:build span instead of
+        lazily inside the first dispatch, and the cache entry is an
+        analyzable compiled program (devstats harvests its cost/memory
+        analysis at insert). A failed lower/compile degrades to the
+        classic lazy-jit behavior (debug-logged), never to a broken
+        step."""
         jitted, trainable, frozen, t_arrs, f_arrs, aux_box = \
             self._build(None, n_inputs)
+        if arg_specs is not None and self.mesh is None:
+            try:
+                # the trace swaps tracers into the live param NDArrays
+                # (inner's _data swap) — hold the net's trace lock for
+                # the whole window, exactly like the eval build
+                with self._trace_lock:
+                    jitted = jitted.lower(*arg_specs).compile()
+            except Exception:
+                _LOG.debug("train AOT lower/compile failed; program "
+                           "compiles lazily on first dispatch",
+                           exc_info=True)
         return jitted, (trainable, frozen, t_arrs, f_arrs, aux_box), None
+
+    def _arg_specs(self, arrs, key):
+        """jax.ShapeDtypeStruct tree matching one step_fn call — what
+        _build_entry AOT-lowers with. None (→ lazy compile, no program
+        stats) on the mesh path or when any piece is unavailable."""
+        if self.mesh is not None:
+            return None
+        try:
+            def sds(x):
+                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+            trainer = self.trainer
+            trainable, frozen = self._split_params()
+            t_specs = [sds(p.data()._data) for p in trainable]
+            f_specs = [sds(p.data()._data) for p in frozen]
+            opt_specs = []
+            for i, p in enumerate(trainable):
+                idx = trainer._param2idx.get(p.name, i)
+                opt_specs.append(jax.tree_util.tree_map(
+                    sds, _tree_to_data(trainer._states[idx])))
+            in_specs = [sds(a._data) for a in arrs]
+            vec = jax.ShapeDtypeStruct((len(trainable),), jnp.float32)
+            return (t_specs, f_specs, opt_specs, in_specs, sds(key),
+                    vec, vec, jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.float32))
+        except Exception:
+            _LOG.debug("train arg-spec construction failed; program "
+                       "compiles lazily on first dispatch", exc_info=True)
+            return None
 
     def _zero_leaf_sharding(self, p):
         """Per-leaf optimizer-state sharding rule under zero=True: shard
@@ -420,25 +476,35 @@ class TrainStep:
             kind="train", mesh=aot.mesh_sig(self.mesh),
             extra=(n_net_inputs, "i%x" % id(self)))
         step_t0 = _time.perf_counter()
+        # the per-step RNG key is drawn BEFORE the build so a compile
+        # miss can shape its arg specs from it (one draw per step either
+        # way — only the draw's position moved)
+        key = _rnd._next_key()
         entry = aot.CACHE.lookup(cache_key)
         compile_miss = entry is None
         flightrec.record("step_begin", step=self._step_count + 1,
                          compile=compile_miss)
         if compile_miss:
             flightrec.record("compile_begin", kind="train")
-            # NB train programs still jax.jit-compile LAZILY on the first
-            # dispatch (donated-buffer programs are not AOT-exported):
-            # this build span covers only tracing-graph construction; the
-            # XLA compile itself lands inside the first train:dispatch.
-            # The retroactive train:compile span below covers the whole
-            # trace+compile+first-run window (same definition as the
-            # mxtpu_jit_compile_seconds_total counter), which is what
-            # separates "slow step" from "recompiling every step".
+            # Single-device train programs AOT-compile inside this build
+            # span (jit().lower(arg_specs).compile() in _build_entry) so
+            # the entry is an analyzable compiled program; the mesh-train
+            # wrapper (and any spec-construction failure) still
+            # jax.jit-compiles LAZILY inside the first train:dispatch
+            # (donated-buffer programs are never jax.export-persisted
+            # either way). The retroactive train:compile span below
+            # covers the whole trace+compile+first-run window (same
+            # definition as the mxtpu_jit_compile_seconds_total counter),
+            # which is what separates "slow step" from "recompiling
+            # every step".
+            arg_specs = self._arg_specs(arrs, key)
             with spans.span("train:build"):
                 entry = aot.compile_cached(
-                    cache_key, lambda: self._build_entry(n_net_inputs))
+                    cache_key,
+                    lambda: self._build_entry(n_net_inputs, arg_specs))
                 self._cache_keys.add(cache_key)
         jitted = entry.fn
+        self._last_stats = entry.stats
         trainable, frozen, t_arrs, f_arrs, aux_box = entry.extras
 
         optimizer = trainer._optimizer
@@ -458,22 +524,37 @@ class TrainStep:
             idx = trainer._param2idx.get(p.name, i)
             opt_states.append(_tree_to_data(trainer._states[idx]))
 
-        key = _rnd._next_key()
         # the whole dispatch + write-back holds the net's trace lock: a
-        # MISS dispatch IS the lazy train trace (inner swaps tracers into
-        # the live param NDArrays), a HIT dispatch reads and then writes
-        # those same ``_data`` slots — either interleaved with a
-        # concurrent eval/warm trace of this net would capture tracers or
-        # lose the step's update to the trace's finally-restore.
-        # Uncontended (the common case: nothing else traces this net) the
-        # RLock costs sub-µs per step.
+        # mesh-path MISS dispatch IS the lazy train trace (inner swaps
+        # tracers into the live param NDArrays), a HIT dispatch reads and
+        # then writes those same ``_data`` slots — either interleaved
+        # with a concurrent eval/warm trace of this net would capture
+        # tracers or lose the step's update to the trace's
+        # finally-restore. Uncontended (the common case: nothing else
+        # traces this net) the RLock costs sub-µs per step.
         with spans.span("train:dispatch", compile=compile_miss), \
                 self._trace_lock:
+            dispatch_t0 = _time.perf_counter()
             loss_full, new_t, new_opt, aux_vals = jitted(
                 [a._data for a in t_arrs], [a._data for a in f_arrs],
                 opt_states, [a._data for a in arrs], key,
                 jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
                 jnp.asarray(t, jnp.int32), jnp.asarray(rescale, jnp.float32))
+            if entry.stats is not None:
+                # device-truth MFU: opt-in sync (the block defeats
+                # donated-buffer step chaining — docs/OBSERVABILITY.md);
+                # unsynced, the observed span is the host dispatch window
+                # and the rolling train MFU can read high while steps
+                # pipeline
+                if config.get_env("MXTPU_DEVSTATS_TRAIN_SYNC"):
+                    try:
+                        jax.block_until_ready(loss_full)
+                    except Exception:
+                        pass
+                devstats.observe_dispatch(
+                    "train", entry.stats,
+                    _time.perf_counter() - dispatch_t0,
+                    model=self._model_id)
 
             for a, d in zip(t_arrs, new_t):
                 a._data = d
@@ -535,6 +616,9 @@ class EvalStep:
         self._model_id = model_id
         self._trace_lock = _net_trace_lock(net)
         self._pure = None       # (param_arrs, pure_fn): built once, no trace
+        # device truth of the most recently dispatched program (aot entry
+        # stats), None pre-dispatch — bench.py's cost-analysis MFU source
+        self._last_stats = None
 
     def _ensure_pure(self):
         if self._pure is None:
@@ -624,10 +708,31 @@ class EvalStep:
         # corrupted by a trace that starts later
         with self._trace_lock:
             param_datas = [a._data for a in param_arrs]
+        self._last_stats = entry.stats
         # the device leg of the serving span chain: under the batcher this
         # nests inside the worker's serve:batch span (same thread)
         with spans.span("eval:step", compile=compile_miss):
+            dispatch_t0 = _time.perf_counter()
             out_datas, _aux = entry.fn(param_datas,
                                        [a._data for a in arrs], key)
+            # MFU observation needs a block-until-ready span (device
+            # time, not enqueue time). Under the batcher (an ambient
+            # dispatch context) the very next step is a host
+            # materialization anyway, so the sync moves cost rather than
+            # adding any — always observe there. STANDALONE eval loops
+            # overlap host prep with device execution, and an
+            # unconditional block would serialize them: opt in via
+            # MXTPU_DEVSTATS_EVAL_SYNC (mirror of the train knob).
+            if entry.stats is not None and (
+                    devstats.in_dispatch_context()
+                    or config.get_env("MXTPU_DEVSTATS_EVAL_SYNC")):
+                try:
+                    jax.block_until_ready(out_datas)
+                except Exception:
+                    pass
+                devstats.observe_dispatch(
+                    "eval", entry.stats,
+                    _time.perf_counter() - dispatch_t0,
+                    model=self._model_id)
         outs = [NDArray(o) for o in out_datas]
         return outs[0] if len(outs) == 1 else tuple(outs)

@@ -225,36 +225,39 @@ def _sample_memory(op_name):
 
 
 def device_memory():
-    """Per-device memory stats (bytes_in_use/peak) via PJRT
-    (≙ the reference's storage profiler, src/profiler/storage_profiler.h).
-    Degrades to {} when jax is unavailable (a host-only tool dumping a
-    trace must not die on the memory appendix)."""
+    """Per-device memory stats (≙ the reference's storage profiler,
+    src/profiler/storage_profiler.h), delegated to the devstats sampler
+    snapshot (telemetry/devstats.py). Stable keys: ``bytes_in_use`` /
+    ``peak_bytes_in_use`` / ``bytes_limit`` per device; backends whose
+    PJRT client reports no memory stats (CPU) degrade to host-RSS
+    report-only samples under ``'host'`` (``rss_bytes`` /
+    ``peak_rss_bytes``) instead of empty dicts. When the sampler daemon
+    runs, this returns its last snapshot without touching the device —
+    so a host-only tool dumping a trace gets the newest known numbers
+    even without a live jax sample path ({} only if devstats itself is
+    unimportable)."""
     try:
-        import jax
+        from .telemetry import devstats
+        return devstats.device_memory()
     except Exception:
         return {}
-    out = {}
-    for d in jax.local_devices():
-        try:
-            s = d.memory_stats() or {}
-        except Exception:
-            s = {}
-        out[str(d)] = {k: s[k] for k in
-                       ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
-                       if k in s}
-    return out
 
 
 def memory_summary():
     """Formatted per-device memory table + the profiled-run peak (the
-    reference's storage-profiler dump)."""
+    reference's storage-profiler dump). Renders the devstats host-RSS
+    report-only fallback row (rss_bytes/peak_rss_bytes under 'host')
+    when the backend exposes no PJRT memory stats — zeros there would
+    defeat the fallback's whole point."""
     lines = ["%-24s %14s %14s %14s"
              % ("Device", "Live(MB)", "Peak(MB)", "Limit(MB)")]
     mb = 1.0 / (1024 * 1024)
     for dev, s in device_memory().items():
         lines.append("%-24s %14.1f %14.1f %14.1f"
-                     % (dev, s.get("bytes_in_use", 0) * mb,
-                        s.get("peak_bytes_in_use", 0) * mb,
+                     % (dev,
+                        s.get("bytes_in_use", s.get("rss_bytes", 0)) * mb,
+                        s.get("peak_bytes_in_use",
+                              s.get("peak_rss_bytes", 0)) * mb,
                         s.get("bytes_limit", 0) * mb))
     lines.append("profiled-run peak: %.1f MB"
                  % (_STATE["peak_bytes"] * mb))

@@ -52,7 +52,7 @@ import queue as _queue
 import numpy as onp
 
 from .. import config
-from ..telemetry import flightrec, spans, watchdog
+from ..telemetry import devstats, flightrec, spans, watchdog
 from .metrics import ServingMetrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
@@ -431,6 +431,12 @@ class DynamicBatcher:
             self.metrics.detach_telemetry()
         except Exception:
             pass
+        # same discipline for the device-truth gauges this model's
+        # dispatches drove: a dead model must not export its last MFU
+        try:
+            devstats.detach_model(self.name)
+        except Exception:
+            pass
 
     def _fail_queued(self, err):
         for q in self._queues:
@@ -650,10 +656,14 @@ class DynamicBatcher:
         """The one servable call site: per-replica ``serve:dispatch`` span
         (the loadgen span-join attributes device time per replica off its
         ``replica`` arg; ``request_ids`` make it joinable per request),
-        replica kwarg forwarded when the servable declares it."""
+        replica kwarg forwarded when the servable declares it. The
+        devstats dispatch context labels the MFU observation — which
+        fires levels deeper, where the compiled entry's FLOPs are known —
+        with THIS model name and replica index."""
         with spans.span("serve:dispatch", model=self.name, replica=replica,
                         batch=int(stacked[0].shape[0]) if stacked else 0,
-                        request_ids=request_ids):
+                        request_ids=request_ids), \
+                devstats.dispatch_context(self.name, replica):
             if self._replica_aware:
                 return self._dispatch_fn(*stacked, replica=replica)
             return self._dispatch_fn(*stacked)

@@ -34,9 +34,14 @@ Routes (TF-Serving REST-shaped):
 - ``GET /debug/spans``          — the finished-span ring as JSONL.
 - ``GET /debug/aot``            — the process-wide AOT executable cache:
   one JSON record per compiled entry (model id, kind, input signature,
-  build vs artifact provenance, idle time) — the live "what is compiled
-  right now" view behind the zero-recompile serving contract
-  (docs/AOT.md).
+  build vs artifact provenance, program cost/memory stats, idle time) —
+  the live "what is compiled right now" view behind the zero-recompile
+  serving contract (docs/AOT.md).
+- ``GET /debug/profile?seconds=N`` — on-demand ``jax.profiler`` capture
+  into a bounded directory (telemetry/devstats.py): blocks for N
+  seconds (clamped to MXTPU_PROFILE_MAX_S) and returns the capture dir;
+  single-flight — a concurrent capture gets 409 instead of corrupting
+  the in-flight trace (docs/OBSERVABILITY.md "Device truth").
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
@@ -141,6 +146,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/debug/aot":
             from .. import aot
             self._send(200, {"entries": aot.CACHE.snapshot()})
+        elif self.path.split("?", 1)[0] == "/debug/profile":
+            self._do_profile()
         elif self.path.rstrip("/") == _MODELS_PREFIX:
             self._send(200, {"models": self.registry.models()})
         elif self.path.startswith(_MODELS_PREFIX + "/"):
@@ -155,6 +162,28 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, desc)
         else:
             self._send(404, {"error": "no route %r" % self.path})
+
+    def _do_profile(self):
+        """GET /debug/profile?seconds=N — the on-demand device-profiler
+        capture (single-flight; 409 while one is in flight). The handler
+        thread blocks for the capture window; the ThreadingHTTPServer
+        keeps answering /metrics and predicts meanwhile."""
+        from urllib.parse import parse_qs, urlparse
+        from ..telemetry import devstats
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            seconds = float(q.get("seconds", ["2"])[0])
+        except ValueError:
+            self._send(400, {"error": "seconds must be a number"})
+            return
+        try:
+            out = devstats.capture_profile(seconds)
+        except devstats.ProfileCaptureBusy as e:
+            self._send(409, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — capture failure, not crash
+            self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
+        else:
+            self._send(200, out)
 
     def do_POST(self):
         if not (self.path.startswith(_MODELS_PREFIX + "/")

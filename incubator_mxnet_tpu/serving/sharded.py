@@ -33,10 +33,11 @@ materializes them host-side.
 from __future__ import annotations
 
 import logging
+import time as _time
 
 from .. import aot
 from .. import config
-from ..telemetry import spans
+from ..telemetry import devstats, spans
 
 __all__ = ["MeshServable", "serving_mesh"]
 
@@ -155,18 +156,21 @@ class MeshServable:
                                  _jax.random.PRNGKey(0))
             return tuple(outs)
 
+        # ONE spec construction closed over by build() AND handed to the
+        # artifact loader: the fresh-build and artifact-load compile
+        # signatures can never diverge
+        param_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
+                                            sharding=d.sharding)
+                       for d in gparams]
+        in_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=repl)
+                    for d in datas]
+
         def build():
             param_shardings = [d.sharding for d in gparams]
             jitted = jax.jit(fwd,
                              in_shardings=(param_shardings,)
                              + (repl,) * len(datas),
                              out_shardings=repl)
-            param_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
-                                                sharding=d.sharding)
-                           for d in gparams]
-            in_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
-                                             sharding=repl)
-                        for d in datas]
             exported = None
             with spans.span("eval:build", model_id=self._model_id,
                             mesh=str(aot.mesh_sig(mesh)), replica=group), \
@@ -186,11 +190,6 @@ class MeshServable:
                     fn = jitted.lower(param_specs, *in_specs).compile()
             return fn, None, exported
 
-        param_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype,
-                                            sharding=d.sharding)
-                       for d in gparams]
-        in_specs = [jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=repl)
-                    for d in datas]
         return aot.compile_cached(key, build, exportable=True,
                                   arg_specs=(param_specs,) + tuple(in_specs))
 
@@ -214,7 +213,26 @@ class MeshServable:
                      else onp.asarray(x), repl)  # mxtpulint: disable=R001
                  for x in stacked_inputs]
         entry = self._compiled(datas, group)
+        t0 = _time.perf_counter()
         out = entry.fn(self._group_params[group], *datas)
+        # device-truth MFU for the tp group: under the batcher (ambient
+        # dispatch context) its reviewed sync point would pay this wait
+        # on the same thread moments later anyway, so always observe
+        # there; a direct caller keeps async dispatch unless
+        # MXTPU_DEVSTATS_EVAL_SYNC opts in (same contract as EvalStep).
+        # The program's FLOPs spread over the whole tp group, so the
+        # observation divides by the group's chip count.
+        if entry.stats is not None and (
+                devstats.in_dispatch_context()
+                or config.get_env("MXTPU_DEVSTATS_EVAL_SYNC")):
+            try:
+                jax.block_until_ready(out)
+            except Exception:
+                pass
+            devstats.observe_dispatch("serve", entry.stats,
+                                      _time.perf_counter() - t0,
+                                      model=self._model_id, replica=group,
+                                      devices=len(mesh.devices.flat))
         if isinstance(out, (list, tuple)) and len(out) == 1:
             return (out[0],)
         return tuple(out) if isinstance(out, (list, tuple)) else (out,)

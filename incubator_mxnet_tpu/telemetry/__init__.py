@@ -34,6 +34,7 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
 from .trace import (new_request_id, current_request_id,
                     set_current_request_id, request_scope,
                     REQUEST_ID_HEADER)
+from . import devstats
 from . import flightrec
 from . import spans
 from . import watchdog
@@ -47,7 +48,7 @@ __all__ = [
     "new_request_id", "current_request_id", "set_current_request_id",
     "request_scope", "REQUEST_ID_HEADER",
     "start_periodic_flush", "stop_periodic_flush", "flush_to_file",
-    "flightrec", "spans", "watchdog",
+    "devstats", "flightrec", "spans", "watchdog",
     "Span", "SpanContext", "span", "record_span", "current_span",
     "current_context",
 ]
@@ -155,5 +156,10 @@ def _maybe_autostart():
     try:
         if config.get_env("MXTPU_WATCHDOG"):
             watchdog.start()
+    except Exception:
+        pass
+    try:
+        if config.get_env("MXTPU_DEVSTATS"):
+            devstats.start()
     except Exception:
         pass

@@ -208,7 +208,7 @@ import json
 import jax
 jax.config.update("jax_platforms", "cpu")
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import aot, gluon, jit, nd
+from incubator_mxnet_tpu import aot, gluon, jit, nd, telemetry
 from incubator_mxnet_tpu.telemetry import spans
 
 net = gluon.nn.Dense(3, in_units=4)
@@ -216,11 +216,17 @@ net.initialize(mx.init.Xavier())
 step = jit.EvalStep(net)
 out = step(nd.ones((2, 4)))
 names = [s["name"] for s in spans.snapshot()]
+prog_flops = [float(l.rsplit(None, 1)[1])
+              for l in telemetry.export_text().splitlines()
+              if l.startswith("mxtpu_aot_program_flops{")]
+entry_stats = [e["stats"] for e in aot.CACHE.snapshot()]
 print(json.dumps({
     "artifact_hits": aot._ARTIFACT_HITS.value(kind="eval"),
     "compiles": jit._COMPILES.value(kind="eval"),
     "compile_spans": [n for n in names
                       if n in ("eval:compile", "train:compile")],
+    "program_flops": prog_flops,
+    "entry_stats": entry_stats,
     "shape": list(out.shape)}))
 """
 
@@ -228,7 +234,10 @@ print(json.dumps({
 def test_artifact_roundtrip_fresh_subprocess(tmp_path, monkeypatch):
     """A fresh process pointed at a populated MXTPU_AOT_CACHE_DIR serves
     its first request without tracing: artifact-hit counter > 0, compile
-    counter unchanged, ZERO train:/eval:compile spans recorded."""
+    counter unchanged, ZERO train:/eval:compile spans recorded — AND
+    device truth survives the zero-compile load: the entry carries the
+    v2 header's program stats and /metrics reports nonzero
+    mxtpu_aot_program_flops."""
     cache_dir = str(tmp_path / "aotcache")
     monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", cache_dir)
     # populate: same architecture as the child builds
@@ -249,6 +258,65 @@ def test_artifact_roundtrip_fresh_subprocess(tmp_path, monkeypatch):
     assert rec["compiles"] == 0, rec
     assert rec["compile_spans"] == [], rec
     assert rec["shape"] == [2, 3]
+    # zero-compile device truth: program FLOPs from the artifact header
+    assert rec["program_flops"] and max(rec["program_flops"]) > 0, rec
+    assert any(s and s.get("flops", 0) > 0 for s in rec["entry_stats"]), rec
+
+
+def test_old_version_artifact_header_rebuilds_with_reanalysis(
+        tmp_path, monkeypatch):
+    """An artifact written by an OLDER format version (v1 magic, no
+    stats header) must fall back to a fresh build WITH re-analysis: no
+    artifact hit, one compile, and the rebuilt entry carries program
+    stats — never a misparse, never an entry without device truth."""
+    cache_dir = str(tmp_path / "aotcache")
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", cache_dir)
+    net = _dense(3)
+    # (6, 4) is unique to this test: the in-memory entry cannot pre-exist
+    jit.EvalStep(net)(nd.ones((6, 4)))
+    files = [os.path.join(dp, f) for dp, _dn, fs in os.walk(cache_dir)
+             for f in fs if f.endswith(".mxtpu-aot")]
+    assert files
+    for path in files:
+        buf = open(path, "rb").read()
+        assert buf.startswith(aot.ARTIFACT_MAGIC)
+        # rewrite as a v1-era file: old magic, payload directly after it
+        with open(path, "wb") as f:
+            f.write(b"MXTPUAOT\x001" + buf[len(aot.ARTIFACT_MAGIC):])
+    for k in list(aot.CACHE.keys()):   # force re-resolution from disk
+        if k.input_sig and k.input_sig[0][0] == (6, 4):
+            aot.CACHE.discard(k)
+    hits0 = aot._ARTIFACT_HITS.value(kind="eval")
+    c0 = jit._COMPILES.value(kind="eval")
+    step = jit.EvalStep(_dense(3))
+    out = step(nd.ones((6, 4)))        # must not raise, must not misload
+    assert out.shape == (6, 3)
+    assert aot._ARTIFACT_HITS.value(kind="eval") == hits0
+    assert jit._COMPILES.value(kind="eval") == c0 + 1
+    assert step._last_stats and step._last_stats["flops"] > 0
+
+
+def test_truncated_v2_header_rebuilds(tmp_path, monkeypatch):
+    """A v2 file whose header length overruns the payload is corrupt:
+    rebuild, never misparse."""
+    cache_dir = str(tmp_path / "aotcache")
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", cache_dir)
+    net = _dense(3)
+    # (13, 4) is unique to this test: the in-memory entry cannot pre-exist
+    jit.EvalStep(net)(nd.ones((13, 4)))
+    files = [os.path.join(dp, f) for dp, _dn, fs in os.walk(cache_dir)
+             for f in fs if f.endswith(".mxtpu-aot")]
+    assert files
+    for path in files:
+        with open(path, "wb") as f:
+            f.write(aot.ARTIFACT_MAGIC + b"\xff\xff\xff\xff{}")
+    for k in list(aot.CACHE.keys()):
+        if k.input_sig and k.input_sig[0][0] == (13, 4):
+            aot.CACHE.discard(k)
+    c0 = jit._COMPILES.value(kind="eval")
+    out = jit.EvalStep(_dense(3))(nd.ones((13, 4)))
+    assert out.shape == (13, 3)
+    assert jit._COMPILES.value(kind="eval") == c0 + 1
 
 
 def test_corrupt_artifact_falls_back_to_build(tmp_path, monkeypatch):

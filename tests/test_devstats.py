@@ -1,0 +1,398 @@
+"""Device-truth observability layer (telemetry/devstats.py): program
+cost/memory analysis on AOT cache entries, per-dispatch MFU gauges, the
+HBM sampler (pressure events, detach-on-stop), the on-demand profiler
+capture, and the promcheck P002 metadata rule the new series must pass."""
+import os
+import threading
+import time
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import aot, gluon, jit, nd, telemetry
+from incubator_mxnet_tpu.telemetry import devstats, flightrec
+
+
+def _dense(units=3, in_units=4):
+    net = gluon.nn.Dense(units, in_units=in_units)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _series(name):
+    return [l for l in telemetry.export_text().splitlines()
+            if l.startswith(name) and not l.startswith("#")
+            and not l.startswith(name + "_")]
+
+
+# ------------------------------------------------------------ program stats
+def test_program_stats_of_compiled_program():
+    import jax
+    import jax.numpy as jnp
+    comp = jax.jit(lambda a, b: a @ b).lower(
+        jax.ShapeDtypeStruct((32, 32), jnp.float32),
+        jax.ShapeDtypeStruct((32, 32), jnp.float32)).compile()
+    stats = devstats.program_stats(comp)
+    assert stats is not None
+    # 2*N^3 FLOPs of a matmul, give or take XLA's accounting
+    assert stats["flops"] >= 32 * 32 * 32
+    assert stats["bytes_accessed"] > 0
+    assert stats["peak_bytes"] >= stats["output_bytes"] > 0
+
+
+def test_program_stats_none_for_unanalyzable():
+    assert devstats.program_stats(lambda x: x) is None
+    import jax
+    # a lazily-jitted wrapper is not a compiled program
+    assert devstats.program_stats(jax.jit(lambda x: x)) is None
+
+
+def test_aot_entry_carries_stats_and_gauges():
+    step = jit.EvalStep(_dense(5))
+    step(nd.ones((3, 4)))
+    assert step._last_stats and step._last_stats["flops"] > 0
+    # the entry in the shared cache carries the same dict
+    entries = [e for e in aot.CACHE.snapshot()
+               if e["stats"] and e["input_sig"][0][0] == [3, 4]]
+    assert entries
+    lines = _series("mxtpu_aot_program_flops")
+    assert any('bucket="3"' in l and float(l.rsplit(None, 1)[1]) > 0
+               for l in lines), lines
+
+
+def test_trainstep_entry_is_analyzable_and_observes_mfu():
+    net = _dense(3)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    step = jit.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    loss0 = float(step(nd.ones((4, 4)), nd.ones((4, 3))).mean().asscalar())
+    assert step._last_stats and step._last_stats["flops"] > 0
+    loss1 = float(step(nd.ones((4, 4)), nd.ones((4, 3))).mean().asscalar())
+    assert loss1 < loss0          # the AOT-compiled step still trains
+    lines = _series("mxtpu_device_mfu")
+    assert any('kind="train"' in l and float(l.rsplit(None, 1)[1]) > 0
+               for l in lines), lines
+
+
+# ------------------------------------------------------------------- peaks
+def test_peaks_env_override(monkeypatch):
+    monkeypatch.setenv("MXTPU_DEVICE_PEAK_FLOPS", "5e12")
+    monkeypatch.setenv("MXTPU_DEVICE_PEAK_HBM_BPS", "2e11")
+    devstats.reset_peaks()
+    try:
+        flops_p, bw_p, source = devstats.peaks()
+        assert flops_p == 5e12 and bw_p == 2e11 and source == "env"
+    finally:
+        monkeypatch.delenv("MXTPU_DEVICE_PEAK_FLOPS")
+        monkeypatch.delenv("MXTPU_DEVICE_PEAK_HBM_BPS")
+        devstats.reset_peaks()
+
+
+def test_peaks_partial_env_override_keeps_fallback_visible(monkeypatch):
+    """Overriding only ONE peak must not report source='env': the other
+    denominator is still the report-only fallback, and a consumer
+    checking for 'fallback' must keep seeing it."""
+    monkeypatch.setenv("MXTPU_DEVICE_PEAK_FLOPS", "5e12")
+    devstats.reset_peaks()
+    try:
+        flops_p, _bw_p, source = devstats.peaks()
+        assert flops_p == 5e12
+        assert source == "env+fallback", source
+    finally:
+        monkeypatch.delenv("MXTPU_DEVICE_PEAK_FLOPS")
+        devstats.reset_peaks()
+
+
+def test_standalone_eval_observation_is_opt_in(monkeypatch):
+    """Outside a serving dispatch context, EvalStep must NOT block (it
+    would serialize host/device overlap in direct eval loops) — the MFU
+    observation fires only under MXTPU_DEVSTATS_EVAL_SYNC."""
+    c = telemetry.REGISTRY.get("mxtpu_device_dispatch_seconds_total")
+    step = jit.EvalStep(_dense(2))
+    step(nd.ones((19, 4)))
+    mid = step._model_id
+    assert step._last_stats is not None          # stats exist...
+    assert c.value(model=mid, kind="eval") == 0  # ...but nothing observed
+    monkeypatch.setenv("MXTPU_DEVSTATS_EVAL_SYNC", "1")
+    step(nd.ones((19, 4)))
+    assert c.value(model=mid, kind="eval") > 0
+
+
+def test_peaks_cpu_fallback_is_report_only():
+    devstats.reset_peaks()
+    flops_p, bw_p, source = devstats.peaks()
+    # CPU is not in the table: the fallback keeps gauges live but marked
+    assert source == "fallback" and flops_p > 0 and bw_p > 0
+
+
+# -------------------------------------------------------- observe_dispatch
+def test_observe_dispatch_context_labels_win():
+    stats = {"flops": 1e9, "bytes_accessed": 1e6, "peak_bytes": 1,
+             "output_bytes": 1}
+    with devstats.dispatch_context("ctx-model", 3):
+        devstats.observe_dispatch("serve", stats, 0.01,
+                                  model="digest-fallback")
+    lines = _series("mxtpu_device_mfu")
+    assert any('model="ctx-model"' in l and 'replica="3"' in l
+               for l in lines), lines
+    assert not any('model="digest-fallback"' in l for l in lines)
+
+
+def test_observe_dispatch_counters_accumulate():
+    stats = {"flops": 100.0, "bytes_accessed": 50.0, "peak_bytes": 1,
+             "output_bytes": 1}
+    c = telemetry.REGISTRY.get("mxtpu_device_flops_total")
+    before = c.value(model="acc-model", kind="eval")
+    for _ in range(3):
+        devstats.observe_dispatch("eval", stats, 0.001, model="acc-model")
+    assert c.value(model="acc-model", kind="eval") == before + 300.0
+    s = telemetry.REGISTRY.get("mxtpu_device_dispatch_seconds_total")
+    assert s.value(model="acc-model", kind="eval") == pytest.approx(
+        0.003, abs=1e-9)
+
+
+def test_observe_dispatch_devices_divisor():
+    """A K-chip program's FLOPs spread over K chips: the MFU observation
+    divides by K×peak, and chip-seconds accrue K× the wall span."""
+    stats = {"flops": 4e8, "bytes_accessed": 1e6, "peak_bytes": 1,
+             "output_bytes": 1}
+    devstats.observe_dispatch("serve", stats, 0.01, model="tp-one")
+    devstats.observe_dispatch("serve", stats, 0.01, model="tp-four",
+                              devices=4)
+    g = telemetry.REGISTRY.get("mxtpu_device_mfu")
+    one = g.value(model="tp-one", kind="serve", replica=0)
+    four = g.value(model="tp-four", kind="serve", replica=0)
+    assert four == pytest.approx(one / 4)
+    chip = telemetry.REGISTRY.get("mxtpu_device_chip_seconds_total")
+    assert chip.value(model="tp-four", kind="serve") == pytest.approx(0.04)
+    assert chip.value(model="tp-one", kind="serve") == pytest.approx(0.01)
+
+
+def test_model_unload_detaches_mfu_gauges():
+    """An unloaded serving model must stop exporting its rolling MFU/bw
+    gauges (batcher.close → devstats.detach_model); the cumulative
+    counters stay, per Prometheus convention."""
+    import numpy as onp
+    from incubator_mxnet_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    reg.load("detach-me", _dense(4), max_batch_size=2, batch_timeout_ms=1.0)
+    reg.predict("detach-me", onp.ones((4,), "float32"))
+    assert any('model="detach-me"' in l
+               for l in _series("mxtpu_device_mfu"))
+    reg.unload("detach-me")
+    for name in ("mxtpu_device_mfu", "mxtpu_device_hbm_bw_util"):
+        assert not any('model="detach-me"' in l for l in _series(name))
+    assert any('model="detach-me"' in l
+               for l in _series("mxtpu_device_flops_total"))
+
+
+def test_program_gauges_unpublished_when_entry_leaves_cache():
+    """Evicted/discarded entries must not export frozen program FLOPs
+    forever (the detach-on-close discipline, cache-entry granularity)."""
+    step = jit.EvalStep(_dense(7))
+    step(nd.ones((17, 4)))         # unique shape: a fresh entry + series
+    mid = [k.model_id for k in aot.CACHE.keys()
+           if k.input_sig and k.input_sig[0][0] == (17, 4)][0]
+    assert any('model="%s"' % mid in l and 'bucket="17"' in l
+               for l in _series("mxtpu_aot_program_flops"))
+    for k in list(aot.CACHE.keys()):
+        if k.model_id == mid:
+            aot.CACHE.discard(k)
+    assert not any('model="%s"' % mid in l
+                   for l in _series("mxtpu_aot_program_flops"))
+    assert not any('model="%s"' % mid in l
+                   for l in _series("mxtpu_aot_program_peak_bytes"))
+
+
+def test_program_gauges_republish_surviving_label_sharer():
+    """Entries can share one (model,kind,bucket) label (dtype variants,
+    per-replica pins): when the last PUBLISHER leaves the cache, the
+    gauges must re-describe a surviving entry's program, not keep the
+    dead one's numbers — and only the last departure removes the series."""
+    def flops_line():
+        lines = [l for l in _series("mxtpu_aot_program_flops")
+                 if 'model="shared-m"' in l]
+        return lines[0] if lines else None
+
+    k1 = aot.cache_key("shared-m", [((4, 8), "float32")], kind="eval",
+                       extra=("a",))
+    k2 = aot.cache_key("shared-m", [((4, 8), "bfloat16")], kind="eval",
+                       extra=("b",))
+    aot.CACHE.insert(k1, lambda: None,
+                     stats={"flops": 111.0, "bytes_accessed": 1,
+                            "peak_bytes": 10, "output_bytes": 1})
+    aot.CACHE.insert(k2, lambda: None,
+                     stats={"flops": 222.0, "bytes_accessed": 1,
+                            "peak_bytes": 20, "output_bytes": 1})
+    assert flops_line().endswith(" 222")     # last insert published
+    aot.CACHE.discard(k2)                    # the publisher departs
+    assert flops_line().endswith(" 111")     # survivor re-published
+    aot.CACHE.discard(k1)
+    assert flops_line() is None
+
+
+def test_observe_dispatch_never_raises_on_garbage():
+    devstats.observe_dispatch("eval", None, 1.0)          # no stats
+    devstats.observe_dispatch("eval", {"flops": 1.0}, 0)  # no duration
+    devstats.observe_dispatch("eval", {"flops": 1.0}, -1)
+
+
+# ------------------------------------------------------------- HBM sampler
+def test_sampler_publishes_injected_source_and_detaches_on_stop():
+    devstats.set_memory_source(lambda: {
+        "tpu:0": {"bytes_in_use": 100, "peak_bytes_in_use": 120,
+                  "bytes_limit": 1000}})
+    try:
+        devstats.start(poll_s=0.01)
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            lines = _series("mxtpu_device_memory_bytes")
+            if any('device="tpu:0"' in l and 'stat="bytes_in_use"' in l
+                   for l in lines):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("sampler never published: %s" % lines)
+        assert devstats.running()
+        snap = devstats.device_memory()
+        assert snap["tpu:0"]["bytes_in_use"] == 100
+    finally:
+        devstats.stop()
+        devstats.set_memory_source(None)
+    # detach-on-stop: the stopped sampler exports nothing
+    assert not _series("mxtpu_device_memory_bytes")
+    assert not devstats.running()
+    # ...and a PASSIVE read after stop (profiler.device_memory, dump)
+    # must not resurrect frozen series nobody will refresh or detach
+    snap = devstats.device_memory()
+    assert snap                      # the read itself still works
+    assert not _series("mxtpu_device_memory_bytes")
+
+
+def test_pressure_event_once_per_episode_with_hysteresis():
+    mem = {"d0": {"bytes_in_use": 950, "peak_bytes_in_use": 950,
+                  "bytes_limit": 1000}}
+    devstats.set_memory_source(lambda: mem)
+    try:
+        def pressure_events():
+            return [e for e in flightrec.snapshot()
+                    if e["event"] == "hbm_pressure" and e.get("device") == "d0"]
+        n0 = len(pressure_events())
+        devstats.sample_now()          # > 90%: fires
+        devstats.sample_now()          # still high: same episode, no refire
+        assert len(pressure_events()) == n0 + 1
+        mem["d0"]["bytes_in_use"] = 880    # between low and high: armed? no
+        devstats.sample_now()
+        assert len(pressure_events()) == n0 + 1
+        mem["d0"]["bytes_in_use"] = 100    # below 85%: episode ends
+        devstats.sample_now()
+        mem["d0"]["bytes_in_use"] = 950    # new episode: fires again
+        devstats.sample_now()
+        assert len(pressure_events()) == n0 + 2
+    finally:
+        devstats.set_memory_source(None)
+
+
+def test_device_memory_stable_keys_on_cpu():
+    devstats.set_memory_source(None)
+    snap = devstats.device_memory()
+    # CPU's PJRT reports no memory stats: the host-RSS fallback keeps the
+    # surface alive with its own stable keys
+    assert snap and "host" in snap
+    assert snap["host"].get("rss_bytes", 0) > 0
+
+
+def test_profiler_device_memory_delegates():
+    from incubator_mxnet_tpu import profiler
+    devstats.set_memory_source(lambda: {
+        "fake:0": {"bytes_in_use": 7, "peak_bytes_in_use": 9,
+                   "bytes_limit": 10}})
+    try:
+        mem = profiler.device_memory()
+        assert mem["fake:0"]["bytes_in_use"] == 7
+    finally:
+        devstats.set_memory_source(None)
+    assert len(profiler.device_memory()) >= 1
+
+
+# --------------------------------------------------------- profile capture
+def test_capture_profile_single_flight_and_prune(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_PROFILE_KEEP", "2")
+    results, errors = [], []
+
+    def cap():
+        try:
+            results.append(devstats.capture_profile(0.4))
+        except devstats.ProfileCaptureBusy as e:
+            errors.append(e)
+
+    t1 = threading.Thread(target=cap)
+    t2 = threading.Thread(target=cap)
+    t1.start()
+    # deterministic overlap: only fire the second capture once the first
+    # holds the single-flight lock (a fixed sleep could let them
+    # serialize on a loaded box and both return 200)
+    deadline = time.monotonic() + 10.0
+    while not devstats.capture_in_progress():
+        assert time.monotonic() < deadline, "first capture never started"
+        time.sleep(0.005)
+    t2.start()
+    t1.join(30)
+    t2.join(30)
+    assert len(results) == 1 and len(errors) == 1
+    assert os.path.isdir(results[0]["dir"])
+    # captures beyond MXTPU_PROFILE_KEEP are pruned oldest-first
+    for _ in range(3):
+        devstats.capture_profile(0.05)
+    captures = [d for d in os.listdir(str(tmp_path))
+                if d.startswith("capture-")]
+    assert len(captures) <= 2, captures
+    assert not devstats.capture_in_progress()
+
+
+def test_capture_seconds_clamped(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_PROFILE_MAX_S", "0.2")
+    t0 = time.perf_counter()
+    out = devstats.capture_profile(30.0)
+    assert time.perf_counter() - t0 < 5.0
+    assert out["seconds"] == pytest.approx(0.2)
+
+
+# --------------------------------------------- exposition hygiene (P002)
+def test_new_series_pass_promcheck_p002():
+    from tools import promcheck
+    # make sure the devstats families are present in the exposition
+    devstats.peaks()
+    devstats.observe_dispatch(
+        "eval", {"flops": 1.0, "bytes_accessed": 1.0, "peak_bytes": 1,
+                 "output_bytes": 1}, 0.001, model="p002")
+    text = telemetry.export_text()
+    promcheck.validate(text)
+    assert promcheck.validate_metadata(text) == []
+
+
+def test_promcheck_p002_flags_metadata_defects():
+    from tools import promcheck
+    # TYPE without HELP
+    bad1 = "# TYPE a counter\na 1\n"
+    # HELP after TYPE
+    bad2 = "# TYPE b gauge\n# HELP b doc\nb 1\n"
+    # sample before its TYPE line
+    bad3 = "c 1\n# HELP c doc\n# TYPE c counter\n"
+    # counter family colliding with a histogram's generated _count name
+    bad4 = ("# HELP h doc\n# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\n'
+            "# HELP h_count doc\n# TYPE h_count counter\n")
+    for text, frag in ((bad1, "no # HELP"), (bad2, "after its # TYPE"),
+                       (bad3, "before its # TYPE"), (bad4, "collides")):
+        findings = promcheck.validate_metadata(text)
+        assert findings, text
+        assert any(frag in msg for _ln, msg in findings), (text, findings)
+        rep = promcheck.report(text)
+        assert not rep["ok"]
+        assert any(f["rule"] == "P002" for f in rep["findings"])
+    good = "# HELP a doc\n# TYPE a counter\na 1\n"
+    assert promcheck.validate_metadata(good) == []
+    assert promcheck.report(good)["ok"]

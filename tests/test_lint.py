@@ -503,7 +503,7 @@ def test_shared_json_shape_with_promcheck(tmp_path):
     findings = run_snippet(tmp_path, "feature.py",
                            "import os\nX = os.environ.get('MXTPU_FOO')\n")
     lint_rep = make_report("mxtpulint", findings)
-    ok_rep = promcheck.report("# TYPE a counter\na 1\n")
+    ok_rep = promcheck.report("# HELP a doc\n# TYPE a counter\na 1\n")
     bad_rep = promcheck.report("total{model= 1\n", path="m.prom")
 
     keys = {"tool", "ok", "findings", "counts", "baselined"}

@@ -1104,6 +1104,25 @@ def test_r001_interprocedural_helper_sync(tmp_path):
     assert findings[0].line == 3
 
 
+def test_r001_interprocedural_analysis_walk_message(tmp_path):
+    """A cost_analysis()/memory_analysis() call one level below a hot
+    path is the device-truth sub-rule — the finding must say 'analysis
+    walk' and point at the cached aot entry stats, not claim a device
+    transfer and recommend laziness."""
+    findings = run_project(tmp_path, {"jit.py": """
+        def read_flops(compiled):
+            return compiled.cost_analysis()[0]["flops"]
+
+        class TrainStep:
+            def __call__(self, x):
+                return read_flops(x)
+    """})
+    assert rule_ids(findings) == ["R001"]
+    msg = findings[0].message
+    assert "analysis walk" in msg and "program_stats" in msg
+    assert "device transfer" not in msg
+
+
 def test_r001_interprocedural_depth_is_one(tmp_path):
     # two levels down is out of contract (documented precision bound)
     findings = run_project(tmp_path, {"jit.py": """
@@ -1121,27 +1140,31 @@ def test_r001_interprocedural_depth_is_one(tmp_path):
 
 
 # --------------------------------------------------------- seeded defects
-def test_seeded_defects_exactly_five():
+def test_seeded_defects_exactly_six():
     """The regression canary: the fixtures contain one deadlock cycle,
     one unlocked cross-thread write, one jax.jit retrace hazard, one
-    AOT-boundary (aot.compile_cached) retrace hazard, and one host-device
-    sync in the replica dispatch hot path (seeded_batcher.py anchors the
-    ``*batcher:DynamicBatcher._dispatch_replica`` pattern) — the analyzer
-    must report exactly those five (ci/run.sh asserts the same thing in
-    the lint stage)."""
+    AOT-boundary (aot.compile_cached) retrace hazard, one host-device
+    sync in the replica dispatch hot path, and one per-dispatch XLA
+    cost_analysis walk in the servable-call hot path (seeded_batcher.py
+    anchors the ``*batcher:DynamicBatcher._dispatch_replica`` /
+    ``._call_servable`` patterns) — the analyzer must report exactly
+    those six (ci/run.sh asserts the same thing in the lint stage)."""
     findings = analyze([SEEDED], root=SEEDED)
     assert rule_ids(findings) == \
-        ["R001", "R009", "R010", "R011", "R011"], findings
+        ["R001", "R001", "R009", "R010", "R011", "R011"], findings
 
 
-def test_seeded_replica_defect_is_the_r001(tmp_path):
-    # the R001 comes from the replica-dispatch fixture specifically,
-    # anchored at the _dispatch_replica hot path
+def test_seeded_replica_defects_are_the_r001s(tmp_path):
+    # both R001s come from the batcher fixture: the host-device sync is
+    # anchored at the _dispatch_replica hot path, the device-truth
+    # analysis-walk defect at _call_servable
     findings = analyze([SEEDED], root=SEEDED)
     r001 = [f for f in findings if f.rule == "R001"]
-    assert len(r001) == 1
-    assert r001[0].path.endswith("seeded_batcher.py")
-    assert "_dispatch_replica" in r001[0].message
+    assert len(r001) == 2
+    assert all(f.path.endswith("seeded_batcher.py") for f in r001)
+    msgs = " | ".join(f.message for f in r001)
+    assert "_dispatch_replica" in msgs
+    assert "_call_servable" in msgs and "cost_analysis" in msgs
 
 
 def test_seeded_defects_clean_under_repo_gate_profile():
@@ -1251,7 +1274,7 @@ def test_new_rules_share_the_ci_json_shape(tmp_path):
     """})
     assert rule_ids(findings) == ["R009", "R010", "R011"]
     rep = make_report("mxtpulint", findings)
-    ok_rep = promcheck.report("# TYPE a counter\na 1\n")
+    ok_rep = promcheck.report("# HELP a doc\n# TYPE a counter\na 1\n")
     keys = {"tool", "ok", "findings", "counts", "baselined"}
     assert set(rep) == keys and set(ok_rep) == keys
     f_keys = {"path", "line", "rule", "message"}

@@ -306,7 +306,10 @@ def test_e2e_request_span_chain_in_one_chrome_dump(tmp_path):
 # ----------------------------------------------- profiler dump satellites
 def test_profiler_dump_degrades_without_jax(tmp_path, monkeypatch):
     """dump() must still write a trace when `import jax` fails (host-only
-    analysis box): deviceMemory degrades to {}."""
+    analysis box). device_memory() now delegates to the devstats sampler
+    snapshot (PR 10 satellite): instead of a bare {}, the memory appendix
+    degrades to the host-RSS report-only fallback (or the sampler's last
+    known device snapshot) — never a per-device sample, never a crash."""
     import sys
     out = tmp_path / "nojax.json"
     profiler.set_config(filename=str(out))
@@ -318,7 +321,9 @@ def test_profiler_dump_degrades_without_jax(tmp_path, monkeypatch):
     monkeypatch.setitem(sys.modules, "jax", None)   # import jax -> error
     profiler.dump()
     payload = json.load(open(out))
-    assert payload["deviceMemory"] == {}
+    # no live jax: no per-device entries; the host fallback (stable keys
+    # rss_bytes / peak_rss_bytes) may stand in
+    assert set(payload["deviceMemory"]) <= {"host"}
     assert any(e["name"] == "ev" for e in payload["traceEvents"])
 
 

@@ -17,7 +17,12 @@ generated ``X-Request-Id`` that the server echoes into its spans, a
 server-side JOIN: between stages the harness scrapes ``GET /metrics``
 and ``GET /debug/spans`` and attributes each stage's time to queue wait
 (``serve:queue``), batch dispatch (``serve:batch``), and device step
-(``eval:step``) — *where* the time went, not just that it grew.
+(``eval:step``) — *where* the time went, not just that it grew. The
+scrape's devstats counters (telemetry/devstats.py) add device truth per
+stage: ``server.metrics.device_s`` (measured block-until-ready device
+seconds inside the stage) and ``server.metrics.mfu`` (the stage's
+achieved FLOP/s against the server's advertised peak) — whether the
+knee is compute, HBM, or host overhead is in the report, not a guess.
 
 Saturation point (detect_saturation): the first stage where offered load
 rose but goodput plateaued (less than ``goodput_frac`` of the added
@@ -299,13 +304,22 @@ class _MonotonicClock:
 
 # --------------------------------------------------------------- summarizing
 def summarize_stage(stage_cfg, n_offered, results, span_text="",
-                    prom_before=None, prom_after=None):
+                    prom_before=None, prom_after=None,
+                    scrape_window_s=None):
     """One stage's report entry from raw per-request results.
 
     ``results``: [{"rid", "status", "latency_ms"}, ...] for every arrival
     (CLIENT_DROPPED status for arrivals shed by the in-flight bound).
     ``span_text``: /debug/spans JSONL scraped AFTER the stage — spans are
     joined by the request ids this stage generated.
+    ``scrape_window_s``: wall time between the two /metrics scrapes,
+    reported as ``server.metrics.mfu_window_s``. It is NOT the MFU
+    denominator (that is the chip-seconds delta, topology-exact); it is
+    the honest wall window the counter deltas cover — the scrapes
+    bracket the drain of in-flight requests too, so under overload it
+    exceeds ``duration_s`` — and the busy fraction
+    ``device_s / mfu_window_s`` is the idleness/host-overhead signal.
+    Defaults to ``duration_s`` for direct callers.
     """
     duration = float(stage_cfg["duration_s"])
     by_status = {}
@@ -348,7 +362,9 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
     }
     out["server"] = _join_spans(rids, ok_rids, span_text)
     if prom_before is not None and prom_after is not None:
-        out["server"]["metrics"] = _metrics_delta(prom_before, prom_after)
+        window = scrape_window_s if scrape_window_s else duration
+        out["server"]["metrics"] = _metrics_delta(prom_before, prom_after,
+                                                  duration_s=window)
     return out
 
 
@@ -414,15 +430,24 @@ _DELTA_COUNTERS = (
     "mxtpu_serving_rejected_total", "mxtpu_serving_expired_total",
     "mxtpu_serving_errors_total", "mxtpu_serving_batches_total",
     "mxtpu_serving_batched_items_total", "mxtpu_jit_compiles_total",
+    # device truth (telemetry/devstats.py): window deltas of these give
+    # the stage's achieved utilization, independent of the rolling gauges
+    "mxtpu_device_flops_total", "mxtpu_device_bytes_accessed_total",
+    "mxtpu_device_dispatch_seconds_total", "mxtpu_device_chip_seconds_total",
 )
 _SNAP_GAUGES = (
     "mxtpu_serving_queue_depth", "mxtpu_http_inflight_requests",
 )
 
 
-def _metrics_delta(before, after):
+def _metrics_delta(before, after, duration_s=None):
     """Per-stage server-side counter deltas + end-of-stage gauge snapshot
-    from two /metrics scrapes (label sets summed per family)."""
+    from two /metrics scrapes (label sets summed per family). With the
+    stage ``duration_s`` and the devstats counters in the scrape, the
+    stage's device truth rides along: ``device_s`` (measured device
+    dispatch seconds inside the stage window) and ``mfu`` (the stage's
+    achieved FLOP/s over the server's advertised peak,
+    mxtpu_device_peak_flops) — docs/OBSERVABILITY.md "Device truth"."""
     out = {"delta": {}, "gauges": {}}
     for name in _DELTA_COUNTERS:
         d = _prom_sum(after, name) - _prom_sum(before, name)
@@ -431,10 +456,36 @@ def _metrics_delta(before, after):
     batches = out["delta"].get("mxtpu_serving_batches_total", 0)
     items = out["delta"].get("mxtpu_serving_batched_items_total", 0)
     out["mean_batch_size"] = (items / batches) if batches else None
+    out["device_s"] = out["delta"].get(
+        "mxtpu_device_dispatch_seconds_total")
+    d_flops = out["delta"].get("mxtpu_device_flops_total")
+    d_chip_s = out["delta"].get("mxtpu_device_chip_seconds_total")
+    peak = _prom_sum(after, "mxtpu_device_peak_flops")
+    # mfu_window_s is set on BOTH branches: consumers computing the busy
+    # fraction device_s / mfu_window_s must not KeyError on a stage with
+    # zero instrumented dispatches
+    out["mfu_window_s"] = duration_s
+    if d_flops is not None and d_chip_s and peak:
+        # per-chip MFU WHILE EXECUTING: flops per chip-second over one
+        # chip's peak — exact under any replica/tp topology (dividing the
+        # fleet-total flops by a wall window and ONE chip's peak would
+        # overstate an N-replica deployment N-fold). The busy fraction
+        # device_s / mfu_window_s carries the idleness/host-overhead
+        # signal separately.
+        out["mfu"] = d_flops / d_chip_s / peak
+    else:
+        out["mfu"] = None
     for name in _SNAP_GAUGES:
         series = _prom_series(after, name)
         if series:
             out["gauges"][name] = _prom_sum(after, name)
+    mfu_series = _prom_series(after, "mxtpu_device_mfu")
+    if mfu_series:
+        out["gauges"]["mxtpu_device_mfu"] = {
+            "%s/%s/r%s" % (dict(l).get("model", "?"),
+                           dict(l).get("kind", "?"),
+                           dict(l).get("replica", "?")): v
+            for l, v in mfu_series.items()}
     bucket = _prom_series(after, "mxtpu_serving_bucket_queue_depth")
     if bucket:
         out["gauges"]["mxtpu_serving_bucket_queue_depth"] = {
@@ -600,6 +651,7 @@ class LoadGen:
         t_run0 = self.clock.now()
         try:
             prom_before = parse_prom(self.transport.scrape())
+            t_scrape = self.clock.now()
             for idx, stage in enumerate(self.stages):
                 n_offered = self._drive_stage(idx, stage, q, sync)
                 if not sync:
@@ -609,12 +661,17 @@ class LoadGen:
                     self.clock.sleep(self.settle_s)
                 span_text = self.transport.spans()
                 prom_after = parse_prom(self.transport.scrape())
+                now = self.clock.now()
                 with self._lock:
                     mine = [r for r in self._results if r["stage"] == idx]
                 summaries.append(summarize_stage(
                     stage, n_offered, mine, span_text,
-                    prom_before, prom_after))
+                    prom_before, prom_after,
+                    # the counters cover scrape→scrape (drain + settle
+                    # included), so the MFU denominator must too
+                    scrape_window_s=now - t_scrape))
                 prom_before = prom_after
+                t_scrape = now
         finally:
             for _w in workers:
                 q.put(None)

@@ -437,6 +437,21 @@ def r001_interprocedural(index):
                 if mark in seen:
                     continue
                 seen.add(mark)
+                if "analysis" in what:
+                    # the device-truth sub-rule: cost_analysis /
+                    # memory_analysis are per-dispatch XLA analysis
+                    # walks, not device transfers — the remediation is
+                    # the cached aot entry stats, not lazier values
+                    yield _finding(
+                        callee, snode, "R001",
+                        "%s inside %r, which hot path %r calls "
+                        "(line %d) — a per-dispatch XLA analysis walk "
+                        "hiding one call level down; harvest device "
+                        "truth ONCE at AOT build/load (aot.CACHE entry "
+                        "stats via devstats.program_stats) and read the "
+                        "cached dict in the helper"
+                        % (what, callee.key, fn.key, node.lineno))
+                    continue
                 yield _finding(
                     callee, snode, "R001",
                     "%s inside %r, which hot path %r calls (line %d) — "

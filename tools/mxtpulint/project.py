@@ -1040,8 +1040,11 @@ class _FunctionWalker:
                     self.fn.acquires.append((held, lock, node))
                     return lock
                 return "-" + lock
-        # host-device sync sites (shared definition with per-file R001)
-        if isinstance(func, ast.Attribute) and name in ("asnumpy", "item"):
+        # host-device sync sites (shared definition with per-file R001;
+        # cost_analysis/memory_analysis are per-dispatch XLA analysis
+        # walks — same hot-path poison, same rule)
+        if isinstance(func, ast.Attribute) and name in (
+                "asnumpy", "item", "cost_analysis", "memory_analysis"):
             self.fn.syncs.append((".%s()" % name, node))
         elif isinstance(func, ast.Attribute) and name == "asarray" \
                 and isinstance(func.value, ast.Name) \

@@ -58,9 +58,24 @@ HOT_PATH_PATTERNS = (
     # every chip in the tp group at once
     "*serving/sharded:MeshServable.predict_batch",
     "*serving/sharded:MeshServable._compiled",
+    # the device-truth layer (telemetry/devstats.py) rides INSIDE every
+    # hot path: the MFU observation fires per dispatch, the HBM sampler
+    # ticks for process lifetime, and the profile-capture handler runs
+    # while traffic serves — a hidden sync (or a per-dispatch XLA
+    # analysis walk) in any of them taxes every dispatch in the process
+    "*telemetry/devstats:observe_dispatch",
+    "*telemetry/devstats:sample_now",
+    "*telemetry/devstats:_poll",
+    "*serving/server:_Handler._do_profile",
 )
 
 _SYNC_ATTRS = ("asnumpy", "item")
+#: XLA program-analysis walks: device truth must be harvested ONCE at
+#: AOT build/load time onto the cache entry (aot.insert →
+#: devstats.program_stats) — calling these per dispatch re-walks the
+#: compiled HLO on the hot path, the defect class the devstats layer
+#: exists to avoid (its seeded canary keeps this sub-rule firing)
+_ANALYSIS_ATTRS = ("cost_analysis", "memory_analysis")
 _NUMPY_MODULES = ("np", "onp", "numpy")
 
 
@@ -80,8 +95,12 @@ def r001_host_sync(ctx):
         hot = None
         f = node.func
         what = None
+        analysis = False
         if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
             what = ".%s()" % f.attr
+        elif isinstance(f, ast.Attribute) and f.attr in _ANALYSIS_ATTRS:
+            what = ".%s()" % f.attr
+            analysis = True
         elif (isinstance(f, ast.Attribute) and f.attr == "asarray"
               and isinstance(f.value, ast.Name)
               and f.value.id in _NUMPY_MODULES):
@@ -90,6 +109,15 @@ def r001_host_sync(ctx):
             continue
         hot = _in_hot_path(ctx, node)
         if hot is None:
+            continue
+        if analysis:
+            yield ctx.finding(
+                node, "R001",
+                "%s inside hot path %r re-walks the compiled program's "
+                "XLA analysis per dispatch — harvest device truth ONCE "
+                "at AOT build/load time (aot.CACHE entry stats via "
+                "devstats.program_stats) and read the cached dict here"
+                % (what, hot))
             continue
         yield ctx.finding(
             node, "R001",

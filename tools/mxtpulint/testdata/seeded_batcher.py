@@ -10,12 +10,17 @@ sync-in-dispatch bug class sharded serving makes N times worse — every
 replica worker that syncs stalls its whole queue):
 
 - a host-device sync inside the replica dispatch hot path (R001):
-  ``.asnumpy()`` on the servable's output inside ``_dispatch_replica``.
+  ``.asnumpy()`` on the servable's output inside ``_dispatch_replica``;
+- a per-dispatch XLA analysis walk inside the servable call hot path
+  (R001, the device-truth sub-rule): ``compiled.cost_analysis()`` inside
+  ``_call_servable`` — program stats must be harvested ONCE at AOT
+  build/load time (aot entry stats via devstats.program_stats), never
+  re-walked per dispatch.
 
 This file lives under tools/, so the REPO gate lints it only under the
 relaxed R003/R005/R006 profile (under which it is clean); the regression
 test and ci/run.sh analyze this directory with the FULL profile and
-assert exactly the five seeded findings (one here, four in
+assert exactly the six seeded findings (two here, four in
 seeded_defects.py).
 """
 
@@ -32,3 +37,12 @@ class DynamicBatcher:
         # R001: the replica worker blocks on a device->host transfer for
         # every batch — the defect class the pattern exists to catch
         return [o.asnumpy() for o in outs]
+
+    def _call_servable(self, stacked, replica):
+        compiled = self._dispatch_fn
+        # R001 (device-truth sub-rule): the worker re-walks the compiled
+        # program's XLA cost analysis on EVERY batch — the per-dispatch
+        # form of what aot.insert harvests once at build/load
+        flops = compiled.cost_analysis()[0]["flops"]
+        del flops
+        return compiled(*stacked)

@@ -24,7 +24,18 @@ Usage::
 ``--json`` emits the same report shape as ``python -m tools.mxtpulint
 --json``, ``tools/loadgen.py --json`` and ``tools/perfgate.py --json``
 (tool/ok/findings/counts/baselined), so CI aggregates every gate with
-one parser; violations carry rule id ``P001``.
+one parser; format violations carry rule id ``P001``, metadata-hygiene
+violations carry ``P002``:
+
+- every exposed family must carry BOTH ``# HELP`` and ``# TYPE`` lines,
+  in canonical order (HELP, then TYPE, then that family's samples) — a
+  family without metadata renders as untyped garbage in most scrapers;
+- one family name must never mix gauge and counter semantics: a
+  re-declaration under a different type is rejected, and a plain
+  family's name must not collide with another histogram/summary
+  family's generated ``_bucket``/``_sum``/``_count`` sample names
+  (ambiguous family resolution — a counter named ``x_count`` next to a
+  histogram ``x`` makes every parser guess).
 """
 from __future__ import annotations
 
@@ -156,13 +167,78 @@ def validate(text):
     return types
 
 
+def validate_metadata(text):
+    """P002: HELP/TYPE hygiene over one exposition. Returns a list of
+    ``(line_no, message)`` violations (all of them, not first-only — the
+    exposition still parses, so every hygiene miss is reportable):
+
+    - a ``# TYPE``-declared family with no ``# HELP`` line;
+    - ``# HELP`` appearing AFTER its family's ``# TYPE`` (canonical
+      order is HELP, TYPE, samples);
+    - a family's first sample appearing BEFORE its ``# TYPE`` line;
+    - gauge/counter (or any type) mixing under one name: a plain
+      family whose name collides with a histogram/summary family's
+      generated ``_bucket``/``_sum``/``_count`` sample names.
+    """
+    type_line, help_line, types = {}, {}, {}
+    first_sample = {}
+    out = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                type_line.setdefault(parts[2], i)
+                types.setdefault(parts[2],
+                                 parts[3] if len(parts) > 3 else "")
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                help_line.setdefault(parts[2], i)
+            continue
+        m = SAMPLE_RE.match(line)
+        if m:
+            first_sample.setdefault(m.group("name"), i)
+    for fam, tline in sorted(type_line.items()):
+        hline = help_line.get(fam)
+        if hline is None:
+            out.append((tline, "line %d: family %r has # TYPE but no "
+                        "# HELP — undocumented metrics rot first" %
+                        (tline, fam)))
+        elif hline > tline:
+            out.append((hline, "line %d: # HELP for %r comes after its "
+                        "# TYPE (canonical order is HELP, TYPE, samples)"
+                        % (hline, fam)))
+        sline = first_sample.get(fam)
+        if types.get(fam) == "histogram":
+            sline = min((s for s in
+                         (first_sample.get(fam + sfx) for sfx in
+                          ("_bucket", "_sum", "_count")) if s is not None),
+                        default=sline)
+        if sline is not None and sline < tline:
+            out.append((sline, "line %d: sample of %r appears before its "
+                        "# TYPE declaration (line %d)" % (sline, fam,
+                                                          tline)))
+        if types.get(fam) in ("histogram", "summary"):
+            for sfx in ("_bucket", "_sum", "_count"):
+                other = fam + sfx
+                if other in type_line:
+                    out.append((type_line[other],
+                                "line %d: family %r collides with %s "
+                                "family %r's generated %s samples — one "
+                                "name must never mix metric kinds"
+                                % (type_line[other], other, types[fam],
+                                   fam, sfx)))
+    return out
+
+
 _LINE_NO_RE = re.compile(r"line (\d+):")
 
 
 def report(text, path="<stdin>"):
     """Validate and return the shared CI report shape (see tools/mxtpulint/
     core.py): {"tool", "ok", "findings", "counts", "baselined"}. The first
-    violation becomes one finding with rule id P001."""
+    format violation becomes one P001 finding; every metadata-hygiene
+    violation becomes a P002 finding."""
     findings = []
     try:
         validate(text)
@@ -171,9 +247,14 @@ def report(text, path="<stdin>"):
         m = _LINE_NO_RE.search(msg)
         findings.append({"path": path, "line": int(m.group(1)) if m else 0,
                          "rule": "P001", "message": msg})
+    for line_no, msg in validate_metadata(text):
+        findings.append({"path": path, "line": line_no, "rule": "P002",
+                         "message": msg})
+    counts = {}
+    for f in findings:
+        counts[f["rule"]] = counts.get(f["rule"], 0) + 1
     return {"tool": "promcheck", "ok": not findings, "findings": findings,
-            "counts": {"P001": len(findings)} if findings else {},
-            "baselined": 0}
+            "counts": counts, "baselined": 0}
 
 
 def main(argv):
@@ -187,6 +268,11 @@ def main(argv):
         sys.stdout.write("\n")
         return 0 if rep["ok"] else 1
     types = validate(text)
+    meta = validate_metadata(text)
+    if meta:
+        for _line_no, msg in meta:
+            print("P002: %s" % msg)
+        return 1
     n_hist = sum(1 for t in types.values() if t == "histogram")
     print("promcheck OK: %d metric families (%d histograms)"
           % (len(types), n_hist))
