@@ -49,6 +49,15 @@
 #             repeats and HARD-FAILS outside PERF_BASELINE.json's
 #             tolerance bands — plus the injected-2x-regression canary
 #             proving the gate can still fire (docs/LOADGEN.md)
+#   slo     - SLO engine e2e (telemetry/slo.py + the tenant wiring): a
+#             tenant-mixed loadgen soak against a servable with an
+#             injectable failure window proves the fast-burn alert
+#             fires during the burst (flightrec event + firing gauge +
+#             burn rate over threshold) and resolves after it via
+#             scrapes alone, per-tenant counters split the soak, and
+#             the live exposition passes promcheck; then the fake-clock
+#             SLO/access-log unit tier (tests/test_slo.py, zero real
+#             sleeps); wall budget 60s
 #   sharded - mesh-sharded serving gate on a forced-8-device CPU host:
 #             two interleaved 1-replica vs 8-replica loadgen soaks of a
 #             timer-bound servable driven through the in-process
@@ -75,7 +84,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability devstats loadgen sharded diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability devstats loadgen slo sharded diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -439,6 +448,107 @@ print('perfgate OK: gate artifact %s' % sys.argv[1])" "$LG_DIR/perfgate.json"
   lg_dt=$(( SECONDS - lg_t0 ))
   echo "loadgen stage wall time: ${lg_dt}s (budget 120s)"
   [ "$lg_dt" -lt 120 ] || { echo "loadgen stage took ${lg_dt}s (budget 120s)"; exit 1; }
+fi
+
+if has_stage slo; then
+  echo "=== slo: burn-rate alert lifecycle + per-tenant accounting e2e ==="
+  # A loadgen soak with a weighted tenant mix against a servable whose
+  # failure window is injectable: the fast-burn alert must FIRE during
+  # the 100%-bad burst (flightrec slo_alert event + firing gauge at 1 +
+  # burn rate over threshold) and RESOLVE after it — resolution driven
+  # by scrapes, not traffic. Per-tenant counters must split the soak,
+  # stage reports must carry the /debug/slo trajectory, and the live
+  # exposition (incl. the new mxtpu_slo_* families) must pass promcheck.
+  # CI-scaled windows: 1 s short / 3 s long, one fast pair.
+  slo_t0=$SECONDS
+  JAX_PLATFORMS=cpu MXTPU_SLO_WINDOWS="1:3" MXTPU_SLO_FAST_BURN=10 \
+    python - <<'EOF'
+import json, time, urllib.request
+from tools import loadgen, promcheck
+from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+
+class Flaky:
+    fail = False
+    def predict_batch(self, x):
+        if self.fail:
+            raise RuntimeError("injected failure window")
+        return (x + 1.0,)
+
+sv = Flaky()
+reg = ModelRegistry()
+reg.load("cim", sv, max_batch_size=8, batch_timeout_ms=2.0)
+
+with ServingServer(reg, port=0) as srv:
+    def get(path):
+        with urllib.request.urlopen(srv.url + path, timeout=10) as r:
+            return r.read().decode()
+
+    def soak(seconds, rps=80):
+        tr = loadgen.HttpTransport(srv.url, "cim", [0.0])
+        lg = loadgen.LoadGen(tr, [{"rps": rps, "duration_s": seconds}],
+                             arrival="constant", seed=1, settle_s=0.1,
+                             tenants=[("alice", 3.0), ("bob", 1.0)])
+        return lg.run()
+
+    def alert(field="state"):
+        by = {s["name"]: s for s in json.loads(get("/debug/slo"))["slos"]}
+        return by["cim/availability"]["alerts"][0][field]
+
+    def tape_states():
+        return [json.loads(l)["state"]
+                for l in get("/debug/flightrec").splitlines()
+                if '"slo_alert"' in l and '"cim/availability"' in l]
+
+    # phase 1: healthy soak — per-tenant split lands, nothing fires
+    st = soak(1.0)["stages"][0]
+    t = st["tenants"]
+    assert t["alice"]["ok"] > t["bob"]["ok"] > 0, t
+    assert st["slo"]["slos"], "stage report missing /debug/slo scrape"
+    assert alert() == "inactive", alert()
+
+    # phase 2: injected failure window — the fast pair must FIRE
+    sv.fail = True
+    soak(1.2)
+    assert alert() == "firing", alert()
+    text = get("/metrics")
+    assert ('mxtpu_slo_alert_firing{slo="cim/availability",pair="fast"}'
+            ' 1') in text
+    burn = [l for l in text.splitlines()
+            if l.startswith('mxtpu_slo_burn_rate{slo="cim/availability"'
+                            ',window="1s"}')]
+    assert burn and float(burn[0].split()[-1]) > 10.0, burn
+    assert "firing" in tape_states(), tape_states()
+
+    # phase 3: failure ends — scrapes alone must RESOLVE the alert as
+    # the bad events age out of the 3 s long window
+    sv.fail = False
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and alert() != "resolved":
+        time.sleep(0.25)
+    assert alert() == "resolved", alert()
+    states = tape_states()
+    assert states.index("firing") < states.index("resolved"), states
+    text = get("/metrics")
+    assert ('mxtpu_slo_alert_firing{slo="cim/availability",pair="fast"}'
+            ' 0') in text
+    # per-tenant accounting split the whole run, good and bad codes
+    for needle in ('tenant="alice",code="200"', 'tenant="bob",code="200"',
+                   'tenant="alice",code="500"'):
+        assert needle in text, needle
+    # the live exposition (with the new families) stays parser-clean
+    for fam in ("mxtpu_slo_burn_rate", "mxtpu_slo_budget_remaining",
+                "mxtpu_slo_events_total", "mxtpu_requests_total"):
+        assert fam in text, fam
+    rep = promcheck.report(text, path="live-scrape")
+    assert rep["ok"], rep["findings"]
+reg.close()
+print("slo OK: fast alert fired in burst, resolved after, "
+      "tenants split, promcheck clean")
+EOF
+  JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q
+  slo_dt=$(( SECONDS - slo_t0 ))
+  echo "slo stage wall time: ${slo_dt}s (budget 60s)"
+  [ "$slo_dt" -lt 60 ] || { echo "slo stage took ${slo_dt}s (budget 60s)"; exit 1; }
 fi
 
 if has_stage sharded; then

@@ -356,6 +356,66 @@ ENV_VARS = {
         "PERF_BASELINE.json entry doesn't pin its own: lower-is-better "
         "fails past baseline*(1+tol), higher-is-better below "
         "baseline*(1-tol). Read stdlib-side by tools/perfgate.py."),
+    "MXTPU_SLO_TARGET": (
+        float, 0.99,
+        "Default availability objective for the per-model SLOs the serving "
+        "registry seeds at load (telemetry/slo.py): the fraction of "
+        "eligible requests (2xx good; 429/504/5xx bad; other 4xx not "
+        "counted) that must succeed. The error budget is 1 - target — "
+        "burn rates are bad-fraction / (1 - target) "
+        "(docs/OBSERVABILITY.md 'SLOs and tenants')."),
+    "MXTPU_SLO_LATENCY_MS": (
+        float, None,
+        "When set, every served model also gets a latency SLO: a 2xx "
+        "response slower than this many milliseconds end-to-end (the "
+        "http:predict span window) counts against the latency error "
+        "budget. None = availability SLO only (telemetry/slo.py)."),
+    "MXTPU_SLO_WINDOW_S": (
+        float, 3600.0,
+        "Error-budget accounting window in seconds for "
+        "mxtpu_slo_budget_remaining: the sliding window over which spent "
+        "budget is computed (and refills as bad events age out). The SRE "
+        "30-day convention is impractical for a process-local ledger; one "
+        "hour is the operational default (telemetry/slo.py)."),
+    "MXTPU_SLO_WINDOWS": (
+        str, "300:3600,3600:21600",
+        "Multi-window burn-rate alert pairs as SHORT:LONG second pairs, "
+        "comma-separated, fastest first (default: the SRE-workbook 5m/1h "
+        "fast pair and 1h/6h slow pair). An alert pair breaches only when "
+        "BOTH its windows' burn rates exceed the pair's threshold — the "
+        "short window gives detection speed, the long one suppresses "
+        "blips. CI scales these down to seconds (telemetry/slo.py)."),
+    "MXTPU_SLO_FAST_BURN": (
+        float, 14.4,
+        "Burn-rate threshold for the FIRST (fast) alert-window pair: 14.4 "
+        "means the error budget is being spent 14.4x faster than the "
+        "objective allows (the SRE-workbook page-now threshold — 2% of a "
+        "30-day budget in one hour)."),
+    "MXTPU_SLO_SLOW_BURN": (
+        float, 6.0,
+        "Burn-rate threshold for the second and later (slow) alert-window "
+        "pairs (the SRE-workbook ticket threshold — 5% of a 30-day "
+        "budget in six hours)."),
+    "MXTPU_ACCESSLOG_SIZE": (
+        int, 4096,
+        "Bound on the structured per-request access-log ring "
+        "(serving/accesslog.py): one record per terminal predict outcome "
+        "{ts, request_id, tenant, model, code, shed_reason, queue_ms, "
+        "batch_ms, device_ms, replica, bucket}, oldest aged out. Served "
+        "at GET /debug/requests?n=."),
+    "MXTPU_ACCESSLOG_FILE": (
+        str, None,
+        "When set, access-log records are ALSO appended to this path as "
+        "JSONL (sampled by MXTPU_ACCESSLOG_SAMPLE). None disables file "
+        "export; the in-memory ring and /debug/requests stay on "
+        "regardless (serving/accesslog.py)."),
+    "MXTPU_ACCESSLOG_SAMPLE": (
+        float, 1.0,
+        "Deterministic sampling rate (0..1) for the access-log JSONL file "
+        "export: a stride sampler writes every record at 1.0, every "
+        "second record at 0.5, none at 0 — deterministic, not random, so "
+        "two identical runs export identical files "
+        "(serving/accesslog.py)."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

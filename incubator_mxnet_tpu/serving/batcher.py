@@ -101,16 +101,24 @@ class _Request:
     """One queued inference item + the completion event its client waits on."""
 
     __slots__ = ("inputs", "deadline", "enqueued_at", "request_id",
-                 "span_ctx", "_event", "_result", "_error")
+                 "span_ctx", "tenant", "dispatch", "_event", "_result",
+                 "_error")
 
-    def __init__(self, inputs, deadline, request_id=None, span_ctx=None):
+    def __init__(self, inputs, deadline, request_id=None, span_ctx=None,
+                 tenant=None):
         self.inputs = inputs            # tuple of per-input arrays, NO batch dim
         self.deadline = deadline        # absolute time.monotonic() or None
         self.request_id = request_id    # trace id riding queue -> dispatch
+        self.tenant = tenant            # accounting label riding alongside it
         # captured SpanContext of the submitter's open span (the HTTP
         # handler's http:predict): the explicit queue-boundary propagation
         # the worker parents its serve:queue/serve:batch spans onto
         self.span_ctx = span_ctx
+        # dispatch facts the worker attaches before completing the request
+        # ({replica, bucket, queue_ms, batch_ms, device_ms}) — what the
+        # access-log record's batch-stage legs are assembled from; None
+        # for requests that never reached a dispatch (shed, expired)
+        self.dispatch = None
         self.enqueued_at = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -249,13 +257,16 @@ class DynamicBatcher:
                                  (r - rr) % self.replicas))
         return live
 
-    def submit(self, *inputs, deadline_ms=None, request_id=None):
+    def submit(self, *inputs, deadline_ms=None, request_id=None,
+               tenant=None):
         """Enqueue one item (arrays WITHOUT the batch dim); returns a future-
         like _Request. Raises QueueFullError/ServingClosedError immediately
         instead of blocking — backpressure is the caller's signal to shed
         load upstream. ``request_id`` (assigned by the HTTP front-end or
         any caller) rides the queue and is emitted on the dispatch's
-        profiler trace event, tying one request to its batch."""
+        profiler trace event, tying one request to its batch; ``tenant``
+        (the clamped X-MXTPU-Tenant value) rides alongside it for the
+        per-tenant accounting and the access-log record."""
         if self._closed or self._paused:
             raise ServingClosedError("batcher %r is shut down" % self.name)
         if deadline_ms is None:
@@ -268,7 +279,7 @@ class DynamicBatcher:
         # shape/dtype signature, which needs real arrays
         req = _Request(tuple(onp.asarray(x) for x in inputs), deadline,
                        request_id=request_id,
-                       span_ctx=spans.current_context())
+                       span_ctx=spans.current_context(), tenant=tenant)
         order = self._route()
         if not order:
             # every replica worker died: nobody will ever service this
@@ -318,7 +329,7 @@ class DynamicBatcher:
         return req
 
     def predict(self, *inputs, deadline_ms=None, timeout=None,
-                request_id=None):
+                request_id=None, tenant=None):
         """Blocking convenience: submit + wait for the result tuple.
 
         A request with a deadline never waits (much) past it: the wait is
@@ -327,14 +338,24 @@ class DynamicBatcher:
         hanging — the worker-side check then drops the stale entry when it
         finally dequeues it."""
         req = self.submit(*inputs, deadline_ms=deadline_ms,
-                          request_id=request_id)
+                          request_id=request_id, tenant=tenant)
         if timeout is None:
-            timeout = 600.0
-            if req.deadline is not None:
-                timeout = min(timeout,
-                              max(0.0, req.deadline - time.monotonic())
-                              + self.batch_timeout_ms / 1000.0 + 0.05)
+            timeout = self.result_timeout(req)
         return req.result(timeout)
+
+    def result_timeout(self, req):
+        """The bounded wait predict() applies to one submitted request:
+        600 s absolute cap, or — for a request with a deadline — the
+        deadline plus one batch window, so a client behind a stuck batch
+        errors at its deadline instead of hanging. Exposed so callers
+        that need the _Request itself (the HTTP front-end assembling
+        access-log records) can reproduce predict()'s wait exactly."""
+        timeout = 600.0
+        if req.deadline is not None:
+            timeout = min(timeout,
+                          max(0.0, req.deadline - time.monotonic())
+                          + self.batch_timeout_ms / 1000.0 + 0.05)
+        return timeout
 
     def queue_depth(self):
         """Requests waiting across every replica queue (not yet gathered)."""
@@ -435,6 +456,13 @@ class DynamicBatcher:
         # dispatches drove: a dead model must not export its last MFU
         try:
             devstats.detach_model(self.name)
+        except Exception:
+            pass
+        # ...and for the SLO engine's burn/budget/alert gauges: an
+        # unloaded model must not keep exporting a frozen burn rate
+        try:
+            from ..telemetry import slo
+            slo.REGISTRY.detach_model(self.name)
         except Exception:
             pass
 
@@ -668,8 +696,24 @@ class DynamicBatcher:
                 return self._dispatch_fn(*stacked, replica=replica)
             return self._dispatch_fn(*stacked)
 
+    def _note_dispatch(self, live, bucket, replica, t0, call_s):
+        """Attach the per-request dispatch facts the access-log record is
+        assembled from (server.py): queue wait (enqueue -> gather), batch
+        time (gather -> now: pad + servable + slice), and the servable
+        call's own duration (the device leg). Set BEFORE succeed()/fail()
+        so the completion event's happens-before makes them visible to
+        the client thread."""
+        now = time.monotonic()
+        for req in live:
+            req.dispatch = {
+                "replica": replica, "bucket": bucket,
+                "queue_ms": max(0.0, t0 - req.enqueued_at) * 1e3,
+                "batch_ms": (now - t0) * 1e3,
+                "device_ms": call_s * 1e3 if call_s is not None else None}
+
     def _dispatch_batch_traced(self, live, n, bucket, t0, replica,
                                request_ids):
+        call_s = None
         try:
             # pad by repeating the last row: always shape/dtype-consistent,
             # never introduces out-of-range values. A raising servable must
@@ -678,12 +722,17 @@ class DynamicBatcher:
                 onp.stack([r.inputs[i] for r in live]
                           + [live[-1].inputs[i]] * (bucket - n))
                 for i in range(len(live[0].inputs)))
+            # timer brackets the servable call ONLY: host-side pad/stack
+            # time belongs to the batch leg, not the device_ms fact
+            tc0 = time.monotonic()
             outs = self._call_servable(stacked, replica, request_ids)
+            call_s = time.monotonic() - tc0
         except Exception as e:  # noqa: BLE001 — forwarded to every waiter
             try:
                 self.metrics.inc("error_count", n)
             except Exception:
                 pass
+            self._note_dispatch(live, bucket, replica, t0, call_s)
             for req in live:
                 req.fail(e)
             return
@@ -707,6 +756,7 @@ class DynamicBatcher:
                 self.metrics.inc("error_count", n)
             except Exception:
                 pass
+            self._note_dispatch(live, bucket, replica, t0, call_s)
             for req in live:
                 req.fail(e)
             return
@@ -725,6 +775,7 @@ class DynamicBatcher:
         except Exception:
             pass
         self._profile_batch(n, bucket, dur, request_ids)
+        self._note_dispatch(live, bucket, replica, t0, call_s)
         for j, req in enumerate(live):
             req.succeed(results[j])
 

@@ -20,7 +20,7 @@ from collections import deque
 
 from .. import telemetry
 
-__all__ = ["ServingMetrics", "percentile"]
+__all__ = ["ServingMetrics", "percentile", "request_accounted"]
 
 
 def percentile(sorted_values, q):
@@ -105,6 +105,34 @@ _REPLICA_DISPATCH = telemetry.counter(
     "Requests dispatched by this data-parallel replica (cumulative) — "
     "compare across replicas to verify the router is balancing "
     "(docs/SERVING.md).", ("model", "replica"))
+_TENANT_REQS = telemetry.counter(
+    "mxtpu_requests_total",
+    "Terminal predict outcomes by model, tenant (X-MXTPU-Tenant header, "
+    "clamped via serving/accesslog.clamp_tenant; 'default' when absent) "
+    "and HTTP status code — the per-tenant request accounting the SLO "
+    "engine and fair scheduling build on (docs/OBSERVABILITY.md 'SLOs "
+    "and tenants'). Hostile random tenant values collapse onto the "
+    "'_other_' series past MXTPU_TELEMETRY_MAX_SERIES.",
+    ("model", "tenant", "code"))
+_TENANT_LATENCY_MS = telemetry.histogram(
+    "mxtpu_request_latency_ms",
+    "End-to-end HTTP predict latency per tenant (body read -> response "
+    "computed, the http:predict span window) in ms — the per-tenant "
+    "complement of mxtpu_serving_request_latency_ms.",
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+             10000),
+    labelnames=("model", "tenant"))
+
+
+def request_accounted(model, tenant, code, latency_ms):
+    """One terminal HTTP predict outcome (server.py): per-tenant request
+    counter + latency histogram on the shared registry. ``code`` is the
+    final HTTP status; every outcome counts, including 4xx."""
+    code_s = str(int(code))
+    _TENANT_REQS.inc(model=model, tenant=tenant, code=code_s)
+    _TENANT_LATENCY_MS.observe(latency_ms, model=model, tenant=tenant)
+
+
 _HTTP_INFLIGHT = telemetry.gauge(
     "mxtpu_http_inflight_requests",
     "Predict requests currently held by the HTTP front-end (body read "

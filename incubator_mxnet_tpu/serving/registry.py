@@ -83,6 +83,16 @@ class _ModelEntry:
         self.versions = {}
         self.current_version = None
         self.metrics = ServingMetrics(model=name)
+        # seed the model's default SLOs (availability; latency too when
+        # MXTPU_SLO_LATENCY_MS is set) so budgets/burn gauges exist from
+        # first load; the batcher's close() detaches them again. Guarded:
+        # a misconfigured objective must not make the model unloadable.
+        try:
+            from ..telemetry import slo
+            slo.REGISTRY.ensure_model(name)
+        except Exception:
+            _LOG.debug("SLO seeding for model %r failed", name,
+                       exc_info=True)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._inflight = {}             # version -> dispatched-batch count
@@ -282,10 +292,16 @@ class _ModelEntry:
                                         if self.versions else None)
 
     def describe(self):
+        try:
+            from ..telemetry import slo
+            slos = slo.REGISTRY.names_for_model(self.name)
+        except Exception:
+            slos = []
         with self._lock:
             return {"name": self.name,
                     "versions": sorted(self.versions),
                     "current_version": self.current_version,
+                    "slos": slos,
                     "warming": self._warming > 0,
                     "queue_depth": self.batcher.queue_depth(),
                     "queue_size": self.batcher.queue_size,
@@ -425,15 +441,17 @@ class ModelRegistry:
                                      % (name, names))
         return entry
 
-    def submit(self, name, *inputs, deadline_ms=None, request_id=None):
+    def submit(self, name, *inputs, deadline_ms=None, request_id=None,
+               tenant=None):
         return self._entry(name).batcher.submit(
-            *inputs, deadline_ms=deadline_ms, request_id=request_id)
+            *inputs, deadline_ms=deadline_ms, request_id=request_id,
+            tenant=tenant)
 
     def predict(self, name, *inputs, deadline_ms=None, timeout=None,
-                request_id=None):
+                request_id=None, tenant=None):
         return self._entry(name).batcher.predict(
             *inputs, deadline_ms=deadline_ms, timeout=timeout,
-            request_id=request_id)
+            request_id=request_id, tenant=tenant)
 
     def metrics(self, name):
         return self._entry(name).metrics

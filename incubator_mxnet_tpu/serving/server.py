@@ -42,16 +42,31 @@ Routes (TF-Serving REST-shaped):
   seconds (clamped to MXTPU_PROFILE_MAX_S) and returns the capture dir;
   single-flight — a concurrent capture gets 409 instead of corrupting
   the in-flight trace (docs/OBSERVABILITY.md "Device truth").
+- ``GET /debug/requests?n=`` — the structured access log: the newest
+  ``n`` terminal predict outcomes as JSONL ``{ts, request_id, tenant,
+  model, code, shed_reason, latency_ms, queue_ms, batch_ms, device_ms,
+  replica, bucket}`` (serving/accesslog.py).
+- ``GET /debug/slo``        — per-SLO error-budget remaining, window
+  burn rates, and alert-pair states (telemetry/slo.py;
+  docs/OBSERVABILITY.md "SLOs and tenants").
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
 header and propagated through the batcher queue onto the profiler's
 ``record_batch`` chrome-trace events.
 
+Tenancy: an ``X-MXTPU-Tenant`` header (clamped; ``default`` when
+absent) labels every terminal outcome — per-tenant
+``mxtpu_requests_total{model,tenant,code}`` counters and latency
+histograms, the access-log record, and the per-model SLO ledger feed
+(2xx good; 429/504/5xx bad; latency objective judged from the
+request's end-to-end handler window).
+
 Error contract (the robustness story made visible):
 
-- queue full        -> 429 (explicit backpressure; shed load upstream)
-- deadline exceeded -> 504
+- queue full        -> 429 + ``Retry-After`` + ``shed_reason:
+  "queue_full"`` (explicit backpressure; shed load upstream)
+- deadline exceeded -> 504 + ``shed_reason: "deadline"``
 - unknown model     -> 404
 - shutting down     -> 503
 - malformed body    -> 400
@@ -60,15 +75,21 @@ Error contract (the robustness story made visible):
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import config
 from .. import telemetry
+from . import accesslog
 from .batcher import (DeadlineExceededError, QueueFullError,
                       ServingClosedError)
-from .metrics import http_request_finished, http_request_started
+from .metrics import (http_request_finished, http_request_started,
+                      request_accounted)
 from .registry import ModelNotFoundError, ModelRegistry
+
+_LOG = logging.getLogger(__name__)
 
 __all__ = ["ServingServer", "serve"]
 
@@ -86,13 +107,15 @@ class _Handler(BaseHTTPRequestHandler):
         pass  # serving metrics replace per-request stderr lines
 
     # ------------------------------------------------------------------
-    def _send(self, code, payload, request_id=None):
+    def _send(self, code, payload, request_id=None, headers=None):
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if request_id is not None:
             self.send_header(telemetry.REQUEST_ID_HEADER, request_id)
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -146,6 +169,24 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/debug/aot":
             from .. import aot
             self._send(200, {"entries": aot.CACHE.snapshot()})
+        elif self.path.split("?", 1)[0] == "/debug/requests":
+            # the structured access log: newest n terminal outcomes as
+            # JSONL (tenant, code, shed_reason, queue/batch/device legs)
+            from urllib.parse import parse_qs, urlparse
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                n = int(q.get("n", ["200"])[0])
+            except ValueError:
+                self._send(400, {"error": "n must be an integer"})
+                return
+            self._send_text(200, accesslog.export_jsonl(n),
+                            "application/jsonl; charset=utf-8")
+        elif self.path == "/debug/slo":
+            # budgets, burn rates, and alert states per SLO (evaluating
+            # the alert state machines now — a scrape can resolve an
+            # alert whose error burst has ended)
+            from ..telemetry import slo
+            self._send(200, slo.REGISTRY.describe())
         elif self.path.split("?", 1)[0] == "/debug/profile":
             self._do_profile()
         elif self.path.rstrip("/") == _MODELS_PREFIX:
@@ -197,16 +238,23 @@ class _Handler(BaseHTTPRequestHandler):
         # the id the batcher carries queue -> dispatch -> profiler event
         req_id = self.headers.get(telemetry.REQUEST_ID_HEADER) \
             or telemetry.new_request_id()
+        # tenant accounting label (X-MXTPU-Tenant, clamped; "default"
+        # when absent) — rides the batcher alongside the request id and
+        # keys the per-tenant counters, the SLO ledger feed, and the
+        # access-log record
+        tenant = accesslog.clamp_tenant(
+            self.headers.get(accesslog.TENANT_HEADER))
         # inflight gauge covers body read through response written — the
         # front-end concurrency signal the load harness reads per stage
         http_request_started()
         try:
-            self._do_predict(name, req_id)
+            self._do_predict(name, req_id, tenant)
         finally:
             http_request_finished()
 
-    def _do_predict(self, name, req_id):
+    def _do_predict(self, name, req_id, tenant):
         import numpy as onp
+        t_start = time.perf_counter()
         try:
             length = int(self.headers.get("Content-Length") or 0)
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -226,35 +274,94 @@ class _Handler(BaseHTTPRequestHandler):
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)  # non-numeric -> 400
         except Exception as e:  # noqa: BLE001 — anything malformed is a 400
-            self._send(400, {"error": "bad request: %s" % e},
-                       request_id=req_id)
+            self._finish(name, tenant, req_id, 400, t_start,
+                         {"error": "bad request: %s" % e})
             return
+        breq = None
         try:
             # root span of the request's trace chain: submit() captures
             # this span's context into the queued request, so the worker's
             # serve:queue / serve:batch spans parent onto it across the
             # queue boundary (HTTP -> queue -> bucket -> device in one
-            # dump)
+            # dump). submit + result (rather than predict) keeps the
+            # request object, whose worker-attached dispatch facts feed
+            # the access-log record.
             with telemetry.request_scope(req_id), \
-                    telemetry.span("http:predict", model=name):
-                outs = self.registry.predict(name, *inputs,
-                                             deadline_ms=deadline_ms,
-                                             request_id=req_id)
+                    telemetry.span("http:predict", model=name,
+                                   tenant=tenant):
+                batcher = self.registry._entry(name).batcher
+                breq = batcher.submit(*inputs, deadline_ms=deadline_ms,
+                                      request_id=req_id, tenant=tenant)
+                outs = breq.result(batcher.result_timeout(breq))
         except QueueFullError as e:
-            self._send(429, {"error": str(e)}, request_id=req_id)
+            # explicit backpressure: a machine-readable shed_reason (no
+            # more string-matching the error text) + a Retry-After hint
+            # sized to the coalescing window — the queue drains at batch
+            # granularity, so "one window from now" is the earliest a
+            # retry can meet a freed slot
+            self._finish(name, tenant, req_id, 429, t_start,
+                         {"error": str(e), "shed_reason": "queue_full"},
+                         shed_reason="queue_full", breq=breq,
+                         headers={"Retry-After": self._retry_after(name)})
         except DeadlineExceededError as e:
-            self._send(504, {"error": str(e)}, request_id=req_id)
+            self._finish(name, tenant, req_id, 504, t_start,
+                         {"error": str(e), "shed_reason": "deadline"},
+                         shed_reason="deadline", breq=breq)
         except ModelNotFoundError as e:
-            self._send(404, {"error": str(e)}, request_id=req_id)
+            self._finish(name, tenant, req_id, 404, t_start,
+                         {"error": str(e)}, breq=breq)
         except ServingClosedError as e:
-            self._send(503, {"error": str(e)}, request_id=req_id)
+            self._finish(name, tenant, req_id, 503, t_start,
+                         {"error": str(e)}, breq=breq)
         except Exception as e:  # noqa: BLE001 — servable failure
-            self._send(500, {"error": "%s: %s" % (type(e).__name__, e)},
-                       request_id=req_id)
+            self._finish(name, tenant, req_id, 500, t_start,
+                         {"error": "%s: %s" % (type(e).__name__, e)},
+                         breq=breq)
         else:
-            self._send(200, {"outputs": [onp.asarray(o).tolist()
-                                         for o in outs]},
-                       request_id=req_id)
+            self._finish(name, tenant, req_id, 200, t_start,
+                         {"outputs": [onp.asarray(o).tolist()
+                                      for o in outs]}, breq=breq)
+
+    def _retry_after(self, name):
+        """Whole-second Retry-After hint for a 429: at least one batch
+        window from now (rounded up) — sooner retries meet the same full
+        queue that shed them."""
+        try:
+            window_ms = self.registry._entry(name).batcher.batch_timeout_ms
+        except Exception:
+            window_ms = 0.0
+        return str(max(1, int(-(-window_ms // 1000))))
+
+    def _finish(self, name, tenant, req_id, code, t_start, payload,
+                shed_reason=None, breq=None, headers=None):
+        """Account one terminal outcome, then send the response.
+        Accounting (per-tenant counters + latency histogram, the SLO
+        ledger, the access-log record) happens BEFORE the send, mirroring
+        the batcher's instrument-before-deliver discipline: a scrape
+        fired the moment the client unblocks must already see this
+        request. A telemetry failure must not turn a served response
+        into a 500 — guarded, debug-logged."""
+        latency_ms = (time.perf_counter() - t_start) * 1e3
+        d = (breq.dispatch if breq is not None else None) or {}
+        try:
+            request_accounted(name, tenant, code, latency_ms)
+            from ..telemetry import slo
+            if code != 404:
+                # a 404 names a model that does not exist — feeding it to
+                # the SLO registry would let hostile model-name probes
+                # seed unbounded SLO objects (404 is not SLO-eligible
+                # anyway; the per-tenant counter above, which IS
+                # cardinality-clamped, still records the probe)
+                slo.REGISTRY.observe(name, code, latency_ms=latency_ms)
+            accesslog.record(
+                request_id=req_id, tenant=tenant, model=name, code=code,
+                latency_ms=latency_ms, shed_reason=shed_reason,
+                queue_ms=d.get("queue_ms"), batch_ms=d.get("batch_ms"),
+                device_ms=d.get("device_ms"), replica=d.get("replica"),
+                bucket=d.get("bucket"))
+        except Exception:
+            _LOG.debug("request accounting failed", exc_info=True)
+        self._send(code, payload, request_id=req_id, headers=headers)
 
 
 class _Server(ThreadingHTTPServer):

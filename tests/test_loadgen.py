@@ -477,3 +477,88 @@ def test_parse_stages_cli_grammar():
         {"rps": 100.0, "duration_s": 1.5}, {"rps": 400.0, "duration_s": 2.0}]
     with pytest.raises(ValueError):
         loadgen._parse_stages("100")
+
+
+# --------------------------------------------------- tenant mix (PR 11)
+class TenantTransport(FakeTransport):
+    """FakeTransport that accepts the optional tenant arg and records it,
+    plus a scripted /debug/slo payload for the between-stage scrape."""
+
+    def __init__(self, clock, script, slo_payload=None):
+        super().__init__(clock, script)
+        self.tenants = []
+        self.slo_payload = slo_payload
+
+    def send(self, rid, tenant=None):
+        self.tenants.append(tenant)
+        return super().send(rid)
+
+    def slo(self):
+        return json.dumps(self.slo_payload) if self.slo_payload else ""
+
+
+def test_parse_tenants_cli_grammar():
+    assert loadgen._parse_tenants("alice:3,bob:1") == [
+        ("alice", 3.0), ("bob", 1.0)]
+    assert loadgen._parse_tenants("solo") == [("solo", 1.0)]  # bare weighs 1
+    assert loadgen._parse_tenants("") is None
+    assert loadgen._parse_tenants(None) is None
+    with pytest.raises(ValueError):
+        loadgen._parse_tenants(":2")
+    with pytest.raises(ValueError):
+        loadgen.LoadGen(FakeTransport(FakeClock(), {}),
+                        [{"rps": 1, "duration_s": 1.0}],
+                        tenants=[("a", 0.0)])     # weights must be > 0
+
+
+def test_tenant_mix_weighted_and_schedule_invariant():
+    """The weighted mix reaches the wire per-request, the stage report
+    gains per-tenant columns, and adding --tenants leaves the arrival
+    schedule byte-identical (a separate RNG stream draws tenants)."""
+    def run(tenants):
+        clock = FakeClock()
+        tr = TenantTransport(clock, {0: (200, 0.002)})
+        lg = loadgen.LoadGen(tr, [{"rps": 200, "duration_s": 1.0}],
+                             arrival="poisson", clock=clock, settle_s=0.0,
+                             run_id="t", seed=7, tenants=tenants)
+        return tr, lg.run(sync=True)
+
+    tr_mix, rep_mix = run([("alice", 3.0), ("bob", 1.0)])
+    tr_none, rep_none = run(None)
+    # identical rid sequence: the tenant draw never perturbs arrivals
+    assert tr_mix.sent == tr_none.sent
+    assert all(t is None for t in tr_none.tenants)
+    assert set(tr_mix.tenants) == {"alice", "bob"}
+    cols = rep_mix["stages"][0]["tenants"]
+    assert set(cols) == {"alice", "bob"}
+    offered = rep_mix["stages"][0]["offered"]
+    assert cols["alice"]["offered"] + cols["bob"]["offered"] == offered
+    assert cols["alice"]["offered"] > cols["bob"]["offered"]  # 3:1 mix
+    for c in cols.values():
+        assert c["ok"] == c["offered"] and c["shed"] == 0
+        assert c["latency_ms"]["p50"] == pytest.approx(2.0)
+        assert c["goodput_rps"] > 0
+    # deterministic: same seed, same mix -> same per-tenant split
+    tr_again, rep_again = run([("alice", 3.0), ("bob", 1.0)])
+    assert tr_again.tenants == tr_mix.tenants
+    # no mix -> no tenants key (report shape is backward compatible)
+    assert "tenants" not in rep_none["stages"][0]
+    assert rep_mix["config"]["tenants"] == [("alice", 3.0), ("bob", 1.0)]
+
+
+def test_stage_report_carries_slo_scrape():
+    payload = {"slos": [{"name": "m/availability", "budget_remaining": 0.5,
+                         "burn_rates": {"300s": 2.0}, "alerts": []}]}
+    clock = FakeClock()
+    tr = TenantTransport(clock, {0: (200, 0.001)}, slo_payload=payload)
+    lg = loadgen.LoadGen(tr, [{"rps": 10, "duration_s": 1.0}],
+                         arrival="constant", clock=clock, settle_s=0.0,
+                         run_id="t", seed=0)
+    rep = lg.run(sync=True)
+    assert rep["stages"][0]["slo"] == payload
+    # a transport without .slo() (older fakes) degrades to no key
+    tr2 = FakeTransport(clock, {0: (200, 0.001)})
+    lg2 = loadgen.LoadGen(tr2, [{"rps": 10, "duration_s": 1.0}],
+                          arrival="constant", clock=clock, settle_s=0.0,
+                          run_id="t", seed=0)
+    assert "slo" not in lg2.run(sync=True)["stages"][0]

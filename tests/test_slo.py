@@ -1,0 +1,574 @@
+"""SLO engine + tenant accounting + structured access log tier
+(telemetry/slo.py, serving/accesslog.py, the server.py tenant wiring) —
+docs/OBSERVABILITY.md "SLOs and tenants".
+
+Every unit test below drives the engine on the injectable clock (the
+loadgen fake-clock pattern): window aging, budget refill, and the whole
+alert lifecycle run with ZERO real sleeps. The HTTP tests at the bottom
+are the e2e tier — real sockets, still no sleeps.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu.serving import (ModelRegistry, ServingServer,
+                                         accesslog)
+from incubator_mxnet_tpu.serving.metrics import request_accounted
+from incubator_mxnet_tpu.telemetry import flightrec
+from incubator_mxnet_tpu.telemetry import registry as treg
+from incubator_mxnet_tpu.telemetry import slo as slomod
+from incubator_mxnet_tpu.telemetry.slo import (SLO, AlertPair, SLORegistry,
+                                               _Ledger, _parse_windows)
+
+
+class Clock:
+    """Injectable monotonic clock: ``clk()`` reads, ``clk.t = x`` sets."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+# ===================================================================== ledger
+def test_ledger_window_boundary():
+    led = _Ledger(10.0, resolution_s=1.0)
+    assert led.bucket_s == 1.0
+    led.add(False, now=100.4)            # one bad in bucket 100
+    led.add(True, now=100.6)             # one good, same bucket
+    assert led.window_counts(10.0, now=100.9) == (1, 1)
+    # still inside the trailing-10s window at t=109.x (buckets 100..109)
+    assert led.window_counts(10.0, now=109.9) == (1, 1)
+    # one bucket later both have aged out (buckets 101..110)
+    assert led.window_counts(10.0, now=110.0) == (0, 0)
+    # a shorter window read over the same ledger excludes them earlier
+    led.add(False, now=112.0)
+    assert led.window_counts(2.0, now=112.0) == (0, 1)
+    assert led.window_counts(2.0, now=114.0) == (0, 0)
+
+
+def test_ledger_zeroes_skipped_buckets():
+    led = _Ledger(10.0, resolution_s=1.0)
+    led.add(False, now=100.0)
+    # a jump far past the ring must not let the stale bucket alias back in
+    led.add(True, now=100.0 + 10 * led.slots)
+    g, b = led.window_counts(10.0, now=100.0 + 10 * led.slots)
+    assert (g, b) == (1, 0)
+
+
+# =============================================================== definitions
+def test_parse_windows_names_and_errors():
+    assert _parse_windows("300:3600,3600:21600") == [
+        ("fast", 300.0, 3600.0), ("slow", 3600.0, 21600.0)]
+    assert _parse_windows("1:2,3:4,5:6")[2] == ("slow2", 5.0, 6.0)
+    with pytest.raises(ValueError):
+        _parse_windows("300")            # no SHORT:LONG separator
+    with pytest.raises(ValueError):
+        _parse_windows("")
+    with pytest.raises(ValueError):      # long < short
+        AlertPair("fast", 60, 30, 1.0)
+    with pytest.raises(ValueError):
+        SLO("s", "m", target=1.5, windows=[(5, 10)], fast_burn=1,
+            slow_burn=1, window_s=60, clock=Clock())
+    with pytest.raises(ValueError):
+        SLO("s", "m", kind="latency", windows=[(5, 10)], fast_burn=1,
+            slow_burn=1, window_s=60, clock=Clock())  # needs latency_ms
+
+
+def _mk_slo(clk, name="t/avail", target=0.9, window_s=60.0,
+            windows=((10.0, 30.0),), fast_burn=1.0, slow_burn=1.0, **kw):
+    return SLO(name, "t", target=target, window_s=window_s,
+               windows=list(windows), fast_burn=fast_burn,
+               slow_burn=slow_burn, clock=clk, **kw)
+
+
+def test_classify_eligibility():
+    clk = Clock()
+    s = _mk_slo(clk)
+    assert s.classify(200) == "good"
+    assert s.classify(204) == "good"
+    for code in (429, 504, 500, 503, 599):
+        assert s.classify(code) == "bad"
+    for code in (400, 404, 418):         # the client's mistake: ineligible
+        assert s.classify(code) is None
+    lat = _mk_slo(clk, name="t/lat", kind="latency", latency_ms=250.0)
+    assert lat.classify(200, latency_ms=100.0) == "good"
+    assert lat.classify(200, latency_ms=300.0) == "bad"   # slow 2xx is bad
+    assert lat.classify(200) == "good"   # no latency info -> availability
+    assert lat.classify(500, latency_ms=1.0) == "bad"     # never-fast
+    assert lat.classify(404) is None
+
+
+# =============================================================== burn/budget
+def test_burn_rate_math():
+    clk = Clock(50.0)
+    s = _mk_slo(clk, target=0.9, windows=[(10.0, 60.0)])
+    for _ in range(9):
+        s.observe(200, now=50.0)
+    s.observe(500, now=50.0)
+    # bad_fraction 0.1 / budget 0.1 -> spending exactly at the allowed rate
+    assert s.burn_rate(10.0, now=50.0) == pytest.approx(1.0)
+    s.observe(500, now=50.0)
+    assert s.burn_rate(10.0, now=50.0) == pytest.approx((2 / 11) / 0.1)
+    # empty window reads 0, not NaN
+    assert s.burn_rate(10.0, now=500.0) == 0.0
+
+
+def test_burn_rate_window_aging_and_pair_window_independence():
+    clk = Clock(50.0)
+    s = _mk_slo(clk, target=0.9, windows=[(10.0, 60.0)])
+    s.observe(500, now=50.0)
+    assert s.burn_rate(10.0, now=55.0) > 0.0
+    # aged out of the short window, still inside the long one
+    clk.t = 65.0
+    assert s.burn_rate(10.0) == 0.0
+    assert s.burn_rate(60.0) > 0.0
+
+
+def test_budget_exhaustion_clamp_and_refill():
+    clk = Clock(100.0)
+    s = _mk_slo(clk, target=0.9, window_s=10.0, windows=[(5.0, 10.0)])
+    assert s.budget_remaining(now=100.0) == 1.0   # empty window: untouched
+    for _ in range(19):
+        s.observe(200, now=100.0)
+    s.observe(500, now=100.0)
+    # 20 eligible, 2 allowed bad, 1 spent -> half the budget left
+    assert s.budget_remaining(now=100.0) == pytest.approx(0.5)
+    s.observe(500, now=100.0)
+    # 21 eligible, 2.1 allowed, 2 spent — nearly gone (the allowance
+    # scales with traffic: more eligible events grow the denominator)
+    assert s.budget_remaining(now=100.0) == pytest.approx(1.0 - 2 / 2.1)
+    s.observe(500, now=100.0)                     # over-spend clamps at 0
+    assert s.budget_remaining(now=100.0) == 0.0
+    clk.t = 111.0                                 # everything aged out
+    assert s.budget_remaining() == 1.0            # refilled
+
+
+# ================================================================ alert pairs
+def test_alert_pair_hysteresis_no_flap():
+    p = AlertPair("fast", 4.0, 8.0, threshold=1.0, pending_s=2.0,
+                  resolve_s=3.0)
+    assert p.evaluate(5.0, 5.0, now=0.0) == ["pending"]
+    assert p.evaluate(5.0, 5.0, now=1.0) == []    # held, not yet firing
+    assert p.evaluate(5.0, 5.0, now=2.5) == ["firing"]
+    # a momentary clear shorter than resolve_s must NOT resolve
+    assert p.evaluate(0.0, 0.0, now=3.0) == []
+    assert p.state == "firing"
+    # breach returns -> the clear streak resets
+    assert p.evaluate(5.0, 5.0, now=4.0) == []
+    assert p.evaluate(0.0, 0.0, now=5.0) == []    # streak restarts at 5
+    assert p.evaluate(0.0, 0.0, now=7.9) == []    # 2.9s clear < 3s
+    assert p.state == "firing"
+    assert p.evaluate(0.0, 0.0, now=8.1) == ["resolved"]
+    # resolved is sticky until the next breach restarts the cycle
+    assert p.evaluate(0.0, 0.0, now=9.0) == []
+    assert p.evaluate(5.0, 5.0, now=10.0) == ["pending"]
+    # a pending that clears before the pending timer goes back inactive
+    assert p.evaluate(0.0, 0.0, now=10.5) == ["inactive"]
+
+
+def test_alert_pair_needs_both_windows_and_zero_pending():
+    p = AlertPair("fast", 4.0, 8.0, threshold=1.0)   # pending_s=0
+    # short window alone breaching is a blip — the long window suppresses
+    assert p.evaluate(5.0, 0.5, now=0.0) == []
+    assert p.state == "inactive"
+    assert p.evaluate(0.5, 5.0, now=1.0) == []
+    # both above -> the full pending -> firing lifecycle in one step
+    assert p.evaluate(5.0, 5.0, now=2.0) == ["pending", "firing"]
+
+
+def test_fast_slow_pair_independence():
+    """A short error burst over a long good history trips the fast pair
+    only: the slow pair's long window has seen too much good traffic."""
+    clk = Clock(0.0)
+    s = _mk_slo(clk, target=0.9, window_s=120.0,
+                windows=[(4.0, 20.0), (20.0, 120.0)],
+                fast_burn=3.0, slow_burn=2.0)
+    transitions = []
+    for t in range(116):                  # 116 good, one per second
+        transitions += s.observe(200, now=float(t))
+    for i in range(20):                   # 4-second 100%-bad burst
+        transitions += s.observe(500, now=116.5 + i * 0.17)
+    clk.t = 120.0
+    transitions += s.evaluate(now=120.0, force=True)
+    states = {(p.name, st) for p, st, _bs, _bl in transitions}
+    assert ("fast", "firing") in states
+    assert all(name == "fast" for name, _ in states)
+    fast, slow = s.pairs
+    assert fast.state == "firing" and slow.state == "inactive"
+    # 20 bad of 136 eligible >> the 10% budget: the window reads exhausted
+    assert s.budget_remaining(now=120.0) == 0.0
+
+
+# ========================================================= registry + events
+def test_registry_observe_emits_flightrec_transitions():
+    clk = Clock(1.0)
+    r = SLORegistry(clock=clk)
+    r.define("fm/availability", "fm", target=0.9, window_s=60.0,
+             windows=[(5.0, 10.0)], fast_burn=1.0, slow_burn=1.0,
+             resolve_s=2.0)
+    r.observe("fm", 500, now=1.0)
+    r.observe("fm", 500, now=1.3)
+    events = [e for e in flightrec.snapshot()
+              if e.get("event") == "slo_alert" and e.get("slo") ==
+              "fm/availability"]
+    assert [e["state"] for e in events] == ["pending", "firing"]
+    assert events[-1]["burn_short"] > 1.0
+    # a quiet tail + describe() calls (the scrape path) resolve it — alert
+    # resolution must not need traffic. Two scrapes: the first starts the
+    # clear streak, the second (past resolve_s) resolves.
+    clk.t = 20.0
+    r.describe()
+    clk.t = 23.0
+    r.describe()
+    events = [e for e in flightrec.snapshot()
+              if e.get("event") == "slo_alert" and e.get("slo") ==
+              "fm/availability"]
+    assert [e["state"] for e in events] == ["pending", "firing", "resolved"]
+
+
+def test_registry_describe_detach_and_unseeded_observe():
+    clk = Clock(5.0)
+    r = SLORegistry(clock=clk)
+    # ineligible outcomes (the only kind a nonexistent model name can
+    # produce) never mint an SLO — hostile probes stay unaccounted here
+    r.observe("nope", 404, now=5.0)
+    r.observe("nope", 400, now=5.0)
+    assert r.describe() == {"slos": []}
+    # an unseeded model gets the default objectives on first ELIGIBLE
+    # observation
+    r.observe("um", 200, now=5.0)
+    d = r.describe()
+    names = [s["name"] for s in d["slos"]]
+    assert "um/availability" in names
+    entry = d["slos"][names.index("um/availability")]
+    assert set(entry) >= {"name", "model", "kind", "target", "window_s",
+                          "budget_remaining", "burn_rates", "alerts"}
+    assert entry["budget_remaining"] == 1.0
+    assert all(a["state"] == "inactive" for a in entry["alerts"])
+    # re-define is idempotent: the ledger must survive a hot reload
+    assert r.define("um/availability", "um") is r.get("um/availability")
+    r.detach_model("um")
+    assert r.get("um/availability") is None
+    assert r.describe() == {"slos": []}
+
+
+def test_registry_ensure_model_latency_objective(monkeypatch):
+    monkeypatch.setenv("MXTPU_SLO_LATENCY_MS", "250")
+    r = SLORegistry(clock=Clock())
+    out = r.ensure_model("lm")
+    assert [s.name for s in out] == ["lm/availability", "lm/latency"]
+    assert out[1].kind == "latency" and out[1].latency_ms == 250.0
+    assert r.ensure_model("lm") == out   # idempotent
+
+
+# ========================================================== tenant label hub
+def test_clamp_tenant_value_normalization():
+    assert accesslog.clamp_tenant(None) == "default"
+    assert accesslog.clamp_tenant("") == "default"
+    assert accesslog.clamp_tenant("  \t ") == "default"
+    assert accesslog.clamp_tenant(" alice ") == "alice"
+    assert accesslog.clamp_tenant("a\x00b\tc") == "abc"   # control chars
+    assert accesslog.clamp_tenant("x" * 500) == "x" * 64  # length clamp
+    assert accesslog.clamp_tenant(123) == "123"
+
+
+def test_hostile_tenants_collapse_into_other(monkeypatch):
+    """R004 in the live registry: a client spraying random tenant headers
+    must land on the '_other_' series, never grow the registry unbounded
+    — the tenant label rides the MXTPU_TELEMETRY_MAX_SERIES clamp."""
+    m = treg.REGISTRY.get("mxtpu_requests_total")
+    assert m is not None, "per-tenant request counter not registered"
+    base = len(m._series)
+    monkeypatch.setenv("MXTPU_TELEMETRY_MAX_SERIES", str(base + 3))
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for i in range(32):
+            request_accounted("clampm", "hostile-%d" % i, 200, 1.0)
+    # at most the 3 slots it could legitimately take, plus _other_
+    assert len(m._series) <= base + 4
+    text = treg.export_text()
+    assert 'tenant="_other_"' in text
+    assert "hostile-31" not in text
+
+
+# ================================================================ access log
+def test_accesslog_ring_bound(monkeypatch):
+    monkeypatch.setenv("MXTPU_ACCESSLOG_SIZE", "32")
+    accesslog.reset()
+    try:
+        for i in range(100):
+            accesslog.record("r%d" % i, "t", "m", 200, latency_ms=1.0)
+        snap = accesslog.snapshot()
+        assert len(snap) == 32                      # oldest aged out
+        assert snap[-1]["request_id"] == "r99"
+        assert snap[0]["request_id"] == "r68"
+        assert len(accesslog.tail(5)) == 5
+        lines = accesslog.export_jsonl(3).splitlines()
+        assert [json.loads(l)["request_id"] for l in lines] == \
+            ["r97", "r98", "r99"]
+        rec = json.loads(lines[-1])
+        assert set(rec) == {"ts", "request_id", "tenant", "model", "code",
+                            "shed_reason", "latency_ms", "queue_ms",
+                            "batch_ms", "device_ms", "replica", "bucket"}
+    finally:
+        monkeypatch.undo()
+        accesslog.reset()
+
+
+def test_accesslog_jsonl_deterministic_sampling(tmp_path, monkeypatch):
+    path = str(tmp_path / "al.jsonl")
+    monkeypatch.setenv("MXTPU_ACCESSLOG_FILE", path)
+    monkeypatch.setenv("MXTPU_ACCESSLOG_SAMPLE", "0.5")
+    accesslog.reset()
+    try:
+        for i in range(10):
+            accesslog.record("r%d" % i, "t", "m", 200)
+        got = [json.loads(l)["request_id"]
+               for l in open(path).read().splitlines()]
+        # stride sampler: exactly ceil(10*0.5) records, at deterministic
+        # evenly-spaced positions — two identical runs export identically
+        assert got == ["r1", "r3", "r5", "r7", "r9"]
+        # rate 0 writes nothing more
+        monkeypatch.setenv("MXTPU_ACCESSLOG_SAMPLE", "0")
+        accesslog.record("r10", "t", "m", 200)
+        assert len(open(path).read().splitlines()) == 5
+    finally:
+        monkeypatch.undo()
+        accesslog.reset()
+
+
+# ============================================================== HTTP e2e tier
+class _Echo:
+    """predict_batch = identity + 1, with an optional blocking gate."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.entered = threading.Event()
+
+    def predict_batch(self, x):
+        self.entered.set()
+        assert self.gate.wait(30.0), "test gate never released"
+        return (x + 1.0,)
+
+
+def _post(url, payload, headers=None, timeout=60.0):
+    """(status, body_dict, response_headers) — unlike test_serving's
+    helper this keeps the headers, which carry Retry-After."""
+    body = json.dumps(payload).encode("utf-8")
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=body, headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_http_e2e_tenant_header_to_counter_ledger_and_accesslog():
+    """One HTTP round trip: tenant header -> per-tenant counter -> SLO
+    ledger outcome -> access-log record."""
+    reg = ModelRegistry()
+    reg.load("e2em", _Echo(), max_batch_size=4, batch_timeout_ms=2.0)
+    with ServingServer(reg, port=0) as srv:
+        code, body, hdrs = _post(srv.url + "/v1/models/e2em:predict",
+                                 {"inputs": [[41.0]]},
+                                 headers={"X-MXTPU-Tenant": "alice",
+                                          "X-Request-Id": "slo-e2e-1"})
+        assert code == 200 and body["outputs"][0] == [42.0]
+        assert hdrs["X-Request-Id"] == "slo-e2e-1"
+        # no header -> the default tenant
+        code, _b, _h = _post(srv.url + "/v1/models/e2em:predict",
+                             {"inputs": [[1.0]]})
+        assert code == 200
+        _c, text = _get(srv.url + "/metrics")
+        assert ('mxtpu_requests_total{model="e2em",tenant="alice",'
+                'code="200"} 1') in text
+        assert ('mxtpu_requests_total{model="e2em",tenant="default",'
+                'code="200"} 1') in text
+        assert 'mxtpu_request_latency_ms' in text
+        assert ('mxtpu_slo_events_total{slo="e2em/availability",'
+                'outcome="good"} 2') in text
+        assert 'mxtpu_slo_budget_remaining{slo="e2em/availability"} 1' \
+            in text
+        # /debug/slo renders budgets, burn rates, and alert states
+        _c, slotext = _get(srv.url + "/debug/slo")
+        d = json.loads(slotext)
+        entry = {s["name"]: s for s in d["slos"]}["e2em/availability"]
+        assert entry["budget_remaining"] == 1.0
+        assert all(a["state"] == "inactive" for a in entry["alerts"])
+        assert {a["pair"] for a in entry["alerts"]} == {"fast", "slow"}
+        # /debug/requests serves the structured tail with dispatch legs
+        _c, reqtext = _get(srv.url + "/debug/requests?n=2")
+        recs = [json.loads(l) for l in reqtext.splitlines()]
+        assert len(recs) == 2
+        by_rid = {r["request_id"]: r for r in recs}
+        rec = by_rid["slo-e2e-1"]
+        assert rec["tenant"] == "alice" and rec["code"] == 200
+        assert rec["model"] == "e2em" and rec["shed_reason"] is None
+        assert rec["queue_ms"] is not None and rec["queue_ms"] >= 0.0
+        assert rec["device_ms"] is not None and rec["replica"] == 0
+        assert rec["latency_ms"] > 0.0
+        # malformed n is a 400, not a traceback
+        code, _body, _h = _post(srv.url + "/v1/models/e2em:predict", {})
+        status = urllib.request.urlopen(
+            urllib.request.Request(srv.url + "/debug/requests?n=2"))
+        assert status.status == 200
+        try:
+            urllib.request.urlopen(srv.url + "/debug/requests?n=x")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    reg.close()
+    # closing the model detaches its SLOs: the burn/budget gauges must
+    # not keep exporting for a dead model
+    from incubator_mxnet_tpu import telemetry
+    assert 'mxtpu_slo_budget_remaining{slo="e2em/availability"}' \
+        not in telemetry.export_text()
+
+
+def test_http_unknown_model_probe_never_seeds_slos():
+    """Hostile model-name probes (404s) must not grow the SLO registry:
+    only loaded models own ledgers and gauges."""
+    reg = ModelRegistry()
+    reg.load("realm", _Echo(), max_batch_size=4, batch_timeout_ms=2.0)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            for i in range(5):
+                code, _b, _h = _post(
+                    srv.url + "/v1/models/ghost%d:predict" % i,
+                    {"inputs": [[1.0]]})
+                assert code == 404
+                # a malformed body 400s BEFORE the model lookup — the
+                # other route a hostile name reaches accounting by
+                code, _b, _h = _post(
+                    srv.url + "/v1/models/ghost%d:predict" % i,
+                    {"inputs": "not-a-list"})
+                assert code == 400
+            names = {s["name"] for s in
+                     json.loads(_get(srv.url + "/debug/slo")[1])["slos"]}
+            assert "realm/availability" in names
+            assert not any(n.startswith("ghost") for n in names), names
+            assert slomod.REGISTRY.get("ghost0/availability") is None
+    finally:
+        reg.close()
+
+
+def test_http_429_retry_after_and_shed_reason_504():
+    """Sheds carry a machine-readable shed_reason (queue_full vs
+    deadline) + a Retry-After hint on 429 — no more string-matching."""
+    sv = _Echo()
+    sv.gate.clear()
+    reg = ModelRegistry()
+    reg.load("shedm", sv, max_batch_size=1, batch_timeout_ms=1.0,
+             queue_size=2)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            # worker blocked mid-batch, queue empty: a short deadline
+            # expires in the queue -> 504 "deadline"
+            blocker = reg.submit("shedm", onp.zeros((1,), "float32"))
+            assert sv.entered.wait(10.0)
+            code, body, _h = _post(srv.url + "/v1/models/shedm:predict",
+                                   {"inputs": [[0.0]], "deadline_ms": 5},
+                                   headers={"X-MXTPU-Tenant": "shedder"})
+            assert code == 504 and body["shed_reason"] == "deadline"
+            # now fill the queue (size 2; the expired request may still
+            # occupy a slot until the blocked worker dequeues it): the
+            # next HTTP post must shed 429 — deterministic, no races
+            from incubator_mxnet_tpu.serving import QueueFullError
+            fillers = []
+            try:
+                for _ in range(3):
+                    fillers.append(
+                        reg.submit("shedm", onp.zeros((1,), "float32")))
+            except QueueFullError:
+                pass
+            code, body, hdrs = _post(srv.url + "/v1/models/shedm:predict",
+                                     {"inputs": [[0.0]]},
+                                     headers={"X-MXTPU-Tenant": "shedder"})
+            assert code == 429 and body["shed_reason"] == "queue_full"
+            assert int(hdrs["Retry-After"]) >= 1
+            sv.gate.set()
+            blocker.result(30.0)
+            for f in fillers:
+                f.result(30.0)
+            # both flavors land in the access log, dispatch legs null
+            # (never dispatched), and in the SLO ledger as bad
+            _c, reqtext = _get(srv.url + "/debug/requests?n=1000")
+            recs = [json.loads(l) for l in reqtext.splitlines()
+                    if json.loads(l)["model"] == "shedm"]
+            by_code = {}
+            for r in recs:
+                by_code.setdefault(r["code"], []).append(r)
+            assert all(r["shed_reason"] == "queue_full"
+                       and r["queue_ms"] is None for r in by_code[429])
+            assert all(r["shed_reason"] == "deadline"
+                       for r in by_code[504])
+            _c, text = _get(srv.url + "/metrics")
+            assert ('mxtpu_slo_events_total{slo="shedm/availability",'
+                    'outcome="bad"} 2') in text
+            assert 'tenant="shedder",code="429"' in text
+            assert 'tenant="shedder",code="504"' in text
+    finally:
+        sv.gate.set()
+        reg.close()
+
+
+def test_http_latency_objective_judged_from_e2e_window(monkeypatch):
+    """With MXTPU_SLO_LATENCY_MS set, a 2xx slower than the threshold
+    spends the latency budget while availability stays good."""
+    monkeypatch.setenv("MXTPU_SLO_LATENCY_MS", "0.0001")  # everything slow
+    reg = ModelRegistry()
+    reg.load("latm", _Echo(), max_batch_size=4, batch_timeout_ms=2.0)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            code, _b, _h = _post(srv.url + "/v1/models/latm:predict",
+                                 {"inputs": [[1.0]]})
+            assert code == 200
+            _c, text = _get(srv.url + "/metrics")
+            assert ('mxtpu_slo_events_total{slo="latm/availability",'
+                    'outcome="good"} 1') in text
+            assert ('mxtpu_slo_events_total{slo="latm/latency",'
+                    'outcome="bad"} 1') in text
+            _c, slotext = _get(srv.url + "/debug/slo")
+            names = {s["name"] for s in json.loads(slotext)["slos"]}
+            assert {"latm/availability", "latm/latency"} <= names
+    finally:
+        reg.close()
+
+
+def test_live_scrape_slo_families_pass_promcheck():
+    """P001/P002 over a live scrape that includes the new mxtpu_slo_*
+    and per-tenant families — the exposition stays parser-clean."""
+    from tools import promcheck
+    reg = ModelRegistry()
+    reg.load("promm", _Echo(), max_batch_size=4, batch_timeout_ms=2.0)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            code, _b, _h = _post(srv.url + "/v1/models/promm:predict",
+                                 {"inputs": [[1.0]]},
+                                 headers={"X-MXTPU-Tenant": "p"})
+            assert code == 200
+            _c, text = _get(srv.url + "/metrics")
+    finally:
+        reg.close()
+    for family in ("mxtpu_slo_burn_rate", "mxtpu_slo_budget_remaining",
+                   "mxtpu_slo_alert_firing", "mxtpu_slo_events_total",
+                   "mxtpu_requests_total", "mxtpu_request_latency_ms"):
+        assert family in text, family
+    rep = promcheck.report(text, path="live-scrape")
+    assert rep["ok"], rep["findings"]

@@ -189,13 +189,17 @@ class HttpTransport:
         self._timeout = (float(timeout_s) if timeout_s is not None
                          else _env("MXTPU_LOADGEN_TIMEOUT_S"))
 
-    def send(self, request_id):
+    def send(self, request_id, tenant=None):
         """Fire one predict; returns the HTTP status (TRANSPORT_ERROR for
-        refused/reset/timeout)."""
-        req = urllib.request.Request(
-            self._predict_url, data=self._body,
-            headers={"Content-Type": "application/json",
-                     "X-Request-Id": request_id})
+        refused/reset/timeout). ``tenant`` (from the --tenants weighted
+        mix) rides the X-MXTPU-Tenant header so the server's per-tenant
+        accounting splits the soak."""
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": request_id}
+        if tenant is not None:
+            headers["X-MXTPU-Tenant"] = tenant
+        req = urllib.request.Request(self._predict_url, data=self._body,
+                                     headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 r.read()
@@ -223,6 +227,15 @@ class HttpTransport:
         """GET /debug/spans JSONL, or ''."""
         try:
             return self._get("/debug/spans")
+        except Exception:
+            return ""
+
+    def slo(self):
+        """GET /debug/slo JSON text, or '' — the between-stage SLO
+        snapshot (budget remaining, burn rates, alert states) the stage
+        reports carry as a trajectory."""
+        try:
+            return self._get("/debug/slo")
         except Exception:
             return ""
 
@@ -257,14 +270,14 @@ class InProcessTransport:
         self._timeout = (float(timeout_s) if timeout_s is not None
                          else _env("MXTPU_LOADGEN_TIMEOUT_S"))
 
-    def send(self, request_id):
+    def send(self, request_id, tenant=None):
         from incubator_mxnet_tpu.serving import batcher as _batcher
         from incubator_mxnet_tpu.serving.registry import ModelNotFoundError
         try:
             self._registry.predict(self._model, self._item,
                                    deadline_ms=self._deadline_ms,
                                    timeout=self._timeout,
-                                   request_id=request_id)
+                                   request_id=request_id, tenant=tenant)
             return 200
         except _batcher.QueueFullError:
             return 429
@@ -291,6 +304,17 @@ class InProcessTransport:
         except Exception:
             return ""
 
+    def slo(self):
+        """The same /debug/slo payload the HTTP route serves, read
+        straight off the process-wide SLO registry. NB: per-tenant/SLO
+        accounting lives in the HTTP front-end, so an in-process soak
+        only sees SLO movement when something else feeds the ledger."""
+        from incubator_mxnet_tpu.telemetry import slo as _slo
+        try:
+            return json.dumps(_slo.REGISTRY.describe())
+        except Exception:
+            return ""
+
 
 class _MonotonicClock:
     """The real clock: monotonic now() + time.sleep."""
@@ -305,13 +329,18 @@ class _MonotonicClock:
 # --------------------------------------------------------------- summarizing
 def summarize_stage(stage_cfg, n_offered, results, span_text="",
                     prom_before=None, prom_after=None,
-                    scrape_window_s=None):
+                    scrape_window_s=None, slo_text=""):
     """One stage's report entry from raw per-request results.
 
     ``results``: [{"rid", "status", "latency_ms"}, ...] for every arrival
-    (CLIENT_DROPPED status for arrivals shed by the in-flight bound).
+    (CLIENT_DROPPED status for arrivals shed by the in-flight bound; a
+    ``tenant`` key when a --tenants mix is configured — those stages
+    additionally carry per-tenant offered/goodput/latency columns).
     ``span_text``: /debug/spans JSONL scraped AFTER the stage — spans are
     joined by the request ids this stage generated.
+    ``slo_text``: /debug/slo JSON scraped AFTER the stage — parsed into
+    the stage's ``slo`` entry, so a ramp's report carries the
+    budget/burn-rate trajectory alongside its latency one.
     ``scrape_window_s``: wall time between the two /metrics scrapes,
     reported as ``server.metrics.mfu_window_s``. It is NOT the MFU
     denominator (that is the chip-seconds delta, topology-exact); it is
@@ -360,11 +389,48 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
         "latency_ms": _pctls(ok_lat),
         "latency_all_ms": _pctls(all_lat),
     }
+    tenants = _tenant_columns(results, duration)
+    if tenants:
+        out["tenants"] = tenants
+    if slo_text:
+        try:
+            out["slo"] = json.loads(slo_text)
+        except ValueError:
+            out["slo"] = None
     out["server"] = _join_spans(rids, ok_rids, span_text)
     if prom_before is not None and prom_after is not None:
         window = scrape_window_s if scrape_window_s else duration
         out["server"]["metrics"] = _metrics_delta(prom_before, prom_after,
                                                   duration_s=window)
+    return out
+
+
+def _tenant_columns(results, duration):
+    """Per-tenant offered/goodput/shed/latency breakdown of one stage's
+    results ({} when no result carries a tenant — the mix was not
+    configured)."""
+    groups = {}
+    for r in results:
+        t = r.get("tenant")
+        if t is None:
+            continue
+        groups.setdefault(t, []).append(r)
+    out = {}
+    for t, rs in sorted(groups.items()):
+        ok_lat = [r["latency_ms"] for r in rs if r["status"] == 200]
+        shed = sum(1 for r in rs if r["status"] in (429, 504))
+        errors = sum(1 for r in rs if r["status"] not in
+                     (200, 429, 504, CLIENT_DROPPED))
+        out[t] = {
+            "offered": len(rs),
+            "ok": len(ok_lat),
+            "goodput_rps": len(ok_lat) / duration if duration else 0.0,
+            "shed": shed,
+            "errors": errors,
+            "client_dropped": sum(1 for r in rs
+                                  if r["status"] == CLIENT_DROPPED),
+            "latency_ms": _pctls(ok_lat),
+        }
     return out
 
 
@@ -541,7 +607,7 @@ class LoadGen:
 
     def __init__(self, transport, stages, arrival="poisson", seed=None,
                  max_clients=None, clock=None, settle_s=0.25, run_id=None,
-                 deadline_ms=None):
+                 deadline_ms=None, tenants=None):
         self.transport = transport
         self.stages = [{"rps": float(s["rps"]),
                         "duration_s": float(s["duration_s"])}
@@ -549,6 +615,17 @@ class LoadGen:
         if not self.stages:
             raise ValueError("need at least one stage")
         self.arrival = arrival
+        # weighted tenant mix: each arrival carries one tenant name drawn
+        # deterministically (own seeded RNG, so adding --tenants never
+        # perturbs the arrival schedule itself)
+        self.tenants = None
+        if tenants:
+            norm = [(str(n), float(w)) for n, w in
+                    (tenants.items() if isinstance(tenants, dict)
+                     else tenants)]
+            if any(w <= 0 for _n, w in norm):
+                raise ValueError("tenant weights must be > 0: %r" % (norm,))
+            self.tenants = norm
         self.seed = int(seed if seed is not None
                         else _env("MXTPU_LOADGEN_SEED"))
         self.max_clients = int(max_clients if max_clients is not None
@@ -564,36 +641,61 @@ class LoadGen:
         self._results = []            # per-request dicts, all stages
 
     # ------------------------------------------------------------- workers
+    def _send(self, rid, tenant):
+        """One transport send; a None tenant calls the legacy one-arg
+        form so transports (and test fakes) without tenant support keep
+        working unchanged."""
+        if tenant is None:
+            return self.transport.send(rid)
+        return self.transport.send(rid, tenant)
+
     def _worker(self, q):
         while True:
             item = q.get()
             if item is None:
                 return
-            stage_idx, rid = item
+            stage_idx, rid, tenant = item
             t0 = self.clock.now()
             try:
-                status = self.transport.send(rid)
+                status = self._send(rid, tenant)
             except Exception:  # a raising transport is a transport error
                 status = TRANSPORT_ERROR
             lat = (self.clock.now() - t0) * 1e3
             with self._lock:
                 self._inflight -= 1
                 self._results.append({"stage": stage_idx, "rid": rid,
-                                      "status": status, "latency_ms": lat})
+                                      "tenant": tenant, "status": status,
+                                      "latency_ms": lat})
 
-    def _record_sync(self, stage_idx, rid):
+    def _record_sync(self, stage_idx, rid, tenant):
         t0 = self.clock.now()
         try:
-            status = self.transport.send(rid)
+            status = self._send(rid, tenant)
         except Exception:
             status = TRANSPORT_ERROR
         lat = (self.clock.now() - t0) * 1e3
         self._results.append({"stage": stage_idx, "rid": rid,
-                              "status": status, "latency_ms": lat})
+                              "tenant": tenant, "status": status,
+                              "latency_ms": lat})
 
     # -------------------------------------------------------------- driving
+    def _pick_tenant(self, rng):
+        """One weighted draw from the tenant mix (None when no mix)."""
+        if self.tenants is None:
+            return None
+        total = sum(w for _n, w in self.tenants)
+        x = rng.random() * total
+        for name, w in self.tenants:
+            x -= w
+            if x < 0:
+                return name
+        return self.tenants[-1][0]
+
     def _drive_stage(self, idx, stage, q, sync):
         rng = random.Random(self.seed * 1000003 + idx)
+        # separate stream for tenant draws: the arrival schedule stays
+        # byte-identical with and without a --tenants mix
+        tenant_rng = random.Random(self.seed * 9176 + idx * 31 + 7)
         offsets = arrival_offsets(self.arrival, stage["rps"],
                                   stage["duration_s"], rng)
         t0 = self.clock.now()
@@ -602,21 +704,22 @@ class LoadGen:
             if delay > 0:
                 self.clock.sleep(delay)
             rid = "lg-%s-s%d-%d" % (self.run_id, idx, seq)
+            tenant = self._pick_tenant(tenant_rng)
             if sync:
-                self._record_sync(idx, rid)
+                self._record_sync(idx, rid, tenant)
                 continue
             with self._lock:
                 admit = self._inflight < self.max_clients
                 if admit:
                     self._inflight += 1
             if admit:
-                q.put((idx, rid))
+                q.put((idx, rid, tenant))
             else:
                 # open-loop honesty: the arrival happened; the client
                 # could not carry it — recorded, not silently skipped
                 with self._lock:
                     self._results.append(
-                        {"stage": idx, "rid": rid,
+                        {"stage": idx, "rid": rid, "tenant": tenant,
                          "status": CLIENT_DROPPED, "latency_ms": 0.0})
         return len(offsets)
 
@@ -660,6 +763,10 @@ class LoadGen:
                     # let worker-side telemetry of the final batch land
                     self.clock.sleep(self.settle_s)
                 span_text = self.transport.spans()
+                # between-stage SLO snapshot (transport-optional: fakes
+                # and older transports without .slo() degrade to none)
+                slo_fn = getattr(self.transport, "slo", None)
+                slo_text = slo_fn() if slo_fn is not None else ""
                 prom_after = parse_prom(self.transport.scrape())
                 now = self.clock.now()
                 with self._lock:
@@ -669,7 +776,7 @@ class LoadGen:
                     prom_before, prom_after,
                     # the counters cover scrape→scrape (drain + settle
                     # included), so the MFU denominator must too
-                    scrape_window_s=now - t_scrape))
+                    scrape_window_s=now - t_scrape, slo_text=slo_text))
                 prom_before = prom_after
                 t_scrape = now
         finally:
@@ -684,6 +791,7 @@ class LoadGen:
             "config": {"arrival": self.arrival, "seed": self.seed,
                        "max_clients": self.max_clients,
                        "deadline_ms": self.deadline_ms,
+                       "tenants": self.tenants,
                        "stages": self.stages},
             "wall_s": wall_s,
             "stages": summaries,
@@ -768,6 +876,21 @@ def _parse_stages(text):
     return stages
 
 
+def _parse_tenants(text):
+    """'alice:3,bob:1' -> [("alice", 3.0), ("bob", 1.0)]; a bare name
+    weighs 1. None/empty -> None (no tenant mix)."""
+    if not text:
+        return None
+    out = []
+    for part in text.split(","):
+        name, sep, weight = part.strip().partition(":")
+        if not name:
+            raise ValueError("bad tenant %r (want NAME or NAME:WEIGHT)"
+                             % part)
+        out.append((name, float(weight) if sep else 1.0))
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python tools/loadgen.py",
@@ -787,6 +910,12 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=None,
                     help="arrival RNG seed (default: MXTPU_LOADGEN_SEED)")
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--tenants", default=None,
+                    help="weighted tenant mix as NAME:WEIGHT comma list "
+                         "(e.g. alice:3,bob:1; bare NAME weighs 1) — "
+                         "each arrival carries one X-MXTPU-Tenant drawn "
+                         "from the mix, and stage reports gain "
+                         "per-tenant columns")
     ap.add_argument("--max-clients", type=int, default=None,
                     help="in-flight bound (default: "
                          "MXTPU_LOADGEN_MAX_CLIENTS)")
@@ -802,7 +931,8 @@ def main(argv=None):
                               deadline_ms=args.deadline_ms)
     lg = LoadGen(transport, _parse_stages(args.stages),
                  arrival=args.arrival, seed=args.seed,
-                 max_clients=args.max_clients, deadline_ms=args.deadline_ms)
+                 max_clients=args.max_clients, deadline_ms=args.deadline_ms,
+                 tenants=_parse_tenants(args.tenants))
     report = lg.run()
     out_path = args.out or "<stdout>"
     if args.out:
@@ -821,6 +951,12 @@ def main(argv=None):
                   % (i, s["offered_rps"], s["goodput_rps"],
                      s["latency_ms"]["p50"], s["latency_ms"]["p99"],
                      100 * s["shed_rate"], s["errors"]))
+            for t, tc in sorted(s.get("tenants", {}).items()):
+                print("  tenant %-12s offered %4d, goodput %.0f rps, "
+                      "p50/p99 %s/%s ms, shed %d"
+                      % (t, tc["offered"], tc["goodput_rps"],
+                         tc["latency_ms"]["p50"], tc["latency_ms"]["p99"],
+                         tc["shed"]))
         sat = report["saturation"]
         print("saturation: %s" % (
             "stage %d (%.0f rps offered, %.0f goodput, %s)"
