@@ -8,14 +8,26 @@
 # Stages:
 #   lint    - syntax walk over every python file (compileall) + the
 #             framework-aware static-analysis gate (tools/mxtpulint/:
-#             per-file rules R001-R008 plus the whole-program passes —
-#             lock-order cycles, cross-thread shared state, jit-retrace
-#             hazards, call-graph-aware hot-path syncs — over
-#             incubator_mxnet_tpu, with tools/ and tests/ under the
+#             per-file rules R001-R008 + R012 plus the whole-program
+#             passes — lock-order cycles, cross-thread shared state,
+#             jit-retrace hazards, call-graph-aware hot-path syncs —
+#             over incubator_mxnet_tpu, with tools/ and tests/ under the
 #             relaxed R003/R005/R006 profile) — hard fail on any
 #             non-baselined finding, on a >30s wall time, and on the
-#             seeded-defect canary (testdata/seeded_defects.py must
-#             yield exactly one R009 + one R010 + one R011)
+#             seeded-defect canary (the testdata fixtures must yield
+#             exactly the seven seeded findings)
+#   hlolint - compiled-artifact static analysis (tools/hlolint/): trace
+#             the serving-shaped programs the repo actually runs (fp32
+#             dense eval buckets + a native-int8 quantized net) into a
+#             fresh MXTPU_AOT_CACHE_DIR and gate the resulting
+#             jax.export StableHLO artifacts through the H-rules with
+#             the EMPTY committed baseline; then the seeded-defect
+#             canary (one fp64 serve program + one donation-less train
+#             module, tools/hlolint/canary.py) must fire exactly
+#             H001+H002; finally the one-parser aggregation: the
+#             mxtpulint / promcheck / hlolint --json reports are merged
+#             into a single per-run artifact and asserted to share the
+#             exact report shape
 #   native  - rebuild libmxtpu.so + libmxtpu_predict.so from src, then a
 #             TSAN (-fsanitize=thread) compile of the native layer (the
 #             race-detection build the TSAN test also uses; ref ASAN job)
@@ -84,7 +96,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint native suite serving aot observability devstats loadgen slo sharded diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats loadgen slo sharded diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -113,18 +125,116 @@ print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
   # Seeded-defect canary: the whole-program passes must still FIRE. The
   # fixtures hold one known deadlock cycle, one unlocked cross-thread
   # write, one jax.jit retrace hazard, one AOT-boundary retrace hazard
-  # (aot.compile_cached), one host-device sync in the replica dispatch
-  # hot path, and one per-dispatch XLA cost_analysis walk in the
-  # servable-call hot path (seeded_batcher.py, HOT_PATH_PATTERNS +
-  # device-truth R001 sub-rule coverage); full-profile analysis rooted
-  # at the fixture dir must report exactly those six.
+  # (aot.compile_cached), one donation-less train-step jit (R012 — the
+  # source-side mirror of hlolint H002), one host-device sync in the
+  # replica dispatch hot path, and one per-dispatch XLA cost_analysis
+  # walk in the servable-call hot path (seeded_batcher.py,
+  # HOT_PATH_PATTERNS + device-truth R001 sub-rule coverage);
+  # full-profile analysis rooted at the fixture dir must report exactly
+  # those seven.
   python - <<'EOF'
 from tools.mxtpulint import analyze
 found = sorted(f.rule for f in analyze(["tools/mxtpulint/testdata"],
                                        root="tools/mxtpulint/testdata"))
-assert found == ["R001", "R001", "R009", "R010", "R011", "R011"], found
+assert found == ["R001", "R001", "R009", "R010", "R011", "R011",
+                 "R012"], found
 print("seeded-defect canary OK: %s" % ", ".join(found))
 EOF
+fi
+
+if has_stage hlolint; then
+  echo "=== hlolint: compiled StableHLO artifact gate + seeded canary + one-parser aggregation ==="
+  hl_t0=$SECONDS
+  HL_DIR=$(mktemp -d -t mxtpu_hlolint.XXXXXX)
+  # 1) Real artifacts, green with the EMPTY committed baseline: trace the
+  # serving-shaped programs the repo actually runs — fp32 dense eval at
+  # the default bucket ladder AND a native-int8 quantized net (whose i8
+  # dot_general is the H006 negative: real int8 stays clean) — into a
+  # fresh cache dir, then the gate must exit 0 with zero findings.
+  JAX_PLATFORMS=cpu MXTPU_AOT_CACHE_DIR="$HL_DIR/cache" python - <<'EOF'
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, jit, nd
+from incubator_mxnet_tpu.contrib import quantization
+
+mx.random.seed(0)
+net = gluon.nn.Dense(8, in_units=16)
+net.initialize(mx.init.Xavier())
+for b in (1, 2, 4):
+    jit.EvalStep(net)(nd.ones((b, 16)))
+qsrc = gluon.nn.HybridSequential()
+qsrc.add(gluon.nn.Dense(8, in_units=16))
+qsrc.initialize(mx.init.Xavier())
+qnet = quantization.quantize_net(qsrc, calib_data=[nd.ones((4, 16))])
+jit.EvalStep(qnet)(nd.ones((4, 16)))
+print("traced 4 real artifacts (3 fp32 buckets + 1 native-int8)")
+EOF
+  JAX_PLATFORMS=cpu python -m tools.hlolint "$HL_DIR/cache" --json --timing \
+      > "$HL_DIR/hlolint.json" \
+    || { JAX_PLATFORMS=cpu python -m tools.hlolint "$HL_DIR/cache" || true
+         exit 1; }
+  python -c "import json,sys; r=json.load(open(sys.argv[1])); \
+assert r['ok'] and r['findings'] == [] and r['baselined'] == 0, r; \
+print('hlolint OK on real artifacts (empty baseline, 0 findings)')" \
+      "$HL_DIR/hlolint.json"
+  # 2) Seeded-defect canary: the H-passes must still FIRE. One fp64
+  # serve program and one donation-less train module must report exactly
+  # H001 + H002 — anything else (more, fewer, different) hard-fails.
+  JAX_PLATFORMS=cpu python -m tools.hlolint.canary "$HL_DIR/canary"
+  if JAX_PLATFORMS=cpu python -m tools.hlolint "$HL_DIR/canary" \
+      --no-baseline --json > "$HL_DIR/canary.json"; then
+    echo "hlolint canary FAILED: seeded defects passed the gate"
+    exit 1
+  fi
+  python - "$HL_DIR/canary.json" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+rules = sorted(f["rule"] for f in rep["findings"])
+assert rules == ["H001", "H002"], rules
+assert rep["counts"] == {"H001": 1, "H002": 1}, rep["counts"]
+print("hlolint seeded-defect canary OK: %s" % ", ".join(rules))
+EOF
+  # 3) One-parser aggregation: all three analyzers' --json reports into
+  # a single per-run artifact, asserting the shared report shape
+  # (tool/ok/findings/counts/baselined; findings path/line/rule/message)
+  # so a downstream consumer can keep using ONE parser for every gate.
+  # The lint stage's report is reused when it ran in this invocation
+  # (the project-wide analysis costs up to its 30s budget); a standalone
+  # `ci/run.sh hlolint` computes it fresh.
+  if [ -n "${LINT_JSON:-}" ] && [ -s "${LINT_JSON:-}" ]; then
+    cp "$LINT_JSON" "$HL_DIR/mxtpulint.json"
+  else
+    python -m tools.mxtpulint incubator_mxnet_tpu tools tests --json \
+        > "$HL_DIR/mxtpulint.json"
+  fi
+  JAX_PLATFORMS=cpu python -c "import incubator_mxnet_tpu as mx; \
+from incubator_mxnet_tpu import telemetry; \
+open('$HL_DIR/metrics.prom', 'w').write(telemetry.export_text())"
+  python tools/promcheck.py "$HL_DIR/metrics.prom" --json \
+      > "$HL_DIR/promcheck.json"
+  python - "$HL_DIR" <<'EOF'
+import json, os, sys
+hl_dir = sys.argv[1]
+reports = [json.load(open(os.path.join(hl_dir, n)))
+           for n in ("mxtpulint.json", "promcheck.json", "hlolint.json")]
+keys = {"tool", "ok", "findings", "counts", "baselined"}
+f_keys = {"path", "line", "rule", "message"}
+for rep in reports:
+    assert set(rep) == keys, (rep.get("tool"), sorted(rep))
+    for f in rep["findings"]:
+        assert set(f) == f_keys, (rep["tool"], sorted(f))
+    assert rep["ok"], (rep["tool"], rep["findings"])
+merged = {"schema": "mxtpu-lint-aggregate-v1",
+          "ok": all(r["ok"] for r in reports),
+          "reports": {r["tool"]: r for r in reports}}
+out = os.path.join(hl_dir, "lint_reports.json")
+with open(out, "w") as f:
+    json.dump(merged, f, indent=1, sort_keys=True)
+print("one-parser aggregation OK: %s (%s)"
+      % (out, ", ".join(sorted(merged["reports"]))))
+EOF
+  hl_dt=$(( SECONDS - hl_t0 ))
+  echo "hlolint stage wall time: ${hl_dt}s (budget 120s)"
+  [ "$hl_dt" -lt 120 ] || { echo "hlolint stage took ${hl_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage native; then

@@ -70,7 +70,7 @@ from .telemetry import devstats, spans
 
 __all__ = ["CacheKey", "cache_key", "AOTCache", "CACHE", "compile_cached",
            "model_id_for", "input_signature", "mesh_sig", "artifact_path",
-           "ARTIFACT_MAGIC", "FORMAT_VERSION"]
+           "ARTIFACT_MAGIC", "FORMAT_VERSION", "collect_inserts"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -280,6 +280,29 @@ class _Entry:
         self.last_used = self.created
 
 
+_collector = threading.local()
+
+
+class collect_inserts:
+    """Record every cache entry THIS THREAD inserts while the context is
+    active. The serving registry wraps each prewarm bucket's warm
+    dispatches in one so the hlolint load gate can lint exactly the
+    programs the warm just produced (build or artifact load) before it
+    repoints traffic at them — no cache-wide diffing, no cross-thread
+    attribution guesswork (warm dispatches run on the one warm thread).
+    Nests: the inner context collects; the outer resumes afterwards."""
+
+    def __enter__(self):
+        self._prev = getattr(_collector, "sink", None)
+        self.entries = []
+        _collector.sink = self.entries
+        return self.entries
+
+    def __exit__(self, *exc):
+        _collector.sink = self._prev
+        return False
+
+
 class AOTCache:
     """Thread-safe LRU map CacheKey -> _Entry (the process-wide instance
     is ``aot.CACHE``). Lookups touch last_used; inserts evict
@@ -325,6 +348,9 @@ class AOTCache:
             # (lock order cache->gauge matches _unpublish_locked)
             if stats:
                 _publish_program_stats(key, stats)
+        sink = getattr(_collector, "sink", None)
+        if sink is not None:
+            sink.append(entry)
         return entry
 
     def _evict_locked(self):

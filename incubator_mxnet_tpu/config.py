@@ -244,6 +244,34 @@ ENV_VARS = {
         "unhandled exception kills the main thread or a worker thread "
         "(sys/threading excepthook chain installed at package import). "
         "Only fires when the tape is non-empty."),
+    "MXTPU_HLOLINT_GATE": (
+        bool, True,
+        "Lint freshly prewarmed serve/eval AOT artifacts (tools/hlolint "
+        "H-rules over the persisted StableHLO modules) inside "
+        "ModelRegistry.load()'s warm path, BEFORE dispatch cuts over to "
+        "the incoming version: error-severity findings (fp64 leak, host "
+        "round-trip, predicted HBM overrun, corrupt artifact) refuse the "
+        "cutover with a degraded reason in describe()/health(); warns "
+        "land in flightrec + mxtpu_hlolint_findings_total{rule}. Only "
+        "artifacts are linted, so loads without MXTPU_AOT_CACHE_DIR (or "
+        "without prewarm) skip the gate (docs/STATIC_ANALYSIS.md)."),
+    "MXTPU_HLOLINT_HBM_BUDGET": (
+        float, None,
+        "Per-device HBM budget in BYTES the hlolint H004 rule compares "
+        "each artifact's header peak_bytes (memory_analysis, persisted "
+        "at export) against — a program predicted to overrun is rejected "
+        "before deploy instead of OOMing after cutover. Unset: the "
+        "devstats per-device-kind capacity table "
+        "(telemetry/devstats.py hbm_capacity()); backends the table "
+        "doesn't know (CPU) skip H004 entirely."),
+    "MXTPU_HLOLINT_PAD_WASTE": (
+        float, 0.5,
+        "hlolint H005 threshold: flag a compiled shape bucket whose "
+        "worst-fit padded batch wastes more than this fraction of its "
+        "compute relative to the next smaller compiled bucket "
+        "((b - (b'+1))/b across the artifact set's bucket ladder). The "
+        "default 0.5 keeps power-of-two ladders (worst case 37.5%) "
+        "clean and fires on gap-toothed ladders like {1, 64}."),
     "MXTPU_WATCHDOG": (
         bool, False,
         "Autostart the stall watchdog monitor thread at package import "

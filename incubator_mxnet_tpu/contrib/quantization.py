@@ -297,8 +297,13 @@ def _make_quantized_classes():
         def forward(self, x):
             if self._native:
                 return self._forward_native(x)
-            xq, xmin, xmax = quantize(x, self._cmin, self._cmax)
-            x_deq = dequantize(xq, xmin, xmax)
+            xq, _xmin, _xmax = quantize(x, self._cmin, self._cmax)
+            # dequantize with the calibration FLOATS, not the NDArray
+            # wrappers quantize() returns: the wrapper form round-trips
+            # through .asnumpy(), which is a TracerArrayConversionError
+            # under a jit trace — this QDQ branch must stay servable
+            # (EvalStep/BlockServable compile it), not eager-only
+            x_deq = dequantize(xq, self._cmin, self._cmax)
             arr = self._conv.weight.data()   # the live NDArray wrapper
             saved = arr._data
             arr._data = self._w_deq._data

@@ -99,6 +99,7 @@ class _ModelEntry:
         self._replica_aware = {}        # version -> predict_batch(replica=)?
         self._warming = 0               # active prewarm threads (describe)
         self._warm_target = None        # only THIS version may repoint()
+        self._degraded = None           # hlolint refusal reason (describe)
         self.batcher = DynamicBatcher(self._dispatch, name=name,
                                       metrics=self.metrics, **batcher_kw)
 
@@ -164,6 +165,7 @@ class _ModelEntry:
             # a direct install supersedes any in-flight warm: its stale
             # repoint()s must not drag dispatch back to an older version
             self._warm_target = version
+            self._degraded = None
             return version
 
     def add_version(self, servable, version):
@@ -184,6 +186,7 @@ class _ModelEntry:
             self._replica_aware[version] = \
                 _accepts_replica(servable.predict_batch)
             self._warm_target = version
+            self._degraded = None
             if self.current_version is None:
                 self.current_version = version
             return version
@@ -215,44 +218,166 @@ class _ModelEntry:
         Always leaves dispatch repointed — a warm failure degrades to the
         old lazy-compile behavior, never to an unroutable model."""
         import numpy as onp
+        from .. import aot
         aware = _accepts_replica(servable.predict_batch)
         n_rep = self.batcher.replicas if aware else 1
         with self._lock:
             self._warming += 1
+        warmed_programs = []
         try:
             for b in sorted(set(self.batcher.buckets)):
+                fresh = []
                 try:
                     synth = [onp.zeros((b,) + tuple(shape),
                                        dtype=onp.dtype(dt))
                              for shape, dt in item_sig]
-                    for r in range(n_rep):
-                        with spans.span("aot:warm", model=self.name,
-                                        version=version, bucket=b,
-                                        replica=r):
-                            if aware:
-                                servable.predict_batch(*synth, replica=r)
-                            else:
-                                servable.predict_batch(*synth)
-                        try:
-                            self.metrics.inc("prewarm_count")
-                        except Exception:
-                            _LOG.debug("prewarm_count update failed",
-                                       exc_info=True)
+                    with aot.collect_inserts() as fresh:
+                        for r in range(n_rep):
+                            with spans.span("aot:warm", model=self.name,
+                                            version=version, bucket=b,
+                                            replica=r):
+                                if aware:
+                                    servable.predict_batch(*synth,
+                                                           replica=r)
+                                else:
+                                    servable.predict_batch(*synth)
+                            try:
+                                self.metrics.inc("prewarm_count")
+                            except Exception:
+                                _LOG.debug("prewarm_count update failed",
+                                           exc_info=True)
                 except Exception:
                     # the incoming model may not accept the observed
                     # signature at all (input shape changed): stop warming
                     # but still swap — first dispatch compiles lazily,
-                    # exactly the pre-AOT behavior
+                    # exactly the pre-AOT behavior. Anything the partial
+                    # warm DID insert (e.g. replica 0's compile before
+                    # replica 1 raised) is still gated: the finally's
+                    # repoint must not cut over an ungated error-severity
+                    # artifact.
                     _LOG.warning(
                         "prewarm of model %r v%s bucket %d failed; "
                         "remaining buckets will compile on first dispatch",
                         self.name, version, b, exc_info=True)
+                    if not self._hlolint_gate(version, fresh,
+                                              warmed_programs):
+                        return
                     break
+                # hlolint load gate: the bucket's freshly compiled/loaded
+                # artifacts are linted BEFORE dispatch is repointed at
+                # them — an error-severity finding (fp64 leak, host
+                # round-trip, predicted HBM overrun) refuses the cutover
+                # and drops the version (the finally's repoint() then
+                # no-ops: the version is gone). A refusal on a LATER
+                # bucket rolls back a version already serving its earlier
+                # buckets — _hlolint_gate logs which case happened.
+                if not self._hlolint_gate(version, fresh, warmed_programs):
+                    return
                 self.repoint(version)
+            self._hlolint_cross(warmed_programs)
         finally:
             self.repoint(version)
             with self._lock:
                 self._warming -= 1
+
+    def _hlolint_gate(self, version, entries, collect=None):
+        """Lint one warmed bucket's new AOT entries (tools/hlolint via
+        their persisted artifacts). Returns False — after unrouting and
+        dropping ``version`` with a loud degraded reason — when an
+        error-severity finding means this compiled program must not take
+        traffic; True (including on any gate-infrastructure failure:
+        the gate must never break a load it cannot judge) otherwise.
+        ``collect`` accumulates the parsed Programs so the cross-program
+        pass after the full warm never re-deserializes the artifacts.
+
+        Each bucket is gated before ITS repoint, but earlier buckets'
+        repoints have already happened — a refusal on a later bucket is
+        therefore a ROLLBACK (the version served traffic on its earlier
+        buckets while this one warmed), and the log says which case
+        occurred. The version drop uses the unload(drain=False)
+        mechanics: in-flight dispatches on the dropped version still
+        deliver their results (_dispatch tolerates a popped _inflight
+        slot)."""
+        if not entries:
+            return True
+        try:
+            if not config.get_env("MXTPU_HLOLINT_GATE"):
+                return True
+            from tools.hlolint import gate as hlogate
+        except ImportError:
+            return True         # tools-less install: no gate to run
+        try:
+            errors, warns = hlogate.lint_entries(entries, collect=collect)
+            hlogate.publish(errors + warns, model=self.name)
+        except Exception:
+            # fail open, but LOUDLY: a broken gate means error-severity
+            # artifacts cut over unjudged from here on
+            _LOG.warning("hlolint gate failed open for model %r — "
+                         "artifacts are cutting over UNLINTED",
+                         self.name, exc_info=True)
+            return True
+        if not errors:
+            return True
+        reason = "; ".join("%s %s: %s" % (f.rule, f.path, f.message)
+                           for f in errors[:3])
+        # evict the refused executables from the process-wide cache: a
+        # retried load must recompile (or re-load the artifact), which
+        # re-inserts and therefore re-gates — a warm cache HIT collects
+        # nothing and would cut the refused program over ungated
+        from .. import aot
+        for entry in entries:
+            try:
+                aot.CACHE.discard(entry.key)
+            except Exception:
+                _LOG.debug("refused-entry cache eviction failed",
+                           exc_info=True)
+        with self._lock:
+            was_current = self.current_version == version
+            self.versions.pop(version, None)
+            self._replica_aware.pop(version, None)
+            self._inflight.pop(version, None)
+            self._degraded = reason
+            if was_current:
+                self.current_version = (max(self.versions)
+                                        if self.versions else None)
+        _LOG.error(
+            "model %r v%s REFUSED by hlolint (%d error finding(s)) — %s: "
+            "%s",
+            self.name, version, len(errors),
+            "dispatch ROLLED BACK (the version was already current — a "
+            "first load, or earlier buckets cut over — while warming "
+            "continued)"
+            if was_current else "dispatch was NOT cut over",
+            reason)
+        try:
+            from ..telemetry import flightrec
+            flightrec.record("hlolint_refused", model=self.name,
+                             version=version, reason=reason,
+                             rolled_back=was_current)
+        except Exception:
+            _LOG.debug("hlolint_refused flightrec record dropped",
+                       exc_info=True)
+        return False
+
+    def _hlolint_cross(self, programs):
+        """The cross-program pass (H005 needs the whole bucket ladder) —
+        warn-only by construction, runs once after the full warm over the
+        Programs the per-bucket gates already parsed (no second
+        deserialize of the same artifacts)."""
+        if not programs:
+            return
+        try:
+            if not config.get_env("MXTPU_HLOLINT_GATE"):
+                return
+            from tools.hlolint import gate as hlogate
+        except ImportError:
+            return
+        try:
+            hlogate.publish(hlogate.lint_programs_set(programs),
+                            model=self.name)
+        except Exception:
+            _LOG.warning("hlolint cross-program pass failed for model %r",
+                         self.name, exc_info=True)
 
     def drop(self, version, drain, timeout, wait_queue_empty=False):
         """Remove one version. With a successor available, dispatch is
@@ -303,6 +428,7 @@ class _ModelEntry:
                     "current_version": self.current_version,
                     "slos": slos,
                     "warming": self._warming > 0,
+                    "degraded": self._degraded,
                     "queue_depth": self.batcher.queue_depth(),
                     "queue_size": self.batcher.queue_size,
                     "replicas": self.batcher.replicas,
@@ -484,6 +610,14 @@ class ModelRegistry:
                 return {"status": "degraded",
                         "reason": "queue >= 80%% for model %r" % e.name,
                         "queue_depth": e.batcher.queue_depth()}
+        for e in entries:
+            if e._degraded:
+                # the last load's compiled program was refused by the
+                # hlolint gate: serving continues on the previous version
+                # (or 404s on a first load), but the operator must see it
+                return {"status": "degraded",
+                        "reason": "model %r load refused by hlolint: %s"
+                                  % (e.name, e._degraded)}
         for e in entries:
             dead = e.batcher.dead_replicas()
             if dead:

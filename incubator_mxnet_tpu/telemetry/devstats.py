@@ -66,7 +66,7 @@ from .registry import counter, gauge
 __all__ = ["program_stats", "peaks", "observe_dispatch", "dispatch_context",
            "start", "stop", "running", "sample_now", "device_memory",
            "set_memory_source", "capture_profile", "ProfileCaptureBusy",
-           "PEAK_TABLE", "reset_peaks"]
+           "PEAK_TABLE", "reset_peaks", "HBM_TABLE", "hbm_capacity"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -88,6 +88,48 @@ PEAK_TABLE = {
 #: accelerators): utilization gauges stay live and internally consistent
 #: but are NOT meaningful against hardware peaks (peaks()[2] == "fallback")
 _FALLBACK_PEAKS = (1e12, 100e9)
+
+#: device_kind prefix -> per-chip HBM CAPACITY in bytes (spec sheets —
+#: the capacity companion of PEAK_TABLE's rate numbers). Consumed by the
+#: hlolint H004 gate: an artifact whose header peak_bytes exceeds this
+#: is rejected before deploy instead of OOMing after cutover. No
+#: fallback entry on purpose — predicting an OOM against a made-up
+#: capacity would reject valid programs, so unknown kinds (CPU) return
+#: None and the H004 rule skips (MXTPU_HLOLINT_HBM_BUDGET overrides).
+HBM_TABLE = {
+    "TPU v4i": 8e9,
+    "TPU v5 lite": 16e9,
+    "TPU v5e": 16e9,
+    "TPU v4": 32e9,
+    "TPU v5p": 95e9,
+    "TPU v5": 95e9,
+    "TPU v6 lite": 32e9,
+    "TPU v6e": 32e9,
+}
+
+
+def hbm_capacity():
+    """(per-chip HBM bytes, source) for this process's backend: the
+    HBM_TABLE entry keyed on ``jax.devices()[0].device_kind`` (source
+    'table'), or (None, 'unknown') for backends the table doesn't know —
+    callers that would otherwise guess (hlolint H004) must skip
+    instead."""
+    kind = ""
+    try:
+        import jax
+        kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    except Exception:
+        pass
+    # longest prefix wins, so e.g. a v5e chip can never fall through to
+    # the broader "TPU v5" entry regardless of table ordering — and a
+    # prefix hit only counts at a word boundary: an unlisted sub-variant
+    # ("TPU v7x") must come back unknown (H004 skips), never inherit a
+    # bigger sibling's capacity and wave a predicted OOM through
+    for prefix in sorted(HBM_TABLE, key=len, reverse=True):
+        if kind == prefix or (kind.startswith(prefix)
+                              and not kind[len(prefix)].isalnum()):
+            return float(HBM_TABLE[prefix]), "table"
+    return None, "unknown"
 
 
 # --------------------------------------------------------------- program facts

@@ -33,7 +33,7 @@ def rule_ids(findings):
 
 def test_rule_catalog_complete():
     assert {"R001", "R002", "R003", "R004", "R005", "R006",
-            "R007", "R008"} <= set(RULES)
+            "R007", "R008", "R012"} <= set(RULES)
     # the whole-program passes live in their own registry (they need the
     # project index, not one file), R001 appearing in both: the per-file
     # rule covers inline hot-path syncs, the pass covers helpers
@@ -418,6 +418,71 @@ def test_r008_conditional_end_still_flagged(tmp_path):
                 sp.end()             # error path leaks the span
     """)
     assert rule_ids(findings) == ["R008"]
+
+
+# ------------------------------------------------------------------ R012
+def test_r012_train_jit_without_donation(tmp_path):
+    findings = run_snippet(tmp_path, "trainer.py", """
+        import jax
+
+        class TrainStep:
+            def _build(self, step_fn):
+                return jax.jit(step_fn)
+
+        def make_train_step(fn):
+            from jax import jit
+            return jit(fn).lower
+    """)
+    assert rule_ids(findings) == ["R012", "R012"]
+    assert "donate_argnums" in findings[0].message
+
+
+def test_r012_negative_donated_and_non_train(tmp_path):
+    findings = run_snippet(tmp_path, "steps.py", """
+        import jax
+
+        def _donate(argnums):
+            return argnums
+
+        class TrainStep:
+            def _build(self, step_fn):
+                # donated: the canonical jit.py form
+                return jax.jit(step_fn, donate_argnums=_donate((0, 2)))
+
+        class EvalTrainerless:
+            pass
+
+        class EvalStep:
+            def _build(self, fn):
+                return jax.jit(fn)     # eval never donates: not flagged
+
+        def load_artifact(exported):
+            return jax.jit(exported.call)
+
+        def constrain_update(fn):
+            # 'train' only as a substring of 'constrain': not a train step
+            return jax.jit(fn)
+
+        class RestrainedSolver:
+            def _build(self, fn):
+                return jax.jit(fn)
+
+        def train_kernel_numba(fn):
+            # a bare `jit` NOT bound from jax: donation advice is bogus
+            from numba import jit
+            return jit(fn)
+    """)
+    assert "R012" not in rule_ids(findings)
+
+
+def test_r012_donate_argnames_counts_as_donation(tmp_path):
+    findings = run_snippet(tmp_path, "trainer2.py", """
+        import jax
+
+        def build_train_step(fn):
+            return jax.jit(fn, donate_argnames=("params",))
+    """)
+    assert "R012" not in rule_ids(findings)
 
 
 # ----------------------------------------------------------- suppression

@@ -1140,18 +1140,20 @@ def test_r001_interprocedural_depth_is_one(tmp_path):
 
 
 # --------------------------------------------------------- seeded defects
-def test_seeded_defects_exactly_six():
+def test_seeded_defects_exactly_seven():
     """The regression canary: the fixtures contain one deadlock cycle,
     one unlocked cross-thread write, one jax.jit retrace hazard, one
-    AOT-boundary (aot.compile_cached) retrace hazard, one host-device
-    sync in the replica dispatch hot path, and one per-dispatch XLA
-    cost_analysis walk in the servable-call hot path (seeded_batcher.py
-    anchors the ``*batcher:DynamicBatcher._dispatch_replica`` /
-    ``._call_servable`` patterns) — the analyzer must report exactly
-    those six (ci/run.sh asserts the same thing in the lint stage)."""
+    AOT-boundary (aot.compile_cached) retrace hazard, one donation-less
+    train-step jit (R012 — the source mirror of hlolint H002), one
+    host-device sync in the replica dispatch hot path, and one
+    per-dispatch XLA cost_analysis walk in the servable-call hot path
+    (seeded_batcher.py anchors the
+    ``*batcher:DynamicBatcher._dispatch_replica`` / ``._call_servable``
+    patterns) — the analyzer must report exactly those seven (ci/run.sh
+    asserts the same thing in the lint stage)."""
     findings = analyze([SEEDED], root=SEEDED)
     assert rule_ids(findings) == \
-        ["R001", "R001", "R009", "R010", "R011", "R011"], findings
+        ["R001", "R001", "R009", "R010", "R011", "R011", "R012"], findings
 
 
 def test_seeded_replica_defects_are_the_r001s(tmp_path):
@@ -1275,13 +1277,30 @@ def test_new_rules_share_the_ci_json_shape(tmp_path):
     assert rule_ids(findings) == ["R009", "R010", "R011"]
     rep = make_report("mxtpulint", findings)
     ok_rep = promcheck.report("# HELP a doc\n# TYPE a counter\na 1\n")
+    # the third analyzer shares the shape BY CONSTRUCTION (hlolint reuses
+    # mxtpulint.core's Finding/make_report) — assert it anyway so a
+    # refactor that forks the report builder fails here, not in CI's
+    # one-parser aggregation
+    from tools import hlolint
+    prog = hlolint.program_from_text(
+        "jax-0/serve-feedface.mxtpu-aot", "serve",
+        'module @jit_f {\n'
+        '  func.func public @main(%arg0: tensor<4xf64> loc("x"))'
+        ' -> (tensor<4xf64>) {\n'
+        '    %0 = stablehlo.multiply %arg0, %arg0 :'
+        ' (tensor<4xf64>, tensor<4xf64>) -> tensor<4xf64>\n'
+        '    return %0 : tensor<4xf64>\n  }\n}\n')
+    hlo_rep = make_report("hlolint", hlolint.analyze_programs([prog]))
     keys = {"tool", "ok", "findings", "counts", "baselined"}
-    assert set(rep) == keys and set(ok_rep) == keys
+    assert set(rep) == keys and set(ok_rep) == keys \
+        and set(hlo_rep) == keys
     f_keys = {"path", "line", "rule", "message"}
-    for entry in rep["findings"]:
+    for entry in rep["findings"] + hlo_rep["findings"]:
         assert set(entry) == f_keys
     assert rep["counts"] == {"R009": 1, "R010": 1, "R011": 1}
+    assert hlo_rep["counts"] == {"H001": 1}
     json.dumps(rep)                     # serializable end to end
+    json.dumps(hlo_rep)
 
 
 # ------------------------------------------------------------------- CLI

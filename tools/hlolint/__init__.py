@@ -1,0 +1,51 @@
+"""hlolint — static analysis over compiled StableHLO AOT artifacts.
+
+The third analyzer: mxtpulint audits the Python source and promcheck the
+metrics exposition, but the unit of execution and deployment is neither
+— it is the jax.export artifact (aot.py, format v2) the device actually
+runs. These rules decide device-behavior properties on that program
+text, where they are decidable (TVM's program-level IR analysis thesis,
+arXiv 1802.04799; pre-deployment dataflow validation as a production
+requirement, arXiv 1605.08695):
+
+  H000  unreadable/corrupt artifact                        [error]
+  H001  fp64 op in a serve/eval program (x64 leak)         [error]
+  H002  train program with zero input-output aliasing
+        (donation miss — source mirror: mxtpulint R012)    [warn]
+  H003  host round-trip (host-callback custom_call/infeed/
+        outfeed) in a serve/eval program                   [error]
+  H004  predicted peak HBM over the device budget
+        (devstats table / MXTPU_HLOLINT_HBM_BUDGET)        [error]
+  H005  shape bucket wastes >MXTPU_HLOLINT_PAD_WASTE of
+        padded compute vs a tighter bucket                 [warn]
+  H006  int8 upcast to fp ahead of the matmul in a
+        quantized program (the 1.78x->1.27x e2e gap)       [warn]
+
+Three consumers, one engine:
+
+- CLI gate: ``python -m tools.hlolint [MXTPU_AOT_CACHE_DIR] --json``
+  (mxtpulint's exit-code/baseline/report contract, shared parser in CI),
+- registry load gate (serving/registry.py + gate.py): freshly prewarmed
+  artifacts are linted BEFORE dispatch cuts over — error findings refuse
+  the cutover with a loud degraded reason, warns land in flightrec and
+  on ``mxtpu_hlolint_findings_total{rule}``,
+- seeded canary (canary.py, ci/run.sh hlolint): generated defect
+  artifacts must fire exactly H001+H002 or the stage hard-fails.
+
+See docs/STATIC_ANALYSIS.md for the catalog with before/afters and
+docs/AOT.md for the artifact format this reads.
+"""
+from tools.mxtpulint.core import (Finding, apply_baseline, load_baseline,
+                                  make_report, save_baseline)
+
+from .artifact import (ArtifactError, Program, iter_artifact_files,
+                       load_cache_entries, load_dir, program_from_text,
+                       read_program, scan_cache, scan_dir)
+from .rules import (RULES, SET_RULES, SEVERITY, analyze_programs,
+                    severity_of)
+
+__all__ = ["Finding", "ArtifactError", "Program", "RULES", "SET_RULES",
+           "SEVERITY", "analyze_programs", "severity_of", "scan_dir",
+           "scan_cache", "load_dir", "load_cache_entries", "read_program",
+           "program_from_text", "iter_artifact_files", "make_report",
+           "load_baseline", "save_baseline", "apply_baseline"]

@@ -1,0 +1,145 @@
+"""CLI: ``python -m tools.hlolint [cache_dir] [options]``.
+
+Exit codes (the mxtpulint contract, shared verbatim):
+  0  clean — every finding is fixed or baselined
+  1  new findings (printed human-readably, or as --json)
+  2  usage error (unknown rule id, missing/unset cache dir, bad combo)
+
+The scan root defaults to MXTPU_AOT_CACHE_DIR — the directory the AOT
+layer (aot.py) persists jax.export artifacts into, i.e. the programs a
+fresh process would actually load and run. ``--json`` emits the shared
+report shape (`tool`/`ok`/`findings`/`counts`/`baselined`) that
+``python -m tools.mxtpulint --json`` and ``tools/promcheck.py --json``
+also produce, so CI aggregates all three gates with one parser.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from tools.mxtpulint.core import (apply_baseline, load_baseline,
+                                  make_report, save_baseline)
+from .rules import RULES, SET_RULES, SEVERITY, severity_of
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _default_dir():
+    try:
+        from incubator_mxnet_tpu import config
+        return config.get_env("MXTPU_AOT_CACHE_DIR")
+    except Exception:
+        return os.environ.get("MXTPU_AOT_CACHE_DIR")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hlolint",
+        description="static analysis over compiled StableHLO AOT "
+                    "artifacts (the jax.export programs in "
+                    "MXTPU_AOT_CACHE_DIR): fp64 leaks, donation misses, "
+                    "host round-trips, HBM-overrun prediction, padding "
+                    "waste, quantized-dtype upcasts",
+        epilog="exit codes: 0 = clean (all findings fixed or baselined); "
+               "1 = new findings; 2 = usage error (unknown rule, "
+               "missing/unset cache dir, bad flag combination)")
+    ap.add_argument("cache_dir", nargs="?", default=None,
+                    help="artifact directory to scan (default: "
+                         "MXTPU_AOT_CACHE_DIR)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the shared CI report shape on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/hlolint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--update-baseline", "--write-baseline",
+                    action="store_true", dest="update_baseline",
+                    help="rewrite the baseline file from the current "
+                         "findings and exit 0 (the goal state is an "
+                         "empty baseline)")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of H-rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog with severities and "
+                         "exit")
+    ap.add_argument("--timing", action="store_true",
+                    help="print scan wall time to stderr (the CI stage "
+                         "budget-checks it)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print("H000  unreadable/corrupt AOT artifact  [error]")
+        for rule_id, (title, _fn) in sorted(RULES.items()):
+            print("%s  %s  [%s]" % (rule_id, title, SEVERITY[rule_id]))
+        for rule_id, (title, _fn) in sorted(SET_RULES.items()):
+            print("%s  %s  [%s, cross-program]"
+                  % (rule_id, title, SEVERITY[rule_id]))
+        return 0
+
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = only - set(RULES) - set(SET_RULES) - {"H000"}
+        if unknown:
+            print("unknown rule(s): %s" % ", ".join(sorted(unknown)),
+                  file=sys.stderr)
+            return 2
+
+    if args.update_baseline and only:
+        print("--update-baseline cannot be combined with --rules: it "
+              "rewrites the whole baseline", file=sys.stderr)
+        return 2
+
+    root = args.cache_dir or _default_dir()
+    if not root:
+        print("no cache dir: pass one or set MXTPU_AOT_CACHE_DIR",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(root):
+        # a typo'd/renamed path must fail loudly, not pass a vacuous gate
+        print("cache dir does not exist: %s" % root, file=sys.stderr)
+        return 2
+
+    from .artifact import scan_dir
+    t0 = time.perf_counter()
+    findings = scan_dir(root, only_rules=only)
+    elapsed = time.perf_counter() - t0
+    if args.timing:
+        print("hlolint: %s in %.2fs" % (root, elapsed), file=sys.stderr)
+
+    if args.update_baseline:
+        path = save_baseline(args.baseline, findings)
+        print("wrote %d finding(s) to %s" % (len(findings), path))
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, old = apply_baseline(findings, baseline)
+    report = make_report("hlolint", new, baselined=len(old))
+
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print("%s:%d: %s[%s] %s" % (f.path, f.line, f.rule,
+                                        severity_of(f.rule), f.message))
+        if new:
+            by_rule = ", ".join("%s=%d" % kv
+                                for kv in sorted(report["counts"].items()))
+            print("hlolint: %d finding(s) [%s]%s"
+                  % (len(new), by_rule,
+                     " (+%d baselined)" % len(old) if old else ""))
+            print("fix the program (docs/STATIC_ANALYSIS.md H-rule "
+                  "catalog), or baseline a reviewed exception")
+        else:
+            print("hlolint OK: 0 findings%s"
+                  % (" (+%d baselined)" % len(old) if old else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""v2 AOT artifact reader: bytes on disk -> analyzable ``Program``.
+
+One artifact (aot.py ``MXTPU_AOT_CACHE_DIR``) is::
+
+    MXTPUAOT\\x002 | 4-byte len | header JSON {format, stats} | jax.export payload
+
+The filename carries the program kind (``serve-<digest>.mxtpu-aot``), the
+header carries the device truth harvested at build time
+(``devstats.program_stats``: flops / bytes_accessed / peak_bytes), and
+the payload deserializes to the StableHLO module text the H-rules walk.
+This module is the ONLY place hlolint touches artifact bytes, so the
+format stays in lockstep with aot.py (magic, header packing and the
+version-in-magic rejection are imported from there, never re-derived).
+
+Two scan roots, one contract:
+
+- ``load_dir(root)`` — every ``*.mxtpu-aot`` under a cache directory
+  (the CLI path; also how CI lints a deploy candidate's artifacts),
+- ``load_cache_entries(entries)`` — live ``aot.CACHE`` entries resolved
+  back to their artifact files (the registry load-gate path).
+
+Both label findings with the artifact path RELATIVE to the cache dir, so
+a directory scan and a live-cache scan of the same cache produce
+byte-identical findings (tests/test_hlolint.py pins that equivalence).
+"""
+from __future__ import annotations
+
+import os
+
+from tools.mxtpulint.core import Finding
+
+__all__ = ["Program", "ArtifactError", "read_program", "program_from_text",
+           "iter_artifact_files", "load_dir", "load_cache_entries",
+           "scan_dir", "scan_cache"]
+
+_SUFFIX = ".mxtpu-aot"
+_KINDS = ("train", "eval", "serve")
+
+
+class ArtifactError(ValueError):
+    """Unreadable / corrupt / wrong-version artifact (H000)."""
+
+
+class Program:
+    """One deserialized artifact, ready for the rules."""
+
+    __slots__ = ("path", "kind", "stats", "facts")
+
+    def __init__(self, path, kind, stats, facts):
+        self.path = path            # scan-root-relative label ('/'-sep)
+        self.kind = kind            # 'train' | 'eval' | 'serve'
+        self.stats = stats          # header device truth dict or None
+        self.facts = facts          # hlo.ModuleFacts
+
+    def __repr__(self):
+        return "Program(%s, kind=%s)" % (self.path, self.kind)
+
+
+def program_from_text(path, kind, text, stats=None):
+    """Build a Program straight from module text (rule unit tests; no
+    artifact bytes involved)."""
+    from .hlo import ModuleFacts
+    return Program(path, kind, stats, ModuleFacts(text))
+
+
+def _kind_of(path):
+    base = os.path.basename(path)
+    kind = base.split("-", 1)[0]
+    if kind not in _KINDS:
+        raise ArtifactError("unrecognized artifact kind in filename %r "
+                            "(expected train-/eval-/serve-)" % base)
+    return kind
+
+
+def read_program(path, label=None):
+    """Parse one artifact file; raises ArtifactError on anything short of
+    a loadable module (truncation, wrong magic/version, undeserializable
+    payload) — the CLI turns that into an H000 finding, it never walks
+    past a corrupt artifact silently."""
+    from incubator_mxnet_tpu import aot
+    from .hlo import ModuleFacts
+    kind = _kind_of(path)
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError as e:
+        raise ArtifactError("unreadable artifact (%s)" % e)
+    if not buf.startswith(aot.ARTIFACT_MAGIC):
+        raise ArtifactError("bad magic/format version (expected %r)"
+                            % aot.ARTIFACT_MAGIC)
+    try:
+        stats, off = aot._unpack_header(buf[len(aot.ARTIFACT_MAGIC):])
+    except Exception as e:
+        raise ArtifactError("corrupt v2 header (%s)" % e)
+    try:
+        from jax import export as jax_export
+        exported = jax_export.deserialize(
+            bytearray(buf[len(aot.ARTIFACT_MAGIC) + off:]))
+        text = exported.mlir_module()
+    except Exception as e:
+        raise ArtifactError("payload does not deserialize (%s: %s)"
+                            % (type(e).__name__, e))
+    return Program(label or path, kind, stats, ModuleFacts(text))
+
+
+def iter_artifact_files(root):
+    """Sorted repo-of-artifacts walk (mirrors mxtpulint's iter_py_files
+    determinism: findings order must not depend on readdir order)."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(_SUFFIX):
+                yield os.path.join(dirpath, fn)
+
+
+def _label(path, root):
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def load_dir(root):
+    """-> (programs, error_findings): every artifact under ``root``,
+    corrupt ones surfaced as H000 findings instead of crashing the
+    scan."""
+    programs, errors = [], []
+    for path in iter_artifact_files(root):
+        label = _label(path, root)
+        try:
+            programs.append(read_program(path, label=label))
+        except ArtifactError as e:
+            errors.append(Finding(label, 0, 0, "H000",
+                                  "unreadable AOT artifact: %s" % e))
+    return programs, errors
+
+
+def load_cache_entries(entries, cache_dir=None):
+    """Resolve live aot.CACHE entries back to their persisted artifacts
+    (-> (programs, error_findings)). Entries whose key has no artifact —
+    train programs, or a disabled/unwritten persistent layer — are
+    skipped: the gate lints what is deployable, and only artifacts are.
+    Duplicate keys resolving to one file (shouldn't happen — the digest
+    covers the whole key) are deduped so findings never double."""
+    from incubator_mxnet_tpu import aot, config
+    if cache_dir is None:
+        cache_dir = config.get_env("MXTPU_AOT_CACHE_DIR")
+    programs, errors, seen = [], [], set()
+    for entry in entries:
+        path = aot.artifact_path(entry.key, cache_dir)
+        if path is None or path in seen or not os.path.exists(path):
+            continue
+        seen.add(path)
+        label = _label(path, cache_dir)
+        try:
+            programs.append(read_program(path, label=label))
+        except ArtifactError as e:
+            errors.append(Finding(label, 0, 0, "H000",
+                                  "unreadable AOT artifact: %s" % e))
+    programs.sort(key=lambda p: p.path)
+    errors.sort(key=lambda f: f.path)
+    return programs, errors
+
+
+def _filter_errors(errors, only_rules):
+    # H000 honors --rules like every other id (it is advertised as
+    # selectable by the CLI's unknown-rule check)
+    if not only_rules:
+        return errors
+    return [f for f in errors if f.rule in only_rules]
+
+
+def scan_dir(root, only_rules=None):
+    """Full scan of a cache directory -> sorted findings."""
+    from .rules import analyze_programs
+    programs, errors = load_dir(root)
+    findings = _filter_errors(errors, only_rules) \
+        + analyze_programs(programs, only_rules=only_rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def scan_cache(cache=None, cache_dir=None, only_rules=None):
+    """Scan the LIVE process cache (aot.CACHE by default) through its
+    persisted artifacts -> sorted findings, byte-identical to
+    ``scan_dir`` over the same directory."""
+    from incubator_mxnet_tpu import aot
+    from .rules import analyze_programs
+    if cache is None:
+        cache = aot.CACHE
+    entries = [e for e in (cache.peek(k) for k in cache.keys())
+               if e is not None]
+    programs, errors = load_cache_entries(entries, cache_dir=cache_dir)
+    findings = _filter_errors(errors, only_rules) \
+        + analyze_programs(programs, only_rules=only_rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,77 @@
+"""Seeded-defect canary artifacts — the lint-the-linter fixture.
+
+``write_canary(dir)`` exports two small but REAL v2 AOT artifacts with
+known compiled-program defects, byte-compatible with aot.py's format
+(magic + header imported from there, never re-derived):
+
+- ``serve-…``: an fp64 elementwise program — must fire **H001** (x64
+  leak on the serving path) and nothing else,
+- ``train-…``: an SGD-shaped ``w - 0.1*g`` module exported WITHOUT
+  donate_argnums — must fire **H002** (zero input-output aliasing) and
+  nothing else.
+
+ci/run.sh's hlolint stage regenerates these per run and hard-fails
+unless the scan reports exactly {H001, H002}: the H-passes can never
+silently rot (the same discipline as mxtpulint's seeded_defects.py).
+Generated, not committed: a serialized jax.export payload is pinned to
+the jax version, and the canary must keep proving the REAL deserialize
+path works on the running toolchain.
+
+CLI: ``python -m tools.hlolint.canary OUT_DIR``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+
+__all__ = ["write_canary"]
+
+
+def write_canary(out_dir):
+    """Write the two seeded artifacts under ``out_dir`` (the same
+    jax-<version>/ layout aot.py uses); returns their paths."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from incubator_mxnet_tpu import aot
+    from incubator_mxnet_tpu.base import enable_x64
+
+    with enable_x64():
+        # H001: a serve program that computes in fp64 end to end
+        exp_f64 = jax_export.export(jax.jit(lambda x: x * 2.0))(
+            jax.ShapeDtypeStruct((8,), jnp.float64))
+
+    def step(w, g):
+        return w - 0.1 * g
+
+    spec = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    # H002: a train module with NO donate_argnums -> zero aliased buffers
+    exp_train = jax_export.export(jax.jit(step))(spec, spec)
+
+    ver_dir = os.path.join(out_dir, "jax-%s" % jax.__version__)
+    os.makedirs(ver_dir, exist_ok=True)
+    paths = []
+    for kind, exported in (("serve", exp_f64), ("train", exp_train)):
+        payload = bytes(exported.serialize())
+        digest = hashlib.sha256(payload).hexdigest()[:32]
+        path = os.path.join(ver_dir, "%s-%s.mxtpu-aot" % (kind, digest))
+        with open(path, "wb") as f:
+            f.write(aot.ARTIFACT_MAGIC + aot._pack_header(None) + payload)
+        paths.append(path)
+    return paths
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m tools.hlolint.canary OUT_DIR",
+              file=sys.stderr)
+        return 2
+    for path in write_canary(argv[0]):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

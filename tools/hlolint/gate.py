@@ -1,0 +1,116 @@
+"""Registry load-gate glue: lint freshly-warmed programs before cutover.
+
+serving/registry.py calls in here (lazily, so a tools-less install just
+skips the gate) from the prewarm path: each warmed bucket's new
+aot.CACHE entries (collected by ``aot.collect_inserts``) are resolved to
+their persisted artifacts and run through the per-program H-rules BEFORE
+dispatch is repointed at the incoming version. Severity decides the
+outcome:
+
+- **error** (H001 fp64 leak, H003 host round-trip, H004 HBM overrun,
+  H000 corrupt artifact): the registry refuses the cutover — the version
+  is dropped, the model's describe()/health() carry the reason, and the
+  previous version (if any) keeps serving.
+- **warn** (H002, H005, H006): traffic cuts over; the finding lands in
+  the flight recorder and on ``mxtpu_hlolint_findings_total{rule}``.
+
+The cross-program pass (H005 needs the whole bucket ladder) runs once
+after the full warm via ``lint_entries_set`` — it can only ever warn.
+
+Scope: the gate covers exactly what the warm thread produced.
+``collect_inserts`` is thread-local by design, so a compile-miss raced
+in by a batcher worker AFTER the early per-bucket cutover (a request at
+a not-yet-warmed bucket) is not gated at insert time — its artifact is
+still caught by the next process's CLI/CI scan of the cache dir and by
+any later warm of the same cache. The alternative (linting inside every
+cache insert) would put artifact deserialization on the dispatch hot
+path, which is the exact stall class this repo's analyzers exist to
+flag.
+"""
+from __future__ import annotations
+
+import logging
+
+from . import artifact as _artifact
+from . import rules as _rules
+
+__all__ = ["lint_entries", "lint_entries_set", "lint_programs_set",
+           "publish", "findings_total"]
+
+_LOG = logging.getLogger(__name__)
+_COUNTER = None
+
+
+def findings_total():
+    """The ``mxtpu_hlolint_findings_total{rule}`` counter, registered on
+    first use (the CLI path never touches the telemetry registry)."""
+    global _COUNTER
+    if _COUNTER is None:
+        from incubator_mxnet_tpu import telemetry
+        _COUNTER = telemetry.counter(
+            "mxtpu_hlolint_findings_total",
+            "hlolint findings surfaced by the registry load gate, by "
+            "H-rule (docs/STATIC_ANALYSIS.md catalog). Error-severity "
+            "rules also refuse the model-version cutover.", ("rule",))
+    return _COUNTER
+
+
+def _split(findings):
+    errors = [f for f in findings
+              if _rules.severity_of(f.rule) == "error"]
+    warns = [f for f in findings
+             if _rules.severity_of(f.rule) != "error"]
+    return errors, warns
+
+
+def lint_entries(entries, cache_dir=None, collect=None):
+    """Per-program rules over the artifacts behind live cache entries ->
+    (error_findings, warn_findings). The set rules (H005) are excluded
+    here: one bucket has no ladder — run the cross pass after the full
+    warm, over the Programs accumulated via ``collect`` (a list the
+    caller keeps so the artifacts are deserialized exactly once)."""
+    programs, errs = _artifact.load_cache_entries(entries,
+                                                  cache_dir=cache_dir)
+    if collect is not None:
+        collect.extend(programs)
+    findings = errs + _rules.analyze_programs(
+        programs, only_rules=set(_rules.RULES))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return _split(findings)
+
+
+def lint_programs_set(programs):
+    """The cross-program pass over already-parsed Programs (the H005
+    bucket ladder) -> warn findings only (set rules never block)."""
+    findings = [f for _rid, (_t, fn) in sorted(_rules.SET_RULES.items())
+                for f in fn(programs)]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return _split(findings)[1]
+
+
+def lint_entries_set(entries, cache_dir=None):
+    """lint_programs_set over live cache entries, for callers that did
+    not keep the per-bucket Programs."""
+    programs, _errs = _artifact.load_cache_entries(entries,
+                                                   cache_dir=cache_dir)
+    return lint_programs_set(programs)
+
+
+def publish(findings, model=None):
+    """Count every finding and file the warns on the flight recorder —
+    guarded: telemetry trouble must never fail the load that surfaced
+    the finding."""
+    for f in findings:
+        try:
+            findings_total().inc(rule=f.rule)
+        except Exception:
+            _LOG.debug("hlolint counter update dropped", exc_info=True)
+        if _rules.severity_of(f.rule) != "error":
+            try:
+                from incubator_mxnet_tpu.telemetry import flightrec
+                flightrec.record("hlolint_finding", rule=f.rule,
+                                 model=str(model), path=f.path,
+                                 message=f.message)
+            except Exception:
+                _LOG.debug("hlolint flightrec record dropped",
+                           exc_info=True)

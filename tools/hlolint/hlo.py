@@ -1,0 +1,165 @@
+"""StableHLO text walker: the one place hlolint parses MLIR.
+
+The analyzed text is exactly what ``jax.export`` records in the v2 AOT
+artifact (``Exported.mlir_module()``): a single ``module`` with one
+``func.func public @main`` whose arguments carry the trace-time ``loc``
+names (``input_datas[0]`` / ``param_datas[1]`` from jit.py's pure-fn
+extraction) and, for donated inputs, the ``tf.aliasing_output`` /
+``jax.buffer_donor`` argument attributes. Ops are one-per-line
+(``%3 = stablehlo.dot_general ... : (types) -> type``), which is why a
+line walker with a handful of regexes is enough — no MLIR parser
+dependency, the same stdlib-only discipline as tools/mxtpulint.
+
+``ModuleFacts`` extracts what the H-rules decide on:
+
+- ``args``: per-argument shape, dtype, loc name, aliased-output flag,
+- ``ops``: per-line op name, custom_call target, operand/result dtypes,
+- dtype presence (``f64_lines``) for the x64-leak rule,
+- the batch bucket (leading dim of the first ``input``-named argument —
+  parameters ride in front of the inputs in the exported signature).
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["Arg", "Op", "ModuleFacts"]
+
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+# %arg0: tensor<4x8xf32> {tf.aliasing_output = 0 : i32} loc("w")
+# The attr group must survive a `}` INSIDE a quoted attr value —
+# mhlo.sharding carries one ({mhlo.sharding = "{devices=[2,1]<=[2]}"}),
+# and truncating there would drop the loc name on every sharded
+# artifact (breaking input_args()/bucket()/group_key for MeshServable
+# programs): match quoted strings and one brace-nesting level whole.
+_ARG_RE = re.compile(
+    r"%arg(\d+):\s*tensor<([^>]*)>"
+    r'(?:\s*(\{(?:[^{}"]|"[^"]*"|\{[^{}]*\})*\}))?'
+    r'(?:\s*loc\("((?:[^"\\]|\\.)*)"\))?')
+_OP_RE = re.compile(r"\b(stablehlo\.[a-z_0-9]+)\b")
+_TARGET_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.]+)")
+# the `: (operand types) -> result types` trailer of an op line
+_SIG_RE = re.compile(r":\s*\(([^)]*)\)\s*->\s*(\S[^{]*)")
+_F64_RE = re.compile(r"tensor<(?:[^>]*x)?f64>")
+_ALIAS_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+def _parse_type(t):
+    """'4x8xf32' -> ((4, 8), 'f32'); 'f32' -> ((), 'f32')."""
+    toks = t.split("x")
+    dims = []
+    for tok in toks[:-1]:
+        try:
+            dims.append(int(tok))
+        except ValueError:
+            dims.append(None)        # dynamic/symbolic dim: keep position
+    return tuple(dims), toks[-1]
+
+
+class Arg:
+    """One main-func argument of the exported module."""
+
+    __slots__ = ("index", "dims", "dtype", "name", "aliased")
+
+    def __init__(self, index, dims, dtype, name, aliased):
+        self.index = index
+        self.dims = dims
+        self.dtype = dtype
+        self.name = name             # trace-time loc name ('' if absent)
+        self.aliased = aliased       # donated: output aliases this buffer
+
+
+class Op:
+    """One op line of the module body."""
+
+    __slots__ = ("lineno", "name", "target", "in_types", "out_types",
+                 "text")
+
+    def __init__(self, lineno, name, target, in_types, out_types, text):
+        self.lineno = lineno
+        self.name = name             # e.g. 'stablehlo.dot_general'
+        self.target = target         # custom_call @target, else None
+        self.in_types = in_types     # [(dims, dtype), ...]
+        self.out_types = out_types
+        self.text = text
+
+    def in_dtypes(self):
+        return [d for _s, d in self.in_types]
+
+    def out_dtypes(self):
+        return [d for _s, d in self.out_types]
+
+
+class ModuleFacts:
+    """Everything the H-rules read, parsed once per program."""
+
+    def __init__(self, text):
+        self.lines = text.splitlines()
+        self.main_line = 0
+        self.args = []
+        self.ops = []
+        self.f64_lines = []
+        for i, line in enumerate(self.lines, 1):
+            if self.main_line == 0 and "func.func public @main" in line:
+                self.main_line = i
+                for m in _ARG_RE.finditer(line):
+                    dims, dtype = _parse_type(m.group(2))
+                    attrs = m.group(3) or ""
+                    self.args.append(Arg(
+                        int(m.group(1)), dims, dtype, m.group(4) or "",
+                        any(a in attrs for a in _ALIAS_ATTRS)))
+            om = _OP_RE.search(line)
+            if om is not None:
+                sig = _SIG_RE.search(line)
+                if sig is not None:
+                    ins = [_parse_type(t)
+                           for t in _TENSOR_RE.findall(sig.group(1))]
+                    outs = [_parse_type(t)
+                            for t in _TENSOR_RE.findall(sig.group(2))]
+                else:
+                    ins = []
+                    outs = [_parse_type(t)
+                            for t in _TENSOR_RE.findall(line)]
+                tm = _TARGET_RE.search(line)
+                self.ops.append(Op(i, om.group(1),
+                                   tm.group(1) if tm else None,
+                                   ins, outs, line.strip()))
+            if _F64_RE.search(line):
+                self.f64_lines.append(i)
+
+    # ------------------------------------------------------------- helpers
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def input_args(self):
+        """The batch-carrying arguments: loc-named ``input*`` when the
+        trace recorded names (jit.py's pure-fn extraction always does);
+        every argument otherwise (hand-exported programs)."""
+        named = [a for a in self.args if a.name.startswith("input")]
+        return named if named else list(self.args)
+
+    def bucket(self):
+        """Leading dim of the first input argument — the batcher's bucket
+        axis — or None for inputless/rank-0 programs."""
+        ins = self.input_args()
+        if ins and ins[0].dims:
+            return ins[0].dims[0]
+        return None
+
+    def group_key(self):
+        """Identity of this program's shape family MODULO the batch
+        bucket: every arg's (name, dims-with-input-dim0-masked, dtype).
+        Two artifacts of one model at different buckets share the key —
+        the H005 padding-ladder grouping."""
+        ins = set(id(a) for a in self.input_args())
+        parts = []
+        for a in self.args:
+            dims = list(a.dims)
+            if id(a) in ins and dims:
+                dims[0] = None
+            parts.append((a.name or a.index, tuple(dims), a.dtype))
+        return tuple(parts)
+
+    def aliased_count(self):
+        return sum(1 for a in self.args if a.aliased)

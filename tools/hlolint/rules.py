@@ -1,0 +1,309 @@
+"""The H-rule set: device-behavior defects decidable on compiled programs.
+
+mxtpulint reads the Python source and promcheck reads the metrics
+exposition; these rules read the third artifact class — the StableHLO
+modules that actually run on the device (jax.export v2 AOT artifacts,
+aot.py). Each rule names the production failure it prevents; the MFU
+sprint (ROADMAP item 2) named every one of them as a silent utilization
+killer. docs/STATIC_ANALYSIS.md carries the catalog with one real
+before/after per rule.
+
+Severities drive the serving load gate (tools/hlolint/gate.py wired into
+serving/registry.py): ``error`` findings refuse cutover of the offending
+model version; ``warn`` findings land in the flight recorder and on the
+``mxtpu_hlolint_findings_total{rule}`` counter but let traffic cut over.
+
+Findings reuse tools.mxtpulint.core's ``Finding`` (path, line, rule,
+message + the stripped module line as the line-number-free baseline key),
+so all three analyzers share one report shape and one baseline mechanic.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["RULES", "SET_RULES", "SEVERITY", "program_rule", "set_rule",
+           "analyze_programs", "severity_of"]
+
+from tools.mxtpulint.core import Finding
+
+RULES = {}          # rule id -> (title, fn(program) -> iterable[Finding])
+SET_RULES = {}      # rule id -> (title, fn(programs) -> iterable[Finding])
+SEVERITY = {
+    # unreadable/corrupt artifact (emitted by artifact.py, not a rule fn)
+    "H000": "error",
+}
+
+
+def program_rule(rule_id, title, severity):
+    def deco(fn):
+        RULES[rule_id] = (title, fn)
+        SEVERITY[rule_id] = severity
+        return fn
+    return deco
+
+
+def set_rule(rule_id, title, severity):
+    """A rule over the whole artifact SET (cross-program facts, e.g. the
+    bucket ladder) — runs once per scan, not once per program."""
+    def deco(fn):
+        SET_RULES[rule_id] = (title, fn)
+        SEVERITY[rule_id] = severity
+        return fn
+    return deco
+
+
+def severity_of(rule_id):
+    return SEVERITY.get(rule_id, "warn")
+
+
+def _finding(prog, lineno, rule_id, message):
+    return Finding(prog.path, lineno, 0, rule_id, message,
+                   prog.facts.line_text(lineno))
+
+
+# --------------------------------------------------------------------- H001
+# An fp64 value in a serve/eval program is almost never intentional: a
+# leaked jax_enable_x64, a numpy float64 literal, or a calibration array
+# that never got cast. The program still runs — at 2x the HBM traffic and
+# half (or worse) the MXU rate, silently. Train programs are exempt only
+# because they never reach the serving path; the kind comes from the
+# artifact filename (aot.artifact_path).
+@program_rule("H001", "fp64 op in a serve/eval program", "error")
+def h001_fp64_leak(prog):
+    if prog.kind == "train":
+        return
+    hits = prog.facts.f64_lines
+    if not hits:
+        return
+    yield _finding(
+        prog, hits[0], "H001",
+        "%s program computes in fp64 (%d line(s), first shown) — an x64 "
+        "leak doubles HBM bytes per element and runs off the MXU fast "
+        "path, silently; cast to f32/bf16 at the program boundary and "
+        "check for a leaked jax_enable_x64 / float64 literal"
+        % (prog.kind, len(hits)))
+
+
+# --------------------------------------------------------------------- H002
+# jit.py compiles train steps with donate_argnums so parameter/optimizer
+# buffers update in place (the kWriteInplace analog). A train module with
+# ZERO input-output aliasing means donation silently fell off (a wrapper
+# re-jit, MXTPU_NO_DONATE left on, an aliasing-defeating dtype change):
+# every step then writes a full fresh copy of the weights — double weight
+# residency and 2x weight HBM traffic. mxtpulint R012 is the source-side
+# mirror of this rule (the jit call site missing donate_argnums).
+# Reach, honestly: aot.artifact_path() persists serve/eval kinds only
+# (train executables never hit MXTPU_AOT_CACHE_DIR), so on a live cache
+# this rule sees train artifacts only where someone put them — the
+# seeded canary, hand-exported dirs, a future train-persistence layer.
+# R012 is the defense that fires on today's deployments; H002 keeps the
+# compiled-side check proven against the day train artifacts persist.
+@program_rule("H002", "train program with zero input-output aliasing",
+              "warn")
+def h002_donation_miss(prog):
+    if prog.kind != "train" or not prog.facts.args:
+        return
+    if prog.facts.aliased_count() == 0:
+        yield _finding(
+            prog, prog.facts.main_line, "H002",
+            "train program aliases zero of its %d input buffer(s) — "
+            "donation miss: jit.py intends in-place parameter updates "
+            "(donate_argnums), but this module copies every updated "
+            "buffer (double weight residency, 2x weight HBM traffic); "
+            "check the jit call site (mxtpulint R012) and MXTPU_NO_DONATE"
+            % len(prog.facts.args))
+
+
+# --------------------------------------------------------------------- H003
+# Host round-trips inside a serve program: custom_call host callbacks
+# (jax pure_callback/io_callback/host_callback lower to
+# @xla_python_cpu_callback / @xla_ffi_python_* targets), infeed/outfeed,
+# send/recv. Every dispatch blocks the device on the host — the exact
+# stall class the async batcher exists to avoid, now baked into the
+# compiled program where no amount of serving-side work can fix it.
+# Only the HOST-callback target class fires: custom_call is also how
+# pure device kernels ship (Pallas/Mosaic @tpu_custom_call, RNG
+# @cu_threefry2x32, ducc_fft, lapack_*/cusolver) and how GSPMD marks
+# partitioning — refusing those would make correct device-only models
+# undeployable through an error-severity gate.
+_HOST_TARGET_RE = re.compile(r"callback|host_|infeed|outfeed",
+                             re.IGNORECASE)
+_ROUNDTRIP_OPS = ("stablehlo.infeed", "stablehlo.outfeed",
+                  "stablehlo.send", "stablehlo.recv")
+
+
+@program_rule("H003", "host round-trip op in a serve/eval program",
+              "error")
+def h003_host_roundtrip(prog):
+    # serve AND eval: BlockServable routes live blocks through
+    # jit.EvalStep, so eval-kind artifacts ARE the serving programs —
+    # the same scoping H001 uses. Train programs host-callback freely
+    # (checkpoint hooks, metrics) off the dispatch path.
+    if prog.kind == "train":
+        return
+    for op in prog.facts.ops:
+        if op.name in _ROUNDTRIP_OPS:
+            what = op.name
+        elif op.name == "stablehlo.custom_call" \
+                and _HOST_TARGET_RE.search(op.target or ""):
+            what = "stablehlo.custom_call @%s" % op.target
+        else:
+            continue
+        yield _finding(
+            prog, op.lineno, "H003",
+            "%s inside a %s program — every dispatch blocks the "
+            "device on a host round-trip (callback/infeed on the "
+            "serving path); compute it outside the exported program or "
+            "precompute it into the servable's state"
+            % (what, prog.kind))
+
+
+# --------------------------------------------------------------------- H004
+# Predicted HBM overrun: the artifact header carries memory_analysis peak
+# bytes (devstats.program_stats, persisted at export time); against the
+# per-device-kind HBM capacity this is decidable BEFORE deploy. Reject at
+# the gate, not OOM after cutover.
+def _hbm_budget():
+    """(budget_bytes, source) — MXTPU_HLOLINT_HBM_BUDGET when set, else
+    the devstats per-device-kind capacity table; (None, None) when
+    neither knows this backend (CPU: the rule is skipped, not guessed)."""
+    from incubator_mxnet_tpu import config
+    env = config.get_env("MXTPU_HLOLINT_HBM_BUDGET")
+    if env:
+        return float(env), "MXTPU_HLOLINT_HBM_BUDGET"
+    from incubator_mxnet_tpu.telemetry import devstats
+    cap, source = devstats.hbm_capacity()
+    if cap:
+        return float(cap), "%s device table" % source
+    return None, None
+
+
+@program_rule("H004", "predicted peak HBM exceeds the device budget",
+              "error")
+def h004_hbm_overrun(prog):
+    budget, source = _hbm_budget()
+    if budget is None or not prog.stats:
+        return
+    peak = float(prog.stats.get("peak_bytes") or 0.0)
+    if peak > budget:
+        yield _finding(
+            prog, prog.facts.main_line, "H004",
+            "predicted peak HBM %.0f bytes (%.2f MiB, artifact header "
+            "memory_analysis) exceeds the %.0f-byte budget (%s) — the "
+            "program OOMs after cutover; shrink the batch bucket, shard "
+            "the model, or raise MXTPU_HLOLINT_HBM_BUDGET deliberately"
+            % (peak, peak / 2 ** 20, budget, source))
+
+
+# --------------------------------------------------------------------- H006
+# Dtype upcast in a quantized program: int8 storage converted to fp
+# before the matmul/conv means the MXU runs at fp width and the int8
+# kernel win (1.78x measured) degrades to the e2e ~1.27x the BENCH
+# trajectory shows. The native path keeps i8 operands with an i32
+# accumulator (preferred_element_type) — a program whose every matmul is
+# fp while an i8->fp convert feeds the data path is the QDQ fallback
+# leaking into serving (MXTPU_INT8_SIM left on, or a backend probe
+# misfiring).
+_FP_DTYPES = ("f32", "f16", "bf16")
+_MATMUL_OPS = ("stablehlo.dot_general", "stablehlo.dot",
+               "stablehlo.convolution")
+
+
+@program_rule("H006", "int8 upcast to fp ahead of the matmul in a "
+                      "quantized program", "warn")
+def h006_quantized_upcast(prog):
+    if prog.kind == "train":
+        return
+    facts = prog.facts
+    upcasts = [op for op in facts.ops
+               if op.name == "stablehlo.convert"
+               and "i8" in op.in_dtypes()
+               and any(d in _FP_DTYPES for d in op.out_dtypes())]
+    if not upcasts:
+        return
+    matmuls = [op for op in facts.ops if op.name in _MATMUL_OPS]
+    if not matmuls:
+        return
+    if any("i8" in op.in_dtypes() for op in matmuls):
+        return                    # a native int8 matmul exists: real path
+    yield _finding(
+        prog, upcasts[0].lineno, "H006",
+        "quantized %s program upcasts int8 to %s before its matmul/conv "
+        "(%d upcast(s), %d fp matmul(s), zero int8 matmuls) — the MXU "
+        "runs at fp width and the int8 kernel win is forfeited (the "
+        "1.78x->1.27x e2e gap); keep operands int8 with "
+        "preferred_element_type=int32, and check MXTPU_INT8_SIM"
+        % (prog.kind, upcasts[0].out_dtypes()[0], len(upcasts),
+           len(matmuls)))
+
+
+# --------------------------------------------------------------------- H005
+# Padding waste across a bucket ladder: the batcher pads every request
+# batch up to its bucket, so a program at bucket b whose next-lower
+# ladder step is b' wastes up to (b - (b'+1))/b of its compute on padding
+# (the worst-fit request, b'+1 items, pays for b). A ladder of 1,2,4,8
+# tops out at 37.5% waste; a ladder of 1,64 wastes 97% — compile a bucket
+# in between. Cross-program by construction: the ladder only exists
+# across the artifact set.
+@set_rule("H005", "shape bucket wastes padded compute vs a tighter "
+                  "bucket", "warn")
+def h005_padding_waste(programs):
+    from incubator_mxnet_tpu import config
+    threshold = float(config.get_env("MXTPU_HLOLINT_PAD_WASTE"))
+    groups = {}
+    for prog in programs:
+        bucket = prog.facts.bucket()
+        if bucket is None or prog.facts.main_line == 0:
+            continue
+        key = (prog.kind, prog.facts.group_key())
+        groups.setdefault(key, []).append((bucket, prog))
+    for (_kind, _sig), members in sorted(
+            groups.items(), key=lambda kv: repr(kv[0])):
+        ladder = sorted({b for b, _p in members})
+        if len(ladder) < 2:
+            continue
+        for bucket, prog in sorted(members,
+                                   key=lambda bp: (bp[0], bp[1].path)):
+            i = ladder.index(bucket)
+            if i == 0:
+                continue
+            lower = ladder[i - 1]
+            waste = (bucket - (lower + 1)) / float(bucket)
+            if waste <= threshold:
+                continue
+            flops_note = ""
+            lower_stats = next((p.stats for b, p in members
+                                if b == lower and p.stats), None)
+            if prog.stats and lower_stats:
+                flops_note = (" (%.3g FLOPs here vs %.3g at bucket %d)"
+                              % (float(prog.stats.get("flops") or 0.0),
+                                 float(lower_stats.get("flops") or 0.0),
+                                 lower))
+            yield _finding(
+                prog, prog.facts.main_line, "H005",
+                "bucket %d pads up to %.0f%% of its compute away: the "
+                "next smaller compiled bucket is %d, so a %d-item batch "
+                "pays for %d%s — add an intermediate bucket (or drop "
+                "this one) to keep worst-case padding under "
+                "MXTPU_HLOLINT_PAD_WASTE=%.2f"
+                % (bucket, 100.0 * waste, lower, lower + 1, bucket,
+                   flops_note, threshold))
+
+
+# ------------------------------------------------------------------ driver
+def analyze_programs(programs, only_rules=None):
+    """Run every (selected) rule over ``programs``; findings sorted by
+    (path, line, rule) — the same order both the directory scan and the
+    live-cache scan produce, which is what makes them byte-comparable."""
+    findings = []
+    for prog in programs:
+        for rule_id, (_title, fn) in sorted(RULES.items()):
+            if only_rules and rule_id not in only_rules:
+                continue
+            findings.extend(fn(prog))
+    for rule_id, (_title, fn) in sorted(SET_RULES.items()):
+        if only_rules and rule_id not in only_rules:
+            continue
+        findings.extend(fn(programs))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

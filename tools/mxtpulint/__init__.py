@@ -18,6 +18,8 @@ engine-dependency checks):
   R006  time.time() differences used as durations (NTP-unsafe)
   R007  non-daemon threading.Thread without a matching join()
   R008  trace span entered without `with` or try/finally end
+  R012  train-step jax.jit call site without donate_argnums (the
+        source-side mirror of hlolint H002's compiled-module check)
 
 **Whole-program passes** (project.py builds the index — module symbol
 tables, import/alias resolution, call graph, per-function summaries;

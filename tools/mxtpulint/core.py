@@ -120,6 +120,11 @@ class FileContext:
         # `from time import perf_counter as time` binds neither.
         self.time_module_aliases = set()
         self.walltime_func_names = set()
+        # binding-accurate jax.jit tracking (R012): names bound to jax's
+        # jit FUNCTION (`from jax import jit [as x]`) — a bare `jit(...)`
+        # call is only jax's if the binding says so (`from numba import
+        # jit` must not fire jax-donation advice).
+        self.jax_jit_aliases = set()
         self._index()
 
     # -- indexes -----------------------------------------------------------
@@ -142,6 +147,11 @@ class FileContext:
                     if alias.name == "time":
                         self.walltime_func_names.add(alias.asname
                                                      or alias.name)
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "jit":
+                        self.jax_jit_aliases.add(alias.asname
+                                                 or alias.name)
             for child in ast.iter_child_nodes(node):
                 visit(child)
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,

@@ -519,6 +519,62 @@ def r007_unjoined_thread(ctx):
             % (" %r" % bound if bound else ""))
 
 
+# --------------------------------------------------------------------- R012
+# A train-step jax.jit call site without donate_argnums compiles a
+# program with ZERO input-output aliasing: every parameter/optimizer
+# update allocates a fresh output buffer — double weight residency and
+# 2x weight HBM traffic, silently (the program is still correct). jit.py
+# routes all train-step compiles through donate_argnums (the
+# kWriteInplace analog); this rule catches the wrapper/fork that forgets
+# it. hlolint H002 is the compiled-artifact mirror: it sees the aliasing
+# actually missing in the exported StableHLO module, this rule sees the
+# call site that caused it. Scope: jit calls whose enclosing function /
+# class qualname says "train" — eval/serve jits (EvalStep, artifact
+# loads) never donate and must not fire.
+_QUAL_WORD_RE = re.compile(r"[A-Z]+(?![a-z])|[A-Z]?[a-z]+|\d+")
+_DONATE_KWS = ("donate_argnums", "donate_argnames")
+
+
+def _is_train_qual(qual):
+    """True when the qualname contains a 'train'-rooted WORD (snake_case
+    or CamelCase segmented): TrainStep, make_train_step, Trainer,
+    training_loop — but not 'constrain_update' / 'RestrainedSolver',
+    where 'train' is only a substring of an unrelated word."""
+    return any(w.lower().startswith("train")
+               for w in _QUAL_WORD_RE.findall(qual))
+
+
+@rule("R012", "train-step jax.jit call site without donate_argnums")
+def r012_train_jit_no_donation(ctx):
+    for node in ctx.walk(ast.Call):
+        f = node.func
+        is_jit = (isinstance(f, ast.Attribute) and f.attr == "jit"
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "jax") \
+            or (isinstance(f, ast.Name)
+                and f.id in ctx.jax_jit_aliases)
+        if not is_jit or not node.args:
+            continue
+        if any(kw.arg in _DONATE_KWS for kw in node.keywords):
+            continue
+        qual = None
+        for fn in ctx.enclosing_functions(node):
+            q = ctx.qualnames.get(fn, fn.name)
+            if _is_train_qual(q):
+                qual = q
+                break
+        if qual is None:
+            continue
+        yield ctx.finding(
+            node, "R012",
+            "jax.jit on the train step in %r without donate_argnums — "
+            "the compiled program aliases zero buffers, so every "
+            "parameter update writes a fresh copy (double weight "
+            "residency, 2x weight HBM traffic; hlolint H002 is the "
+            "compiled-artifact mirror); donate the parameter/optimizer-"
+            "state argnums, or gate it behind MXTPU_NO_DONATE" % qual)
+
+
 # --------------------------------------------------------------------- R008
 # A trace span entered manually (`sp.start()` / `sp.__enter__()`) and not
 # guaranteed to end corrupts more than itself: the thread-local parent

@@ -13,12 +13,17 @@ silently stop firing is worse than no lint):
 - an AOT-path retrace hazard (R011): a dict literal argument at an
   ``aot.compile_cached`` boundary (the shared executable cache keys on
   its arguments the same way jax.jit keys on statics — an unhashable
-  per-call object defeats the cache).
+  per-call object defeats the cache),
+- a donation miss (R012): a train-step ``jax.jit`` call site without
+  ``donate_argnums`` (the source-side mirror of hlolint H002 — the
+  compiled module would alias zero buffers and copy every weight
+  update).
 
 This file lives under tools/, so the REPO gate lints it only under the
 relaxed R003/R005/R006 profile (under which it is clean); the regression
 test and ci/run.sh analyze it with the FULL profile rooted at this
-directory and assert exactly these four findings.
+directory and assert exactly these five findings (plus the two in
+seeded_batcher.py).
 """
 import threading
 
@@ -74,3 +79,11 @@ def warm(x):
     # R011: dict literal flowing into the AOT executable-cache boundary
     return compile_cached(("m", "eval", ((4,), "float32")),
                           lambda: (_model, None, None), {"device": 0})
+
+
+class SeededTrainStep:
+    """R012 anchor: a train-step jit that donates nothing."""
+
+    def _build(self, step_fn):
+        # R012: no donate_argnums — every weight update copies its buffer
+        return jax.jit(step_fn)
