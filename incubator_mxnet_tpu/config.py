@@ -350,6 +350,51 @@ ENV_VARS = {
         float, 60.0,
         "Upper clamp on GET /debug/profile?seconds=N capture length — an "
         "operator typo must not leave the profiler tracing for an hour."),
+    "MXTPU_PROFILE_PYTHON_TRACER": (
+        bool, False,
+        "Include python frames in profiler captures. OFF by default: the "
+        "python tracer taxes every interpreter call while tracing (~30% "
+        "on a timer-bound serving request), which lands on p99 whenever "
+        "a capture overlaps traffic — the continuous profstats daemon's "
+        "whole operating mode. The XLA op events the attribution layer "
+        "reads survive with it off."),
+    "MXTPU_PROFSTATS": (
+        bool, False,
+        "Autostart the continuous low-duty-cycle profiler daemon at "
+        "package import (telemetry/profstats.py; profstats.start()/"
+        "stop() at runtime): every MXTPU_PROFSTATS_INTERVAL_S it "
+        "captures MXTPU_PROFSTATS_CAPTURE_S of jax.profiler trace and "
+        "folds the per-op summary into "
+        "mxtpu_profile_op_seconds_total{model,category} / "
+        "mxtpu_profile_device_idle_ratio and GET /debug/hotspots "
+        "(docs/OBSERVABILITY.md 'Op-level attribution')."),
+    "MXTPU_PROFSTATS_INTERVAL_S": (
+        float, 300.0,
+        "Seconds between continuous-profiler capture cycles "
+        "(telemetry/profstats.py daemon)."),
+    "MXTPU_PROFSTATS_CAPTURE_S": (
+        float, 2.0,
+        "Trace length per continuous-profiler cycle; clamped to "
+        "MXTPU_PROFSTATS_MAX_DUTY x MXTPU_PROFSTATS_INTERVAL_S so the "
+        "profiler stays a sampling tax, never steady tracing."),
+    "MXTPU_PROFSTATS_MAX_LOAD": (
+        float, 0.5,
+        "Queue-occupancy ceiling above which a continuous-profiler "
+        "cycle is skipped (outcome=skipped_load on "
+        "mxtpu_profile_captures_total): profiling is for finding the "
+        "MFU gap, not for widening it under overload. Load probes: "
+        "each serving ModelRegistry registers its max replica-queue "
+        "occupancy (profstats.add_load_probe)."),
+    "MXTPU_PROFSTATS_MAX_DUTY": (
+        float, 0.02,
+        "Overhead budget: max fraction of each daemon interval spent "
+        "tracing (the capture length clamp)."),
+    "MXTPU_PROFSTATS_SUMMARIES": (
+        int, 32,
+        "How many capture summaries the bounded profstats store keeps "
+        "for GET /debug/hotspots?capture=<id> re-fetch — summaries "
+        "outlive the pruned capture dirs themselves "
+        "(MXTPU_PROFILE_KEEP)."),
     "MXTPU_LOADGEN_SEED": (
         int, 0,
         "Arrival-process RNG seed for the open-loop load generator "

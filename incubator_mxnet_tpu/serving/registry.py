@@ -445,6 +445,22 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._entries = {}
         self._closed = False
+        # the continuous profiler's overload signal: it skips a capture
+        # cycle while any of this registry's queues runs hot — profiling
+        # must never widen the overload it exists to explain
+        from ..telemetry import profstats
+        self._probe_name = "serving-registry-%d" % id(self)
+        profstats.add_load_probe(self._probe_name, self._queue_occupancy)
+
+    def _queue_occupancy(self):
+        """Max replica-queue occupancy across loaded models, in [0, 1]."""
+        with self._lock:
+            entries = list(self._entries.values())
+        occ = 0.0
+        for e in entries:
+            cap = max(1, e.batcher.total_queue_size)
+            occ = max(occ, e.batcher.queue_depth() / cap)
+        return occ
 
     # ------------------------------------------------------------ lifecycle
     def load(self, name, servable, version=None, prewarm=None,
@@ -551,6 +567,8 @@ class ModelRegistry:
 
     def close(self, drain=True):
         """Graceful shutdown of every model's batcher (queue drained first)."""
+        from ..telemetry import profstats
+        profstats.remove_load_probe(self._probe_name)
         with self._lock:
             self._closed = True
             entries = list(self._entries.values())

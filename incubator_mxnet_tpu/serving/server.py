@@ -39,9 +39,19 @@ Routes (TF-Serving REST-shaped):
   serving contract (docs/AOT.md).
 - ``GET /debug/profile?seconds=N`` — on-demand ``jax.profiler`` capture
   into a bounded directory (telemetry/devstats.py): blocks for N
-  seconds (clamped to MXTPU_PROFILE_MAX_S) and returns the capture dir;
-  single-flight — a concurrent capture gets 409 instead of corrupting
-  the in-flight trace (docs/OBSERVABILITY.md "Device truth").
+  seconds (clamped to MXTPU_PROFILE_MAX_S) and returns the capture dir
+  plus a ``capture_id`` (stable across the dir prune — re-fetch via
+  ``GET /debug/hotspots?capture=<id>``) and a ``summary`` (top-K ops +
+  device-idle ratio, telemetry/profstats.py); single-flight — a
+  concurrent capture gets 409 instead of corrupting the in-flight
+  trace (docs/OBSERVABILITY.md "Device truth").
+- ``GET /debug/hotspots?n=K`` — the ranked per-op hotspot table the
+  profstats layer accumulates over every folded capture (continuous
+  daemon + operator captures): top-K ops with XLA category, self time,
+  count and share, the per-category split, and the device-idle ratio.
+  ``?capture=<id>`` returns one remembered capture's full summary
+  instead (bounded store, MXTPU_PROFSTATS_SUMMARIES;
+  docs/OBSERVABILITY.md "Op-level attribution").
 - ``GET /debug/requests?n=`` — the structured access log: the newest
   ``n`` terminal predict outcomes as JSONL ``{ts, request_id, tenant,
   model, code, shed_reason, latency_ms, queue_ms, batch_ms, device_ms,
@@ -189,6 +199,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, slo.REGISTRY.describe())
         elif self.path.split("?", 1)[0] == "/debug/profile":
             self._do_profile()
+        elif self.path.split("?", 1)[0] == "/debug/hotspots":
+            self._do_hotspots()
         elif self.path.rstrip("/") == _MODELS_PREFIX:
             self._send(200, {"models": self.registry.models()})
         elif self.path.startswith(_MODELS_PREFIX + "/"):
@@ -208,9 +220,13 @@ class _Handler(BaseHTTPRequestHandler):
         """GET /debug/profile?seconds=N — the on-demand device-profiler
         capture (single-flight; 409 while one is in flight). The handler
         thread blocks for the capture window; the ThreadingHTTPServer
-        keeps answering /metrics and predicts meanwhile."""
+        keeps answering /metrics and predicts meanwhile. The response
+        carries the parsed ``summary`` (top-K ops + idle ratio) and a
+        ``capture_id`` that stays fetchable via GET /debug/hotspots
+        ?capture=<id> after the dir itself is pruned."""
         from urllib.parse import parse_qs, urlparse
         from ..telemetry import devstats
+        from ..telemetry.profstats import brief, capture_and_summarize
         q = parse_qs(urlparse(self.path).query)
         try:
             seconds = float(q.get("seconds", ["2"])[0])
@@ -218,13 +234,36 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": "seconds must be a number"})
             return
         try:
-            out = devstats.capture_profile(seconds)
+            out, summary = capture_and_summarize(seconds)
         except devstats.ProfileCaptureBusy as e:
             self._send(409, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — capture failure, not crash
             self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
         else:
+            out["summary"] = brief(summary)
             self._send(200, out)
+
+    def _do_hotspots(self):
+        """GET /debug/hotspots?n=K — the rolling ranked hotspot table;
+        ``?capture=<id>`` returns one remembered capture summary."""
+        from urllib.parse import parse_qs, urlparse
+        from ..telemetry import profstats
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            n = int(q.get("n", ["20"])[0])
+        except ValueError:
+            self._send(400, {"error": "n must be an integer"})
+            return
+        cid = q.get("capture", [None])[0]
+        if cid:
+            summary = profstats.get_summary(cid)
+            if summary is None:
+                self._send(404, {"error": "no remembered capture %r" % cid,
+                                 "known": profstats.summaries()})
+            else:
+                self._send(200, summary)
+            return
+        self._send(200, profstats.hotspots(n))
 
     def do_POST(self):
         if not (self.path.startswith(_MODELS_PREFIX + "/")

@@ -36,6 +36,7 @@ from .trace import (new_request_id, current_request_id,
                     REQUEST_ID_HEADER)
 from . import devstats
 from . import flightrec
+from . import profstats
 from . import slo
 from . import spans
 from . import watchdog
@@ -49,7 +50,7 @@ __all__ = [
     "new_request_id", "current_request_id", "set_current_request_id",
     "request_scope", "REQUEST_ID_HEADER",
     "start_periodic_flush", "stop_periodic_flush", "flush_to_file",
-    "devstats", "flightrec", "slo", "spans", "watchdog",
+    "devstats", "flightrec", "profstats", "slo", "spans", "watchdog",
     "Span", "SpanContext", "span", "record_span", "current_span",
     "current_context",
 ]
@@ -162,5 +163,10 @@ def _maybe_autostart():
     try:
         if config.get_env("MXTPU_DEVSTATS"):
             devstats.start()
+    except Exception:
+        pass
+    try:
+        if config.get_env("MXTPU_PROFSTATS"):
+            profstats.start()
     except Exception:
         pass

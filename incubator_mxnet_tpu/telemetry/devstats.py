@@ -51,6 +51,7 @@ See docs/OBSERVABILITY.md "Device truth".
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import os
@@ -66,6 +67,7 @@ from .registry import counter, gauge
 __all__ = ["program_stats", "peaks", "observe_dispatch", "dispatch_context",
            "start", "stop", "running", "sample_now", "device_memory",
            "set_memory_source", "capture_profile", "ProfileCaptureBusy",
+           "capture_in_progress", "dispatch_totals",
            "PEAK_TABLE", "reset_peaks", "HBM_TABLE", "hbm_capacity"]
 
 _LOG = logging.getLogger(__name__)
@@ -644,16 +646,66 @@ def _capture_base(out_dir=None):
     return base
 
 
+def _prune_mtime(path):
+    """Missing-file-tolerant sort key: a capture subdir can be deleted
+    (concurrent prune in another process, operator rm) between
+    os.listdir and the sort's getmtime — a vanished dir sorts oldest and
+    its rmtree below is already an ignore_errors no-op."""
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
 def _prune(base, keep):
     """Bound the capture dir: keep the ``keep`` newest capture subdirs."""
     try:
         subdirs = [os.path.join(base, d) for d in os.listdir(base)
                    if d.startswith("capture-")]
-        subdirs.sort(key=os.path.getmtime)
+        subdirs.sort(key=_prune_mtime)
         for victim in subdirs[:max(0, len(subdirs) - keep)]:
             shutil.rmtree(victim, ignore_errors=True)
     except Exception:
         _LOG.debug("profile dir prune failed", exc_info=True)
+
+
+@contextlib.contextmanager
+def _trace_session(path):
+    """One profiler capture into ``path``, python tracer OFF by default.
+
+    The python tracer instruments every interpreter call while tracing
+    — measured ~30% on a timer-bound serving request — and that tax
+    lands squarely on p99 whenever a capture overlaps traffic (the
+    continuous profstats daemon's whole operating mode). The op-level
+    attribution layer only reads the XLA TraceMe events (host_tracer),
+    which survive with the python tracer off, so off is the default;
+    MXTPU_PROFILE_PYTHON_TRACER=1 re-enables python frames for
+    interactive debugging. Falls back to jax.profiler.start_trace when
+    the jaxlib session API is unavailable."""
+    from .. import config
+    import jax
+    sess = None
+    try:
+        from jax._src.lib import xla_client
+        jax.devices()                    # backends must exist first
+        opts = xla_client.profiler.ProfileOptions()
+        opts.python_tracer_level = (
+            1 if config.get_env("MXTPU_PROFILE_PYTHON_TRACER") else 0)
+        sess = xla_client.profiler.ProfilerSession(opts)
+    except Exception:
+        _LOG.debug("low-overhead profiler session unavailable; falling "
+                   "back to jax.profiler.start_trace", exc_info=True)
+    if sess is None:
+        jax.profiler.start_trace(path)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+        return
+    try:
+        yield
+    finally:
+        sess.export(sess.stop(), path)
 
 
 def capture_profile(seconds=2.0, out_dir=None):
@@ -666,20 +718,20 @@ def capture_profile(seconds=2.0, out_dir=None):
     from .. import config
     if _capture_lock.acquire(blocking=False):
         try:
-            import jax
             max_s = float(config.get_env("MXTPU_PROFILE_MAX_S"))
             seconds = min(max(0.05, float(seconds)), max(0.05, max_s))
             base = _capture_base(out_dir)
             path = os.path.join(base, "capture-%d-%d"
                                 % (os.getpid(), next(_capture_seq)))
             os.makedirs(path, exist_ok=True)
-            jax.profiler.start_trace(path)
-            try:
+            with _trace_session(path):
                 _time.sleep(seconds)
-            finally:
-                jax.profiler.stop_trace()
             _prune(base, int(config.get_env("MXTPU_PROFILE_KEEP")))
-            return {"dir": path, "seconds": seconds}
+            # capture_id = the subdir basename: stable across _prune (a
+            # remembered profstats summary under this id outlives the
+            # dir), unique per process+sequence
+            return {"dir": path, "seconds": seconds,
+                    "capture_id": os.path.basename(path)}
         finally:
             _capture_lock.release()
     raise ProfileCaptureBusy(
@@ -695,3 +747,21 @@ def capture_in_progress():
         finally:
             _capture_lock.release()
     return True
+
+
+def dispatch_totals():
+    """Process-cumulative dispatch facts summed over every (model, kind)
+    series — the before/after snapshot pair profstats subtracts to join
+    a capture window against device truth: {"flops", "bytes",
+    "dispatch_s", "chip_s", "by_model": {model: dispatch_s}}."""
+    out = {"flops": 0.0, "bytes": 0.0, "dispatch_s": 0.0, "chip_s": 0.0,
+           "by_model": {}}
+    for metric, key in ((_FLOPS_TOTAL, "flops"), (_BYTES_TOTAL, "bytes"),
+                        (_DISPATCH_SECONDS, "dispatch_s"),
+                        (_CHIP_SECONDS, "chip_s")):
+        for labels, v in metric.series():
+            out[key] += v
+            if key == "dispatch_s":
+                m = labels.get("model", "-")
+                out["by_model"][m] = out["by_model"].get(m, 0.0) + v
+    return out

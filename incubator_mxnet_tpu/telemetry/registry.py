@@ -177,6 +177,14 @@ class Counter(_Metric):
                                           _fmt(self._series[key][0])))
         return lines
 
+    def series(self):
+        """[(labels_dict, value)] snapshot for programmatic consumers
+        (devstats.dispatch_totals sums windows over every label set —
+        exposition-text parsing is for scrapers, not in-process code)."""
+        with self._lock:
+            items = [(key, s[0]) for key, s in sorted(self._series.items())]
+        return [(dict(zip(self.labelnames, key)), v) for key, v in items]
+
 
 class Gauge(_Metric):
     """Point-in-time value: ``set``/``inc``/``dec``, or ``set_function`` to

@@ -1,0 +1,416 @@
+"""telemetry/profstats.py — the op-level profile-intelligence layer.
+
+Committed synthetic chrome-trace fixtures (tests/fixtures/profstats/)
+pin the parser against the event shapes the jax profiler actually emits:
+CPU-style flat tracks whose op events carry ``args.hlo_op`` /
+``args.hlo_module``, and TPU-style device tracks where pid names mark
+the device lanes and ``jit_*`` / all-digit umbrellas must never count
+as ops. On top: the devstats join, the rolling fold + /debug/hotspots
+e2e, the daemon's skip/clamp/detach discipline, and the profsum CLI
+round-trip + diff (report shape shared with promcheck)."""
+import gzip
+import json
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from incubator_mxnet_tpu.telemetry import devstats, profstats, spans, watchdog
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "profstats")
+
+
+def fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+@pytest.fixture(autouse=True)
+def _clean_rolling():
+    profstats.reset_rolling()
+    yield
+    profstats.reset_rolling()
+    # the e2e soak below emits hundreds of serve:* spans; drop them so
+    # later suites using the mark-slice idiom (snapshot()[mark:]) never
+    # meet a saturated ring this file filled
+    spans.reset()
+
+
+# ------------------------------------------------------------ categorize
+def test_categorize_mapping():
+    assert profstats.categorize("dot.4") == "matmul"
+    assert profstats.categorize("dot_general") == "matmul"
+    assert profstats.categorize("gemm_fusion.2") == "matmul"
+    assert profstats.categorize("convolution.7") == "conv"
+    assert profstats.categorize("conv.1") == "conv"
+    # "convert" must NOT read as conv (tokens, not substrings)
+    assert profstats.categorize("convert.3") == "elementwise"
+    assert profstats.categorize("reduce.10") == "reduce"
+    assert profstats.categorize("reduce-window") == "reduce"
+    assert profstats.categorize("copy.1") == "copy"
+    assert profstats.categorize("transpose.2") == "copy"
+    assert profstats.categorize("dynamic-slice.5") == "copy"
+    assert profstats.categorize("infeed.1") == "infeed"
+    assert profstats.categorize("outfeed") == "infeed"
+    assert profstats.categorize("all-reduce.3") == "collective"
+    assert profstats.categorize("reduce-scatter.1") == "collective"
+    assert profstats.categorize("tanh.5") == "elementwise"
+    assert profstats.categorize("loop_fusion.12") == "elementwise"
+    assert profstats.categorize("%multiply.9") == "elementwise"
+    assert profstats.categorize("frobnicate.1") == "other"
+
+
+# ---------------------------------------------------------- trace loading
+def test_load_trace_gz_and_plain_agree():
+    gz = profstats.load_trace(fx("basic.trace.json.gz"))
+    plain = profstats.load_trace(fx("basic.trace.json"))
+    assert gz == plain and len(gz) > 0
+
+
+def test_load_trace_broken_raises():
+    with pytest.raises(ValueError):
+        profstats.load_trace(fx("broken.trace.json"))
+
+
+def test_basic_summary_selftimes_categories_idle():
+    s = profstats.summarize_trace(fx("basic.trace.json.gz"))
+    by_op = {o["op"]: o for o in s["ops"]}
+    assert by_op["dot.1"]["self_us"] == pytest.approx(3000.0)
+    assert by_op["dot.1"]["count"] == 3
+    assert by_op["dot.1"]["category"] == "matmul"
+    assert by_op["dot.1"]["module"] == "jit_step"
+    assert by_op["tanh.2"]["self_us"] == pytest.approx(600.0)
+    assert by_op["reduce-window.3"]["self_us"] == pytest.approx(600.0)
+    assert by_op["copy.4"]["self_us"] == pytest.approx(100.0)
+    # ranked by self time, matmul first; shares sum to 1
+    assert s["ops"][0]["op"] == "dot.1"
+    assert sum(o["share"] for o in s["ops"]) == pytest.approx(1.0)
+    assert s["categories"]["matmul"]["self_us"] == pytest.approx(3000.0)
+    assert s["categories"]["reduce"]["count"] == 2
+    # busy 4300us of a 6300us window on one track; the python-thread
+    # noise event (dur 9999) must not have widened the window
+    assert s["window_us"] == pytest.approx(6300.0)
+    assert s["device_busy_us"] == pytest.approx(4300.0)
+    assert s["device_tracks"] == 1
+    assert s["device_idle_ratio"] == pytest.approx(1 - 4300 / 6300, abs=1e-6)
+    # the seeded 2000us idle gap is the top gap
+    assert s["gaps"][0]["dur_us"] == pytest.approx(2000.0)
+    assert s["programs"]["jit_step"] == pytest.approx(4300.0)
+
+
+def test_nested_device_track_self_time():
+    """TPU-shaped track: umbrellas (jit_* / digits) are containers, a
+    parent op's self time excludes its children."""
+    s = profstats.summarize_trace(fx("nested.trace.json"))
+    by_op = {o["op"]: o for o in s["ops"]}
+    assert set(by_op) == {"fusion.1", "dot.2", "conv.3"}
+    assert by_op["dot.2"]["self_us"] == pytest.approx(2000.0)
+    assert by_op["fusion.1"]["self_us"] == pytest.approx(1000.0)
+    assert by_op["conv.3"]["self_us"] == pytest.approx(800.0)
+    assert by_op["conv.3"]["category"] == "conv"
+    # umbrella jit_train stretches the window to 4000; busy is the op
+    # interval union 0..3000 + 3100..3900
+    assert s["window_us"] == pytest.approx(4000.0)
+    assert s["device_busy_us"] == pytest.approx(3800.0)
+
+
+def test_malformed_events_degrade_not_raise():
+    s = profstats.summarize_trace(fx("malformed.trace.json"))
+    assert s["events"] == 1                      # the one good op
+    assert s["ops"][0]["op"] == "dot.5"
+    assert s["skipped_events"] == 4              # no-ts, bad types, neg dur
+    assert s["device_idle_ratio"] is not None
+
+
+def test_empty_trace_degrades():
+    s = profstats.summarize_trace(fx("empty.trace.json"))
+    assert s["events"] == 0 and s["ops"] == []
+    assert s["device_idle_ratio"] is None
+    assert "(no op events)" in profstats.format_table(s)
+
+
+def test_capture_dir_walk_counts_bad_traces(tmp_path):
+    cap = tmp_path / "capture-99-1"
+    cap.mkdir()
+    shutil.copy(fx("basic.trace.json.gz"), cap / "host0.trace.json.gz")
+    shutil.copy(fx("broken.trace.json"), cap / "host1.trace.json")
+    s = profstats.summarize_capture(str(cap))
+    assert s["traces"] == 1 and s["trace_errors"] == 1
+    assert s["capture_id"] == "capture-99-1"
+    assert s["events"] == 9
+
+
+# --------------------------------------------------------- _prune race fix
+def test_prune_tolerates_vanished_subdir(tmp_path, monkeypatch):
+    """A capture dir deleted between os.listdir and the mtime sort must
+    not crash the prune (the race the missing-file-tolerant key fixes)."""
+    base = tmp_path / "profdir"
+    base.mkdir()
+    for i in range(3):
+        d = base / ("capture-1-%d" % i)
+        d.mkdir()
+        os.utime(d, (i + 1, i + 1))          # distinct, ordered mtimes
+    real_listdir = os.listdir
+
+    def ghost_listdir(p):
+        out = list(real_listdir(p))
+        if str(p) == str(base):
+            out.append("capture-ghost")      # listed, already deleted
+        return out
+
+    monkeypatch.setattr(os, "listdir", ghost_listdir)
+    devstats._prune(str(base), keep=2)       # must not raise
+    left = sorted(real_listdir(str(base)))
+    # ghost sorted oldest (mtime 0) and its rmtree no-opped; the real
+    # oldest capture went; the 2 newest survive
+    assert left == ["capture-1-1", "capture-1-2"]
+
+
+# ----------------------------------------------------------- devstats join
+def test_attach_devstats_flops_share_and_category_mfu():
+    s = profstats.summarize_trace(fx("basic.trace.json.gz"))
+    before = {"flops": 1e9, "bytes": 0.0, "dispatch_s": 1.0, "chip_s": 1.0,
+              "by_model": {"m1": 0.75, "m2": 0.25}}
+    after = {"flops": 5.3e9, "bytes": 1e6, "dispatch_s": 2.5, "chip_s": 2.5,
+             "by_model": {"m1": 1.875, "m2": 0.625}}
+    profstats._attach_devstats(s, before, after, wall_s=2.0,
+                               t0_us=0.0, t1_us=2e6)
+    dv = s["devstats"]
+    peak = devstats.peaks()[0]
+    assert dv["flops"] == pytest.approx(4.3e9)
+    assert dv["dispatch_s"] == pytest.approx(1.5)
+    assert dv["mfu"] == pytest.approx(4.3e9 / (1.5 * peak))
+    # category MFU splits the window MFU by time share; sums back to it
+    assert sum(dv["category_mfu"].values()) == pytest.approx(dv["mfu"])
+    assert dv["category_mfu"]["matmul"] == pytest.approx(
+        dv["mfu"] * s["categories"]["matmul"]["share"])
+    # per-op FLOPs share: time-proportional attribution of window flops
+    assert sum(o["flops_est"] for o in s["ops"]) == pytest.approx(4.3e9)
+    top = s["ops"][0]
+    assert top["flops_est"] == pytest.approx(top["share"] * 4.3e9)
+    assert dv["by_model"] == {"m1": pytest.approx(1.125),
+                              "m2": pytest.approx(0.375)}
+    assert s["bubbles"]["spans"] >= 0
+
+
+def test_dispatch_totals_shape():
+    t = devstats.dispatch_totals()
+    assert set(t) == {"flops", "bytes", "dispatch_s", "chip_s", "by_model"}
+    assert isinstance(t["by_model"], dict)
+
+
+def test_counter_series_accessor():
+    from incubator_mxnet_tpu import telemetry
+    c = telemetry.counter("mxtpu_test_profstats_series_total", "t",
+                          ("a", "b"))
+    c.inc(2.5, a="x", b="y")
+    c.inc(1.0, a="z", b="w")
+    assert ({"a": "x", "b": "y"}, 2.5) in c.series()
+    assert len(c.series()) == 2
+
+
+# ------------------------------------------------------ rolling aggregates
+def test_fold_and_hotspots_ranking():
+    s = profstats.summarize_trace(fx("basic.trace.json.gz"))
+    before = profstats._OP_SECONDS.value(model="-", category="matmul")
+    profstats.fold_summary(s)
+    profstats.fold_summary(s)
+    hs = profstats.hotspots(3)
+    assert hs["captures"] == 2
+    assert hs["ops"][0]["op"] == "dot.1"
+    assert hs["ops"][0]["self_us"] == pytest.approx(6000.0)
+    assert hs["ops"][0]["count"] == 6
+    assert len(hs["ops"]) == 3                   # top-n clamp
+    assert hs["categories"]["matmul"]["self_us"] == pytest.approx(6000.0)
+    assert hs["device_idle_ratio"] == pytest.approx(1 - 4300 / 6300,
+                                                    abs=1e-6)
+    # no devstats delta on the summary -> attributed to model "-"
+    after = profstats._OP_SECONDS.value(model="-", category="matmul")
+    assert after - before == pytest.approx(6000.0 / 1e6)
+    assert profstats._IDLE_RATIO.value() == pytest.approx(
+        1 - 4300 / 6300, abs=1e-6)
+
+
+def test_remember_store_bounded_and_fetchable(monkeypatch):
+    from incubator_mxnet_tpu import config
+    for i in range(40):
+        profstats.remember({"capture_id": "capture-1-%d" % i})
+    bound = int(config.get_env("MXTPU_PROFSTATS_SUMMARIES"))
+    ids = profstats.summaries()
+    assert len(ids) == bound
+    assert ids[-1] == "capture-1-39"             # newest survive
+    assert profstats.get_summary("capture-1-39") is not None
+    assert profstats.get_summary("capture-1-0") is None
+
+
+# ----------------------------------------------------------------- daemon
+def test_run_once_skips_under_load():
+    profstats.add_load_probe("test-overload", lambda: 0.95)
+    try:
+        before = profstats._CAPTURES.value(outcome="skipped_load")
+        assert profstats.run_once(capture_s=0.05, interval_s=10.0) is None
+        assert profstats._CAPTURES.value(outcome="skipped_load") \
+            == before + 1
+    finally:
+        profstats.remove_load_probe("test-overload")
+    assert profstats.current_load() == 0.0
+
+
+def test_run_once_skips_while_operator_capture_in_flight():
+    assert devstats._capture_lock.acquire(blocking=False)
+    try:
+        before = profstats._CAPTURES.value(outcome="skipped_busy")
+        assert profstats.run_once(capture_s=0.05, interval_s=10.0) is None
+        assert profstats._CAPTURES.value(outcome="skipped_busy") \
+            == before + 1
+    finally:
+        devstats._capture_lock.release()
+
+
+def test_run_once_clamps_capture_to_duty_budget(monkeypatch):
+    seen = []
+
+    def fake_capture(seconds, out_dir=None, fold=True):
+        seen.append(seconds)
+        return {"dir": "x"}, {"events": 1}
+
+    monkeypatch.setattr(profstats, "capture_and_summarize", fake_capture)
+    profstats.run_once(capture_s=10.0, interval_s=10.0)
+    # MXTPU_PROFSTATS_MAX_DUTY defaults to 0.02 -> 0.2s of a 10s interval
+    assert seen == [pytest.approx(0.2)]
+
+
+def test_daemon_start_stop_detaches(monkeypatch):
+    # the daemon must never fire a real capture here: long interval
+    assert profstats.start(interval_s=3600.0, capture_s=0.05)
+    try:
+        assert profstats.running()
+        assert not profstats.start()             # idempotent
+        assert "profstats" in watchdog.channels()
+        profstats._IDLE_RATIO.set(0.25)
+    finally:
+        profstats.stop()
+    assert not profstats.running()
+    assert "profstats" not in watchdog.channels()
+    # detach-on-stop: the idle gauge exports no stale series
+    assert all(not ln.startswith("mxtpu_profile_device_idle_ratio ")
+               for ln in profstats._IDLE_RATIO.collect())
+    profstats.stop()                             # idempotent
+
+
+# ------------------------------------------------------------ HTTP e2e
+def test_debug_profile_and_hotspots_e2e():
+    """GET /debug/profile gains capture_id + summary; the id stays
+    fetchable via GET /debug/hotspots?capture=<id>; the bare route
+    serves the rolling ranked table."""
+    import urllib.error as _ue
+    import urllib.request as _ur
+    from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+
+    class _Echo:
+        def predict_batch(self, x):
+            return (x + 1.0,)
+
+    def get_json(url):
+        try:
+            with _ur.urlopen(url, timeout=60.0) as r:
+                return r.status, json.loads(r.read())
+        except _ue.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    reg = ModelRegistry()
+    reg.load("echo", _Echo(), max_batch_size=4, batch_timeout_ms=2.0)
+    with ServingServer(reg, port=0) as srv:
+        stop = threading.Event()
+        churn_errors = []
+
+        def churn():
+            body = json.dumps({"inputs": [[1.0, 2.0]]}).encode()
+            while not stop.is_set():
+                req = _ur.Request(
+                    srv.url + "/v1/models/echo:predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    _ur.urlopen(req, timeout=30.0).read()
+                except Exception as e:   # surface transport-level failures
+                    churn_errors.append(repr(e))
+                time.sleep(0.002)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            code, out = get_json(srv.url + "/debug/profile?seconds=0.2")
+        finally:
+            stop.set()
+            t.join(timeout=30.0)
+        assert code == 200, out
+        assert out["capture_id"].startswith("capture-")
+        assert "dir" in out
+        assert isinstance(out["summary"]["ops"], list)
+        assert "device_idle_ratio" in out["summary"]
+        assert out["summary"]["devstats"]["window_s"] > 0
+        # re-fetch by capture id (the full summary, not the brief)
+        code, full = get_json(srv.url + "/debug/hotspots?capture="
+                              + out["capture_id"])
+        assert code == 200 and full["schema"] == profstats.SCHEMA
+        assert full["capture_id"] == out["capture_id"]
+        # unknown id -> 404 with the known list
+        code, err = get_json(srv.url + "/debug/hotspots?capture=nope")
+        assert code == 404 and out["capture_id"] in err["known"]
+        # the rolling table folded the operator capture
+        code, hs = get_json(srv.url + "/debug/hotspots?n=5")
+        assert code == 200 and hs["captures"] >= 1
+        assert isinstance(hs["ops"], list)
+        code, _ = get_json(srv.url + "/debug/hotspots?n=bogus")
+        assert code == 400
+    reg.close()
+
+
+# --------------------------------------------------------------- profsum
+def test_profsum_roundtrip_diff_empty_and_canary(tmp_path, capsys):
+    from tools import profsum
+    cap = tmp_path / "capture-7-1"
+    cap.mkdir()
+    shutil.copy(fx("basic.trace.json.gz"), cap / "h.trace.json.gz")
+    out_a = str(tmp_path / "a.json")
+    out_b = str(tmp_path / "b.json")
+    assert profsum.main(["summarize", str(cap), "--out", out_a]) == 0
+    assert profsum.main([str(cap), "--out", out_b]) == 0   # bare form
+    capsys.readouterr()
+    # identical summaries diff empty
+    assert profsum.main(["diff", out_a, out_b, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is True and rep["findings"] == []
+    # injected 2x slowdown on the top op fires and names the op class
+    assert profsum.main(["diff", out_a, out_b, "--json",
+                         "--inject-slowdown", "2.0"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is False
+    rules = {f["rule"] for f in rep["findings"]}
+    assert "S001" in rules and "S002" in rules
+    msg = " | ".join(f["message"] for f in rep["findings"])
+    assert "dot.1" in msg and "matmul" in msg
+
+
+def test_profsum_report_shape_parity_with_promcheck(tmp_path):
+    from tools import profsum
+    from tools import promcheck
+    a = profstats.summarize_trace(fx("basic.trace.json.gz"))
+    b = profstats.summarize_trace(fx("basic.trace.json"))
+    rep = profsum.diff_report(a, profsum.inject_slowdown(b, 3.0),
+                              b_path="b")
+    ref = promcheck.report("bogus exposition {", path="x")
+    assert set(rep) == set(ref)
+    assert rep["tool"] == "profsum"
+    assert set(rep["findings"][0]) == set(ref["findings"][0])
+    assert rep["counts"]["S001"] == sum(
+        1 for f in rep["findings"] if f["rule"] == "S001")
+
+
+def test_profsum_rejects_non_summary_json(tmp_path):
+    from tools import profsum
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"schema": "something-else"}))
+    with pytest.raises(ValueError):
+        profsum.load_input(str(p))

@@ -67,6 +67,12 @@ HOT_PATH_PATTERNS = (
     "*telemetry/devstats:sample_now",
     "*telemetry/devstats:_poll",
     "*serving/server:_Handler._do_profile",
+    # the continuous profiler's fold path (telemetry/profstats.py): the
+    # daemon loop and the per-capture fold run while traffic serves —
+    # a sync or a trace/analysis walk snuck in there turns the
+    # low-duty-cycle profiler into a steady dispatch tax
+    "*telemetry/profstats:fold_summary",
+    "*telemetry/profstats:_daemon_loop",
 )
 
 _SYNC_ATTRS = ("asnumpy", "item")
@@ -76,6 +82,11 @@ _SYNC_ATTRS = ("asnumpy", "item")
 #: compiled HLO on the hot path, the defect class the devstats layer
 #: exists to avoid (its seeded canary keeps this sub-rule firing)
 _ANALYSIS_ATTRS = ("cost_analysis", "memory_analysis")
+#: chrome-trace walks (telemetry/profstats.py): summarizing a profiler
+#: capture is a gzip+json parse over thousands of events — it belongs on
+#: the profstats daemon / operator route, NEVER inside a dispatch hot
+#: path; the rolling aggregates (profstats.hotspots) are the cheap read
+_TRACE_ATTRS = ("summarize_capture", "summarize_trace", "load_trace")
 _NUMPY_MODULES = ("np", "onp", "numpy")
 
 
@@ -101,6 +112,9 @@ def r001_host_sync(ctx):
         elif isinstance(f, ast.Attribute) and f.attr in _ANALYSIS_ATTRS:
             what = ".%s()" % f.attr
             analysis = True
+        elif isinstance(f, ast.Attribute) and f.attr in _TRACE_ATTRS:
+            what = ".%s()" % f.attr
+            analysis = "trace"
         elif (isinstance(f, ast.Attribute) and f.attr == "asarray"
               and isinstance(f.value, ast.Name)
               and f.value.id in _NUMPY_MODULES):
@@ -109,6 +123,15 @@ def r001_host_sync(ctx):
             continue
         hot = _in_hot_path(ctx, node)
         if hot is None:
+            continue
+        if analysis == "trace":
+            yield ctx.finding(
+                node, "R001",
+                "%s inside hot path %r parses a profiler trace per "
+                "dispatch — a gzip+json walk over thousands of events; "
+                "trace summarize belongs on the profstats daemon or the "
+                "operator route, read the rolling aggregates "
+                "(profstats.hotspots) here instead" % (what, hot))
             continue
         if analysis:
             yield ctx.finding(

@@ -1,12 +1,11 @@
 """Capture a device profile of the bench train step and print the op-time
-breakdown (parses the chrome-trace json the jax profiler emits)."""
-import glob
-import gzip
-import json
+breakdown — the ranked-hotspot table, rendered by the shared chrome-trace
+parser in telemetry/profstats.py (the same summarizer behind
+tools/profsum.py and GET /debug/hotspots; this tool used to carry its own
+ad-hoc parse, folded in there)."""
 import os
 import sys
 import tempfile
-from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -16,6 +15,7 @@ def main():
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, jit
+    from incubator_mxnet_tpu.telemetry import profstats
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     mx.random.seed(0)
@@ -38,34 +38,13 @@ def main():
             loss = step(x, y)
         float(loss.mean().asscalar())
 
-    traces = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
-                       recursive=True)
-    if not traces:
+    summary = profstats.summarize_capture(logdir)
+    if not summary["traces"]:
         print("no trace found under", logdir)
         return
-    with gzip.open(traces[0], "rt") as f:
-        trace = json.load(f)
-
-    # device-track complete events: aggregate wall time by op name
-    pid_names = {}
-    for ev in trace["traceEvents"]:
-        if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            pid_names[ev["pid"]] = ev["args"].get("name", "")
-    dev_pids = {p for p, n in pid_names.items()
-                if "TPU" in n or "Device" in n or "/device" in n.lower()}
-    agg = defaultdict(float)
-    total = 0.0
-    for ev in trace["traceEvents"]:
-        if ev.get("ph") == "X" and ev.get("pid") in dev_pids:
-            name = ev.get("name", "?")
-            if name.startswith("jit_") or name.isdigit():
-                continue  # umbrella/program events double-count leaf ops
-            agg[name] += ev.get("dur", 0.0)
-            total += ev.get("dur", 0.0)
-    print("pids:", {p: n for p, n in pid_names.items()})
-    print("total leaf-op device us per 5 steps: %.0f" % total)
-    for name, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:40]:
-        print("%10.0f us  %5.1f%%  %s" % (dur, 100 * dur / max(total, 1), name))
+    print("capture: %s (%d trace(s), %d op events over 5 steps)"
+          % (logdir, summary["traces"], summary["events"]))
+    print(profstats.format_table(summary, top=40))
 
 
 if __name__ == "__main__":
