@@ -81,6 +81,16 @@
 #             the live exposition passes promcheck; then the fake-clock
 #             SLO/access-log unit tier (tests/test_slo.py, zero real
 #             sleeps); wall budget 60s
+#   generate - generative-inference serving gate (serving/generate.py +
+#             ops/kvcache.py, docs/GENERATE.md): a saturating mixed
+#             prefill/decode soak through tools/loadgen.py --generate
+#             must beat the sequential-decode baseline on tokens/s
+#             (continuous batching earning its keep), the post-warm
+#             window must see zero compiles (counter + span patterns),
+#             the decode window's op profile must be memory-bound
+#             (non-matmul categories own the self time), and the
+#             undonated-decode canary must fire hlolint H002 at error
+#             severity with a nonzero exit
 #   sharded - mesh-sharded serving gate on a forced-8-device CPU host:
 #             two interleaved 1-replica vs 8-replica loadgen soaks of a
 #             timer-bound servable driven through the in-process
@@ -107,7 +117,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo sharded diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo generate sharded diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -835,6 +845,141 @@ EOF
   slo_dt=$(( SECONDS - slo_t0 ))
   echo "slo stage wall time: ${slo_dt}s (budget 60s)"
   [ "$slo_dt" -lt 60 ] || { echo "slo stage took ${slo_dt}s (budget 60s)"; exit 1; }
+fi
+
+if has_stage generate; then
+  echo "=== generate: continuous-batching decode gate + decode H002 canary ==="
+  # The generative-serving contract, gated four ways (docs/GENERATE.md):
+  # continuous batching must BEAT the sequential-decode baseline on
+  # tokens/s under a saturating mixed prefill/decode soak; the post-warm
+  # window must see ZERO compiles (counter + span patterns); the decode
+  # window's op profile must be memory-bound (gather/scatter/fusion own
+  # the self time, not matmul — a decode step that goes compute-bound
+  # has lost paged attention); and the undonated-decode canary must
+  # fire H002 at error severity with a nonzero exit.
+  gen_t0=$SECONDS
+  GEN_DIR=$(mktemp -d -t mxtpu_generate.XXXXXX)
+  JAX_PLATFORMS=cpu MXTPU_PROFILE_DIR="$GEN_DIR/prof" python - "$GEN_DIR" <<'EOF'
+import json, random, sys, threading, time
+from incubator_mxnet_tpu import jit
+from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+from incubator_mxnet_tpu.telemetry import profstats, spans
+from tools import loadgen
+
+out_dir = sys.argv[1]
+# tiny geometry keeps prewarm cheap while 4 sequences decode per step
+reg = ModelRegistry()
+reg.load_generator("gen-ci", seed=0, block_size=8, num_blocks=96,
+                   max_batch=4, prefill_len=16, max_tokens=32)
+eng = reg.generator("gen-ci")
+PROMPT_LEN, MAX_NEW = 12, 24
+
+# ---- sequential-decode baseline: one sequence at a time through the
+# SAME compiled programs (bucket 1, private pool) — the per-request
+# throughput continuous batching must beat
+rng = random.Random(0)
+prompts = [[rng.randrange(1, 256) for _ in range(PROMPT_LEN)]
+           for _ in range(6)]
+t0 = time.monotonic()
+seq_tokens = 0
+for p in prompts:
+    toks, reason = eng.generate_sequential(p, max_new_tokens=MAX_NEW)
+    assert reason == "max_tokens", reason
+    seq_tokens += len(toks)
+seq_tps = seq_tokens / (time.monotonic() - t0)
+
+# ---- post-warm window opens here: NOTHING below may compile
+kinds = ("train", "eval", "serve", "decode")
+c0 = sum(jit._COMPILES.value(kind=k) for k in kinds)
+mark = len(spans.snapshot())
+
+with ServingServer(reg, port=0) as srv:
+    # saturating open-loop soak: arrivals join mid-flight (mixed
+    # prefill/decode), offered token rate ~3.9x the sequential baseline
+    # so goodput measures capacity, with 429 shed doing backpressure
+    tr = loadgen.GenHttpTransport(srv.url, "gen-ci",
+                                  prompt_len=PROMPT_LEN, max_new=MAX_NEW)
+    lg = loadgen.LoadGen(tr, stages=[{"rps": 150, "duration_s": 4.0}],
+                         arrival="poisson", seed=0, max_clients=64)
+    report = lg.run()
+    st = report["stages"][0]
+    gen = st["generate"]
+    cont_tps = gen["tokens_per_s"]
+    assert st["errors"] == 0, st
+    assert gen["finish_reasons"] == {"max_tokens": st["ok"]}, gen
+    ratio = cont_tps / seq_tps
+    assert ratio > 1.3, (
+        "continuous batching must beat sequential decode: "
+        "%.0f vs %.0f tok/s (x%.2f)" % (cont_tps, seq_tps, ratio))
+
+    # ---- decode is memory-bound: profile a decode-dominated window
+    # (24 decode steps per 1 prefill per request) and require the
+    # non-matmul categories to own the self time
+    profstats.capture_and_summarize(0.05, fold=False)  # 1st-session setup
+    def decode_traffic():
+        time.sleep(0.2)
+        stop_t = time.monotonic() + 1.4
+        def churn(i):
+            j = 0
+            while time.monotonic() < stop_t:
+                s = eng.submit(prompts[i % len(prompts)],
+                               max_new_tokens=MAX_NEW,
+                               request_id="prof-%d-%d" % (i, j))
+                for _ in s:
+                    pass
+                j += 1
+        ths = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+        for t in ths: t.start()
+        for t in ths: t.join(60.0)
+    tt = threading.Thread(target=decode_traffic)
+    tt.start()
+    _, summary = profstats.capture_and_summarize(2.5)
+    tt.join(60.0)
+    cats = {k: v["self_us"] for k, v in summary["categories"].items()}
+    total_us = sum(cats.values())
+    mm_share = cats.get("matmul", 0) / max(total_us, 1)
+    assert total_us > 0, summary
+    assert mm_share < 0.5, (
+        "decode window should be memory-bound, matmul owns %.0f%%: %s"
+        % (100 * mm_share, cats))
+
+    # ---- zero-compile contract over EVERYTHING since the mark
+    c1 = sum(jit._COMPILES.value(kind=k) for k in kinds)
+    assert c1 == c0, "compiles moved post-warm: %d -> %d" % (c0, c1)
+    bad = [s["name"] for s in spans.snapshot()[mark:]
+           if s["name"] in ("train:compile", "eval:compile", "gen:compile")]
+    assert not bad, "compile spans landed post-warm: %s" % bad
+
+with open(out_dir + "/gen_stage.json", "w") as f:
+    json.dump({"seq_tok_per_s": seq_tps, "cont_tok_per_s": cont_tps,
+               "ratio": ratio, "matmul_share": mm_share,
+               "categories": cats, "stage": st}, f, indent=1)
+print("generate OK: %.0f tok/s continuous vs %.0f sequential (x%.2f), "
+      "0 post-warm compiles, matmul %.0f%% of decode self time"
+      % (cont_tps, seq_tps, ratio, 100 * mm_share))
+EOF
+  # decode H002 canary: an undonated decode artifact must be REFUSED —
+  # nonzero exit with exactly one error-severity H002
+  JAX_PLATFORMS=cpu python -c "from tools.hlolint.canary import \
+write_decode_canary; write_decode_canary('$GEN_DIR/decode_canary')"
+  if JAX_PLATFORMS=cpu python -m tools.hlolint "$GEN_DIR/decode_canary" \
+      --no-baseline --json > "$GEN_DIR/decode_canary.json"; then
+    echo "generate decode canary FAILED: undonated decode passed the gate"
+    exit 1
+  fi
+  python - "$GEN_DIR/decode_canary.json" <<'EOF'
+import json, sys
+from tools.hlolint.rules import severity_of
+rep = json.load(open(sys.argv[1]))
+assert rep["counts"] == {"H002": 1}, rep["counts"]
+f = rep["findings"][0]
+assert severity_of(f["rule"], f["path"]) == "error", f
+print("decode H002 canary OK: error severity on %s"
+      % f["path"].rsplit("/", 1)[-1])
+EOF
+  gen_dt=$(( SECONDS - gen_t0 ))
+  echo "generate stage wall time: ${gen_dt}s (budget 120s)"
+  [ "$gen_dt" -lt 120 ] || { echo "generate stage took ${gen_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage sharded; then

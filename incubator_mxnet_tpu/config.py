@@ -272,6 +272,50 @@ ENV_VARS = {
         "((b - (b'+1))/b across the artifact set's bucket ladder). The "
         "default 0.5 keeps power-of-two ladders (worst case 37.5%) "
         "clean and fires on gap-toothed ladders like {1, 64}."),
+    "MXTPU_GEN_BLOCK_SIZE": (
+        int, 16,
+        "Token slots per KV-cache block (ops/kvcache.py paged pool). "
+        "Smaller blocks waste less tail capacity per sequence but grow "
+        "the block tables; docs/GENERATE.md has the sizing math."),
+    "MXTPU_GEN_KV_BLOCKS": (
+        int, 256,
+        "KV pool capacity in blocks, preallocated in HBM at engine "
+        "construction (serving/generate.py). Admission of new sequences "
+        "backpressures when the free list runs dry; size against "
+        "devstats hbm_capacity() per docs/GENERATE.md."),
+    "MXTPU_GEN_MAX_BATCH": (
+        int, 8,
+        "Upper decode-batch bucket of the continuous-batching loop (and "
+        "the prefill batcher's max batch). The decode bucket ladder is "
+        "powers of two up to this; every bucket is AOT-prewarmed so "
+        "steady-state decode never compiles."),
+    "MXTPU_GEN_PREFILL_LEN": (
+        int, 64,
+        "Fixed prompt shape of the compiled prefill programs: prompts "
+        "are padded to this length (true length rides as data), longer "
+        "ones are rejected 400. One shape keeps prefill on the bucketed "
+        "batcher's handful of compiled programs."),
+    "MXTPU_GEN_MAX_TOKENS": (
+        int, 128,
+        "Cap on max_new_tokens per generate request; also sizes the "
+        "per-sequence block-table width (with MXTPU_GEN_PREFILL_LEN)."),
+    "MXTPU_GEN_STEP_IDLE_MS": (
+        float, 1.0,
+        "Decode-loop sleep granularity when NO sequence is in flight "
+        "(the loop never sleeps between steps while anything decodes)."),
+    "MXTPU_GEN_SLO_INTER_TOKEN_MS": (
+        float, None,
+        "When set, each tenant generating on a model gets a "
+        "<model>/inter_token/<tenant> SLO (telemetry/slo.py kind="
+        "inter_token) fed one outcome per token gap against this "
+        "threshold in ms — burn-rate alerts and /debug/slo rows per "
+        "tenant. Unset: no inter-token objectives are minted."),
+    "MXTPU_GEN_PREWARM": (
+        bool, True,
+        "AOT-compile (or artifact-load) every generative program bucket "
+        "at engine construction and route fresh decode artifacts "
+        "through the hlolint gate. Disable only in tests that assert "
+        "compile-counting behavior."),
     "MXTPU_WATCHDOG": (
         bool, False,
         "Autostart the stall watchdog monitor thread at package import "

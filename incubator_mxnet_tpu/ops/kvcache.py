@@ -1,0 +1,214 @@
+"""Paged (blocked) KV cache for autoregressive decode — the vLLM-style
+HBM pool behind serving/generate.py's continuous-batching engine.
+
+The problem this layout solves: a naive per-sequence KV cache allocates
+``max_seq_len`` of HBM per request up front, so the in-flight batch is
+sized by the WORST-CASE context even though most sequences retire early
+— the dominant HBM waste in production generative serving. Here the
+cache is one preallocated pool of fixed-size **blocks**
+(``MXTPU_GEN_BLOCK_SIZE`` token slots each); a sequence owns a list of
+block ids (its **block table**) that grows one block at a time as it
+decodes and returns to the free list the moment it retires, so pool
+occupancy tracks the LIVE token count, not the worst case.
+
+Split of responsibilities:
+
+- ``BlockAllocator`` — host-side free-list bookkeeping (alloc/free/used;
+  LIFO reuse so tests can pin reuse determinism). Pure Python, lock
+  guarded: only the decode loop and join path touch it.
+- the pure functions — jit-safe pool updates and reads
+  (``write_seq`` / ``append_token`` / ``gather_layer`` /
+  ``paged_attention``), all expressed as XLA scatter/gather on a pool
+  argument that is **donated** by the decode program
+  (``donate_argnums``), so steady-state decode updates the cache
+  in place instead of copying the whole pool every step. hlolint's
+  H002 decode generalization (tools/hlolint/rules.py) lints exactly
+  this: a compiled decode program whose pool does not alias
+  input→output is an error-severity finding at the load gate.
+
+Out-of-range index convention: scatters use ``mode="drop"`` with
+``num_blocks`` (one past the last block) as the "nowhere" index, so
+padded positions and inactive batch slots write NOTHING rather than
+corrupting block 0 of a live sequence; gathers use the default clamp
+mode and mask by length instead. Both conventions are jit-safe (no
+host-side branching on traced values).
+
+Pool layout: ``(num_blocks, block_size, layers, 2, heads, head_dim)``
+— the leading two dims are the paging geometry (one scatter/gather
+covers every layer), the trailing ``2`` is K/V.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KVCacheOOM", "BlockAllocator", "pool_shape", "make_pool",
+           "pool_bytes", "blocks_for", "write_seq", "append_token",
+           "gather_layer", "paged_attention"]
+
+
+class KVCacheOOM(RuntimeError):
+    """The pool has fewer free blocks than the allocation needs — the
+    engine's join path turns this into admission backpressure (the
+    request waits; decode of the live batch keeps freeing blocks)."""
+
+
+class BlockAllocator:
+    """Host-side free-list over ``num_blocks`` pool blocks.
+
+    LIFO reuse (the most recently freed block is handed out first) —
+    deterministic, so tests can assert a retired sequence's blocks are
+    the exact ones a joining sequence receives. All methods are
+    thread-safe; the invariant ``used + free == total`` holds at every
+    exit and double-frees raise instead of silently corrupting the
+    free list.
+    """
+
+    def __init__(self, num_blocks):
+        if num_blocks <= 0:
+            raise ValueError("need at least one block, got %d" % num_blocks)
+        self.total = int(num_blocks)
+        self._lock = threading.Lock()
+        # stack order: block 0 on top so first alloc is [0, 1, ...]
+        self._free = list(range(self.total - 1, -1, -1))
+        self._held = set()
+
+    @property
+    def used(self):
+        with self._lock:
+            return self.total - len(self._free)
+
+    @property
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n):
+        """Take ``n`` blocks or raise KVCacheOOM taking NONE (an
+        admission decision must never half-allocate)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError("alloc(%d)" % n)
+        with self._lock:
+            if n > len(self._free):
+                raise KVCacheOOM(
+                    "need %d KV block(s), %d free of %d — raise "
+                    "MXTPU_GEN_KV_BLOCKS or lower the admission load"
+                    % (n, len(self._free), self.total))
+            taken = [self._free.pop() for _ in range(n)]
+            self._held.update(taken)
+        return taken
+
+    def free(self, blocks):
+        """Return blocks to the free list (newest freed reused first)."""
+        with self._lock:
+            for b in blocks:
+                b = int(b)
+                if b not in self._held:
+                    raise ValueError("double free of KV block %d" % b)
+                self._held.discard(b)
+                self._free.append(b)
+
+
+def blocks_for(tokens, block_size):
+    """Blocks needed to hold ``tokens`` positions (ceil division)."""
+    return max(1, -(-int(tokens) // int(block_size)))
+
+
+def pool_shape(num_blocks, block_size, layers, heads, head_dim):
+    """The pool's array shape — the one place the layout is spelled."""
+    return (num_blocks, block_size, layers, 2, heads, head_dim)
+
+
+def make_pool(num_blocks, block_size, layers, heads, head_dim,
+              dtype=jnp.float32):
+    """The preallocated HBM pool, zero-filled (unwritten slots read as
+    zeros — finite, so a masked row never produces NaN scores)."""
+    return jnp.zeros(pool_shape(num_blocks, block_size, layers, heads,
+                                head_dim), dtype=dtype)
+
+
+def pool_bytes(num_blocks, block_size, layers, heads, head_dim,
+               dtype=jnp.float32):
+    """Planning math for docs/GENERATE.md sizing against devstats
+    ``hbm_capacity()``: bytes one pool occupies."""
+    n = num_blocks * block_size * layers * 2 * heads * head_dim
+    return int(n) * jnp.dtype(dtype).itemsize
+
+
+def _nowhere(pool):
+    """The drop index: one past the last block (mode='drop' discards)."""
+    return pool.shape[0]
+
+
+def write_seq(pool, blocks, k, v, length):
+    """Write one sequence's prefill K/V into its blocks (jit-safe).
+
+    ``blocks``: (max_blocks,) int32 block table row; ``k``/``v``:
+    (L_pad, layers, heads, head_dim) — positions ``>= length`` are
+    padding and are dropped (their scatter index is out of range).
+    Returns the updated pool; the compiled join program donates ``pool``
+    so this is an in-place block write on device.
+    """
+    L_pad = k.shape[0]
+    bs = pool.shape[1]
+    pos = jnp.arange(L_pad, dtype=jnp.int32)
+    blk = jnp.where(pos < length, blocks[pos // bs], _nowhere(pool))
+    off = pos % bs
+    kv = jnp.stack([k, v], axis=2)      # (L_pad, layers, 2, heads, hd)
+    return pool.at[blk, off].set(kv, mode="drop")
+
+
+def append_token(pool, block_tables, lengths, layer, k, v, active=None):
+    """Append one decode step's K/V at position ``lengths[i]`` for every
+    batch row (jit-safe, one layer at a time — layer ``l``'s K/V only
+    exists after layer ``l-1``'s attention ran).
+
+    ``block_tables``: (B, max_blocks) int32; ``lengths``: (B,) int32
+    (the position being written); ``k``/``v``: (B, heads, head_dim).
+    Rows where ``active`` is False (padded batch slots) write nothing.
+    """
+    bs = pool.shape[1]
+    b_idx = jnp.arange(block_tables.shape[0])
+    blk = block_tables[b_idx, lengths // bs]
+    if active is not None:
+        blk = jnp.where(active, blk, _nowhere(pool))
+    off = lengths % bs
+    kv = jnp.stack([k, v], axis=1)      # (B, 2, heads, hd)
+    return pool.at[blk, off, layer].set(kv, mode="drop")
+
+
+def gather_layer(pool, block_tables, layer):
+    """One layer's cached K and V for every row, block-table order =
+    position order: -> (keys, values), each (B, T, heads, head_dim)
+    where ``T = max_blocks * block_size``. Out-of-range table entries
+    clamp (default gather mode) — callers mask by length, so clamped
+    garbage never reaches the softmax unmasked."""
+    g = pool[block_tables]              # (B, max_blocks, bs, layers, 2, h, d)
+    B, mb, bs = g.shape[0], g.shape[1], g.shape[2]
+    g = g[:, :, :, layer]               # (B, max_blocks, bs, 2, h, d)
+    g = g.reshape(B, mb * bs, 2, g.shape[4], g.shape[5])
+    return g[:, :, 0], g[:, :, 1]
+
+
+def paged_attention(q, keys, values, lengths):
+    """Masked single-token attention over the gathered cache (jit-safe).
+
+    ``q``: (B, heads, head_dim) — the current position's query;
+    ``keys``/``values``: (B, T, heads, head_dim) from ``gather_layer``;
+    ``lengths``: (B,) int32 — the number of VALID positions (including
+    the token just appended). Softmax runs in fp32 (the flash-kernel
+    numerics convention, ops/attention.py) and positions ``>= length``
+    score ``-inf`` — with length >= 1 guaranteed by the caller the row
+    sum is always finite.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bhd,bthd->bht", q, keys).astype(jnp.float32) * scale
+    t = jnp.arange(keys.shape[1], dtype=jnp.int32)
+    mask = t[None, :] < lengths[:, None]            # (B, T)
+    s = jnp.where(mask[:, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(values.dtype)
+    return jnp.einsum("bht,bthd->bhd", p, values)

@@ -1,0 +1,954 @@
+"""Generative-inference serving: continuous batching over a paged KV
+cache (the subsystem ROADMAP item 1's kernels exist to feed).
+
+One ``GenerativeEngine`` owns one model's autoregressive serving:
+
+- **Prefill** rides the existing :class:`~.batcher.DynamicBatcher`:
+  prompts are padded to the fixed ``MXTPU_GEN_PREFILL_LEN`` shape (true
+  length rides as a scalar input), so cross-request coalescing hits the
+  same handful of compiled batch buckets one-shot predict traffic does.
+  The prefill program returns the prompt's full K/V stack plus the FIRST
+  sampled token — TTFT is one batched dispatch, never a decode-loop wait.
+- **Decode** is a persistent single-thread loop over an in-flight batch:
+  each step embeds every live sequence's last token, appends its K/V
+  into the paged pool (ops/kvcache.py), attends over the cache, and
+  samples the next token. Requests JOIN the batch between steps (their
+  prefill K/V is scattered into freshly allocated blocks) and LEAVE the
+  moment they retire (EOS / max-tokens / client disconnect / pool
+  exhaustion), freeing their blocks — batch composition changes per
+  step, compiled shapes never do: the loop pads the live set up to a
+  fixed ladder of decode-batch buckets, every bucket AOT-prewarmed via
+  ``aot.compile_cached`` (kind="decode"), so steady-state decode
+  performs ZERO XLA compiles (the CI generate stage asserts it on the
+  compile counter and on ``gen:compile`` span absence).
+
+Per-row numerics are BATCH-COMPOSITION-INDEPENDENT by construction: the
+sampling key is ``fold_in(PRNGKey(seed_row), n_generated_row)`` computed
+inside the program, every attention read is masked by the row's own
+length, and row-wise matmul/softmax results are bitwise identical across
+bucket sizes on a fixed backend — so a sequence decoded mid-batch,
+joined and left around by strangers, emits exactly the tokens the
+sequential reference (``generate_sequential``, same compiled programs at
+bucket 1 on a private pool) emits. tests/test_generate.py pins that
+bit-exactness; the CI stage uses the sequential path as the goodput
+baseline continuous batching must beat.
+
+Donation contract: the decode and KV-join programs donate the pool
+argument (``donate_argnums=(0,)``), so the multi-MB cache updates in
+place instead of round-tripping HBM every step. tools/hlolint's H002
+generalization lints the persisted ``decode-*`` artifacts for exactly
+this input→output aliasing at error severity; ``warm()`` routes its
+fresh artifacts through the same load gate the predict registry uses.
+
+The model served here is ``TinyLM`` — a self-contained two-layer
+pre-norm transformer (tied embeddings, paramless RMSNorm, no positional
+encoding) whose weights are derived from a seed and baked into the
+compiled programs as constants. It is deliberately small: the subsystem
+under test is the serving machinery (paging, batching, zero-compile
+steady state, streaming, SLOs), not the language model.
+
+Telemetry: ``gen:prefill`` / ``gen:decode_step`` spans (request_ids
+attached, so the loadgen span join attributes device time per request),
+``mxtpu_gen_tokens_total{model,tenant,phase}``,
+``mxtpu_gen_inflight_seqs``, ``mxtpu_gen_kv_blocks_{used,total}``, the
+``mxtpu_gen_inter_token_ms`` histogram, and — when
+``MXTPU_GEN_SLO_INTER_TOKEN_MS`` is set — one per-tenant
+``<model>/inter_token/<tenant>`` SLO fed a 200-coded outcome per token
+gap (telemetry/slo.py ``observe_named``). See docs/GENERATE.md.
+"""
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+from collections import deque
+
+import numpy as onp
+
+from .. import aot, config
+from .. import jit as jit_mod
+from .. import telemetry
+from ..ops import kvcache
+from ..telemetry import flightrec, spans, watchdog
+from ..telemetry import slo as slo_mod
+from . import accesslog
+from .batcher import DynamicBatcher, QueueFullError, ServingClosedError, \
+    default_buckets
+
+__all__ = ["GenerativeEngine", "GenStream", "BadGenRequest", "TinyLM",
+           "EOS_TOKEN", "QueueFullError", "ServingClosedError"]
+
+_LOG = logging.getLogger(__name__)
+
+#: Retiring token id: a sampled 0 ends the sequence (reason "eos").
+EOS_TOKEN = 0
+
+_FINISH_REASONS = ("eos", "max_tokens", "disconnect", "kv_oom", "error")
+
+_TOKENS = telemetry.counter(
+    "mxtpu_gen_tokens_total",
+    "Tokens through the generative engine: phase=prefill counts prompt "
+    "tokens ingested, phase=decode counts tokens GENERATED (the goodput "
+    "numerator loadgen --generate reports).",
+    ("model", "tenant", "phase"))
+_INFLIGHT = telemetry.gauge(
+    "mxtpu_gen_inflight_seqs",
+    "Sequences currently owned by the engine: decoding in the in-flight "
+    "batch plus admitted-but-waiting joins.", ("model",))
+_KV_USED = telemetry.gauge(
+    "mxtpu_gen_kv_blocks_used",
+    "KV pool blocks held by live sequences.", ("model",))
+_KV_TOTAL = telemetry.gauge(
+    "mxtpu_gen_kv_blocks_total",
+    "KV pool capacity in blocks (MXTPU_GEN_KV_BLOCKS).", ("model",))
+_INTER_TOKEN_MS = telemetry.histogram(
+    "mxtpu_gen_inter_token_ms",
+    "Gap between consecutive streamed tokens of one sequence, measured "
+    "at engine emit (excludes HTTP write). The p99 here is what the "
+    "per-tenant inter_token SLO objectives budget.",
+    buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500),
+    labelnames=("model",))
+
+
+class BadGenRequest(ValueError):
+    """Client-malformed generate request (HTTP 400): bad token ids,
+    empty/oversized prompt, max_new_tokens out of range."""
+
+
+# ------------------------------------------------------------------ TinyLM
+class TinyLM:
+    """Seed-derived two-layer pre-norm transformer, 256-token byte
+    vocabulary, tied embeddings, no positional encoding. The weights are
+    CLOSED OVER by the compiled programs (baked constants): the whole
+    model is ~100 KB, and constant-baking keeps every program's runtime
+    argument list down to the serving state (pool / tables / tokens),
+    which is what the donation and zero-compile contracts are about."""
+
+    VOCAB = 256
+    D_MODEL = 64
+    LAYERS = 2
+    HEADS = 2
+    HEAD_DIM = 32
+
+    def __init__(self, seed=0):
+        import jax
+        self.seed = int(seed)
+        key = jax.random.PRNGKey(self.seed)
+        def draw(shape):
+            nonlocal key
+            key, sub = jax.random.split(key)
+            return jax.random.normal(sub, shape, "float32") * 0.02
+        d, h, hd = self.D_MODEL, self.HEADS, self.HEAD_DIM
+        self.emb = draw((self.VOCAB, d))
+        self.layers = [
+            {"wq": draw((d, h * hd)), "wk": draw((d, h * hd)),
+             "wv": draw((d, h * hd)), "wo": draw((h * hd, d)),
+             "w1": draw((d, 4 * d)), "w2": draw((4 * d, d))}
+            for _ in range(self.LAYERS)]
+
+    def model_id(self):
+        """Stable digest (aot.CacheKey model_id): seed + architecture —
+        a fresh process with the same seed resolves the same persisted
+        artifacts."""
+        return "tinylm-s%d-v%d-d%d-l%d-h%dx%d" % (
+            self.seed, self.VOCAB, self.D_MODEL, self.LAYERS, self.HEADS,
+            self.HEAD_DIM)
+
+    # -------------------------------------------------------- pure pieces
+    @staticmethod
+    def _rms(x):
+        import jax.numpy as jnp
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x / jnp.sqrt(ms + 1e-6)
+
+    def _mlp(self, layer, x):
+        import jax.numpy as jnp
+        return jnp.maximum(self._rms(x) @ layer["w1"], 0.0) @ layer["w2"]
+
+    def _sample(self, logits, key, temperature, top_k):
+        """Greedy when temperature <= 0; else temperature softmax
+        restricted to the top_k ranked logits (top_k <= 0 = full vocab).
+        Rank masking (argsort of argsort) instead of a dynamic slice
+        keeps per-row top_k jit-safe."""
+        import jax
+        import jax.numpy as jnp
+        greedy = jnp.argmax(logits).astype(jnp.int32)
+        scaled = (logits / jnp.maximum(temperature, 1e-6)
+                  ).astype(jnp.float32)
+        k_eff = jnp.where(top_k > 0, top_k, logits.shape[-1])
+        rank = jnp.argsort(jnp.argsort(-scaled))
+        masked = jnp.where(rank < k_eff, scaled, -jnp.inf)
+        sampled = jax.random.categorical(key, masked).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    def prefill_one(self, tokens, length, seed, temperature, top_k):
+        """One row's prompt pass: causal self-attention over the padded
+        prompt -> (k_all, v_all) in write_seq layout (L, layers, heads,
+        head_dim) + the first generated token, sampled inside the
+        program with fold_in(key(seed), 0)."""
+        import jax
+        import jax.numpy as jnp
+        L = tokens.shape[0]
+        h, hd = self.HEADS, self.HEAD_DIM
+        x = self.emb[tokens]                          # (L, d)
+        pos = jnp.arange(L, dtype=jnp.int32)
+        causal = pos[None, :] <= pos[:, None]         # (Lq, Lk)
+        ks, vs = [], []
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        for layer in self.layers:
+            hn = self._rms(x)
+            q = (hn @ layer["wq"]).reshape(L, h, hd)
+            k = (hn @ layer["wk"]).reshape(L, h, hd)
+            v = (hn @ layer["wv"]).reshape(L, h, hd)
+            s = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32) * scale
+            s = jnp.where(causal[None], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            o = jnp.einsum("hqk,khd->qhd", p, v).reshape(L, h * hd)
+            x = x + o @ layer["wo"]
+            x = x + self._mlp(layer, x)
+            ks.append(k)
+            vs.append(v)
+        k_all = jnp.stack(ks, axis=1)                 # (L, layers, h, hd)
+        v_all = jnp.stack(vs, axis=1)
+        x_last = jnp.take(x, length - 1, axis=0)      # clamp-safe; len >= 1
+        logits = x_last @ self.emb.T
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+        first = self._sample(logits, key, temperature, top_k)
+        return k_all, v_all, first
+
+    def decode_step(self, pool, block_tables, lengths, last_tokens, seeds,
+                    n_generated, temperatures, top_ks, active):
+        """One continuous-batching step over the whole bucket: append
+        every row's last token K/V at its own position, attend over its
+        own cache prefix, sample its next token with its own
+        fold_in(key(seed_row), n_generated_row). ``pool`` is DONATED by
+        the compiled program — the in-place cache update H002-decode
+        lints for."""
+        import jax
+        import jax.numpy as jnp
+        B = last_tokens.shape[0]
+        h, hd = self.HEADS, self.HEAD_DIM
+        x = self.emb[last_tokens]                     # (B, d)
+        for li, layer in enumerate(self.layers):
+            hn = self._rms(x)
+            q = (hn @ layer["wq"]).reshape(B, h, hd)
+            k = (hn @ layer["wk"]).reshape(B, h, hd)
+            v = (hn @ layer["wv"]).reshape(B, h, hd)
+            pool = kvcache.append_token(pool, block_tables, lengths, li,
+                                        k, v, active=active)
+            keys, vals = kvcache.gather_layer(pool, block_tables, li)
+            att_len = jnp.maximum(lengths + 1, 1)
+            o = kvcache.paged_attention(q, keys, vals, att_len)
+            x = x + o.reshape(B, h * hd) @ layer["wo"]
+            x = x + self._mlp(layer, x)
+        logits = x @ self.emb.T                       # (B, V)
+        keys_r = jax.vmap(
+            lambda s, n: jax.random.fold_in(jax.random.PRNGKey(s), n)
+        )(seeds, n_generated)
+        next_t = jax.vmap(self._sample)(logits, keys_r, temperatures,
+                                        top_ks)
+        return pool, next_t
+
+
+# ------------------------------------------------------------- stream handle
+class GenStream:
+    """The streaming handle one submit() returns: a bounded queue of
+    ``("tok", id)`` events terminated by one ``("end", reason)``. The
+    HTTP front-end iterates it into chunked-response lines; a consumer
+    that dies calls ``cancel()`` and the decode loop retires the row at
+    its next step (reason "disconnect")."""
+
+    def __init__(self, request_id, tenant, maxsize=0):
+        self.request_id = request_id
+        self.tenant = tenant
+        self.finish_reason = None
+        self._q = _queue.Queue(maxsize=maxsize)
+        self._cancel = threading.Event()
+
+    @property
+    def cancelled(self):
+        return self._cancel.is_set()
+
+    def cancel(self):
+        """Client-gone signal: the engine frees the row's KV blocks at
+        the next decode step. Idempotent; safe from any thread."""
+        self._cancel.set()
+
+    def get(self, timeout=None):
+        """Next event, ('tok', id) or ('end', reason); raises
+        queue.Empty on timeout."""
+        return self._q.get(timeout=timeout)
+
+    def __iter__(self):
+        """Token ids until the terminal event (blocking; the engine's
+        step cadence bounds the gaps)."""
+        while True:
+            kind, val = self.get(timeout=600.0)
+            if kind == "end":
+                self.finish_reason = val
+                return
+            yield val
+
+    def tokens(self, timeout=600.0):
+        """Drain to completion -> (token list, finish reason)."""
+        out = []
+        deadline = time.monotonic() + timeout
+        while True:
+            kind, val = self.get(timeout=max(0.0, deadline -
+                                             time.monotonic()))
+            if kind == "end":
+                self.finish_reason = val
+                return out, val
+            out.append(val)
+
+    # engine side
+    def _emit(self, tok):
+        self._q.put(("tok", int(tok)))
+
+    def _end(self, reason):
+        self.finish_reason = reason
+        self._q.put(("end", reason))
+
+
+class _Seq:
+    """Decode-loop state of one admitted sequence."""
+
+    __slots__ = ("stream", "request_id", "tenant", "seed", "temperature",
+                 "top_k", "max_new", "length", "last_token", "n_generated",
+                 "blocks", "table", "k_all", "v_all", "t_last", "slo_name")
+
+    def __init__(self, stream, k_all, v_all, length, first_token, seed,
+                 temperature, top_k, max_new, slo_name):
+        self.stream = stream
+        self.request_id = stream.request_id
+        self.tenant = stream.tenant
+        self.k_all = k_all          # (PREFILL_LEN, layers, h, hd), numpy
+        self.v_all = v_all
+        self.length = int(length)   # K/V entries in cache once joined
+        self.last_token = int(first_token)
+        self.seed = int(seed)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.max_new = int(max_new)
+        self.n_generated = 1        # the prefill-sampled first token
+        self.blocks = None
+        self.table = None
+        self.t_last = time.monotonic()
+        self.slo_name = slo_name
+
+
+# ------------------------------------------------------------------- engine
+class GenerativeEngine:
+    """Continuous-batching generative server for one model.
+
+    Lifecycle: construct -> (``prewarm`` compiles/loads every program
+    bucket and lints the fresh decode artifacts) -> ``submit()`` per
+    request -> ``close()``. The decode loop thread starts at
+    construction and idles at ``MXTPU_GEN_STEP_IDLE_MS`` granularity
+    when no sequence is live.
+    """
+
+    def __init__(self, name="tinylm", model=None, seed=0, block_size=None,
+                 num_blocks=None, max_batch=None, prefill_len=None,
+                 max_tokens=None, prewarm=None, eos_token=EOS_TOKEN,
+                 batch_timeout_ms=None):
+        self.name = name
+        self.model = model if model is not None else TinyLM(seed)
+        self.eos_token = int(eos_token)
+        self.block_size = int(block_size if block_size is not None
+                              else config.get_env("MXTPU_GEN_BLOCK_SIZE"))
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else config.get_env("MXTPU_GEN_KV_BLOCKS"))
+        self.max_batch = int(max_batch if max_batch is not None
+                             else config.get_env("MXTPU_GEN_MAX_BATCH"))
+        self.prefill_len = int(prefill_len if prefill_len is not None
+                               else config.get_env("MXTPU_GEN_PREFILL_LEN"))
+        self.max_tokens = int(max_tokens if max_tokens is not None
+                              else config.get_env("MXTPU_GEN_MAX_TOKENS"))
+        self.step_idle_s = float(
+            config.get_env("MXTPU_GEN_STEP_IDLE_MS")) / 1000.0
+        self._slo_ms = config.get_env("MXTPU_GEN_SLO_INTER_TOKEN_MS")
+        if prewarm is None:
+            prewarm = bool(config.get_env("MXTPU_GEN_PREWARM"))
+        # longest cache a sequence can need: full prompt + every
+        # generated token but the last (whose K/V is never appended)
+        self.max_blocks = kvcache.blocks_for(
+            self.prefill_len + self.max_tokens, self.block_size)
+        if self.max_blocks > self.num_blocks:
+            raise ValueError(
+                "one max-length sequence needs %d KV blocks but the pool "
+                "holds %d — raise MXTPU_GEN_KV_BLOCKS or shrink "
+                "MXTPU_GEN_PREFILL_LEN/MXTPU_GEN_MAX_TOKENS"
+                % (self.max_blocks, self.num_blocks))
+        self.decode_buckets = default_buckets(self.max_batch)
+        self._model_id = self.model.model_id()
+        m = self.model
+        self._alloc = kvcache.BlockAllocator(self.num_blocks)
+        # every pool rebind keeps this shape; spec builders read the
+        # immutable tuple so only the decode loop ever touches _pool
+        self._pool_shape = kvcache.pool_shape(
+            self.num_blocks, self.block_size, m.LAYERS, m.HEADS, m.HEAD_DIM)
+        self._pool = kvcache.make_pool(
+            self.num_blocks, self.block_size, m.LAYERS, m.HEADS, m.HEAD_DIM)
+        # program tables (bucket -> compiled fn); misses compile through
+        # aot.compile_cached, so post-warm lookups never build
+        self._fn_lock = threading.Lock()
+        self._prefill_fns = {}
+        self._decode_fns = {}
+        self._write_fn_cached = None
+        # decode-loop state: _active is owned by the loop thread; _pending
+        # and the wake condition are the submit->loop handoff
+        self._active = []
+        self._pend_lock = threading.Lock()
+        self._pending = deque()
+        self._pending_cap = max(16, 4 * self.max_batch)
+        self._wake = threading.Condition(self._pend_lock)
+        self._closed = False
+        self._inflight_fn = lambda: self._inflight_count()
+        self._kv_used_fn = lambda: self._alloc.used
+        self._kv_total_fn = lambda: self._alloc.total
+        try:
+            _INFLIGHT.set_function(self._inflight_fn, model=self.name)
+            _KV_USED.set_function(self._kv_used_fn, model=self.name)
+            _KV_TOTAL.set_function(self._kv_total_fn, model=self.name)
+        except Exception:
+            _LOG.debug("gen gauge binding failed", exc_info=True)
+        # prefill coalescing rides the standard batcher; its servable is
+        # the bucket-compiled prefill program lookup
+        self._prefill = DynamicBatcher(
+            self._prefill_dispatch, max_batch_size=self.max_batch,
+            batch_timeout_ms=batch_timeout_ms,
+            name="%s-prefill" % self.name, replicas=1)
+        if prewarm:
+            self.warm()
+        self._hb = watchdog.register("genloop:%s" % self.name)
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        daemon=True,
+                                        name="mxtpu-gen-%s" % self.name)
+        self._thread.start()
+
+    # ------------------------------------------------------------ compiling
+    def _specs(self, *shape_dtypes):
+        import jax
+        return tuple(jax.ShapeDtypeStruct(s, d) for s, d in shape_dtypes)
+
+    def _compile(self, tag, fn, arg_specs, kind, donate=()):
+        """Build-or-load one program through the shared AOT cache. Fresh
+        builds are counted on the jit compile counter under this
+        program's kind and traced as ``gen:compile`` spans — the
+        steady-state zero-compile assertion watches exactly these."""
+        import jax
+        key = aot.cache_key(
+            self._model_id, aot.input_signature(arg_specs), kind=kind,
+            extra=(tag,))
+
+        def build():
+            t0 = time.monotonic()
+            donate_n = jit_mod._donate(tuple(donate))
+            jitted = jax.jit(fn, donate_argnums=donate_n) if donate_n \
+                else jax.jit(fn)
+            exported = None
+            try:
+                from jax import export as jax_export
+                exported = jax_export.export(jitted)(*arg_specs)
+                inner = jax.jit(exported.call, donate_argnums=donate_n) \
+                    if donate_n else jax.jit(exported.call)
+                compiled = inner.lower(*arg_specs).compile()
+            except Exception:
+                _LOG.debug("gen %s export failed; direct AOT", tag,
+                           exc_info=True)
+                exported = None
+                compiled = jitted.lower(*arg_specs).compile()
+            dur = time.monotonic() - t0
+            try:
+                jit_mod._COMPILES.inc(kind=kind)
+                jit_mod._COMPILE_SECONDS.inc(dur, kind=kind)
+            except Exception:
+                pass
+            jit_mod._record_compile_span("gen:compile", dur)
+            return compiled, {}, exported
+
+        entry = aot.compile_cached(key, build, exportable=True,
+                                   arg_specs=arg_specs)
+        return entry.fn
+
+    def _prefill_fn(self, bucket):
+        with self._fn_lock:
+            fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        m = self.model
+        L = self.prefill_len
+        batched = jax.vmap(m.prefill_one)
+        specs = self._specs(
+            ((bucket, L), "int32"), ((bucket,), "int32"),
+            ((bucket,), "int32"), ((bucket,), "float32"),
+            ((bucket,), "int32"))
+        fn = self._compile("prefill-b%d" % bucket, batched, specs,
+                           kind="serve")
+        with self._fn_lock:
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode_fn(self, bucket):
+        with self._fn_lock:
+            fn = self._decode_fns.get(bucket)
+        if fn is not None:
+            return fn
+        m = self.model
+        specs = self._specs(
+            (self._pool_shape, "float32"),
+            ((bucket, self.max_blocks), "int32"), ((bucket,), "int32"),
+            ((bucket,), "int32"), ((bucket,), "int32"),
+            ((bucket,), "int32"), ((bucket,), "float32"),
+            ((bucket,), "int32"), ((bucket,), "bool"))
+        fn = self._compile("decode-b%d" % bucket, m.decode_step, specs,
+                           kind="decode", donate=(0,))
+        with self._fn_lock:
+            self._decode_fns[bucket] = fn
+        return fn
+
+    def _write_fn(self):
+        if self._write_fn_cached is not None:
+            return self._write_fn_cached
+        m = self.model
+        specs = self._specs(
+            (self._pool_shape, "float32"),
+            ((self.max_blocks,), "int32"),
+            ((self.prefill_len, m.LAYERS, m.HEADS, m.HEAD_DIM), "float32"),
+            ((self.prefill_len, m.LAYERS, m.HEADS, m.HEAD_DIM), "float32"),
+            ((), "int32"))
+        fn = self._compile("kvjoin", kvcache.write_seq, specs,
+                           kind="decode", donate=(0,))
+        self._write_fn_cached = fn
+        return fn
+
+    def warm(self):
+        """Compile/load every fixed-shape program — all prefill batch
+        buckets, all decode-batch buckets, the KV-join scatter — then
+        route the freshly inserted decode artifacts through the hlolint
+        load gate (MXTPU_HLOLINT_GATE): a decode program that copies its
+        pool (H002 at error severity) refuses to serve."""
+        t0 = time.monotonic()
+        with aot.collect_inserts() as fresh:
+            for b in self._prefill.buckets:
+                with spans.span("aot:warm", model=self.name,
+                                what="gen-prefill", bucket=b):
+                    self._prefill_fn(b)
+            for b in self.decode_buckets:
+                with spans.span("aot:warm", model=self.name,
+                                what="gen-decode", bucket=b):
+                    self._decode_fn(b)
+            with spans.span("aot:warm", model=self.name, what="gen-kvjoin"):
+                self._write_fn()
+        self._gate_artifacts(fresh)
+        flightrec.record("gen_warm", model=self.name,
+                         prefill_buckets=len(self._prefill.buckets),
+                         decode_buckets=len(self.decode_buckets),
+                         dur_ms=round((time.monotonic() - t0) * 1e3, 1))
+
+    def _gate_artifacts(self, entries):
+        """The registry's hlolint load-gate discipline, engine-side: lint
+        what the warm just produced; error findings (a decode program
+        with zero aliasing) fail the load instead of serving slow."""
+        if not config.get_env("MXTPU_HLOLINT_GATE"):
+            return
+        try:
+            from tools.hlolint import gate as hlogate
+        except ImportError:
+            return
+        try:
+            errors, warns = hlogate.lint_entries(entries)
+            hlogate.publish(errors + warns, model=self.name)
+        except Exception:
+            _LOG.warning("gen hlolint gate failed open", exc_info=True)
+            return
+        if errors:
+            flightrec.record("hlolint_refused", model=self.name,
+                             errors=[f.rule for f in errors])
+            raise RuntimeError(
+                "hlolint refused generative load of %r: %s"
+                % (self.name, "; ".join("%s %s: %s" % (f.path, f.rule,
+                                                       f.message)
+                                        for f in errors)))
+
+    # -------------------------------------------------------------- metrics
+    def _inflight_count(self):
+        with self._pend_lock:
+            pend = len(self._pending)
+        return len(self._active) + pend
+
+    def kv_blocks(self):
+        """(used, total) — test/debug hook mirroring the gauges."""
+        return self._alloc.used, self._alloc.total
+
+    # --------------------------------------------------------------- submit
+    def _validate(self, prompt, max_new_tokens, temperature, top_k, seed):
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            raise BadGenRequest("prompt must be a list of token ids")
+        if not prompt:
+            raise BadGenRequest("prompt must not be empty")
+        if len(prompt) > self.prefill_len:
+            raise BadGenRequest(
+                "prompt length %d exceeds MXTPU_GEN_PREFILL_LEN=%d"
+                % (len(prompt), self.prefill_len))
+        if any(t < 0 or t >= self.model.VOCAB for t in prompt):
+            raise BadGenRequest("token ids must be in [0, %d)"
+                               % self.model.VOCAB)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.max_tokens)
+        if not 1 <= max_new <= self.max_tokens:
+            raise BadGenRequest(
+                "max_new_tokens must be in [1, %d] (MXTPU_GEN_MAX_TOKENS)"
+                % self.max_tokens)
+        try:
+            temperature = float(temperature)
+            top_k = int(top_k)
+            # PRNG seeds ride as int32 program inputs
+            seed = int(seed) & 0x7FFFFFFF
+        except (TypeError, ValueError):
+            raise BadGenRequest(
+                "temperature/top_k/seed must be numeric")
+        return prompt, max_new, temperature, top_k, seed
+
+    def submit(self, prompt, max_new_tokens=None, temperature=0.0,
+               top_k=0, seed=0, tenant=None, request_id=None,
+               deadline_ms=None):
+        """Prefill NOW (batched, synchronous — the returned stream
+        already holds the first token), then hand the sequence to the
+        decode loop. Raises BadGenRequest (400), QueueFullError (429),
+        ServingClosedError (503); batcher deadline errors propagate
+        (504)."""
+        if self._closed:
+            raise ServingClosedError("engine %r is shut down" % self.name)
+        prompt, max_new, temperature, top_k, seed = self._validate(
+            prompt, max_new_tokens, temperature, top_k, seed)
+        tenant = accesslog.clamp_tenant(tenant)
+        slo_name = None
+        if self._slo_ms is not None:
+            slo_name = "%s/inter_token/%s" % (self.name, tenant)
+            try:
+                slo_mod.REGISTRY.define(slo_name, self.name,
+                                        kind="inter_token",
+                                        latency_ms=self._slo_ms)
+            except Exception:
+                _LOG.debug("inter_token SLO define failed", exc_info=True)
+                slo_name = None
+        with self._pend_lock:
+            backlog = len(self._pending)
+        if backlog >= self._pending_cap:
+            raise QueueFullError(
+                "engine %r: %d sequences awaiting decode admission "
+                "(cap %d) — the KV pool or decode batch is saturated"
+                % (self.name, backlog, self._pending_cap))
+        P = len(prompt)
+        padded = onp.zeros(self.prefill_len, onp.int32)
+        padded[:P] = prompt
+        with spans.span("gen:prefill", model=self.name,
+                        request_id=request_id, tenant=tenant,
+                        prompt_len=P):
+            req = self._prefill.submit(
+                padded, onp.int32(P), onp.int32(seed),
+                onp.float32(temperature), onp.int32(top_k),
+                deadline_ms=deadline_ms, request_id=request_id,
+                tenant=tenant)
+            k_all, v_all, first = req.result(
+                self._prefill.result_timeout(req))
+        first = int(first)
+        stream = GenStream(request_id, tenant)
+        try:
+            _TOKENS.inc(P, model=self.name, tenant=tenant, phase="prefill")
+        except Exception:
+            pass
+        seq = _Seq(stream, k_all, v_all, P, first, seed, temperature,
+                   top_k, max_new, slo_name)
+        self._emit_token(seq, first, first_token=True)
+        if first == self.eos_token:
+            stream._end("eos")
+            return stream
+        if max_new <= 1:
+            stream._end("max_tokens")
+            return stream
+        with self._wake:
+            if self._closed:
+                stream._end("error")
+                raise ServingClosedError("engine %r is shut down"
+                                         % self.name)
+            self._pending.append(seq)
+            self._wake.notify()
+        return stream
+
+    def _emit_token(self, seq, tok, first_token=False):
+        """Account then deliver (instrument-before-deliver: a scrape the
+        moment the client unblocks must already see this token). The
+        first token's delay is TTFT, not an inter-token gap — it counts
+        on the token counter but not on the gap histogram/SLO."""
+        now = time.monotonic()
+        gap_ms = (now - seq.t_last) * 1e3
+        seq.t_last = now
+        try:
+            _TOKENS.inc(model=self.name, tenant=seq.tenant, phase="decode")
+            if not first_token:
+                _INTER_TOKEN_MS.observe(gap_ms, model=self.name)
+        except Exception:
+            _LOG.debug("gen token metrics failed", exc_info=True)
+        if seq.slo_name is not None and not first_token:
+            try:
+                slo_mod.REGISTRY.observe_named(seq.slo_name, 200,
+                                               latency_ms=gap_ms)
+            except Exception:
+                _LOG.debug("inter_token SLO observe failed", exc_info=True)
+        seq.stream._emit(tok)
+
+    # ---------------------------------------------------------- decode loop
+    def _admit(self):
+        """Move pending sequences into the in-flight batch: allocate
+        their block tables, scatter their prefill K/V into the pool
+        (donated join program). A pool too full for the HEAD sequence
+        leaves the queue intact — retirements keep freeing blocks, so
+        admission is backpressure, never failure, while anything is
+        still decoding. With NOTHING decoding the pool is empty, so an
+        OOM then means the pool can never hold the sequence (guarded at
+        construction) — retire it as kv_oom rather than deadlock."""
+        while len(self._active) < self.max_batch:
+            with self._wake:
+                if not self._pending:
+                    return
+                seq = self._pending[0]
+                if seq.stream.cancelled:
+                    self._pending.popleft()
+                    seq.stream._end("disconnect")
+                    continue
+                need = kvcache.blocks_for(seq.length + seq.max_new - 1,
+                                          self.block_size)
+                try:
+                    blocks = self._alloc.alloc(need)
+                except kvcache.KVCacheOOM:
+                    if self._active:
+                        return
+                    self._pending.popleft()
+                    flightrec.record("gen_kv_oom", model=self.name,
+                                     request_id=seq.request_id, need=need)
+                    seq.stream._end("kv_oom")
+                    continue
+                self._pending.popleft()
+            seq.blocks = blocks
+            table = onp.full(self.max_blocks, self.num_blocks, onp.int32)
+            table[:len(blocks)] = blocks
+            seq.table = table
+            self._pool = self._write_fn()(
+                self._pool, table, seq.k_all, seq.v_all,
+                onp.int32(seq.length))
+            seq.k_all = seq.v_all = None
+            self._active.append(seq)
+            flightrec.record("gen_join", model=self.name,
+                             request_id=seq.request_id, blocks=len(blocks),
+                             batch=len(self._active))
+
+    def _retire(self, seq, reason):
+        self._active.remove(seq)
+        if seq.blocks:
+            self._alloc.free(seq.blocks)
+            seq.blocks = None
+        seq.stream._end(reason)
+        flightrec.record("gen_retire", model=self.name,
+                         request_id=seq.request_id, reason=reason,
+                         generated=seq.n_generated)
+
+    def _bucket_for(self, n):
+        for b in self.decode_buckets:
+            if b >= n:
+                return b
+        return self.decode_buckets[-1]
+
+    def _step(self):
+        act = list(self._active)
+        n = len(act)
+        B = self._bucket_for(n)
+        mb = self.max_blocks
+        tables = onp.full((B, mb), self.num_blocks, onp.int32)
+        lengths = onp.ones(B, onp.int32)
+        last = onp.zeros(B, onp.int32)
+        seeds = onp.zeros(B, onp.int32)
+        ngen = onp.zeros(B, onp.int32)
+        temps = onp.zeros(B, onp.float32)
+        topks = onp.zeros(B, onp.int32)
+        active = onp.zeros(B, bool)
+        for i, s in enumerate(act):
+            tables[i] = s.table
+            lengths[i] = s.length
+            last[i] = s.last_token
+            seeds[i] = s.seed
+            ngen[i] = s.n_generated
+            temps[i] = s.temperature
+            topks[i] = s.top_k
+            active[i] = True
+        fn = self._decode_fn(B)
+        with spans.span("gen:decode_step", model=self.name, batch=n,
+                        bucket=B,
+                        request_ids=[s.request_id for s in act
+                                     if s.request_id is not None]):
+            self._pool, next_t = fn(self._pool, tables, lengths, last,
+                                    seeds, ngen, temps, topks, active)
+            # reviewed sync point: one host transfer for the whole step's
+            # sampled tokens, inside the step span so the span measures
+            # true step latency  # mxtpulint: disable=R001
+            next_t = onp.asarray(next_t)
+        for i, s in enumerate(act):
+            tok = int(next_t[i])
+            s.length += 1
+            s.last_token = tok
+            s.n_generated += 1
+            if s.stream.cancelled:
+                self._retire(s, "disconnect")
+                continue
+            self._emit_token(s, tok)
+            if tok == self.eos_token:
+                self._retire(s, "eos")
+            elif s.n_generated >= s.max_new:
+                self._retire(s, "max_tokens")
+
+    def _decode_loop(self):
+        try:
+            while True:
+                watchdog.heartbeat(self._hb)
+                self._admit()
+                if self._active:
+                    self._step()
+                    continue
+                with self._wake:
+                    if self._closed and not self._pending:
+                        return
+                    if not self._pending:
+                        self._wake.wait(max(self.step_idle_s, 0.001)
+                                        if not self._closed else 0.01)
+        except BaseException as e:
+            _LOG.error("gen decode loop for %r died", self.name,
+                       exc_info=True)
+            for s in list(self._active):
+                try:
+                    self._retire(s, "error")
+                except Exception:
+                    _LOG.error("retiring %r after decode-loop death failed",
+                               s.request_id, exc_info=True)
+            with self._wake:
+                pend, self._pending = list(self._pending), deque()
+            for s in pend:
+                s.stream._end("error")
+            if not isinstance(e, Exception):
+                raise
+        finally:
+            watchdog.unregister(self._hb)
+
+    # ------------------------------------------------- sequential reference
+    def generate_sequential(self, prompt, max_new_tokens=None,
+                            temperature=0.0, top_k=0, seed=0):
+        """Decode one sequence alone, through the SAME compiled programs
+        at bucket 1 on a PRIVATE pool -> (tokens, finish_reason). This
+        is both the bit-exactness oracle for the join/leave tests and
+        the per-request baseline the CI stage requires continuous
+        batching to beat on tokens/s."""
+        prompt, max_new, temperature, top_k, seed = self._validate(
+            prompt, max_new_tokens, temperature, top_k, seed)
+        P = len(prompt)
+        padded = onp.zeros((1, self.prefill_len), onp.int32)
+        padded[0, :P] = prompt
+        k_all, v_all, first = self._prefill_fn(1)(
+            padded, onp.array([P], onp.int32),
+            onp.array([seed], onp.int32),
+            onp.array([temperature], onp.float32),
+            onp.array([top_k], onp.int32))
+        tokens = [int(first[0])]
+        if tokens[0] == self.eos_token:
+            return tokens, "eos"
+        if max_new <= 1:
+            return tokens, "max_tokens"
+        m = self.model
+        pool = kvcache.make_pool(self.num_blocks, self.block_size,
+                                 m.LAYERS, m.HEADS, m.HEAD_DIM)
+        need = kvcache.blocks_for(P + max_new - 1, self.block_size)
+        table = onp.full(self.max_blocks, self.num_blocks, onp.int32)
+        table[:need] = onp.arange(need)
+        pool = self._write_fn()(pool, table, onp.asarray(k_all[0]),
+                                onp.asarray(v_all[0]), onp.int32(P))
+        fn = self._decode_fn(1)
+        length, last, ngen = P, tokens[0], 1
+        reason = "max_tokens"
+        while ngen < max_new:
+            pool, nt = fn(pool, table[None], onp.array([length], onp.int32),
+                          onp.array([last], onp.int32),
+                          onp.array([seed], onp.int32),
+                          onp.array([ngen], onp.int32),
+                          onp.array([temperature], onp.float32),
+                          onp.array([top_k], onp.int32),
+                          onp.array([True]))
+            last = int(onp.asarray(nt)[0])
+            tokens.append(last)
+            length += 1
+            ngen += 1
+            if last == self.eos_token:
+                reason = "eos"
+                break
+        return tokens, reason
+
+    # -------------------------------------------------------------- dispatch
+    def _prefill_dispatch(self, prompts, lengths, seeds, temps, top_ks):
+        """The batcher's servable: route the stacked bucket through that
+        bucket's compiled prefill program."""
+        fn = self._prefill_fn(int(prompts.shape[0]))
+        return fn(prompts, lengths, seeds, temps, top_ks)
+
+    # ------------------------------------------------------------ inspection
+    @property
+    def alive(self):
+        """Decode-loop thread still running (health surface)."""
+        return self._thread.is_alive()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def describe(self):
+        """The GET /v1/models-shaped description of this engine."""
+        return {"name": self.name,
+                "kind": "generator",
+                "model_id": self._model_id,
+                "block_size": self.block_size,
+                "kv_blocks_total": self._alloc.total,
+                "kv_blocks_used": self._alloc.used,
+                "max_batch": self.max_batch,
+                "decode_buckets": list(self.decode_buckets),
+                "prefill_len": self.prefill_len,
+                "max_tokens": self.max_tokens,
+                "inflight": self._inflight_count(),
+                "eos_token": self.eos_token,
+                "closed": self._closed}
+
+    # ---------------------------------------------------------------- close
+    def close(self, timeout=30.0):
+        """Stop intake, finish/fail what's in flight, release telemetry
+        bindings. Live sequences finish their natural retirement (the
+        loop drains active + pending before exiting)."""
+        self._closed = True
+        try:
+            self._prefill.close(drain=True, timeout=timeout)
+        except Exception:
+            _LOG.debug("prefill batcher close failed", exc_info=True)
+        with self._wake:
+            self._wake.notify_all()
+        self._thread.join(timeout)
+        for g, fn in ((_INFLIGHT, self._inflight_fn),
+                      (_KV_USED, self._kv_used_fn),
+                      (_KV_TOTAL, self._kv_total_fn)):
+            try:
+                g.remove_function(fn)
+            except Exception:
+                pass
+        try:
+            slo_mod.REGISTRY.detach_model(self.name)
+        except Exception:
+            pass

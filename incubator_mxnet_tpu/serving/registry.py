@@ -444,6 +444,7 @@ class ModelRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries = {}
+        self._generators = {}           # name -> GenerativeEngine
         self._closed = False
         # the continuous profiler's overload signal: it skips a capture
         # cycle while any of this registry's queues runs hot — profiling
@@ -566,14 +567,77 @@ class ModelRegistry:
             entry.batcher.resume_intake()
 
     def close(self, drain=True):
-        """Graceful shutdown of every model's batcher (queue drained first)."""
+        """Graceful shutdown of every model's batcher (queue drained first)
+        and every generator's decode loop (live sequences retire)."""
         from ..telemetry import profstats
         profstats.remove_load_probe(self._probe_name)
         with self._lock:
             self._closed = True
             entries = list(self._entries.values())
+            generators = list(self._generators.values())
         for entry in entries:
             entry.batcher.close(drain=drain)
+        for engine in generators:
+            try:
+                engine.close()
+            except Exception:
+                _LOG.debug("generator close failed", exc_info=True)
+
+    # ----------------------------------------------------------- generators
+    def load_generator(self, name, engine=None, **engine_kw):
+        """Register a generative engine under ``name`` (POST /generate
+        routes on it). Pass a constructed ``GenerativeEngine`` or let this
+        build one (``engine_kw`` forwards to its constructor; prewarm
+        happens inside construction, so by the time this returns the
+        decode/prefill buckets are compiled and — under
+        MXTPU_HLOLINT_GATE — their artifacts linted). One engine per
+        name; re-registering an open name is an error (a generator holds
+        a live KV pool — hot-swap means close + load, there is no
+        version ladder to drain across)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("registry is shut down")
+            old = self._generators.get(name)
+            if old is not None and not old.closed:
+                raise ValueError("generator %r is already loaded (close "
+                                 "it before replacing)" % name)
+        if engine is None:
+            from .generate import GenerativeEngine
+            engine = GenerativeEngine(name=name, **engine_kw)
+        # seed the model-level availability SLO so /debug/slo carries the
+        # generator from first load (the per-tenant inter_token
+        # objectives appear on first submit); engine.close() detaches all
+        # of them
+        try:
+            from ..telemetry import slo
+            slo.REGISTRY.ensure_model(name)
+        except Exception:
+            _LOG.debug("SLO seeding for generator %r failed", name,
+                       exc_info=True)
+        with self._lock:
+            if self._closed:
+                engine.close()
+                raise RuntimeError("registry is shut down")
+            self._generators[name] = engine
+        return engine
+
+    def generator(self, name):
+        """The live engine for ``name`` — ModelNotFoundError (-> 404)
+        when absent or already closed."""
+        with self._lock:
+            engine = self._generators.get(name)
+            names = sorted(n for n, e in self._generators.items()
+                           if not e.closed) if engine is None else None
+        if engine is None or engine.closed:
+            raise ModelNotFoundError("no generator %r loaded (have: %s)"
+                                     % (name, names or sorted(
+                                         self._generators)))
+        return engine
+
+    def generators(self):
+        with self._lock:
+            engines = list(self._generators.values())
+        return [e.describe() for e in engines]
 
     # ------------------------------------------------------------ inference
     def _entry(self, name):
@@ -617,12 +681,18 @@ class ModelRegistry:
         with self._lock:
             closed = self._closed
             entries = list(self._entries.values())
+            generators = list(self._generators.values())
         if closed:
             return {"status": "unhealthy", "reason": "shutting down"}
         for e in entries:
             if not e.batcher.alive and not e.batcher.closed:
                 return {"status": "unhealthy",
                         "reason": "worker thread dead for model %r" % e.name}
+        for g in generators:
+            if not g.alive and not g.closed:
+                return {"status": "unhealthy",
+                        "reason": "decode loop dead for generator %r"
+                                  % g.name}
         for e in entries:
             if e.batcher.queue_depth() >= 0.8 * e.batcher.total_queue_size:
                 return {"status": "degraded",

@@ -13,9 +13,25 @@ Routes (TF-Serving REST-shaped):
   ...], "deadline_ms": <optional>, "dtype": <optional, default float32>}``;
   response ``{"outputs": [<nested list>, ...]}``. Each input is ONE item,
   WITHOUT the batch dim — cross-request batching is the server's job.
+- ``POST /generate`` — generative inference against a registered
+  ``GenerativeEngine`` (registry.load_generator; docs/GENERATE.md). Body
+  ``{"model": <name — optional when exactly one generator is loaded>,
+  "prompt": [<token ids>], "max_new_tokens", "temperature", "top_k",
+  "seed", "deadline_ms"}``. The response streams as
+  ``Transfer-Encoding: chunked`` JSONL — one ``{"token": id, "index":
+  n}`` line per generated token the moment the decode loop emits it
+  (the first line is the prefill's token, so TTFT is measurable at the
+  client), terminated by one ``{"done": true, "reason": "eos" |
+  "max_tokens" | ..., "tokens": n}`` line. A client that hangs up
+  mid-stream cancels the sequence: the decode loop retires the row and
+  frees its KV blocks at the next step. Pre-stream failures use the
+  predict error contract (400 bad request / invalid prompt, 429 prefill
+  queue full + ``Retry-After``, 404 unknown generator, 503 shutting
+  down, 504 prefill deadline).
 - ``GET /v1/models``            — registered models + queue/batch config
   (incl. per-model ``replicas`` / ``replica_depths`` / ``dead_replicas``
-  — the data-parallel serving topology, docs/SERVING.md).
+  — the data-parallel serving topology, docs/SERVING.md) and loaded
+  generators (KV-pool occupancy, bucket ladder, in-flight sequences).
 - ``GET /v1/models/<name>``     — one model + its metrics snapshot
   (``replica_dispatch`` shows the router's per-replica balance).
 - ``GET /metrics``              — Prometheus text exposition of the
@@ -202,7 +218,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path.split("?", 1)[0] == "/debug/hotspots":
             self._do_hotspots()
         elif self.path.rstrip("/") == _MODELS_PREFIX:
-            self._send(200, {"models": self.registry.models()})
+            self._send(200, {"models": self.registry.models(),
+                             "generators": self.registry.generators()})
         elif self.path.startswith(_MODELS_PREFIX + "/"):
             name = self._model_name()
             try:
@@ -266,10 +283,22 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, profstats.hotspots(n))
 
     def do_POST(self):
+        if self.path == "/generate":
+            req_id = self.headers.get(telemetry.REQUEST_ID_HEADER) \
+                or telemetry.new_request_id()
+            tenant = accesslog.clamp_tenant(
+                self.headers.get(accesslog.TENANT_HEADER))
+            http_request_started()
+            try:
+                self._do_generate(req_id, tenant)
+            finally:
+                http_request_finished()
+            return
         if not (self.path.startswith(_MODELS_PREFIX + "/")
                 and self.path.endswith(_PREDICT_SUFFIX)):
             self._send(404, {"error": "no route %r (POST "
-                             "/v1/models/<name>:predict)" % self.path})
+                             "/v1/models/<name>:predict or "
+                             "POST /generate)" % self.path})
             return
         name = self._model_name()
         # request-scoped trace id: a client-supplied X-Request-Id wins (the
@@ -371,17 +400,139 @@ class _Handler(BaseHTTPRequestHandler):
             window_ms = 0.0
         return str(max(1, int(-(-window_ms // 1000))))
 
-    def _finish(self, name, tenant, req_id, code, t_start, payload,
-                shed_reason=None, breq=None, headers=None):
-        """Account one terminal outcome, then send the response.
-        Accounting (per-tenant counters + latency histogram, the SLO
-        ledger, the access-log record) happens BEFORE the send, mirroring
-        the batcher's instrument-before-deliver discipline: a scrape
-        fired the moment the client unblocks must already see this
-        request. A telemetry failure must not turn a served response
-        into a 500 — guarded, debug-logged."""
+    # ------------------------------------------------------------ generate
+    def _do_generate(self, req_id, tenant):
+        """POST /generate: validate + prefill synchronously (every failure
+        there still has the buffered-JSON error contract), then stream
+        the decode loop's tokens as chunked JSONL."""
+        from .generate import BadGenRequest
+        t_start = time.perf_counter()
+        name = None
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length) or b"{}")
+            name = req.get("model")
+            if name is None:
+                gens = [g["name"] for g in self.registry.generators()
+                        if not g["closed"]]
+                if len(gens) != 1:
+                    raise ValueError(
+                        "'model' is required when %d generators are "
+                        "loaded" % len(gens))
+                name = gens[0]
+            prompt = req.get("prompt")
+            kw = {"max_new_tokens": req.get("max_new_tokens"),
+                  "temperature": float(req.get("temperature", 0.0)),
+                  "top_k": int(req.get("top_k", 0)),
+                  "seed": int(req.get("seed", 0))}
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+        except Exception as e:  # noqa: BLE001 — anything malformed is a 400
+            self._finish(name or "-", tenant, req_id, 400, t_start,
+                         {"error": "bad request: %s" % e})
+            return
+        try:
+            # the root span covers validate + the batched prefill (the
+            # engine's gen:prefill span parents onto it); the decode
+            # stream outlives it by design — decode steps are engine-
+            # scoped gen:decode_step spans, not per-request children
+            with telemetry.request_scope(req_id), \
+                    telemetry.span("http:generate", model=name,
+                                   tenant=tenant):
+                engine = self.registry.generator(name)
+                stream = engine.submit(prompt, tenant=tenant,
+                                       request_id=req_id,
+                                       deadline_ms=deadline_ms, **kw)
+        except BadGenRequest as e:
+            self._finish(name, tenant, req_id, 400, t_start,
+                         {"error": "bad request: %s" % e})
+        except QueueFullError as e:
+            self._finish(name, tenant, req_id, 429, t_start,
+                         {"error": str(e), "shed_reason": "queue_full"},
+                         shed_reason="queue_full",
+                         headers={"Retry-After": "1"})
+        except DeadlineExceededError as e:
+            self._finish(name, tenant, req_id, 504, t_start,
+                         {"error": str(e), "shed_reason": "deadline"},
+                         shed_reason="deadline")
+        except ModelNotFoundError as e:
+            self._finish(name, tenant, req_id, 404, t_start,
+                         {"error": str(e)})
+        except ServingClosedError as e:
+            self._finish(name, tenant, req_id, 503, t_start,
+                         {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — engine failure
+            self._finish(name, tenant, req_id, 500, t_start,
+                         {"error": "%s: %s" % (type(e).__name__, e)})
+        else:
+            self._stream_generate(name, stream, tenant, req_id, t_start)
+
+    def _chunk(self, obj):
+        """One HTTP/1.1 chunk holding one JSON line."""
+        data = (json.dumps(obj) + "\n").encode("utf-8")
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def _stream_generate(self, name, stream, tenant, req_id, t_start):
+        """Drain one GenStream into a chunked response. Terminal
+        accounting happens when the engine's ``("end", reason)`` event
+        arrives — BEFORE the final done-chunk is written, keeping the
+        instrument-before-deliver discipline for the record the access
+        log and SLO ledger see (the per-token counters/histograms were
+        already recorded by the engine at emit time). A write that hits
+        a dead client cancels the sequence; the decode loop frees its
+        KV blocks at the next step."""
+        import queue as _pyqueue
+        ntok, code, shed, reason = 0, 200, None, None
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "application/jsonl; charset=utf-8")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header(telemetry.REQUEST_ID_HEADER, req_id)
+            self.send_header(accesslog.TENANT_HEADER, tenant)
+            self.end_headers()
+            while True:
+                kind, val = stream.get(timeout=600.0)
+                if kind == "end":
+                    reason = val
+                    break
+                self._chunk({"token": val, "index": ntok})
+                ntok += 1
+        except (BrokenPipeError, ConnectionResetError):
+            stream.cancel()
+            code, shed = 499, "client_disconnect"
+        except _pyqueue.Empty:
+            # the decode loop stopped feeding this stream (stalled or
+            # died) — give up the connection; the watchdog's stall report
+            # is the diagnosis surface
+            stream.cancel()
+            code, shed = 504, "stream_stalled"
+        except Exception:  # noqa: BLE001 — never kill the handler thread
+            stream.cancel()
+            code = 500
+        if reason in ("kv_oom", "error"):
+            # headers already said 200; the access log still records the
+            # degraded finish so capacity trouble is attributable
+            shed = reason
+        self._account(name, tenant, req_id, code, t_start, shed_reason=shed)
+        if code == 200:
+            try:
+                self._chunk({"done": True, "reason": reason,
+                             "tokens": ntok})
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                stream.cancel()
+
+    def _account(self, name, tenant, req_id, code, t_start,
+                 shed_reason=None, dispatch=None):
+        """The shared terminal-outcome accounting (per-tenant counters,
+        SLO ledger, access log) — guarded: telemetry failure never turns
+        a served response into a 500."""
         latency_ms = (time.perf_counter() - t_start) * 1e3
-        d = (breq.dispatch if breq is not None else None) or {}
+        d = dispatch or {}
         try:
             request_accounted(name, tenant, code, latency_ms)
             from ..telemetry import slo
@@ -400,6 +551,18 @@ class _Handler(BaseHTTPRequestHandler):
                 bucket=d.get("bucket"))
         except Exception:
             _LOG.debug("request accounting failed", exc_info=True)
+
+    def _finish(self, name, tenant, req_id, code, t_start, payload,
+                shed_reason=None, breq=None, headers=None):
+        """Account one terminal outcome, then send the response.
+        Accounting (per-tenant counters + latency histogram, the SLO
+        ledger, the access-log record) happens BEFORE the send, mirroring
+        the batcher's instrument-before-deliver discipline: a scrape
+        fired the moment the client unblocks must already see this
+        request."""
+        self._account(name, tenant, req_id, code, t_start,
+                      shed_reason=shed_reason,
+                      dispatch=breq.dispatch if breq is not None else None)
         self._send(code, payload, request_id=req_id, headers=headers)
 
 

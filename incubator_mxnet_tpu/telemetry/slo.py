@@ -242,10 +242,16 @@ def _parse_windows(spec):
 class SLO:
     """One objective over one model's request stream.
 
-    ``kind`` is ``"availability"`` (2xx good) or ``"latency"`` (2xx good
-    only when its end-to-end latency is <= ``latency_ms``). Both kinds
-    count 429/504/5xx as bad and ignore other 4xx. All time arithmetic
-    uses the injected ``clock``.
+    ``kind`` is ``"availability"`` (2xx good), ``"latency"`` (2xx good
+    only when its end-to-end latency is <= ``latency_ms``), or
+    ``"inter_token"`` (same threshold arithmetic as latency, but the
+    outcome stream is PER GENERATED TOKEN, not per request — the decode
+    engine feeds one observation per token gap, so the target reads as
+    "p-target of inter-token gaps under latency_ms"; objectives are
+    minted per tenant by serving/generate.py under
+    ``MXTPU_GEN_SLO_INTER_TOKEN_MS``). All kinds count 429/504/5xx as
+    bad and ignore other 4xx. All time arithmetic uses the injected
+    ``clock``.
     """
 
     def __init__(self, name, model, kind="availability", target=None,
@@ -253,10 +259,10 @@ class SLO:
                  fast_burn=None, slow_burn=None, pending_s=0.0,
                  resolve_s=None, resolution_s=0.25, clock=None):
         from .. import config
-        if kind not in ("availability", "latency"):
+        if kind not in ("availability", "latency", "inter_token"):
             raise ValueError("unknown SLO kind %r" % kind)
-        if kind == "latency" and latency_ms is None:
-            raise ValueError("latency SLO %r needs latency_ms" % name)
+        if kind in ("latency", "inter_token") and latency_ms is None:
+            raise ValueError("%s SLO %r needs latency_ms" % (kind, name))
         self.name = name
         self.model = model
         self.kind = kind
@@ -295,7 +301,8 @@ class SLO:
         """'good' / 'bad' / None (not an SLO-eligible outcome)."""
         code = int(code)
         if 200 <= code < 300:
-            if (self.kind == "latency" and latency_ms is not None
+            if (self.kind in ("latency", "inter_token")
+                    and latency_ms is not None
                     and latency_ms > self.latency_ms):
                 return "bad"
             return "good"
@@ -486,6 +493,19 @@ class SLORegistry:
             slos = self.ensure_model(model)
         for s in slos:
             self._emit(s.observe(code, latency_ms=latency_ms, now=now), s)
+
+    def observe_named(self, name, code, latency_ms=None, now=None):
+        """Feed one outcome into EXACTLY the named SLO (no-op when it
+        does not exist). The per-tenant inter-token objectives need this
+        addressing: ``observe(model, ...)`` fans one outcome into every
+        SLO of the model, which would charge tenant A's token gap
+        against tenant B's budget. The caller defines the objective
+        first (``define``) and then feeds only its own series here."""
+        with self._lock:
+            s = self._slos.get(name)
+        if s is None:
+            return
+        self._emit(s.observe(code, latency_ms=latency_ms, now=now), s)
 
     def _emit(self, transitions, s):
         """One flightrec event per alert state transition — the alert

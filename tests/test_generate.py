@@ -1,0 +1,422 @@
+"""Generative serving tier: the paged KV cache's allocator/index-op
+invariants, continuous-batching decode proven BIT-EXACT against the
+sequential single-sequence reference (join/leave mid-batch included),
+the zero-compile steady-state contract after prewarm, the streaming
+HTTP front-end under concurrent clients with a mid-stream disconnect,
+the per-tenant inter-token SLO rows, and the H002-decode escalation
+fixtures (docs/GENERATE.md)."""
+import json
+import http.client
+import os
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from incubator_mxnet_tpu import telemetry                        # noqa: E402
+from incubator_mxnet_tpu.ops import kvcache                      # noqa: E402
+from incubator_mxnet_tpu.serving import generate as gen          # noqa: E402
+from incubator_mxnet_tpu.serving.registry import ModelRegistry   # noqa: E402
+from incubator_mxnet_tpu.serving.server import ServingServer     # noqa: E402
+
+# one geometry for every engine in this module: the AOT cache is
+# process-wide, so identical shapes compile once across all fixtures
+GEO = dict(block_size=8, num_blocks=48, max_batch=4, prefill_len=16,
+           max_tokens=16)
+
+
+@pytest.fixture(scope="module")
+def serving():
+    """One engine + registry + HTTP server shared by the module (the
+    engine's warm compiles every bucket once for all tests)."""
+    reg = ModelRegistry()
+    eng = reg.load_generator("gen-t", seed=0, **GEO)
+    srv = ServingServer(reg, port=0).start()
+    yield type("S", (), {"eng": eng, "reg": reg, "srv": srv,
+                         "url": srv.url, "host": srv.host,
+                         "port": srv.port})
+    srv.stop()
+
+
+# ----------------------------------------------------------- KV allocator
+def test_blocks_for_ceiling():
+    assert kvcache.blocks_for(1, 8) == 1
+    assert kvcache.blocks_for(8, 8) == 1
+    assert kvcache.blocks_for(9, 8) == 2
+    assert kvcache.blocks_for(0, 8) == 1          # a sequence owns >= 1
+
+
+def test_allocator_alloc_free_reuse_lifo():
+    a = kvcache.BlockAllocator(4)
+    first = a.alloc(2)
+    assert first == [0, 1] and a.used == 2 and a.free_count == 2
+    second = a.alloc(2)
+    assert second == [2, 3] and a.free_count == 0
+    a.free(second)
+    assert a.used == 2
+    # freed blocks come back newest-first: reuse is LIFO so a hot block's
+    # pool rows stay cache/HBM-resident
+    assert a.alloc(1) == [3]
+    a.free(first)
+    a.free([3])
+    assert a.used == 0 and a.free_count == 4
+
+
+def test_allocator_oom_is_all_or_nothing():
+    a = kvcache.BlockAllocator(3)
+    a.alloc(2)
+    with pytest.raises(kvcache.KVCacheOOM):
+        a.alloc(2)
+    # the failed alloc must not leak its partial grab
+    assert a.free_count == 1 and a.alloc(1) is not None
+
+
+def test_allocator_double_free_raises():
+    a = kvcache.BlockAllocator(2)
+    blocks = a.alloc(1)
+    a.free(blocks)
+    with pytest.raises(ValueError):
+        a.free(blocks)
+    with pytest.raises(ValueError):
+        a.free([7])                                # never-held block id
+
+
+# ----------------------------------------------------- KV cache index ops
+def test_write_seq_gather_roundtrip_and_padding_dropped():
+    bs, layers, heads, hd = 4, 2, 2, 3
+    pool = kvcache.make_pool(6, bs, layers, heads, hd)
+    length, max_blocks = 7, 3
+    k = onp.random.RandomState(0).randn(
+        2 * max_blocks * bs, layers, heads, hd).astype(onp.float32)
+    v = onp.random.RandomState(1).randn(
+        2 * max_blocks * bs, layers, heads, hd).astype(onp.float32)
+    k, v = k[:max_blocks * bs], v[:max_blocks * bs]
+    blocks = onp.array([5, 2, 0], onp.int32)       # deliberately unordered
+    pool = kvcache.write_seq(pool, blocks, k, v, onp.int32(length))
+    table = onp.full((1, max_blocks), 6, onp.int32)
+    table[0] = blocks
+    keys, values = kvcache.gather_layer(pool, table, 1)
+    keys, values = onp.asarray(keys), onp.asarray(values)
+    assert keys.shape == (1, max_blocks * bs, heads, hd)
+    onp.testing.assert_array_equal(keys[0, :length], k[:length, 1])
+    onp.testing.assert_array_equal(values[0, :length], v[:length, 1])
+    # positions >= length were dropped at write: the pool rows past the
+    # sequence end stay zero
+    assert not keys[0, length:].any()
+
+
+def test_append_token_active_mask_drops_pad_rows():
+    bs, layers, heads, hd = 4, 1, 1, 2
+    pool = kvcache.make_pool(4, bs, layers, heads, hd)
+    tables = onp.array([[0, 1], [2, 3]], onp.int32)
+    lengths = onp.array([5, 3], onp.int32)
+    k = onp.ones((2, heads, hd), onp.float32)
+    v = 2 * onp.ones((2, heads, hd), onp.float32)
+    active = onp.array([True, False])
+    pool = onp.asarray(kvcache.append_token(
+        pool, tables, lengths, 0, k, v, active=active))
+    # row 0 landed at block 1 (pos 5 -> block idx 1, offset 1)
+    assert pool[1, 1, 0, 0].any()
+    # row 1 was inactive: its slot (block 3, offset 3) stayed zero
+    assert not pool[3, 3].any()
+
+
+def test_paged_attention_matches_dense_reference():
+    B, T, heads, hd = 2, 6, 2, 4
+    rng = onp.random.RandomState(2)
+    q = rng.randn(B, heads, hd).astype(onp.float32)
+    keys = rng.randn(B, T, heads, hd).astype(onp.float32)
+    values = rng.randn(B, T, heads, hd).astype(onp.float32)
+    lengths = onp.array([4, 6], onp.int32)
+    out = onp.asarray(kvcache.paged_attention(q, keys, values, lengths))
+    for b in range(B):
+        for h in range(heads):
+            att = keys[b, :lengths[b], h] @ q[b, h] / onp.sqrt(hd)
+            w = onp.exp(att - att.max())
+            w /= w.sum()
+            ref = w @ values[b, :lengths[b], h]
+            onp.testing.assert_allclose(out[b, h], ref, rtol=2e-5,
+                                        atol=2e-6)
+
+
+# ------------------------------------------------------------- validation
+def test_submit_validation_errors(serving):
+    e = serving.eng
+    with pytest.raises(gen.BadGenRequest):
+        e.submit([])                               # empty prompt
+    with pytest.raises(gen.BadGenRequest):
+        e.submit([1] * (GEO["prefill_len"] + 1))   # too long
+    with pytest.raises(gen.BadGenRequest):
+        e.submit([999999])                         # out-of-vocab id
+    with pytest.raises(gen.BadGenRequest):
+        e.submit([1], max_new_tokens=0)
+    with pytest.raises(gen.BadGenRequest):
+        e.submit([1], max_new_tokens=GEO["max_tokens"] + 1)
+
+
+# ---------------------------------------- bit-exactness vs sequential ref
+def test_continuous_batching_bit_exact_with_join_leave(serving):
+    """Mixed prompts/params submitted concurrently — sequences join the
+    in-flight batch as others retire (different max_new => different
+    retirement steps), and every stream must be BITWISE identical to the
+    same request decoded alone through the sequential reference."""
+    e = serving.eng
+    reqs = [
+        {"prompt": [3, 5, 8], "max_new_tokens": 12, "seed": 7,
+         "temperature": 0.8, "top_k": 40},
+        {"prompt": [200, 4], "max_new_tokens": 5, "seed": 1},
+        {"prompt": list(range(1, 14)), "max_new_tokens": 9, "seed": 9,
+         "temperature": 1.3, "top_k": 3},
+        {"prompt": [42], "max_new_tokens": 16, "seed": 3,
+         "temperature": 0.5, "top_k": 0},
+    ]
+    refs = [e.generate_sequential(**r) for r in reqs]
+    streams = [e.submit(tenant="bitexact", **r) for r in reqs]
+    got = [s.tokens(timeout=120.0) for s in streams]
+    for r, (ref_toks, ref_reason), (toks, reason) in zip(reqs, refs, got):
+        assert toks == ref_toks, r
+        assert reason == ref_reason, r
+    # every retirement freed its blocks
+    deadline = time.monotonic() + 10.0
+    while e._alloc.used and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert e._alloc.used == 0
+
+
+# -------------------------------------------------- zero-compile contract
+def test_steady_state_decode_zero_compiles(serving):
+    """After prewarm, a burst of mixed-shape generate traffic must run
+    without ANY XLA build: the compile counters hold and no compile span
+    lands in the ring (the acceptance criterion ci/run.sh soaks on)."""
+    from incubator_mxnet_tpu import jit as jm
+    from incubator_mxnet_tpu.telemetry import spans
+    e = serving.eng
+    c0 = sum(jm._COMPILES.value(kind=k)
+             for k in ("train", "eval", "serve", "decode"))
+    mark = len(spans.snapshot())
+    streams = [e.submit([i + 1, i + 2], max_new_tokens=4 + (i % 3),
+                        seed=i, temperature=0.5 * (i % 2), top_k=10 * i)
+               for i in range(6)]
+    for s in streams:
+        s.tokens(timeout=120.0)
+    c1 = sum(jm._COMPILES.value(kind=k)
+             for k in ("train", "eval", "serve", "decode"))
+    assert c1 == c0
+    bad = [s for s in spans.snapshot()[mark:]
+           if s.get("name") in ("train:compile", "eval:compile",
+                                "gen:compile")]
+    assert bad == []
+
+
+# --------------------------------------------------------- HTTP streaming
+def _gen_http(host, port, body, headers=None, read_tokens=None,
+              timeout=60.0):
+    """One POST /generate; returns (status, headers, [parsed lines]).
+    ``read_tokens``: stop (and hard-close the socket) after N token
+    lines — the mid-stream-disconnect client."""
+    c = http.client.HTTPConnection(host, port, timeout=timeout)
+    c.request("POST", "/generate", json.dumps(body).encode("utf-8"),
+              {"Content-Type": "application/json", **(headers or {})})
+    r = c.getresponse()
+    hdrs = dict(r.getheaders())
+    if r.status != 200:
+        payload = [json.loads(r.read().decode("utf-8"))]
+        c.close()
+        return r.status, hdrs, payload
+    lines, buf = [], b""
+    while True:
+        ch = r.read(1)
+        if not ch:
+            break
+        buf += ch
+        if ch == b"\n":
+            lines.append(json.loads(buf.decode("utf-8")))
+            buf = b""
+            if read_tokens is not None and len(lines) >= read_tokens:
+                c.sock.close()                      # simulate client death
+                return r.status, hdrs, lines
+            if lines[-1].get("done"):
+                break
+    c.close()
+    return r.status, hdrs, lines
+
+
+def test_http_streaming_8_clients_one_disconnects(serving):
+    """8 concurrent streaming clients; client 3 hangs up mid-stream. The
+    survivors must stream to completion bit-exact vs the sequential
+    reference, and the dead client's KV blocks must be freed."""
+    e = serving.eng
+    reqs = [{"model": "gen-t", "prompt": [10 + i, 20 + i],
+             "max_new_tokens": 6 + (i % 4), "seed": 100 + i,
+             "temperature": 0.6, "top_k": 25} for i in range(8)]
+    refs = [e.generate_sequential(
+        r["prompt"], r["max_new_tokens"], temperature=r["temperature"],
+        top_k=r["top_k"], seed=r["seed"]) for r in reqs]
+    results = [None] * 8
+
+    def client(i):
+        kill = 2 if i == 3 else None
+        results[i] = _gen_http(
+            serving.host, serving.port, reqs[i],
+            headers={"X-Request-Id": "e2e-%d" % i,
+                     "X-MXTPU-Tenant": "t-e2e"},
+            read_tokens=kill)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    for i, (status, hdrs, lines) in enumerate(results):
+        assert status == 200, (i, results[i])
+        assert hdrs.get("X-Request-Id") == "e2e-%d" % i
+        if i == 3:
+            continue                                # the disconnector
+        toks = [l["token"] for l in lines if "token" in l]
+        done = [l for l in lines if l.get("done")]
+        assert toks == refs[i][0], i
+        assert done and done[0]["reason"] == refs[i][1]
+    # the disconnected row retires at the next step and frees its blocks
+    deadline = time.monotonic() + 15.0
+    while e._alloc.used and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert e._alloc.used == 0
+    # the access log carries the tenant-labeled terminal outcomes
+    from incubator_mxnet_tpu.serving import accesslog
+    recs = [json.loads(l) for l in
+            accesslog.export_jsonl(500).splitlines() if l]
+    assert any(r["model"] == "gen-t" and r["tenant"] == "t-e2e"
+               and r["code"] == 200 for r in recs)
+
+
+def test_http_error_contract(serving):
+    st, _h, body = _gen_http(serving.host, serving.port,
+                             {"model": "gen-t", "prompt": []})
+    assert st == 400 and "error" in body[0]
+    st, _h, body = _gen_http(serving.host, serving.port,
+                             {"model": "nope", "prompt": [1]})
+    assert st == 404
+    # with exactly one generator loaded the model field is optional
+    st, _h, lines = _gen_http(serving.host, serving.port,
+                              {"prompt": [7], "max_new_tokens": 2})
+    assert st == 200 and lines[-1].get("done")
+
+
+def test_models_listing_and_gen_metrics(serving):
+    c = http.client.HTTPConnection(serving.host, serving.port, timeout=30)
+    c.request("GET", "/v1/models")
+    r = c.getresponse()
+    payload = json.loads(r.read().decode("utf-8"))
+    c.close()
+    gens = {g["name"]: g for g in payload["generators"]}
+    d = gens["gen-t"]
+    assert d["kind"] == "generator"
+    assert d["kv_blocks_total"] == GEO["num_blocks"]
+    assert d["decode_buckets"][-1] == GEO["max_batch"]
+    # token counters and KV gauges ride the process-wide exposition
+    text = telemetry.export_text()
+    assert 'mxtpu_gen_tokens_total{model="gen-t"' in text
+    assert 'mxtpu_gen_kv_blocks_total{model="gen-t"}' in text
+    assert "mxtpu_gen_inter_token_ms_bucket" in text
+    assert gen._TOKENS.value(model="gen-t", tenant="bitexact",
+                             phase="decode") > 0
+
+
+# ------------------------------------------------------ inter-token SLOs
+def test_inter_token_slo_rows_per_tenant(monkeypatch):
+    from incubator_mxnet_tpu.telemetry import slo
+    monkeypatch.setenv("MXTPU_GEN_SLO_INTER_TOKEN_MS", "250")
+    e = gen.GenerativeEngine(name="gen-slo", seed=0, **GEO)
+    try:
+        for tenant in ("alice", "bob"):
+            e.submit([5, 6], max_new_tokens=4, seed=2,
+                     tenant=tenant).tokens(timeout=120.0)
+        names = {s["name"]: s for s in slo.REGISTRY.describe()["slos"]}
+        for tenant in ("alice", "bob"):
+            row = names["gen-slo/inter_token/" + tenant]
+            assert row["kind"] == "inter_token"
+            assert row["latency_ms"] == 250.0
+            # 3 decode gaps per request (first token is TTFT, not a gap)
+            n = sum(slo._EVENTS.value(slo=row["name"], outcome=o)
+                    for o in ("good", "bad"))
+            assert n == 3, row["name"]
+    finally:
+        e.close()
+    names_after = {s["name"] for s in slo.REGISTRY.describe()["slos"]}
+    assert not any(n.startswith("gen-slo/") for n in names_after)
+
+
+# ------------------------------------------------- H002 decode escalation
+def test_h002_decode_text_fixtures():
+    """Positive: a decode program with zero aliased inputs fires H002 at
+    ERROR severity (path-aware). Negative: the donated twin is clean."""
+    from tools import hlolint
+    body = ["%0 = stablehlo.add %arg0, %arg1 : (tensor<4x8xf32>, "
+            "tensor<4x8xf32>) -> tensor<4x8xf32>"]
+    pos = hlolint.program_from_text(
+        "jax-0/decode-cafe.mxtpu-aot", "decode",
+        "module @jit_step {\n  func.func public @main(%arg0: "
+        "tensor<4x8xf32>, %arg1: tensor<4x8xf32>) -> (tensor<4x8xf32>) "
+        "{\n" + "\n".join("    " + l for l in body)
+        + "\n    return %0 : tensor<4x8xf32>\n  }\n}\n")
+    out = hlolint.analyze_programs([pos])
+    assert sorted(f.rule for f in out) == ["H002"]
+    assert hlolint.severity_of("H002", pos.path) == "error"
+    assert "decode" in out[0].message
+    # same module, pool donated -> clean
+    neg = hlolint.program_from_text(
+        "jax-0/decode-beef.mxtpu-aot", "decode",
+        "module @jit_step {\n  func.func public @main(%arg0: "
+        "tensor<4x8xf32> {tf.aliasing_output = 0 : i32}, %arg1: "
+        "tensor<4x8xf32>) -> (tensor<4x8xf32>) {\n"
+        + "\n".join("    " + l for l in body)
+        + "\n    return %0 : tensor<4x8xf32>\n  }\n}\n")
+    assert hlolint.analyze_programs([neg]) == []
+    # the escalation is path-scoped: train-/eval- H002 stays a warning
+    assert hlolint.severity_of("H002") == "warn"
+    assert hlolint.severity_of("H002", "jax-0/train-cafe.mxtpu-aot") \
+        == "warn"
+
+
+def test_decode_canary_artifact_fires_h002_error(tmp_path):
+    """The REAL seeded artifact (undonated KV-pool step through
+    jax.export) scans to exactly H002 at error severity — the fixture
+    ci/run.sh's generate stage gates on."""
+    from tools.hlolint.artifact import scan_dir
+    from tools.hlolint.canary import write_decode_canary
+    from tools.hlolint.rules import severity_of
+    path = write_decode_canary(str(tmp_path))
+    assert os.path.basename(path).startswith("decode-")
+    findings = scan_dir(str(tmp_path))
+    assert [f.rule for f in findings] == ["H002"]
+    assert severity_of("H002", findings[0].path) == "error"
+
+
+def test_engine_warm_artifacts_persist_and_lint_clean(tmp_path,
+                                                      monkeypatch):
+    """A prewarmed engine persists donated decode-/serve- artifacts that
+    the linter finds CLEAN — donation survives the export path, so the
+    load gate passes the real programs it exists to judge."""
+    from tools.hlolint.artifact import load_dir, scan_dir
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", str(tmp_path))
+    # seed=1 gives a distinct model_id: fresh cache keys force real
+    # builds (an in-memory AOT hit would persist nothing and make this
+    # test vacuous)
+    e = gen.GenerativeEngine(name="gen-art", seed=1, block_size=8,
+                             num_blocks=24, max_batch=2, prefill_len=16,
+                             max_tokens=8)
+    try:
+        programs, errors = load_dir(str(tmp_path))
+        kinds = sorted(p.kind for p in programs)
+        assert errors == []
+        assert "decode" in kinds and "serve" in kinds
+        assert scan_dir(str(tmp_path)) == []
+    finally:
+        e.close()

@@ -588,10 +588,18 @@ def test_metrics_routes_prometheus_and_json_backcompat():
                                 {"inputs": [[1.0]]})
         assert code == 200
         # ---- /metrics: Prometheus text, validated by the stdlib parser
-        with _u.urlopen(srv.url + "/metrics", timeout=30.0) as resp:
-            assert resp.status == 200
-            assert resp.headers["Content-Type"].startswith("text/plain")
-            text = resp.read().decode("utf-8")
+        # The predict handler decrements the inflight gauge AFTER the
+        # response bytes land, so an immediate scrape can truthfully
+        # capture inflight=1 while the settled in-process export shows
+        # 0 — re-scrape until the two views converge.
+        for _ in range(100):
+            with _u.urlopen(srv.url + "/metrics", timeout=30.0) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode("utf-8")
+            if text == _tel.export_text():
+                break
+            _time.sleep(0.02)
         assert "# TYPE mxtpu_serving_requests_total counter" in text
         assert 'mxtpu_serving_requests_total{model="echo2"}' in text
         assert "# TYPE mxtpu_serving_batch_size histogram" in text

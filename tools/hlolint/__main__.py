@@ -126,7 +126,8 @@ def main(argv=None):
     else:
         for f in new:
             print("%s:%d: %s[%s] %s" % (f.path, f.line, f.rule,
-                                        severity_of(f.rule), f.message))
+                                        severity_of(f.rule, f.path),
+                                        f.message))
         if new:
             by_rule = ", ".join("%s=%d" % kv
                                 for kv in sorted(report["counts"].items()))

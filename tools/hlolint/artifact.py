@@ -34,7 +34,7 @@ __all__ = ["Program", "ArtifactError", "read_program", "program_from_text",
            "scan_dir", "scan_cache"]
 
 _SUFFIX = ".mxtpu-aot"
-_KINDS = ("train", "eval", "serve")
+_KINDS = ("train", "eval", "serve", "decode")
 
 
 class ArtifactError(ValueError):
@@ -48,7 +48,7 @@ class Program:
 
     def __init__(self, path, kind, stats, facts):
         self.path = path            # scan-root-relative label ('/'-sep)
-        self.kind = kind            # 'train' | 'eval' | 'serve'
+        self.kind = kind            # 'train' | 'eval' | 'serve' | 'decode'
         self.stats = stats          # header device truth dict or None
         self.facts = facts          # hlo.ModuleFacts
 
@@ -68,7 +68,7 @@ def _kind_of(path):
     kind = base.split("-", 1)[0]
     if kind not in _KINDS:
         raise ArtifactError("unrecognized artifact kind in filename %r "
-                            "(expected train-/eval-/serve-)" % base)
+                            "(expected train-/eval-/serve-/decode-)" % base)
     return kind
 
 

@@ -17,6 +17,13 @@ Generated, not committed: a serialized jax.export payload is pinned to
 the jax version, and the canary must keep proving the REAL deserialize
 path works on the running toolchain.
 
+``write_decode_canary(dir)`` is the generative-serving sibling: one
+``decode-…`` artifact exported WITHOUT pool donation — must fire **H002**
+at ERROR severity (the path-aware escalation serving/generate.py's load
+gate relies on). It writes to its own directory and is exercised by
+ci/run.sh's generate stage, so the base canary's exact-{H001, H002}
+assertion stays byte-stable.
+
 CLI: ``python -m tools.hlolint.canary OUT_DIR``.
 """
 from __future__ import annotations
@@ -25,7 +32,7 @@ import hashlib
 import os
 import sys
 
-__all__ = ["write_canary"]
+__all__ = ["write_canary", "write_decode_canary"]
 
 
 def write_canary(out_dir):
@@ -60,6 +67,34 @@ def write_canary(out_dir):
             f.write(aot.ARTIFACT_MAGIC + aot._pack_header(None) + payload)
         paths.append(path)
     return paths
+
+
+def write_decode_canary(out_dir):
+    """Write one seeded DECODE artifact under ``out_dir``: a KV-pool
+    update step exported without donate_argnums, so its module aliases
+    zero inputs — the H002-at-error-severity fixture for the generative
+    load gate. Returns the artifact path."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from incubator_mxnet_tpu import aot
+
+    def step(pool, k):
+        # the donation-missing shape of serving/generate.py's decode
+        # step: pool in, updated pool out — but NOT donated
+        return pool.at[0].set(k), jnp.argmax(k)
+
+    exp = jax_export.export(jax.jit(step))(
+        jax.ShapeDtypeStruct((16, 8, 4), jnp.float32),
+        jax.ShapeDtypeStruct((8, 4), jnp.float32))
+    ver_dir = os.path.join(out_dir, "jax-%s" % jax.__version__)
+    os.makedirs(ver_dir, exist_ok=True)
+    payload = bytes(exp.serialize())
+    digest = hashlib.sha256(payload).hexdigest()[:32]
+    path = os.path.join(ver_dir, "decode-%s.mxtpu-aot" % digest)
+    with open(path, "wb") as f:
+        f.write(aot.ARTIFACT_MAGIC + aot._pack_header(None) + payload)
+    return path
 
 
 def main(argv=None):

@@ -56,10 +56,11 @@ def findings_total():
 
 
 def _split(findings):
+    # path-aware severity: H002 escalates to error on decode-* artifacts
     errors = [f for f in findings
-              if _rules.severity_of(f.rule) == "error"]
+              if _rules.severity_of(f.rule, f.path) == "error"]
     warns = [f for f in findings
-             if _rules.severity_of(f.rule) != "error"]
+             if _rules.severity_of(f.rule, f.path) != "error"]
     return errors, warns
 
 
@@ -105,7 +106,7 @@ def publish(findings, model=None):
             findings_total().inc(rule=f.rule)
         except Exception:
             _LOG.debug("hlolint counter update dropped", exc_info=True)
-        if _rules.severity_of(f.rule) != "error":
+        if _rules.severity_of(f.rule, f.path) != "error":
             try:
                 from incubator_mxnet_tpu.telemetry import flightrec
                 flightrec.record("hlolint_finding", rule=f.rule,

@@ -19,6 +19,7 @@ so all three analyzers share one report shape and one baseline mechanic.
 """
 from __future__ import annotations
 
+import os
 import re
 
 __all__ = ["RULES", "SET_RULES", "SEVERITY", "program_rule", "set_rule",
@@ -52,7 +53,18 @@ def set_rule(rule_id, title, severity):
     return deco
 
 
-def severity_of(rule_id):
+def severity_of(rule_id, path=None):
+    """Severity of one finding — rule-keyed, with one path-aware
+    escalation: H002 on a ``decode-*`` artifact is an ERROR, not the
+    train-kind warn. A decode program that fails to alias its KV pool
+    copies the whole cache every token (serving/generate.py's donation
+    contract), so the load gate must refuse it, while the train-kind
+    finding stays advisory (train artifacts aren't deployed). Callers
+    that have a finding pass its path; rule-only queries (severity
+    legends, --rules validation) omit it and get the base severity."""
+    if rule_id == "H002" and path is not None \
+            and os.path.basename(str(path)).startswith("decode-"):
+        return "error"
     return SEVERITY.get(rule_id, "warn")
 
 
@@ -92,26 +104,44 @@ def h001_fp64_leak(prog):
 # every step then writes a full fresh copy of the weights — double weight
 # residency and 2x weight HBM traffic. mxtpulint R012 is the source-side
 # mirror of this rule (the jit call site missing donate_argnums).
-# Reach, honestly: aot.artifact_path() persists serve/eval kinds only
-# (train executables never hit MXTPU_AOT_CACHE_DIR), so on a live cache
-# this rule sees train artifacts only where someone put them — the
+# DECODE programs (serving/generate.py) carry the same contract on the
+# paged KV pool: the continuous-batching step donates the pool so the
+# cache updates in place; zero aliasing there means every token copies
+# the entire multi-MB pool through HBM — a steady-state serving
+# regression, so severity_of() escalates decode-kind findings to error
+# (the registry load gate refuses the artifact).
+# Train reach, honestly: aot.artifact_path() persists non-train kinds
+# only (train executables never hit MXTPU_AOT_CACHE_DIR), so on a live
+# cache the train form fires only where someone put artifacts — the
 # seeded canary, hand-exported dirs, a future train-persistence layer.
-# R012 is the defense that fires on today's deployments; H002 keeps the
-# compiled-side check proven against the day train artifacts persist.
-@program_rule("H002", "train program with zero input-output aliasing",
-              "warn")
+# R012 is the defense that fires on today's train deployments; decode
+# artifacts ARE persisted, so for them this rule is the live gate.
+@program_rule("H002", "train/decode program with zero input-output "
+                      "aliasing", "warn")
 def h002_donation_miss(prog):
-    if prog.kind != "train" or not prog.facts.args:
+    if prog.kind not in ("train", "decode") or not prog.facts.args:
         return
-    if prog.facts.aliased_count() == 0:
+    if prog.facts.aliased_count() != 0:
+        return
+    if prog.kind == "decode":
         yield _finding(
             prog, prog.facts.main_line, "H002",
-            "train program aliases zero of its %d input buffer(s) — "
-            "donation miss: jit.py intends in-place parameter updates "
-            "(donate_argnums), but this module copies every updated "
-            "buffer (double weight residency, 2x weight HBM traffic); "
-            "check the jit call site (mxtpulint R012) and MXTPU_NO_DONATE"
-            % len(prog.facts.args))
+            "decode program aliases zero of its %d input buffer(s) — "
+            "the paged KV pool is copied in full every decode step "
+            "instead of updating in place (donate_argnums fell off the "
+            "decode/kv-join program: a wrapper re-jit, MXTPU_NO_DONATE "
+            "left on, or an artifact-reload path that dropped donation); "
+            "steady-state decode pays the whole pool in HBM traffic per "
+            "token" % len(prog.facts.args))
+        return
+    yield _finding(
+        prog, prog.facts.main_line, "H002",
+        "train program aliases zero of its %d input buffer(s) — "
+        "donation miss: jit.py intends in-place parameter updates "
+        "(donate_argnums), but this module copies every updated "
+        "buffer (double weight residency, 2x weight HBM traffic); "
+        "check the jit call site (mxtpulint R012) and MXTPU_NO_DONATE"
+        % len(prog.facts.args))
 
 
 # --------------------------------------------------------------------- H003

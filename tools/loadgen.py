@@ -36,6 +36,17 @@ Usage::
         --item '[0.0, 0.0, 0.0, 0.0]' --stages 100x2,400x2,1600x2 \\
         --out report.json [--json] [--arrival poisson|constant]
 
+Generative mode (``--generate PROMPT_LEN:MAX_NEW``): each arrival is one
+``POST /generate`` whose chunked token stream is consumed as it arrives
+(docs/GENERATE.md). The open-loop discipline is unchanged — arrivals are
+scheduled by the clock — but the per-request record gains streaming
+truth: TTFT (first token line), every inter-token gap, tokens received,
+and the finish reason. Stage summaries gain a ``generate`` section
+(tokens/s goodput, TTFT p50/95/99, inter-token p50/95/99, finish-reason
+counts) and the span join additionally attributes ``gen:prefill`` and
+``gen:decode_step`` time — prompts and sampling seeds are derived
+deterministically from each request id, so a soak is replayable.
+
 ``--json`` additionally emits the shared CI report shape (``tool`` /
 ``ok`` / ``findings`` / ``counts`` / ``baselined`` — the same parser
 that reads ``python -m tools.mxtpulint --json`` and ``tools/promcheck.py
@@ -65,7 +76,8 @@ import queue as _queue
 import urllib.error
 import urllib.request
 
-__all__ = ["LoadGen", "HttpTransport", "InProcessTransport",
+__all__ = ["LoadGen", "HttpTransport", "GenHttpTransport",
+           "InProcessTransport",
            "arrival_offsets", "percentile",
            "parse_prom", "summarize_stage", "detect_saturation",
            "gate_metrics", "report_ci", "REPORT_SCHEMA", "METRICS_SCHEMA"]
@@ -240,6 +252,94 @@ class HttpTransport:
             return ""
 
 
+class GenHttpTransport(HttpTransport):
+    """Streaming generative client: one ``POST /generate`` per ``send()``,
+    consuming the chunked JSONL token stream as it arrives. ``send()``
+    returns a RICH result dict (status + ttft_ms + itl_ms gaps + tokens +
+    finish reason) instead of a bare status; the driver folds the extras
+    into the per-request record and ``summarize_stage`` reduces them to
+    the stage's ``generate`` section. Prompt token ids and the sampling
+    seed are derived from the request id (crc32), so a rerun with the
+    same --seed offers a byte-identical request mix."""
+
+    def __init__(self, url, model, prompt_len, max_new, temperature=0.0,
+                 top_k=0, deadline_ms=None, timeout_s=None, seed=0):
+        self.url = url.rstrip("/")
+        self._gen_url = self.url + "/generate"
+        self._model = model
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._deadline_ms = deadline_ms
+        self._seed = int(seed)
+        self._timeout = (float(timeout_s) if timeout_s is not None
+                         else _env("MXTPU_LOADGEN_TIMEOUT_S"))
+
+    def send(self, request_id, tenant=None):
+        import zlib
+        rng = random.Random(zlib.crc32(request_id.encode("utf-8"))
+                            ^ self._seed)
+        body = {"model": self._model,
+                # never token 0: that is the engine's EOS and a prompt
+                # containing it is still legal but ends runs instantly,
+                # which would make tokens/s depend on the rid mix
+                "prompt": [rng.randrange(1, 256)
+                           for _ in range(self.prompt_len)],
+                "max_new_tokens": self.max_new,
+                "temperature": self.temperature,
+                "top_k": self.top_k,
+                "seed": rng.randrange(1 << 30)}
+        if self._deadline_ms is not None:
+            body["deadline_ms"] = self._deadline_ms
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": request_id}
+        if tenant is not None:
+            headers["X-MXTPU-Tenant"] = tenant
+        req = urllib.request.Request(
+            self._gen_url, data=json.dumps(body).encode("utf-8"),
+            headers=headers)
+        t0 = time.monotonic()
+        ttft, t_prev, gaps, ntok, reason = None, None, [], 0, None
+        try:
+            # http.client dechunks transparently; the server flushes one
+            # chunk per JSON line, so readline returns tokens as they are
+            # generated — the timestamps below are real streaming truth
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                for line in r:
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    now = time.monotonic()
+                    if rec.get("done"):
+                        reason = rec.get("reason")
+                        break
+                    if "token" not in rec:
+                        continue
+                    ntok += 1
+                    if ttft is None:
+                        ttft = (now - t0) * 1e3
+                    else:
+                        gaps.append((now - t_prev) * 1e3)
+                    t_prev = now
+                status = r.status
+        except urllib.error.HTTPError as e:
+            e.close()
+            return e.code
+        except Exception:  # refused / reset / timeout
+            return TRANSPORT_ERROR
+        if reason is None:
+            # the stream died without its terminal line — a served-but-
+            # truncated response is a server error, not a success
+            return {"status": 500, "ttft_ms": ttft, "tokens": ntok,
+                    "itl_ms": gaps, "reason": "truncated"}
+        return {"status": status, "ttft_ms": ttft, "tokens": ntok,
+                "itl_ms": gaps, "reason": reason}
+
+
 class InProcessTransport:
     """Drive a live ``ModelRegistry`` directly — no HTTP, no sockets.
 
@@ -392,6 +492,9 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
     tenants = _tenant_columns(results, duration)
     if tenants:
         out["tenants"] = tenants
+    gen = _generate_columns(results, duration)
+    if gen:
+        out["generate"] = gen
     if slo_text:
         try:
             out["slo"] = json.loads(slo_text)
@@ -434,6 +537,31 @@ def _tenant_columns(results, duration):
     return out
 
 
+def _generate_columns(results, duration):
+    """The stage's streaming-generation reduction ({} when no result is
+    from a generate transport): tokens/s goodput (tokens received on OK
+    streams over the stage window — the number continuous batching must
+    beat sequential decode on), TTFT and inter-token percentiles over
+    every stream's raw gaps, and the finish-reason counts (a rising
+    kv_oom share is the capacity signal)."""
+    rs = [r for r in results if "tokens" in r]
+    if not rs:
+        return {}
+    tokens_ok = sum(r["tokens"] for r in rs if r["status"] == 200)
+    ttfts = [r["ttft_ms"] for r in rs if r.get("ttft_ms") is not None]
+    gaps = [g for r in rs for g in (r.get("itl_ms") or ())]
+    reasons = {}
+    for r in rs:
+        if r.get("reason"):
+            reasons[r["reason"]] = reasons.get(r["reason"], 0) + 1
+    return {"requests": len(rs),
+            "tokens_ok": tokens_ok,
+            "tokens_per_s": tokens_ok / duration if duration else 0.0,
+            "ttft_ms": _pctls(ttfts),
+            "inter_token_ms": dict(_pctls(gaps), count=len(gaps)),
+            "finish_reasons": dict(sorted(reasons.items()))}
+
+
 def _join_spans(rids, ok_rids, span_text):
     """Attribute the stage's server-side time by span kind, joined on the
     X-Request-Id each request carried: queue wait (serve:queue), batch
@@ -444,7 +572,14 @@ def _join_spans(rids, ok_rids, span_text):
     request (http:predict)."""
     kinds = {"serve:queue": "queue_ms", "serve:batch": "batch_ms",
              "serve:dispatch": "dispatch_ms",
-             "eval:step": "device_ms", "http:predict": "http_ms"}
+             "eval:step": "device_ms", "http:predict": "http_ms",
+             # generative serving (docs/GENERATE.md): the batched-prefill
+             # leg and every decode step this stage's sequences rode in
+             # (decode_step spans carry every rider's id in
+             # args.request_ids, same as serve:batch)
+             "http:generate": "http_ms",
+             "gen:prefill": "prefill_ms",
+             "gen:decode_step": "decode_step_ms"}
     durs = {v: [] for v in kinds.values()}
     replica_durs = {}
     joined_rids = set()
@@ -649,6 +784,22 @@ class LoadGen:
             return self.transport.send(rid)
         return self.transport.send(rid, tenant)
 
+    @staticmethod
+    def _make_record(stage_idx, rid, tenant, status, lat):
+        """Normalize one transport result: a bare int status, or a rich
+        dict (streaming transports — GenHttpTransport) whose extras
+        (ttft_ms, tokens, itl_ms, reason) ride the record into
+        summarize_stage's ``generate`` reduction."""
+        rec = {"stage": stage_idx, "rid": rid, "tenant": tenant}
+        if isinstance(status, dict):
+            extra = dict(status)
+            rec["status"] = int(extra.pop("status", TRANSPORT_ERROR))
+            rec.update(extra)
+        else:
+            rec["status"] = status
+        rec["latency_ms"] = lat
+        return rec
+
     def _worker(self, q):
         while True:
             item = q.get()
@@ -663,9 +814,8 @@ class LoadGen:
             lat = (self.clock.now() - t0) * 1e3
             with self._lock:
                 self._inflight -= 1
-                self._results.append({"stage": stage_idx, "rid": rid,
-                                      "tenant": tenant, "status": status,
-                                      "latency_ms": lat})
+                self._results.append(self._make_record(
+                    stage_idx, rid, tenant, status, lat))
 
     def _record_sync(self, stage_idx, rid, tenant):
         t0 = self.clock.now()
@@ -674,9 +824,8 @@ class LoadGen:
         except Exception:
             status = TRANSPORT_ERROR
         lat = (self.clock.now() - t0) * 1e3
-        self._results.append({"stage": stage_idx, "rid": rid,
-                              "tenant": tenant, "status": status,
-                              "latency_ms": lat})
+        self._results.append(self._make_record(
+            stage_idx, rid, tenant, status, lat))
 
     # -------------------------------------------------------------- driving
     def _pick_tenant(self, rng):
@@ -829,6 +978,14 @@ def gate_metrics(report):
     sat = report.get("saturation")
     if sat:
         m["loadgen_saturation_goodput_rps"] = sat["goodput_rps"]
+    g0 = st0.get("generate")
+    if g0:
+        # generative-mode facts (docs/GENERATE.md): the tokens/s goodput
+        # the CI stage compares against the sequential-decode baseline,
+        # plus the streaming tails
+        m["loadgen_gen_tokens_per_s"] = g0["tokens_per_s"]
+        m["loadgen_gen_ttft_p99_ms"] = g0["ttft_ms"]["p99"]
+        m["loadgen_gen_inter_token_p99_ms"] = g0["inter_token_ms"]["p99"]
     # a stage-0 with no OK responses has no percentiles — drop the Nones
     # rather than emit unparseable metrics
     return {"schema": METRICS_SCHEMA,
@@ -902,6 +1059,18 @@ def main(argv=None):
     ap.add_argument("--item", default="[0.0]",
                     help="JSON for ONE input item, no batch dim "
                          "(default: [0.0])")
+    ap.add_argument("--generate", default=None, metavar="PROMPT_LEN:MAX_NEW",
+                    help="generative mode: each arrival is one streaming "
+                         "POST /generate with a PROMPT_LEN-token prompt "
+                         "asking for MAX_NEW tokens (--item is ignored); "
+                         "stage reports gain tokens/s, TTFT and "
+                         "inter-token percentiles")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="generative sampling temperature (0 = greedy; "
+                         "only with --generate)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="generative top-k cutoff (0 = full vocab; only "
+                         "with --generate)")
     ap.add_argument("--stages", default="50x2,200x2,800x2",
                     help="ramp as RPSxSECONDS comma list "
                          "(default: 50x2,200x2,800x2)")
@@ -927,8 +1096,22 @@ def main(argv=None):
     ap.add_argument("--require-saturation", action="store_true")
     args = ap.parse_args(argv)
 
-    transport = HttpTransport(args.url, args.model, json.loads(args.item),
-                              deadline_ms=args.deadline_ms)
+    if args.generate:
+        plen, _sep, mnew = args.generate.partition(":")
+        if not _sep:
+            print("bad --generate %r (want PROMPT_LEN:MAX_NEW)"
+                  % args.generate, file=sys.stderr)
+            return 2
+        transport = GenHttpTransport(
+            args.url, args.model, int(plen), int(mnew),
+            temperature=args.temperature, top_k=args.top_k,
+            deadline_ms=args.deadline_ms,
+            seed=args.seed if args.seed is not None
+            else _env("MXTPU_LOADGEN_SEED"))
+    else:
+        transport = HttpTransport(args.url, args.model,
+                                  json.loads(args.item),
+                                  deadline_ms=args.deadline_ms)
     lg = LoadGen(transport, _parse_stages(args.stages),
                  arrival=args.arrival, seed=args.seed,
                  max_clients=args.max_clients, deadline_ms=args.deadline_ms,
@@ -951,6 +1134,15 @@ def main(argv=None):
                   % (i, s["offered_rps"], s["goodput_rps"],
                      s["latency_ms"]["p50"], s["latency_ms"]["p99"],
                      100 * s["shed_rate"], s["errors"]))
+            g = s.get("generate")
+            if g:
+                print("  generate: %.0f tok/s (%d tokens), TTFT p50/p99 "
+                      "%s/%s ms, inter-token p50/p99 %s/%s ms, reasons %s"
+                      % (g["tokens_per_s"], g["tokens_ok"],
+                         g["ttft_ms"]["p50"], g["ttft_ms"]["p99"],
+                         g["inter_token_ms"]["p50"],
+                         g["inter_token_ms"]["p99"],
+                         g["finish_reasons"]))
             for t, tc in sorted(s.get("tenants", {}).items()):
                 print("  tenant %-12s offered %4d, goodput %.0f rps, "
                       "p50/p99 %s/%s ms, shed %d"
