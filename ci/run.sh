@@ -91,6 +91,17 @@
 #             (non-matmul categories own the self time), and the
 #             undonated-decode canary must fire hlolint H002 at error
 #             severity with a nonzero exit
+#   numerics - numerics-sentinel gate (telemetry/numwatch.py,
+#             docs/OBSERVABILITY.md "Numerical health"): an injected-NaN
+#             canary servable fires exactly ONE nan_storm flightrec
+#             episode (hysteresis, not an event per poisoned batch); a
+#             deliberately mis-calibrated int8 servable's shadow vs its
+#             fp32 reference breaches and flips health to degraded while
+#             a sanely calibrated twin stays clean; the tap reducers add
+#             ZERO post-warm compiles (aot miss counter, kind
+#             "numwatch"); and interleaved paired p99 repeats (profstats
+#             phase-B methodology) hold the taps-on serving tax to
+#             <= 1.10x
 #   sharded - mesh-sharded serving gate on a forced-8-device CPU host:
 #             two interleaved 1-replica vs 8-replica loadgen soaks of a
 #             timer-bound servable driven through the in-process
@@ -117,7 +128,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo generate sharded diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo generate numerics sharded diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -149,16 +160,18 @@ print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
   # (aot.compile_cached), one donation-less train-step jit (R012 — the
   # source-side mirror of hlolint H002), one host-device sync in the
   # replica dispatch hot path, one per-dispatch XLA cost_analysis walk
-  # in the servable-call hot path, and one per-dispatch profiler-trace
-  # parse in the batch hot path (seeded_batcher.py, HOT_PATH_PATTERNS +
-  # the device-truth and trace-walk R001 sub-rules); full-profile
-  # analysis rooted at the fixture dir must report exactly those eight.
+  # in the servable-call hot path, one per-dispatch profiler-trace
+  # parse in the batch hot path, and one per-element host-side
+  # finite-check loop in the worker loop (seeded_batcher.py,
+  # HOT_PATH_PATTERNS + the device-truth, trace-walk and finite-check
+  # R001 sub-rules); full-profile analysis rooted at the fixture dir
+  # must report exactly those nine.
   python - <<'EOF'
 from tools.mxtpulint import analyze
 found = sorted(f.rule for f in analyze(["tools/mxtpulint/testdata"],
                                        root="tools/mxtpulint/testdata"))
-assert found == ["R001", "R001", "R001", "R009", "R010", "R011", "R011",
-                 "R012"], found
+assert found == ["R001", "R001", "R001", "R001", "R009", "R010", "R011",
+                 "R011", "R012"], found
 print("seeded-defect canary OK: %s" % ", ".join(found))
 EOF
 fi
@@ -980,6 +993,147 @@ EOF
   gen_dt=$(( SECONDS - gen_t0 ))
   echo "generate stage wall time: ${gen_dt}s (budget 120s)"
   [ "$gen_dt" -lt 120 ] || { echo "generate stage took ${gen_dt}s (budget 120s)"; exit 1; }
+fi
+
+if has_stage numerics; then
+  echo "=== numerics: NaN canary + int8 shadow divergence + tap-tax gate ==="
+  num_t0=$SECONDS
+  JAX_PLATFORMS=cpu MXTPU_NUMWATCH_SAMPLE=1.0 python - <<'EOF'
+import json, os, time
+import numpy as onp
+from incubator_mxnet_tpu import aot, nd, gluon
+from incubator_mxnet_tpu.contrib import quantization
+from incubator_mxnet_tpu.serving import ModelRegistry
+from incubator_mxnet_tpu.telemetry import flightrec, numwatch
+
+# --------------- phase A: injected-NaN canary -> exactly ONE episode
+# The canary poisons every batch after its third: a contiguous storm.
+# Hysteresis must collapse it into ONE nan_storm flightrec event (the
+# per-batch evidence lives in the nonfinite counter), not one event
+# per poisoned dispatch — the episode contract under a real divergence.
+class NaNCanary:
+    def __init__(self):
+        self.n = 0
+
+    def predict_batch(self, x):
+        self.n += 1
+        out = x + 1.0
+        if self.n > 3:
+            out = out.copy()
+            out.flat[0] = float("nan")
+        return (out,)
+
+reg = ModelRegistry()
+reg.load("nan-canary", NaNCanary(), max_batch_size=4, batch_timeout_ms=1.0)
+item = onp.ones((4,), "float32")
+for _ in range(12):
+    reg.predict("nan-canary", item, timeout=30.0)
+storms = [e for e in flightrec.snapshot() if e["event"] == "nan_storm"
+          and e.get("model") == "nan-canary"]
+assert len(storms) == 1, storms
+d = numwatch.describe()["taps"]["nan-canary/serve:outputs"]
+assert d["in_storm"] and d["storms"] == 1, d
+assert d["nonfinite"] >= 5, d        # every poisoned tap still counted
+print("nan canary OK: %d poisoned taps -> 1 nan_storm episode"
+      % d["nonfinite"])
+
+# ------- phase B: shadow divergence -> degraded flip (bad calib only)
+# Same fp32 Dense twice through the int8 path: one calibrated to its
+# real activation range, one to a sliver (the shipped-bad-constants
+# accident). The bad one's shadow vs the fp32 reference must breach
+# and flip health to degraded; the sane one must stay clean.
+class NDServable:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def predict_batch(self, x):
+        return (onp.asarray(self._fn(nd.array(x)), "float32"),)
+
+net = gluon.nn.Dense(4, in_units=8)
+net.initialize()
+q_bad = quantization.QuantizedDense(net, -0.01, 0.01)
+q_ok = quantization.QuantizedDense(net, -4.0, 4.0)
+reg.load("int8-bad", NDServable(q_bad), max_batch_size=4,
+         batch_timeout_ms=1.0)
+reg.load("int8-ok", NDServable(q_ok), max_batch_size=4,
+         batch_timeout_ms=1.0)
+reg.register_shadow("int8-bad", NDServable(net), stride=1, threshold=0.05)
+reg.register_shadow("int8-ok", NDServable(net), stride=1, threshold=0.5)
+qx = onp.linspace(-2.0, 2.0, 8).astype("float32")
+for _ in range(3):
+    reg.predict("int8-bad", qx, timeout=30.0)
+    reg.predict("int8-ok", qx, timeout=30.0)
+assert numwatch.shadow_drain(60.0)
+sh = numwatch.describe()["shadows"]
+assert sh["int8-bad"]["breached"] and sh["int8-bad"]["samples"] >= 1, sh
+assert not sh["int8-ok"]["breached"] and sh["int8-ok"]["breaches"] == 0, sh
+h = reg.health()
+assert h["status"] == "degraded", h
+assert "int8-bad" in h["reason"] and "shadow divergence" in h["reason"], h
+bad_desc = [m for m in reg.models() if m["name"] == "int8-bad"][0]
+ok_desc = [m for m in reg.models() if m["name"] == "int8-ok"][0]
+assert bad_desc["degraded"] and not ok_desc["degraded"]
+print("shadow OK: bad calib max_abs_diff %.3g degraded, clean calib %.3g"
+      % (sh["int8-bad"]["last"]["max_abs_diff"],
+         sh["int8-ok"]["last"]["max_abs_diff"]))
+reg.close()
+
+# ---------------- phase C+D: zero post-warm compiles + paired tap tax
+# TIMER-bound servable (profstats phase-B methodology): capacity set by
+# clocks, so paired p99 measures the tap's tax, not host speed. Warm
+# every reducer signature first; the timed window must then add ZERO
+# aot misses of kind "numwatch", and the min per-repeat paired ratio
+# must hold <= 1.10.
+class SlowEcho:
+    def predict_batch(self, x):
+        time.sleep(0.004)
+        return (x + 1.0,)
+
+reg2 = ModelRegistry()
+reg2.load("slownum", SlowEcho(), max_batch_size=4, batch_timeout_ms=1.0)
+slow_item = onp.zeros((4,), dtype=onp.float32)
+for _ in range(50):                  # warm reducers at every batch shape
+    reg2.predict("slownum", slow_item, timeout=30.0)
+misses0 = aot._MISSES.value(kind="numwatch")
+
+def p99(n=300):
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        reg2.predict("slownum", slow_item, timeout=30.0)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return lat[int(0.99 * len(lat)) - 1]
+
+pairs = []
+for rep in range(4):
+    os.environ["MXTPU_NUMWATCH_SAMPLE"] = "0.0"
+    off_i = p99()
+    os.environ["MXTPU_NUMWATCH_SAMPLE"] = "1.0"
+    on_i = p99()
+    pairs.append((off_i, on_i))
+ratio = min(on_i / off_i for off_i, on_i in pairs)
+assert ratio <= 1.10, (ratio, pairs)
+assert aot._MISSES.value(kind="numwatch") == misses0, \
+    (misses0, aot._MISSES.value(kind="numwatch"))
+print("tap tax OK: best paired p99 ratio %.3f over %d repeats, "
+      "0 post-warm reducer compiles" % (ratio, len(pairs)))
+
+# the loadgen between-stage scrape path: the in-process transport's
+# numerics() feeds summarize_stage the same describe() snapshot
+from tools import loadgen
+num_text = json.dumps(numwatch.describe())
+stage = loadgen.summarize_stage({"name": "numcheck", "rps": 0,
+                                 "concurrency": 1, "duration_s": 1.0},
+                                0, [], numerics_text=num_text)
+assert "slownum/serve:outputs" in stage["numerics"]["taps"], \
+    list(stage["numerics"]["taps"])
+reg2.close()
+print("numerics scrape OK: stage report carries the sentinel snapshot")
+EOF
+  num_dt=$(( SECONDS - num_t0 ))
+  echo "numerics stage wall time: ${num_dt}s (budget 120s)"
+  [ "$num_dt" -lt 120 ] || { echo "numerics stage took ${num_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage sharded; then

@@ -533,6 +533,30 @@ ENV_VARS = {
         "second record at 0.5, none at 0 — deterministic, not random, so "
         "two identical runs export identical files "
         "(serving/accesslog.py)."),
+    "MXTPU_NUMWATCH_SAMPLE": (
+        float, 0.0,
+        "Numerics-sentinel tap sampling rate (telemetry/numwatch.py): 0 "
+        "disables the on-device stats taps (the default); a rate r in "
+        "(0, 1] taps every round(1/r)-th dispatch at each site "
+        "(deterministic stride, not random — two identical runs tap "
+        "identical dispatches). Tap sites: TrainStep loss/params, "
+        "serving dispatch outputs, decode-loop logits "
+        "(docs/OBSERVABILITY.md 'Numerical health')."),
+    "MXTPU_SHADOW_SAMPLE": (
+        float, 0.0,
+        "Default shadow-execution sampling rate for models with a "
+        "registered reference servable (numwatch.register_shadow): 0 "
+        "disables; rate r re-executes every round(1/r)-th dispatched "
+        "batch through the reference on a background worker and compares "
+        "outputs into mxtpu_shadow_divergence{model,metric}. A per-model "
+        "stride passed to register_shadow overrides this."),
+    "MXTPU_SHADOW_THRESHOLD": (
+        float, 0.25,
+        "Max-abs-diff breach threshold for shadow divergence: a shadow "
+        "sample whose primary-vs-reference max absolute output "
+        "difference exceeds this flips the served model's health to "
+        "degraded (once per breach episode) and fires a shadow_breach "
+        "flightrec event (telemetry/numwatch.py)."),
     "MXTPU_SEED": (
         int, None,
         "Global RNG seed applied at package import (MXNET_SEED analog): "

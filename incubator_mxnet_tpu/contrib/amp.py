@@ -9,8 +9,6 @@ A dynamic loss scaler is provided for fp16-style flows anyway (API parity).
 """
 from __future__ import annotations
 
-import numpy as onp
-
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
@@ -58,8 +56,26 @@ class LossScaler:
             g._data = (g * inv)._data
 
     def check_and_update(self, grads):
-        """Returns True if grads are finite (step should apply)."""
-        finite = all(bool(onp.isfinite(g.asnumpy()).all()) for g in grads)
+        """Returns True if grads are finite (step should apply).
+
+        The finiteness check is ONE fused on-device reduction over the
+        whole grad list with a single scalar device->host transfer — a
+        per-gradient ``.asnumpy()`` round-trip here would sync the
+        pipeline once per parameter, every step (the shape mxtpulint
+        R001 flags in hot paths)."""
+        import jax.numpy as jnp
+        leaves = [getattr(g, "_data", g) for g in grads]
+        if leaves:
+            all_finite = jnp.array(True)
+            for leaf in leaves:
+                all_finite = jnp.logical_and(
+                    all_finite,
+                    jnp.all(jnp.isfinite(jnp.asarray(leaf,
+                                                     dtype=jnp.float32))))
+            # reviewed sync point: the one scalar transfer of the check
+            finite = bool(all_finite)
+        else:
+            finite = True
         if finite:
             self._unskipped += 1
             if self._unskipped >= self._scale_window:

@@ -27,7 +27,7 @@ from . import aot
 from . import autograd
 from . import config
 from . import telemetry
-from .telemetry import devstats, flightrec, spans, watchdog
+from .telemetry import devstats, flightrec, numwatch, spans, watchdog
 from .gluon import _functional
 from .ndarray import NDArray
 from .ndarray import random as _rnd
@@ -564,6 +564,13 @@ class TrainStep:
                                                      new_opt[i])
             for a, v in zip(aux_box, aux_vals):
                 a._data = v
+        # numerics sentinel (stride-sampled, default off): on-device
+        # stats taps over the per-sample loss and the updated parameter
+        # tree — grads are fused inside the step program, so a NaN storm
+        # in them surfaces here as non-finite loss/updates. tap() never
+        # raises and costs a dict increment when unsampled.
+        numwatch.tap(self._model_id, "train:loss", (loss_full,))
+        numwatch.tap(self._model_id, "train:params", new_t)
         step_dur = _time.perf_counter() - step_t0
         _STEP_SECONDS.observe(step_dur)
         _STEPS.inc()

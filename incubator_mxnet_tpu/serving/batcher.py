@@ -52,7 +52,7 @@ import queue as _queue
 import numpy as onp
 
 from .. import config
-from ..telemetry import devstats, flightrec, spans, watchdog
+from ..telemetry import devstats, flightrec, numwatch, spans, watchdog
 from .metrics import ServingMetrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
@@ -458,6 +458,13 @@ class DynamicBatcher:
             devstats.detach_model(self.name)
         except Exception:
             pass
+        # ...and the numerics sentinel's tap series, storm episodes and
+        # shadow registration — an unloaded model must not export a
+        # frozen abs-max or keep a reference servable pinned
+        try:
+            numwatch.detach_model(self.name)
+        except Exception:
+            pass
         # ...and for the SLO engine's burn/budget/alert gauges: an
         # unloaded model must not keep exporting a frozen burn rate
         try:
@@ -737,6 +744,12 @@ class DynamicBatcher:
                 req.fail(e)
             return
         dur = time.monotonic() - t0
+        # numerics sentinel: stride-sampled stats tap over the DEVICE
+        # outputs, before the host materialization below — one packed
+        # scalar-bundle transfer when sampled, a dict increment when not
+        # (tap() never raises; R005)
+        numwatch.tap(self.name, "serve:outputs",
+                     outs if isinstance(outs, (list, tuple)) else (outs,))
         try:
             # normalize + slice BEFORE delivering anything: malformed
             # servable output (scalar, short dim 0, ragged) must fail the
@@ -778,6 +791,10 @@ class DynamicBatcher:
         self._note_dispatch(live, bucket, replica, t0, call_s)
         for j, req in enumerate(live):
             req.succeed(results[j])
+        # shadow sampling: offer this batch (padded inputs + host outputs)
+        # to the numerics sentinel's background comparator AFTER delivery —
+        # a full shadow queue drops the sample, never delays the response
+        numwatch.shadow_offer(self.name, stacked, outs)
 
     def _profile_batch(self, n, bucket, dur, request_ids=None):
         """Per-batch hook into the framework profiler (no-op unless

@@ -70,7 +70,7 @@ from .. import aot, config
 from .. import jit as jit_mod
 from .. import telemetry
 from ..ops import kvcache
-from ..telemetry import flightrec, spans, watchdog
+from ..telemetry import flightrec, numwatch, spans, watchdog
 from ..telemetry import slo as slo_mod
 from . import accesslog
 from .batcher import DynamicBatcher, QueueFullError, ServingClosedError, \
@@ -84,7 +84,8 @@ _LOG = logging.getLogger(__name__)
 #: Retiring token id: a sampled 0 ends the sequence (reason "eos").
 EOS_TOKEN = 0
 
-_FINISH_REASONS = ("eos", "max_tokens", "disconnect", "kv_oom", "error")
+_FINISH_REASONS = ("eos", "max_tokens", "disconnect", "kv_oom", "error",
+                   "numeric_error")
 
 _TOKENS = telemetry.counter(
     "mxtpu_gen_tokens_total",
@@ -248,7 +249,13 @@ class TinyLM:
         )(seeds, n_generated)
         next_t = jax.vmap(self._sample)(logits, keys_r, temperatures,
                                         top_ks)
-        return pool, next_t
+        # numerics sentinel: per-row logit health, fused into the step
+        # program — a row with any non-finite logit samples garbage, and
+        # the engine retires it (finish_reason "numeric_error") instead
+        # of streaming the garbage token. One extra bool[B] output rides
+        # the existing host transfer; no separate tap dispatch.
+        row_finite = jnp.all(jnp.isfinite(logits), axis=-1)
+        return pool, next_t, row_finite
 
 
 # ------------------------------------------------------------- stream handle
@@ -792,12 +799,22 @@ class GenerativeEngine:
                         bucket=B,
                         request_ids=[s.request_id for s in act
                                      if s.request_id is not None]):
-            self._pool, next_t = fn(self._pool, tables, lengths, last,
-                                    seeds, ngen, temps, topks, active)
+            self._pool, next_t, row_finite = fn(self._pool, tables, lengths,
+                                                last, seeds, ngen, temps,
+                                                topks, active)
             # reviewed sync point: one host transfer for the whole step's
-            # sampled tokens, inside the step span so the span measures
-            # true step latency  # mxtpulint: disable=R001
+            # sampled tokens (plus the fused per-row logit-health bools),
+            # inside the step span so the span measures true step
+            # latency  # mxtpulint: disable=R001
             next_t = onp.asarray(next_t)
+            finite = onp.asarray(row_finite)  # mxtpulint: disable=R001
+        # feed the sentinel the step's finite fraction over LIVE rows
+        # (note() applies the nonfinite counter + nan_storm hysteresis
+        # and never raises; padding rows carry zero activations and
+        # must not dilute the signal)
+        if n:
+            numwatch.note(self.name, "gen:logits",
+                          float(onp.mean(finite[:n])))
         for i, s in enumerate(act):
             tok = int(next_t[i])
             s.length += 1
@@ -805,6 +822,12 @@ class GenerativeEngine:
             s.n_generated += 1
             if s.stream.cancelled:
                 self._retire(s, "disconnect")
+                continue
+            if not bool(finite[i]):
+                # non-finite decode logits: the sampled token is garbage —
+                # free the row's KV blocks and end the stream loudly
+                # instead of emitting it (gen_retire carries the reason)
+                self._retire(s, "numeric_error")
                 continue
             self._emit_token(s, tok)
             if tok == self.eos_token:
@@ -879,13 +902,17 @@ class GenerativeEngine:
         length, last, ngen = P, tokens[0], 1
         reason = "max_tokens"
         while ngen < max_new:
-            pool, nt = fn(pool, table[None], onp.array([length], onp.int32),
-                          onp.array([last], onp.int32),
-                          onp.array([seed], onp.int32),
-                          onp.array([ngen], onp.int32),
-                          onp.array([temperature], onp.float32),
-                          onp.array([top_k], onp.int32),
-                          onp.array([True]))
+            pool, nt, fin = fn(pool, table[None],
+                               onp.array([length], onp.int32),
+                               onp.array([last], onp.int32),
+                               onp.array([seed], onp.int32),
+                               onp.array([ngen], onp.int32),
+                               onp.array([temperature], onp.float32),
+                               onp.array([top_k], onp.int32),
+                               onp.array([True]))
+            if not bool(onp.asarray(fin)[0]):
+                reason = "numeric_error"
+                break
             last = int(onp.asarray(nt)[0])
             tokens.append(last)
             length += 1
@@ -950,5 +977,12 @@ class GenerativeEngine:
                 pass
         try:
             slo_mod.REGISTRY.detach_model(self.name)
+        except Exception:
+            pass
+        # numerics sentinel: drop this engine's tap series and any open
+        # storm episode (detach-on-close; the prefill batcher's close
+        # already detached its own sites)
+        try:
+            numwatch.detach_model(self.name)
         except Exception:
             pass

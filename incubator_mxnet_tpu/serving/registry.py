@@ -191,6 +191,14 @@ class _ModelEntry:
                 self.current_version = version
             return version
 
+    def set_degraded(self, reason):
+        """Flip this model's health/describe() to degraded with ``reason``
+        — the numerics sentinel's shadow-breach callback lands here (the
+        hlolint refusal shape). Sticky until the next install/add_version:
+        a divergence breach is an operator decision, not a flap."""
+        with self._lock:
+            self._degraded = str(reason)
+
     def repoint(self, version):
         """Cut dispatch over to ``version`` — only honored while it is
         still the newest warm target (idempotent; no-op once a newer
@@ -336,7 +344,7 @@ class _ModelEntry:
             self.versions.pop(version, None)
             self._replica_aware.pop(version, None)
             self._inflight.pop(version, None)
-            self._degraded = reason
+            self._degraded = "load refused by hlolint: %s" % reason
             if was_current:
                 self.current_version = (max(self.versions)
                                         if self.versions else None)
@@ -664,6 +672,28 @@ class ModelRegistry:
     def metrics(self, name):
         return self._entry(name).metrics
 
+    # ------------------------------------------------------------- numerics
+    def register_shadow(self, name, reference, stride=None, threshold=None):
+        """Attach ``reference`` (servable or Gluon block) as ``name``'s
+        numerics shadow: a deterministic stride of dispatched batches is
+        re-executed through it off the hot path and compared
+        (telemetry/numwatch.py). A max-abs-diff breach beyond
+        ``threshold`` (default MXTPU_SHADOW_THRESHOLD) flips this model's
+        describe()/health() to degraded — the int8-vs-bf16 divergence
+        gate ROADMAP's serving-quantization item needs."""
+        entry = self._entry(name)
+        reference = _as_servable(reference)
+        from ..telemetry import numwatch
+        numwatch.register_shadow(name, reference, stride=stride,
+                                 threshold=threshold,
+                                 on_breach=entry.set_degraded)
+
+    def unregister_shadow(self, name):
+        """Detach ``name``'s numerics shadow (the degraded flag, if
+        already flipped, stays until the next load)."""
+        from ..telemetry import numwatch
+        return numwatch.unregister_shadow(name)
+
     # ------------------------------------------------------------ inspection
     def models(self):
         with self._lock:
@@ -700,11 +730,12 @@ class ModelRegistry:
                         "queue_depth": e.batcher.queue_depth()}
         for e in entries:
             if e._degraded:
-                # the last load's compiled program was refused by the
-                # hlolint gate: serving continues on the previous version
-                # (or 404s on a first load), but the operator must see it
+                # a measurement-driven gate flipped this model's flag — a
+                # load refused by hlolint, or a shadow-divergence breach
+                # from the numerics sentinel: serving continues, but the
+                # operator must see it
                 return {"status": "degraded",
-                        "reason": "model %r load refused by hlolint: %s"
+                        "reason": "model %r degraded: %s"
                                   % (e.name, e._degraded)}
         for e in entries:
             dead = e.batcher.dead_replicas()

@@ -75,6 +75,10 @@ Routes (TF-Serving REST-shaped):
 - ``GET /debug/slo``        — per-SLO error-budget remaining, window
   burn rates, and alert-pair states (telemetry/slo.py;
   docs/OBSERVABILITY.md "SLOs and tenants").
+- ``GET /debug/numerics``   — the numerics sentinel: per-site tap stats
+  (finite fraction / abs-max / rms, storm episodes) and per-model
+  shadow divergence (telemetry/numwatch.py; docs/OBSERVABILITY.md
+  "Numerical health").
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
@@ -213,6 +217,11 @@ class _Handler(BaseHTTPRequestHandler):
             # alert whose error burst has ended)
             from ..telemetry import slo
             self._send(200, slo.REGISTRY.describe())
+        elif self.path == "/debug/numerics":
+            # the numerics sentinel: per-site tap stats / storm episodes
+            # and per-model shadow divergence (telemetry/numwatch.py)
+            from ..telemetry import numwatch
+            self._send(200, numwatch.describe())
         elif self.path.split("?", 1)[0] == "/debug/profile":
             self._do_profile()
         elif self.path.split("?", 1)[0] == "/debug/hotspots":

@@ -1,0 +1,495 @@
+"""Numerical-health observability (the numerics sentinel): value-level
+taps + shadow-sampled divergence tracking.
+
+Every other telemetry layer here measures *time and structure* (spans,
+devstats MFU, profstats hotspots, SLO burn rates); this one observes the
+*values* flowing through the system — the NaN storm in training, the
+drifting int8 logits in serving, the non-finite decode logits a sampler
+would silently turn into garbage tokens.
+
+Two halves:
+
+**On-device stats taps** — ``tap(model, site, leaves)`` runs a tiny
+reducer program over a tensor tree ON DEVICE and brings back one packed
+scalar bundle ``[finite_fraction, abs_max, rms]`` in a single
+device->host transfer. Reducers are AOT-compiled once per shape/dtype
+signature through ``aot.compile_cached`` (kind ``"numwatch"`` — the aot
+hit/miss counters attribute them, and steady state never recompiles).
+Tap sites are stride-sampled by ``MXTPU_NUMWATCH_SAMPLE`` (0 disables —
+the default; 1.0 taps every dispatch; 0.25 every 4th — deterministic
+stride, not random, so two identical runs tap identical dispatches).
+Call sites today: TrainStep loss/updated params (jit.py), serving
+dispatch outputs (serving/batcher.py), and the decode loop's logits
+(serving/generate.py, via the fused per-row finiteness output).
+
+Non-finite detections increment
+``mxtpu_numwatch_nonfinite_total{model,site}`` and fire a once-per-
+episode ``nan_storm`` flight-recorder event with hysteresis (the
+devstats hbm_pressure / watchdog precedent): the first non-finite tap at
+a site opens an episode and records the event; further non-finite taps
+in the same episode are counted but not re-recorded; a fully-finite tap
+closes the episode and re-arms it. Rolling abs-max / rms land in
+``mxtpu_numwatch_absmax{model,site}`` / ``mxtpu_numwatch_rms{...}``.
+
+**Shadow execution sampling** — ``register_shadow(model, reference)``
+attaches a reference servable (e.g. the bf16 original of an
+int8-quantized deployment) to a served model. A deterministic stride
+(``MXTPU_SHADOW_SAMPLE``) of dispatched batches is re-executed through
+the reference OFF the hot path (a single daemon worker thread with a
+bounded queue — overload drops samples, never delays serving) and the
+primary/reference outputs are compared: max-abs-diff, top-1 agreement
+and mean logit KL land in ``mxtpu_shadow_divergence{model,metric}``.
+A max-abs-diff above ``MXTPU_SHADOW_THRESHOLD`` is a BREACH: the
+``on_breach`` callback (the serving registry wires it to the model
+entry's degraded flag — the hlolint refusal shape) fires once per
+breach episode together with a ``shadow_breach`` flightrec event.
+
+Everything in this module follows the R005 discipline: a telemetry
+failure must never fail the traffic it observes — every public entry
+point swallows exceptions into a debug log.
+
+Surfaces: ``describe()`` backs ``GET /debug/numerics`` (serving/server)
+and loadgen's between-stage scrape; ``detach_model()`` is called from
+the batcher/generator close paths so an unloaded model exports no
+frozen series (the detach-on-close contract).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import queue as _queue
+import threading
+
+import numpy as onp
+
+from .registry import counter, gauge
+from . import flightrec
+
+__all__ = ["tap", "note", "shadow_offer", "register_shadow",
+           "unregister_shadow", "shadow_drain", "describe", "detach_model",
+           "reset", "sample_stride", "shadow_stride"]
+
+_LOG = logging.getLogger(__name__)
+
+_NONFINITE = counter(
+    "mxtpu_numwatch_nonfinite_total",
+    "Sampled taps that observed at least one non-finite element",
+    ("model", "site"))
+_TAPS = counter(
+    "mxtpu_numwatch_taps_total",
+    "Sampled numerics taps executed (per model and tap site)",
+    ("model", "site"))
+_ABSMAX = gauge(
+    "mxtpu_numwatch_absmax",
+    "Rolling abs-max over the last sampled tap (non-finite masked out)",
+    ("model", "site"))
+_RMS = gauge(
+    "mxtpu_numwatch_rms",
+    "Rolling rms over the last sampled tap (non-finite masked out)",
+    ("model", "site"))
+_SHADOW_DIV = gauge(
+    "mxtpu_shadow_divergence",
+    "Primary-vs-reference divergence of the last shadow sample "
+    "(metric: max_abs_diff | top1_agreement | logit_kl)",
+    ("model", "metric"))
+_SHADOW_SAMPLES = counter(
+    "mxtpu_shadow_samples_total",
+    "Batches re-executed through the registered reference servable",
+    ("model",))
+_SHADOW_BREACHES = counter(
+    "mxtpu_shadow_breaches_total",
+    "Shadow samples whose max-abs-diff exceeded MXTPU_SHADOW_THRESHOLD",
+    ("model",))
+_SHADOW_DROPS = counter(
+    "mxtpu_shadow_drops_total",
+    "Shadow samples dropped because the worker queue was full",
+    ("model",))
+
+_lock = threading.Lock()
+_tap_counts = {}        # (model, site) -> dispatches seen (stride clock)
+_storms = set()         # (model, site) keys inside a nan_storm episode
+_storm_counts = {}      # (model, site) -> episodes fired (describe)
+_last_stats = {}        # (model, site) -> (finite_frac, absmax, rms)
+
+
+def sample_stride():
+    """Tap stride from MXTPU_NUMWATCH_SAMPLE: 0 -> disabled (stride 0),
+    rate r in (0, 1] -> every round(1/r)-th dispatch."""
+    from .. import config
+    try:
+        rate = float(config.get_env("MXTPU_NUMWATCH_SAMPLE") or 0.0)
+    except Exception:
+        return 0
+    if rate <= 0.0:
+        return 0
+    return max(1, int(round(1.0 / min(1.0, rate))))
+
+
+def shadow_stride():
+    """Shadow stride from MXTPU_SHADOW_SAMPLE (same 0-disables contract)."""
+    from .. import config
+    try:
+        rate = float(config.get_env("MXTPU_SHADOW_SAMPLE") or 0.0)
+    except Exception:
+        return 0
+    if rate <= 0.0:
+        return 0
+    return max(1, int(round(1.0 / min(1.0, rate))))
+
+
+# --------------------------------------------------------------- reducers
+def _leaf_data(a):
+    """Unwrap NDArray (_data) and leave jax/numpy arrays alone."""
+    return getattr(a, "_data", a)
+
+
+def _reducer_entry(sig):
+    """AOT-cached packed reducer for one input signature: returns the
+    cache entry whose .fn maps the leaves to a float32[3] bundle
+    [finite_fraction, abs_max, rms] — ONE device->host transfer for the
+    whole tree, compiled once per signature (aot kind='numwatch')."""
+    from .. import aot
+
+    key = aot.cache_key("numwatch", sig, kind="numwatch",
+                        extra=(len(sig),))
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        specs = [jax.ShapeDtypeStruct(shape, jnp.dtype(dt))
+                 for shape, dt in sig]
+        total = max(1, sum(int(onp.prod(s or (1,))) for s, _ in sig))
+
+        def reduce_stats(*leaves):
+            finite = jnp.asarray(0.0, jnp.float32)
+            absmax = jnp.asarray(0.0, jnp.float32)
+            sumsq = jnp.asarray(0.0, jnp.float32)
+            for leaf in leaves:
+                x = leaf.astype(jnp.float32)
+                ok = jnp.isfinite(x)
+                finite = finite + jnp.sum(ok).astype(jnp.float32)
+                masked = jnp.where(ok, x, 0.0)
+                absmax = jnp.maximum(absmax, jnp.max(jnp.abs(masked)))
+                sumsq = sumsq + jnp.sum(masked * masked)
+            return jnp.stack([finite / total, absmax,
+                              jnp.sqrt(sumsq / total)])
+
+        fn = jax.jit(reduce_stats).lower(*specs).compile()
+        return fn, None, None
+
+    return aot.compile_cached(key, build)
+
+
+def tap(model, site, leaves):
+    """Stride-sampled on-device stats tap over ``leaves`` (a flat list of
+    NDArray / jax / numpy arrays). Never raises; never blocks beyond the
+    one scalar-bundle transfer. Call from hot paths freely — an unsampled
+    call is a dict increment under a lock."""
+    try:
+        stride = sample_stride()
+        if stride <= 0:
+            return None
+        k = (str(model), str(site))
+        with _lock:
+            n = _tap_counts.get(k, 0)
+            _tap_counts[k] = n + 1
+        if n % stride != 0:
+            return None
+        leaves = [_leaf_data(a) for a in leaves
+                  if hasattr(a, "shape") and hasattr(a, "dtype")]
+        if not leaves:
+            return None
+        sig = tuple((tuple(int(d) for d in a.shape), str(a.dtype))
+                    for a in leaves)
+        entry = _reducer_entry(sig)
+        # reviewed sync point: the packed [finite_frac, absmax, rms]
+        # bundle is the tap's entire host traffic
+        bundle = onp.asarray(entry.fn(*leaves))  # mxtpulint: disable=R001
+        return note(model, site,
+                    float(bundle[0]), float(bundle[1]), float(bundle[2]))
+    except Exception:
+        _LOG.debug("numwatch tap at %s/%s dropped", model, site,
+                   exc_info=True)
+        return None
+
+
+def note(model, site, finite_frac, absmax=None, rms=None):
+    """Record one observation's health facts (the tap's back half, also
+    called directly by sites that compute finiteness inside their own
+    compiled program — the decode loop's fused per-row check). Applies
+    the counter/gauge updates and the nan_storm hysteresis: an episode
+    OPENS (event fires once) when finite_frac drops below 1.0 and CLOSES
+    (re-arms) on the next fully-finite observation."""
+    try:
+        model, site = str(model), str(site)
+        _TAPS.inc(model=model, site=site)
+        if absmax is not None:
+            _ABSMAX.set(float(absmax), model=model, site=site)
+        if rms is not None:
+            _RMS.set(float(rms), model=model, site=site)
+        k = (model, site)
+        fire = False
+        with _lock:
+            _last_stats[k] = (float(finite_frac), absmax, rms)
+            in_episode = k in _storms
+            if finite_frac < 1.0 and not in_episode:
+                _storms.add(k)
+                _storm_counts[k] = _storm_counts.get(k, 0) + 1
+                fire = True
+            elif finite_frac >= 1.0 and in_episode:
+                _storms.discard(k)
+        if finite_frac < 1.0:
+            _NONFINITE.inc(model=model, site=site)
+        if fire:
+            # outside the lock (devstats precedent): flightrec never
+            # raises, but it must not serialize the tap path either
+            flightrec.record("nan_storm", model=model, site=site,
+                            finite_frac=round(float(finite_frac), 6))
+        return bool(finite_frac >= 1.0)
+    except Exception:
+        _LOG.debug("numwatch note at %s/%s dropped", model, site,
+                   exc_info=True)
+        return None
+
+
+# --------------------------------------------------------- shadow sampling
+class _Shadow:
+    """One model's registered reference + its stride clock and episode."""
+
+    __slots__ = ("reference", "stride", "threshold", "on_breach",
+                 "count", "breached", "last")
+
+    def __init__(self, reference, stride, threshold, on_breach):
+        self.reference = reference
+        self.stride = stride
+        self.threshold = threshold
+        self.on_breach = on_breach
+        self.count = 0          # dispatches seen (stride clock)
+        self.breached = False   # inside a breach episode
+        self.last = None        # last comparison dict (describe)
+
+
+_shadows = {}                   # model -> _Shadow
+_shadow_q = None                # _queue.Queue of (model, stacked, primary)
+_shadow_thread = None
+_SHADOW_QUEUE_SIZE = 64
+
+
+def register_shadow(model, reference, stride=None, threshold=None,
+                    on_breach=None):
+    """Attach ``reference`` (a servable with predict_batch, or a bare
+    callable) as ``model``'s shadow. ``stride`` defaults to the
+    MXTPU_SHADOW_SAMPLE-derived stride resolved at offer time; ``threshold``
+    to MXTPU_SHADOW_THRESHOLD. ``on_breach(reason)`` fires once per breach
+    episode (the registry wires the degraded-health flip here)."""
+    with _lock:
+        _shadows[str(model)] = _Shadow(reference, stride, threshold,
+                                       on_breach)
+    _ensure_worker()
+
+
+def unregister_shadow(model):
+    with _lock:
+        return _shadows.pop(str(model), None) is not None
+
+
+def _ensure_worker():
+    global _shadow_q, _shadow_thread
+    with _lock:
+        if _shadow_thread is not None and _shadow_thread.is_alive():
+            return
+        _shadow_q = _queue.Queue(maxsize=_SHADOW_QUEUE_SIZE)
+        _shadow_thread = threading.Thread(
+            target=_shadow_loop, args=(_shadow_q,), daemon=True,
+            name="mxtpu-numwatch-shadow")
+        _shadow_thread.start()
+
+
+def _shadow_loop(q):
+    while True:
+        model, stacked, primary = q.get()
+        try:
+            _shadow_compare(model, stacked, primary)
+        except Exception:
+            _LOG.debug("shadow comparison for model %r dropped", model,
+                       exc_info=True)
+        finally:
+            q.task_done()
+
+
+def shadow_offer(model, stacked, primary_outs):
+    """Hot-path hook (serving/batcher, AFTER results landed on host):
+    stride-sample this dispatch into the shadow worker's bounded queue.
+    Full queue -> sample dropped and counted, never blocks serving."""
+    try:
+        model = str(model)
+        with _lock:
+            sh = _shadows.get(model)
+            if sh is None:
+                return
+            n = sh.count
+            sh.count = n + 1
+            stride = sh.stride
+        if stride is None:
+            stride = shadow_stride()
+        if stride <= 0 or n % stride != 0:
+            return
+        q = _shadow_q
+        if q is None:
+            return
+        try:
+            # not a device sync: the batcher hands over outputs it ALREADY
+            # materialized on host for slicing — asarray is a no-op wrap
+            q.put_nowait((model, tuple(stacked),
+                          tuple(onp.asarray(o)  # mxtpulint: disable=R001
+                                for o in primary_outs)))
+        except _queue.Full:
+            _SHADOW_DROPS.inc(model=model)
+    except Exception:
+        _LOG.debug("shadow offer for model %r dropped", model,
+                   exc_info=True)
+
+
+def shadow_drain(timeout=10.0):
+    """Block until every queued shadow sample has been compared (tests /
+    CI determinism; the serving path never calls this)."""
+    q = _shadow_q
+    if q is None:
+        return True
+    deadline = threading.Event()
+    t = threading.Thread(target=lambda: (q.join(), deadline.set()),
+                         daemon=True)
+    t.start()
+    return deadline.wait(timeout)
+
+
+def _softmax(x):
+    x = x.astype(onp.float64)
+    x = x - x.max(axis=-1, keepdims=True)
+    e = onp.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _shadow_compare(model, stacked, primary):
+    sh = _shadows.get(model)
+    if sh is None:
+        return
+    ref_outs = sh.reference.predict_batch(*stacked) \
+        if hasattr(sh.reference, "predict_batch") else sh.reference(*stacked)
+    if not isinstance(ref_outs, (list, tuple)):
+        ref_outs = (ref_outs,)
+    # reviewed sync point: the shadow worker thread owns this transfer —
+    # it is off the serving hot path by construction
+    p = onp.asarray(primary[0], dtype=onp.float64)
+    r = onp.asarray(  # mxtpulint: disable=R001
+        _leaf_data(ref_outs[0]), dtype=onp.float64)
+    if p.shape != r.shape:
+        raise ValueError("shadow output shape %s != primary %s"
+                         % (r.shape, p.shape))
+    max_abs = float(onp.max(onp.abs(p - r))) if p.size else 0.0
+    comparison = {"max_abs_diff": max_abs}
+    if p.ndim >= 2 and p.shape[-1] > 1:
+        comparison["top1_agreement"] = float(
+            onp.mean(p.argmax(axis=-1) == r.argmax(axis=-1)))
+        sp, sr = _softmax(p), _softmax(r)
+        comparison["logit_kl"] = float(onp.mean(onp.sum(
+            sr * (onp.log(sr + 1e-12) - onp.log(sp + 1e-12)), axis=-1)))
+    for metric, value in comparison.items():
+        _SHADOW_DIV.set(value, model=model, metric=metric)
+    _SHADOW_SAMPLES.inc(model=model)
+
+    from .. import config
+    threshold = sh.threshold
+    if threshold is None:
+        threshold = float(config.get_env("MXTPU_SHADOW_THRESHOLD"))
+    breach = max_abs > threshold
+    fire = False
+    with _lock:
+        sh.last = dict(comparison, breach=breach, threshold=threshold)
+        if breach and not sh.breached:
+            sh.breached = True
+            fire = True
+        elif not breach and sh.breached:
+            # recovery re-arms the episode; the degraded flag the
+            # registry set stays sticky until the next load (the
+            # hlolint-refusal shape: an operator decision, not a flap)
+            sh.breached = False
+    if breach:
+        _SHADOW_BREACHES.inc(model=model)
+    if fire:
+        reason = ("shadow divergence breach: max_abs_diff=%.4g > "
+                  "threshold=%.4g" % (max_abs, threshold))
+        flightrec.record("shadow_breach", model=model,
+                         max_abs_diff=round(max_abs, 6),
+                         threshold=threshold)
+        cb = sh.on_breach
+        if cb is not None:
+            try:
+                cb(reason)
+            except Exception:
+                _LOG.debug("shadow on_breach callback for %r failed",
+                           model, exc_info=True)
+
+
+# ------------------------------------------------------------- inspection
+def describe():
+    """JSON-able snapshot (GET /debug/numerics, loadgen scrape)."""
+    with _lock:
+        taps = {"%s/%s" % k: {"sampled": _TAPS.value(model=k[0], site=k[1]),
+                              "nonfinite": _NONFINITE.value(model=k[0],
+                                                            site=k[1]),
+                              "storms": _storm_counts.get(k, 0),
+                              "in_storm": k in _storms,
+                              "last": list(_last_stats.get(k) or ())}
+                for k in sorted(_last_stats)}
+        shadows = {m: {"stride": sh.stride, "threshold": sh.threshold,
+                       "offered": sh.count,
+                       "samples": _SHADOW_SAMPLES.value(model=m),
+                       "breaches": _SHADOW_BREACHES.value(model=m),
+                       "drops": _SHADOW_DROPS.value(model=m),
+                       "breached": sh.breached,
+                       "last": dict(sh.last) if sh.last else None}
+                   for m, sh in sorted(_shadows.items())}
+    return {"sample_stride": sample_stride(),
+            "shadow_stride": shadow_stride(),
+            "taps": taps, "shadows": shadows}
+
+
+def detach_model(model):
+    """Drop every series and episode this model drove (the detach-on-close
+    contract: an unloaded model must not export frozen health). Called
+    from the batcher/generator close paths; never raises."""
+    model = str(model)
+    try:
+        with _lock:
+            keys = [k for k in _last_stats if k[0] == model]
+            for k in keys:
+                _last_stats.pop(k, None)
+                _tap_counts.pop(k, None)
+                _storm_counts.pop(k, None)
+                _storms.discard(k)
+            _shadows.pop(model, None)
+        for _, site in keys:
+            for g in (_ABSMAX, _RMS):
+                try:
+                    g.remove(model=model, site=site)
+                except Exception:
+                    pass
+        for metric in ("max_abs_diff", "top1_agreement", "logit_kl"):
+            try:
+                _SHADOW_DIV.remove(model=model, metric=metric)
+            except Exception:
+                pass
+    except Exception:
+        _LOG.debug("numwatch detach for model %r dropped", model,
+                   exc_info=True)
+
+
+def reset():
+    """Test hook: forget every episode, stride clock and shadow."""
+    with _lock:
+        _tap_counts.clear()
+        _storms.clear()
+        _storm_counts.clear()
+        _last_stats.clear()
+        _shadows.clear()

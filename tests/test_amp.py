@@ -39,6 +39,40 @@ def test_loss_scaler_dynamics():
     assert g.asnumpy().item() == 1.0
 
 
+def test_loss_scaler_single_fused_transfer(monkeypatch):
+    """Regression: the overflow check is ONE fused on-device reduction.
+    The old implementation called ``.asnumpy()`` per gradient — a host
+    round-trip for every tensor in the tree. Pin that to zero: the only
+    host traffic left is the final scalar ``bool()`` coercion, and no
+    host-side numpy finite-check may see the gradients either."""
+    import numpy
+    ls = amp.LossScaler(init_scale=2.0, scale_factor=2.0, scale_window=100)
+    grads = [nd.ones((4, 4)) for _ in range(16)]
+
+    calls = {"asnumpy": 0}
+    orig_asnumpy = nd.NDArray.asnumpy
+
+    def spy(self):
+        calls["asnumpy"] += 1
+        return orig_asnumpy(self)
+
+    def boom(*a, **kw):
+        raise AssertionError("host-side numpy finite check in LossScaler")
+
+    monkeypatch.setattr(nd.NDArray, "asnumpy", spy)
+    monkeypatch.setattr(numpy, "isfinite", boom)
+    monkeypatch.setattr(numpy, "isnan", boom)
+    assert ls.check_and_update(grads) is True
+    assert calls["asnumpy"] == 0
+    # behavior unchanged: one poisoned gradient anywhere in the tree
+    # still skips the step and shrinks the scale
+    grads[7] = nd.array([float("nan")] * 4)
+    s = ls.loss_scale
+    assert ls.check_and_update(grads) is False
+    assert ls.loss_scale == s / 2.0
+    assert calls["asnumpy"] == 0
+
+
 def test_bf16_training_with_master_weights():
     from incubator_mxnet_tpu.gluon.data.vision import _synthetic
     data, label = _synthetic(256, (16,), 4, seed=3)

@@ -353,6 +353,50 @@ def test_inter_token_slo_rows_per_tenant(monkeypatch):
     assert not any(n.startswith("gen-slo/") for n in names_after)
 
 
+def test_decode_numeric_error_retires_row_and_frees_kv():
+    """Non-finite decode logits retire the row with finish_reason
+    'numeric_error' — KV blocks freed, gen_retire carries the reason,
+    the numerics sentinel logs the nonfinite observation — instead of
+    streaming a garbage token sampled from NaNs."""
+    from incubator_mxnet_tpu.telemetry import flightrec, numwatch
+    numwatch.reset()
+    e = gen.GenerativeEngine(name="gen-nan", seed=0, **GEO)
+    try:
+        # poison the compiled decode programs: the fused per-row
+        # finiteness bit reads False for every live row, as it would if
+        # the logits had gone NaN on device
+        real = e._decode_fn
+
+        def poisoned(bucket):
+            fn = real(bucket)
+
+            def wrapped(*a):
+                pool, nt, fin = fn(*a)
+                return pool, nt, onp.zeros(onp.asarray(fin).shape, bool)
+            return wrapped
+
+        e._decode_fn = poisoned
+        used0 = e._alloc.used
+        stream = e.submit([5, 6, 7], max_new_tokens=8, seed=3)
+        toks, reason = stream.tokens(timeout=120.0)
+        assert reason == "numeric_error"
+        assert stream.finish_reason == "numeric_error"
+        assert len(toks) <= 1            # prefill's first token at most
+        deadline = time.monotonic() + 30.0
+        while e._alloc.used > used0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert e._alloc.used == used0    # the row's blocks went back
+        retires = [ev for ev in flightrec.snapshot()
+                   if ev["event"] == "gen_retire"
+                   and ev.get("model") == "gen-nan"]
+        assert retires and retires[-1]["reason"] == "numeric_error"
+        d = numwatch.describe()["taps"].get("gen-nan/gen:logits")
+        assert d and d["nonfinite"] >= 1
+    finally:
+        e.close()
+        numwatch.reset()
+
+
 # ------------------------------------------------- H002 decode escalation
 def test_h002_decode_text_fixtures():
     """Positive: a decode program with zero aliased inputs fires H002 at

@@ -76,6 +76,48 @@ def test_r001_cold_path_clean(tmp_path):
     assert "R001" not in rule_ids(findings)
 
 
+def test_r001_finite_check_loop_positive(tmp_path):
+    # the amp.py loss-scaler shape: a host-side numpy isfinite per
+    # gradient inside a loop/comprehension in a hot path — each
+    # iteration syncs one device array to host
+    findings = run_snippet(tmp_path, "amp.py", """
+        import numpy as onp
+
+        class LossScaler:
+            def check_and_update(self, grads):
+                finite = all(bool(onp.isfinite(g).all()) for g in grads)
+                for g in grads:
+                    if onp.isnan(g).any():
+                        return False
+                return finite
+    """)
+    assert rule_ids(findings) == ["R001", "R001"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "isfinite" in msgs and "isnan" in msgs
+    assert "jnp.isfinite" in msgs    # the advice names the fused fix
+
+
+def test_r001_finite_check_clean_cases(tmp_path):
+    # a single (non-loop) isfinite in a hot path, an on-device
+    # jnp.isfinite reduction (the fix), and a loop OUTSIDE a hot path
+    # must all stay clean
+    findings = run_snippet(tmp_path, "amp.py", """
+        import numpy as onp
+        import jax.numpy as jnp
+
+        class LossScaler:
+            def check_and_update(self, grads):
+                ok = jnp.array(True)
+                for g in grads:
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+                return bool(ok) and bool(onp.isfinite(self.loss_scale))
+
+        def offline_audit(arrays):
+            return [onp.isfinite(a).all() for a in arrays]
+    """)
+    assert "R001" not in rule_ids(findings)
+
+
 # ------------------------------------------------------------------ R002
 def test_r002_env_bypass_positive(tmp_path):
     findings = run_snippet(tmp_path, "feature.py", """

@@ -1140,37 +1140,41 @@ def test_r001_interprocedural_depth_is_one(tmp_path):
 
 
 # --------------------------------------------------------- seeded defects
-def test_seeded_defects_exactly_eight():
+def test_seeded_defects_exactly_nine():
     """The regression canary: the fixtures contain one deadlock cycle,
     one unlocked cross-thread write, one jax.jit retrace hazard, one
     AOT-boundary (aot.compile_cached) retrace hazard, one donation-less
     train-step jit (R012 — the source mirror of hlolint H002), one
     host-device sync in the replica dispatch hot path, one per-dispatch
-    XLA cost_analysis walk in the servable-call hot path, and one
-    per-dispatch profiler-trace parse in the batch hot path
+    XLA cost_analysis walk in the servable-call hot path, one
+    per-dispatch profiler-trace parse in the batch hot path, and one
+    per-element host-side finite-check loop in the worker loop
     (seeded_batcher.py anchors the
     ``*batcher:DynamicBatcher._dispatch_replica`` / ``._call_servable``
-    / ``._process_batch`` patterns) — the analyzer must report exactly
-    those eight (ci/run.sh asserts the same thing in the lint stage)."""
+    / ``._process_batch`` / ``._run_loop`` patterns) — the analyzer
+    must report exactly those nine (ci/run.sh asserts the same thing in
+    the lint stage)."""
     findings = analyze([SEEDED], root=SEEDED)
     assert rule_ids(findings) == \
-        ["R001", "R001", "R001", "R009", "R010", "R011", "R011",
+        ["R001", "R001", "R001", "R001", "R009", "R010", "R011", "R011",
          "R012"], findings
 
 
 def test_seeded_replica_defects_are_the_r001s(tmp_path):
-    # all three R001s come from the batcher fixture: the host-device
+    # all four R001s come from the batcher fixture: the host-device
     # sync is anchored at the _dispatch_replica hot path, the
-    # device-truth analysis-walk defect at _call_servable, and the
-    # trace-walk defect at _process_batch
+    # device-truth analysis-walk defect at _call_servable, the
+    # trace-walk defect at _process_batch, and the per-element
+    # finite-check loop at _run_loop
     findings = analyze([SEEDED], root=SEEDED)
     r001 = [f for f in findings if f.rule == "R001"]
-    assert len(r001) == 3
+    assert len(r001) == 4
     assert all(f.path.endswith("seeded_batcher.py") for f in r001)
     msgs = " | ".join(f.message for f in r001)
     assert "_dispatch_replica" in msgs
     assert "_call_servable" in msgs and "cost_analysis" in msgs
     assert "_process_batch" in msgs and "summarize_capture" in msgs
+    assert "_run_loop" in msgs and "isfinite" in msgs
 
 
 def test_seeded_defects_clean_under_repo_gate_profile():

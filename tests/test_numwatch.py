@@ -1,0 +1,285 @@
+"""Numerics sentinel (telemetry/numwatch): on-device stats taps, NaN-storm
+hysteresis, shadow-sampled int8/bf16 divergence, and the degraded-health
+flip the serving registry wires to a breach."""
+import json as _json
+import urllib.request as _urlreq
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import aot, nd, gluon
+from incubator_mxnet_tpu.contrib import quantization
+from incubator_mxnet_tpu.serving import ModelRegistry, ServingServer
+from incubator_mxnet_tpu.telemetry import flightrec, numwatch
+
+
+@pytest.fixture(autouse=True)
+def _clean_numwatch(monkeypatch):
+    """Every test starts with no stride clocks, no storms, no shadows,
+    taps enabled at rate 1.0 unless the test overrides it."""
+    monkeypatch.setenv("MXTPU_NUMWATCH_SAMPLE", "1.0")
+    numwatch.reset()
+    flightrec.reset()
+    yield
+    numwatch.reset()
+
+
+def _storm_events():
+    return [e for e in flightrec.snapshot() if e["event"] == "nan_storm"]
+
+
+# ------------------------------------------------------------------ taps
+def test_tap_math_matches_numpy_reference():
+    """finite_fraction / abs-max / rms agree with a host-side numpy
+    computation, with non-finite elements masked out of absmax and rms."""
+    a = onp.asarray([1.0, -3.0, 2.0, 0.0], "float32")
+    b = onp.asarray([[4.0, float("nan")], [float("inf"), -2.0]], "float32")
+    assert numwatch.tap("tapmath", "s", [a, b]) is False   # non-finite seen
+
+    st = numwatch.describe()["taps"]["tapmath/s"]["last"]
+    finite_frac, absmax, rms = st
+    vals = onp.concatenate([a.ravel(), b.ravel()])
+    ok = onp.isfinite(vals)
+    masked = onp.where(ok, vals, 0.0)
+    assert finite_frac == pytest.approx(ok.mean())
+    assert absmax == pytest.approx(onp.abs(masked).max())
+    assert rms == pytest.approx(onp.sqrt((masked ** 2).mean()), rel=1e-5)
+
+
+def test_tap_accepts_ndarray_leaves_and_reports_finite():
+    assert numwatch.tap("tapnd", "s", [nd.ones((3, 3))]) is True
+    d = numwatch.describe()["taps"]["tapnd/s"]
+    assert d["nonfinite"] == 0 and d["sampled"] == 1
+    assert d["last"][0] == 1.0
+
+
+def test_tap_zero_recompile_steady_state():
+    """One aot miss (kind='numwatch') per signature; repeat taps at the
+    same signature are pure cache hits — the zero-recompile contract."""
+    x = onp.ones((8, 4), "float32")
+    numwatch.tap("m", "warm", [x])
+    misses = aot._MISSES.value(kind="numwatch")
+    hits = aot._HITS.value(kind="numwatch")
+    for _ in range(10):
+        numwatch.tap("m", "warm", [x + 1.0])
+    assert aot._MISSES.value(kind="numwatch") == misses
+    assert aot._HITS.value(kind="numwatch") == hits + 10
+    # a NEW signature compiles exactly once more
+    numwatch.tap("m", "warm2", [onp.ones((2, 2), "float32")])
+    assert aot._MISSES.value(kind="numwatch") == misses + 1
+
+
+def test_tap_stride_is_deterministic(monkeypatch):
+    """rate 0.25 -> every 4th dispatch, anchored at the first: two
+    identical runs tap identical dispatches (no randomness)."""
+    monkeypatch.setenv("MXTPU_NUMWATCH_SAMPLE", "0.25")
+    assert numwatch.sample_stride() == 4
+    x = onp.ones((2,), "float32")
+    for _ in range(8):
+        numwatch.tap("stridem", "s", [x])
+    assert numwatch.describe()["taps"]["stridem/s"]["sampled"] == 2  # 0th, 4th
+
+    numwatch.reset()                    # stride clock rewinds, counters kept
+    for _ in range(8):
+        numwatch.tap("stridem", "s", [x])
+    assert numwatch.describe()["taps"]["stridem/s"]["sampled"] == 4
+
+
+def test_tap_disabled_at_zero_rate(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMWATCH_SAMPLE", "0.0")
+    assert numwatch.sample_stride() == 0
+    assert numwatch.tap("m", "s", [onp.ones((2,), "float32")]) is None
+    assert numwatch.describe()["taps"] == {}
+
+
+# ------------------------------------------------------------- hysteresis
+def test_nan_storm_fires_once_then_rearms():
+    """First non-finite tap opens the episode and records ONE nan_storm
+    event; further bad taps are counted silently; a clean tap closes the
+    episode; the next bad tap opens (and records) a second one."""
+    bad = onp.asarray([1.0, float("nan")], "float32")
+    good = onp.ones((2,), "float32")
+
+    numwatch.tap("storm", "s", [bad])
+    numwatch.tap("storm", "s", [bad])
+    numwatch.tap("storm", "s", [bad])
+    assert len(_storm_events()) == 1
+    d = numwatch.describe()["taps"]["storm/s"]
+    assert d["in_storm"] is True and d["storms"] == 1
+    assert d["nonfinite"] == 3          # every bad tap still counts
+
+    numwatch.tap("storm", "s", [good])  # closes + re-arms
+    assert numwatch.describe()["taps"]["storm/s"]["in_storm"] is False
+    numwatch.tap("storm", "s", [bad])   # second episode
+    events = _storm_events()
+    assert len(events) == 2
+    assert events[0]["model"] == "storm" and events[0]["site"] == "s"
+    assert numwatch.describe()["taps"]["storm/s"]["storms"] == 2
+
+
+def test_note_direct_entry_drives_same_hysteresis():
+    """Sites with a fused in-program check (the decode loop) call note()
+    directly — same counters, same episode machinery."""
+    assert numwatch.note("g", "gen:logits", 0.5) is False
+    assert numwatch.note("g", "gen:logits", 1.0) is True
+    assert numwatch.note("g", "gen:logits", 0.75) is False
+    assert len(_storm_events()) == 2
+    assert numwatch.describe()["taps"]["g/gen:logits"]["nonfinite"] == 2
+
+
+# ------------------------------------------------------- shadow execution
+class _NDServable:
+    """Serves an incubator callable (Dense block or QuantizedDense) on the
+    batcher's numpy batches; returns numpy via the NDArray array protocol."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def predict_batch(self, x):
+        return (onp.asarray(self._fn(nd.array(x)), "float32"),)
+
+
+def _dense_pair(miscalibrated):
+    """A bf16-faithful fp32 Dense and its int8 twin; ``miscalibrated``
+    shrinks the activation calibration range to a sliver so the int8
+    outputs clip and diverge."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    span = 0.01 if miscalibrated else 4.0
+    qd = quantization.QuantizedDense(net, -span, span)
+    return net, qd
+
+
+def test_shadow_divergence_breach_flips_health_degraded():
+    """A mis-calibrated int8 primary vs its fp32 reference: max-abs-diff
+    crosses the threshold, the shadow_breach event fires once, and the
+    registry-wired on_breach flips the model's health to degraded."""
+    net, qd = _dense_pair(miscalibrated=True)
+    reg = ModelRegistry()
+    try:
+        reg.load("int8", _NDServable(qd), max_batch_size=4,
+                 batch_timeout_ms=1.0)
+        reg.register_shadow("int8", _NDServable(net), stride=1,
+                            threshold=0.05)
+        x = onp.linspace(-2.0, 2.0, 8).astype("float32")
+        for _ in range(3):
+            reg.predict("int8", x)
+        assert numwatch.shadow_drain(30.0)
+
+        sh = numwatch.describe()["shadows"]["int8"]
+        assert sh["samples"] >= 1 and sh["breached"] is True
+        assert sh["last"]["max_abs_diff"] > 0.05
+        breaches = [e for e in flightrec.snapshot()
+                    if e["event"] == "shadow_breach"]
+        assert len(breaches) == 1       # once per episode, not per sample
+        h = reg.health()
+        assert h["status"] == "degraded"
+        assert "shadow divergence breach" in h["reason"]
+        desc = [m for m in reg.models() if m["name"] == "int8"][0]
+        assert "shadow divergence breach" in desc["degraded"]
+    finally:
+        reg.close()
+
+
+def test_shadow_clean_int8_stays_clean():
+    """A sanely calibrated int8 model under the same harness never
+    breaches and the registry stays healthy."""
+    net, qd = _dense_pair(miscalibrated=False)
+    reg = ModelRegistry()
+    try:
+        reg.load("int8ok", _NDServable(qd), max_batch_size=4,
+                 batch_timeout_ms=1.0)
+        reg.register_shadow("int8ok", _NDServable(net), stride=1,
+                            threshold=0.5)
+        x = onp.linspace(-2.0, 2.0, 8).astype("float32")
+        for _ in range(3):
+            reg.predict("int8ok", x)
+        assert numwatch.shadow_drain(30.0)
+
+        sh = numwatch.describe()["shadows"]["int8ok"]
+        assert sh["samples"] >= 1 and sh["breached"] is False
+        assert sh["breaches"] == 0
+        assert sh["last"]["max_abs_diff"] <= 0.5
+        assert reg.health()["status"] == "healthy"
+    finally:
+        reg.close()
+
+
+def test_shadow_metrics_include_top1_and_kl():
+    """2-D outputs with a class axis also report top-1 agreement and mean
+    logit KL; a reference identical to the primary scores perfectly."""
+    numwatch.register_shadow(
+        "shmet", lambda x: (x,), stride=1, threshold=0.5)
+    logits = onp.asarray([[1.0, 2.0, 3.0], [3.0, 1.0, 0.0]], "float32")
+    numwatch.shadow_offer("shmet", (logits,), (logits,))
+    assert numwatch.shadow_drain(30.0)
+    last = numwatch.describe()["shadows"]["shmet"]["last"]
+    assert last["max_abs_diff"] == 0.0
+    assert last["top1_agreement"] == 1.0
+    assert last["logit_kl"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_shadow_stride_samples_every_nth():
+    numwatch.register_shadow("shstr", lambda x: (x,), stride=3, threshold=9.0)
+    x = onp.ones((2, 2), "float32")
+    for _ in range(7):
+        numwatch.shadow_offer("shstr", (x,), (x,))
+    assert numwatch.shadow_drain(30.0)
+    sh = numwatch.describe()["shadows"]["shstr"]
+    assert sh["offered"] == 7
+    assert sh["samples"] == 3           # dispatches 0, 3, 6
+
+
+# --------------------------------------------------------------- surfaces
+def test_debug_numerics_endpoint_e2e():
+    """GET /debug/numerics serves the describe() snapshot over HTTP."""
+    numwatch.tap("web", "serve:outputs", [onp.ones((2, 2), "float32")])
+    reg = ModelRegistry()
+    try:
+        with ServingServer(reg, port=0) as srv:
+            with _urlreq.urlopen(srv.url + "/debug/numerics",
+                                 timeout=30.0) as resp:
+                body = _json.loads(resp.read().decode())
+        assert body["sample_stride"] == 1
+        assert body["taps"]["web/serve:outputs"]["sampled"] == 1
+    finally:
+        reg.close()
+
+
+def test_detach_on_close_drops_model_series():
+    """Unloading a model (registry close path) must drop its tap series,
+    storm episodes and shadow registration — no frozen health exports."""
+    bad = onp.asarray([float("nan")], "float32")
+    numwatch.tap("gone", "serve:outputs", [bad])
+    numwatch.register_shadow("gone", lambda x: (x,), stride=1)
+    numwatch.tap("kept", "serve:outputs", [onp.ones((1,), "float32")])
+
+    numwatch.detach_model("gone")
+    d = numwatch.describe()
+    assert "gone/serve:outputs" not in d["taps"]
+    assert "gone" not in d["shadows"]
+    assert "kept/serve:outputs" in d["taps"]
+
+
+def test_batcher_close_detaches(monkeypatch):
+    """End-to-end: serving traffic creates the series, unload removes it."""
+    reg = ModelRegistry()
+    try:
+        reg.load("tmp", _NDServable(lambda x: x), max_batch_size=2,
+                 batch_timeout_ms=1.0)
+        reg.predict("tmp", onp.ones((3,), "float32"))
+        assert any(k.startswith("tmp/")
+                   for k in numwatch.describe()["taps"])
+        reg.unload("tmp")
+        assert not any(k.startswith("tmp/")
+                       for k in numwatch.describe()["taps"])
+    finally:
+        reg.close()
+
+
+def test_telemetry_failure_never_raises(monkeypatch):
+    """R005: a broken reducer build must not fail the tapped path."""
+    def boom(*a, **kw):
+        raise RuntimeError("reducer exploded")
+    monkeypatch.setattr(numwatch, "_reducer_entry", boom)
+    assert numwatch.tap("m", "s", [onp.ones((2,), "float32")]) is None

@@ -251,6 +251,15 @@ class HttpTransport:
         except Exception:
             return ""
 
+    def numerics(self):
+        """GET /debug/numerics JSON text, or '' — the numerics-sentinel
+        snapshot (tap stats, storm episodes, shadow divergence) the
+        stage reports carry alongside the SLO one."""
+        try:
+            return self._get("/debug/numerics")
+        except Exception:
+            return ""
+
 
 class GenHttpTransport(HttpTransport):
     """Streaming generative client: one ``POST /generate`` per ``send()``,
@@ -415,6 +424,15 @@ class InProcessTransport:
         except Exception:
             return ""
 
+    def numerics(self):
+        """The same /debug/numerics payload the HTTP route serves, read
+        straight off the numerics sentinel."""
+        from incubator_mxnet_tpu.telemetry import numwatch as _numwatch
+        try:
+            return json.dumps(_numwatch.describe())
+        except Exception:
+            return ""
+
 
 class _MonotonicClock:
     """The real clock: monotonic now() + time.sleep."""
@@ -429,7 +447,7 @@ class _MonotonicClock:
 # --------------------------------------------------------------- summarizing
 def summarize_stage(stage_cfg, n_offered, results, span_text="",
                     prom_before=None, prom_after=None,
-                    scrape_window_s=None, slo_text=""):
+                    scrape_window_s=None, slo_text="", numerics_text=""):
     """One stage's report entry from raw per-request results.
 
     ``results``: [{"rid", "status", "latency_ms"}, ...] for every arrival
@@ -441,6 +459,9 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
     ``slo_text``: /debug/slo JSON scraped AFTER the stage — parsed into
     the stage's ``slo`` entry, so a ramp's report carries the
     budget/burn-rate trajectory alongside its latency one.
+    ``numerics_text``: /debug/numerics JSON scraped AFTER the stage —
+    parsed into the stage's ``numerics`` entry (tap health + shadow
+    divergence trajectory, telemetry/numwatch.py).
     ``scrape_window_s``: wall time between the two /metrics scrapes,
     reported as ``server.metrics.mfu_window_s``. It is NOT the MFU
     denominator (that is the chip-seconds delta, topology-exact); it is
@@ -500,6 +521,11 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
             out["slo"] = json.loads(slo_text)
         except ValueError:
             out["slo"] = None
+    if numerics_text:
+        try:
+            out["numerics"] = json.loads(numerics_text)
+        except ValueError:
+            out["numerics"] = None
     out["server"] = _join_spans(rids, ok_rids, span_text)
     if prom_before is not None and prom_after is not None:
         window = scrape_window_s if scrape_window_s else duration
@@ -916,6 +942,8 @@ class LoadGen:
                 # and older transports without .slo() degrade to none)
                 slo_fn = getattr(self.transport, "slo", None)
                 slo_text = slo_fn() if slo_fn is not None else ""
+                num_fn = getattr(self.transport, "numerics", None)
+                numerics_text = num_fn() if num_fn is not None else ""
                 prom_after = parse_prom(self.transport.scrape())
                 now = self.clock.now()
                 with self._lock:
@@ -925,7 +953,8 @@ class LoadGen:
                     prom_before, prom_after,
                     # the counters cover scrape→scrape (drain + settle
                     # included), so the MFU denominator must too
-                    scrape_window_s=now - t_scrape, slo_text=slo_text))
+                    scrape_window_s=now - t_scrape, slo_text=slo_text,
+                    numerics_text=numerics_text))
                 prom_before = prom_after
                 t_scrape = now
         finally:

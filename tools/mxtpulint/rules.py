@@ -73,6 +73,12 @@ HOT_PATH_PATTERNS = (
     # low-duty-cycle profiler into a steady dispatch tax
     "*telemetry/profstats:fold_summary",
     "*telemetry/profstats:_daemon_loop",
+    # the AMP loss scaler runs once per optimizer step between the train
+    # dispatch and the weight update — a per-gradient host round-trip in
+    # its finiteness check syncs the pipeline once per parameter, every
+    # step (the defect the fused on-device jnp.isfinite reduction fixed)
+    "*amp:LossScaler.check_and_update",
+    "*amp:LossScaler.unscale",
 )
 
 _SYNC_ATTRS = ("asnumpy", "item")
@@ -87,7 +93,15 @@ _ANALYSIS_ATTRS = ("cost_analysis", "memory_analysis")
 #: the profstats daemon / operator route, NEVER inside a dispatch hot
 #: path; the rolling aggregates (profstats.hotspots) are the cheap read
 _TRACE_ATTRS = ("summarize_capture", "summarize_trace", "load_trace")
+#: host-side finite checks (numpy-module isfinite/isnan): flagged only
+#: INSIDE a loop/comprehension in a hot path — the per-element shape
+#: (``all(onp.isfinite(g.asnumpy()).all() for g in grads)``) syncs a
+#: device array to host once per iteration; the fix is ONE fused
+#: on-device jnp.isfinite reduction with a single scalar transfer
+_FINITE_ATTRS = ("isfinite", "isnan")
 _NUMPY_MODULES = ("np", "onp", "numpy")
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.GeneratorExp,
+               ast.ListComp, ast.SetComp, ast.DictComp)
 
 
 def _in_hot_path(ctx, node):
@@ -119,10 +133,26 @@ def r001_host_sync(ctx):
               and isinstance(f.value, ast.Name)
               and f.value.id in _NUMPY_MODULES):
             what = "%s.asarray()" % f.value.id
+        elif (isinstance(f, ast.Attribute) and f.attr in _FINITE_ATTRS
+              and isinstance(f.value, ast.Name)
+              and f.value.id in _NUMPY_MODULES
+              and any(isinstance(a, _LOOP_NODES)
+                      for a in ctx.ancestors(node))):
+            what = "%s.%s()" % (f.value.id, f.attr)
+            analysis = "finite"
         if what is None:
             continue
         hot = _in_hot_path(ctx, node)
         if hot is None:
+            continue
+        if analysis == "finite":
+            yield ctx.finding(
+                node, "R001",
+                "%s inside a loop in hot path %r is a per-element "
+                "host-side finite check — every iteration materializes a "
+                "device array on host; fuse ONE on-device jnp.isfinite "
+                "reduction over the whole tree and transfer a single "
+                "scalar" % (what, hot))
             continue
         if analysis == "trace":
             yield ctx.finding(
