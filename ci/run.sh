@@ -8,14 +8,14 @@
 # Stages:
 #   lint    - syntax walk over every python file (compileall) + the
 #             framework-aware static-analysis gate (tools/mxtpulint/:
-#             per-file rules R001-R008 + R012 plus the whole-program
+#             per-file rules R001-R008 + R012-R013 plus the whole-program
 #             passes — lock-order cycles, cross-thread shared state,
 #             jit-retrace hazards, call-graph-aware hot-path syncs —
 #             over incubator_mxnet_tpu, with tools/ and tests/ under the
 #             relaxed R003/R005/R006 profile) — hard fail on any
 #             non-baselined finding, on a >30s wall time, and on the
 #             seeded-defect canary (the testdata fixtures must yield
-#             exactly the seven seeded findings)
+#             exactly the ten seeded findings)
 #   hlolint - compiled-artifact static analysis (tools/hlolint/): trace
 #             the serving-shaped programs the repo actually runs (fp32
 #             dense eval buckets + a native-int8 quantized net) into a
@@ -112,6 +112,22 @@
 #             goodput scaling ratio perfgate-compared against the
 #             committed sharded_goodput_scaling baseline — the hard
 #             >=3x 1->8 contract of the replica router (docs/SERVING.md)
+#   chaos   - self-healing serving gate (telemetry/faultlab.py +
+#             serving/resilience.py, docs/RESILIENCE.md): the chaos unit
+#             tier (tests/test_resilience.py — deterministic fault
+#             injection, retry/respawn/park, decode-loop resurrection,
+#             last-known-good rollback, the 503 no_replicas contract,
+#             and the <= 1.05x disarmed-guard-tax paired-p99 gate); then
+#             a supervised 4-replica loadgen soak with seeded replica
+#             kills injected mid-ramp (--faults) asserting availability
+#             >= 97% under chaos, zero stranded arrivals, clean
+#             before/after stages, retries + respawns actually observed,
+#             and the fleet healed within the backoff budget; a decode
+#             loop killed under supervision must finish its streams
+#             after resurrection, and a degraded flip must roll dispatch
+#             back to the prior version; finally the unsupervised canary
+#             (same kill, no Supervisor) must FAIL the healed check —
+#             proof the gate fires; wall budget 120s
 #   diagnostics - the "why is it slow / why is it stuck" layer: span
 #             tracing (nesting, queue-boundary propagation, chrome-trace
 #             parenting, 16-thread race), flight recorder (ring bound,
@@ -128,14 +144,14 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo generate numerics sharded diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo generate numerics sharded chaos diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
 if has_stage lint; then
   echo "=== lint: syntax walk + mxtpulint gate (two-phase) ==="
   python -m compileall -q incubator_mxnet_tpu tests tools benchmark bench.py __graft_entry__.py
-  # Per-file rules R001-R008 over the runtime (tools/ and tests/ under
+  # Per-file rules R001-R008 + R012-R013 over the runtime (tools/ and tests/ under
   # the relaxed R003/R005/R006 profile) + the whole-program passes
   # (R009-R011, interprocedural R001); exits nonzero on any finding that
   # is neither inline-suppressed nor in tools/mxtpulint/baseline.json.
@@ -161,17 +177,19 @@ print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
   # source-side mirror of hlolint H002), one host-device sync in the
   # replica dispatch hot path, one per-dispatch XLA cost_analysis walk
   # in the servable-call hot path, one per-dispatch profiler-trace
-  # parse in the batch hot path, and one per-element host-side
+  # parse in the batch hot path, one per-element host-side
   # finite-check loop in the worker loop (seeded_batcher.py,
   # HOT_PATH_PATTERNS + the device-truth, trace-walk and finite-check
-  # R001 sub-rules); full-profile analysis rooted at the fixture dir
-  # must report exactly those nine.
+  # R001 sub-rules), and one unpaced respawn retry loop (R013 — the
+  # source-side mirror of the supervisor's backoff/park policy);
+  # full-profile analysis rooted at the fixture dir must report exactly
+  # those ten.
   python - <<'EOF'
 from tools.mxtpulint import analyze
 found = sorted(f.rule for f in analyze(["tools/mxtpulint/testdata"],
                                        root="tools/mxtpulint/testdata"))
 assert found == ["R001", "R001", "R001", "R001", "R009", "R010", "R011",
-                 "R011", "R012"], found
+                 "R011", "R012", "R013"], found
 print("seeded-defect canary OK: %s" % ", ".join(found))
 EOF
 fi
@@ -1223,6 +1241,190 @@ EOF
   sh_dt=$(( SECONDS - sh_t0 ))
   echo "sharded stage wall time: ${sh_dt}s (budget 120s)"
   [ "$sh_dt" -lt 120 ] || { echo "sharded stage took ${sh_dt}s (budget 120s)"; exit 1; }
+fi
+
+if has_stage chaos; then
+  echo "=== chaos: faultlab + self-healing serving gate ==="
+  ch_t0=$SECONDS
+  # Phase A: the chaos unit tier — faultlab determinism (stride/p/seed/
+  # budget), the bounded predict retry, replica respawn + the
+  # supervisor's backoff/park state machine, decode-loop resurrection
+  # (bit-exact survivors vs loud engine_restart), last-known-good
+  # rollback + quarantine, the 503 no_replicas / dead-genloop-delisting
+  # HTTP contract, and the <= 1.05x disarmed-guard-tax paired-p99 gate.
+  JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+  # Phase B: a supervised fleet UNDER chaos. A 3-stage in-process
+  # loadgen ramp (clean -> seeded replica kills via --faults plumbing ->
+  # recovery) against 4 replicas with a running Supervisor: the soak
+  # must hold >= 97% availability during the kill stage, strand zero
+  # arrivals, keep the clean stages error-free, actually observe the
+  # injected kills / retries / respawns (a chaos run that injected
+  # nothing proves nothing), and end with the fleet healed inside the
+  # backoff budget. Then a supervised decode-loop kill must finish its
+  # streams after resurrection, and a degraded flip must roll dispatch
+  # back to the last known good version.
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+import numpy as onp
+from tools import loadgen
+from incubator_mxnet_tpu.serving import ModelRegistry, Supervisor
+from incubator_mxnet_tpu.serving import batcher as batcher_mod
+from incubator_mxnet_tpu.telemetry import faultlab, flightrec
+
+
+class SlowEcho:
+    def predict_batch(self, x):
+        time.sleep(0.005)
+        return (x,)
+
+
+def wait_for(pred, timeout, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return bool(pred())
+
+
+reg = ModelRegistry()
+reg.load("chaos", SlowEcho(), max_batch_size=8, batch_timeout_ms=2.0,
+         queue_size=32, replicas=4, prewarm=False)
+# crash_n high on purpose: this soak measures healing, not the park
+# breaker (the breaker has its own unit coverage)
+sup = Supervisor(reg, poll_s=0.02, backoff_base_s=0.05,
+                 backoff_cap_s=0.2, crash_n=99,
+                 crash_window_s=30.0).start()
+tr = loadgen.InProcessTransport(reg, "chaos", [0.0, 0.0, 0.0, 0.0],
+                                timeout_s=10.0)
+stages = [{"rps": 150, "duration_s": 2.0},
+          {"rps": 150, "duration_s": 3.0},
+          {"rps": 150, "duration_s": 2.0}]
+lg = loadgen.LoadGen(
+    tr, stages=stages, arrival="poisson", seed=0, max_clients=256,
+    # every 40th dispatched batch kills its replica worker during stage
+    # 1 only — deterministic (stride, not p), so the soak ALWAYS injects
+    faults={1: "batcher.dispatch:replica_kill:stride=40", 2: ""})
+report = lg.run()
+b = reg._entry("chaos").batcher
+
+for i, s in enumerate(report["stages"]):
+    print("stage %d (faults=%s): offered %d ok %d shed %d errors %d"
+          % (i, s.get("fault_spec"), s["offered"], s["ok"], s["shed"],
+             s["errors"]))
+    # zero stranded arrivals: every arrival got a terminal status
+    assert s["client_dropped"] == 0, s
+    assert s["completed"] == s["offered"], s
+s0, s1, s2 = report["stages"]
+assert s0["errors"] == 0, s0         # clean before...
+assert s2["errors"] == 0, s2         # ...and clean after recovery
+avail = s1["ok"] / float(s1["offered"])
+assert avail >= 0.97, "availability %.4f under replica kills" % avail
+# the chaos actually bit: kills fired, retries happened, workers reborn
+kills = faultlab._FIRED.value(site="batcher.dispatch",
+                              kind="replica_kill")
+assert kills >= 2, "only %d injected kills — soak too gentle" % kills
+assert batcher_mod._RETRIES.value(model="chaos") >= 1
+respawns = [e for e in flightrec.snapshot()
+            if e["event"] == "replica_respawned"
+            and e.get("model") == "chaos"]
+assert respawns, "supervisor never respawned a worker"
+# healed within the backoff budget (cap 0.2s + poll 0.02s << 5s)
+assert wait_for(lambda: not b.dead_replicas(), 5.0), b.dead_replicas()
+assert len(b.replica_dispatch_counts()) == 4
+print("chaos soak OK: availability %.4f under %d kills, %d respawns, "
+      "fleet healed" % (avail, kills, len(respawns)))
+
+# -- supervised decode-loop kill: streams FINISH after resurrection
+eng = reg.load_generator("chaos-gen", seed=0, block_size=8, num_blocks=48,
+                         max_batch=4, prefill_len=16, max_tokens=16)
+assert wait_for(lambda: getattr(eng, "_supervised", False), 5.0), \
+    "supervisor never adopted the engine"
+faultlab.arm("generate.step:replica_kill:stride=5:budget=1")
+streams = [eng.submit([3, 1, 4], max_new_tokens=10, seed=7),
+           eng.submit([2, 7, 1], max_new_tokens=10, seed=9)]
+for st in streams:
+    toks, reason = st.tokens(timeout=60.0)
+    assert reason in ("max_tokens", "eos"), reason
+    assert toks, "stream finished empty"
+res = [e for e in flightrec.snapshot()
+       if e["event"] == "genloop_resurrected"
+       and e.get("model") == "chaos-gen"]
+assert res, "decode loop was never killed+resurrected"
+faultlab.disarm()
+print("genloop chaos OK: %d streams finished across a resurrection"
+      % len(streams))
+
+# -- degraded flip rolls dispatch back to the last known good version
+class Biased:
+    def __init__(self, bias):
+        self.bias = bias
+    def predict_batch(self, x):
+        return (x + self.bias,)
+
+reg.load("chaos-roll", Biased(1.0), max_batch_size=4,
+         batch_timeout_ms=1.0, queue_size=8, prewarm=False)
+reg.load("chaos-roll", Biased(100.0), prewarm=False)
+entry = reg._entry("chaos-roll")
+entry.set_degraded("chaos-stage divergence breach")
+d = entry.describe()
+assert d["current_version"] == 1 and d["degraded"] is None, d
+assert d["rolled_back"]["from_version"] == 2, d
+out = reg.predict("chaos-roll", onp.float32([1.0]), timeout=10.0)
+assert float(out[0][0]) == 2.0, out
+print("rollback OK: degraded v2 -> serving v1, provenance %r"
+      % (d["rolled_back"],))
+
+sup.stop()
+reg.close()
+EOF
+  # Phase C: the unsupervised canary — the SAME kill with no Supervisor
+  # must fail the healed-within-budget check. If this inner script
+  # passes, the phase-B healing assertion proves nothing; fail the stage.
+  if JAX_PLATFORMS=cpu python - <<'EOF'
+import sys, time
+import numpy as onp
+from incubator_mxnet_tpu.serving import ModelRegistry
+from incubator_mxnet_tpu.telemetry import faultlab
+
+
+class Echo:
+    def predict_batch(self, x):
+        return (x,)
+
+
+reg = ModelRegistry()
+reg.load("nosup", Echo(), max_batch_size=4, batch_timeout_ms=1.0,
+         queue_size=8, replicas=2, prewarm=False)
+b = reg._entry("nosup").batcher
+faultlab.arm("batcher.dispatch:replica_kill:stride=1:budget=1")
+try:
+    reg.predict("nosup", onp.float32([1.0]), timeout=10.0)
+except Exception:
+    pass
+deadline = time.monotonic() + 2.0
+while time.monotonic() < deadline and not b.dead_replicas():
+    time.sleep(0.02)
+if not b.dead_replicas():
+    sys.exit(1)        # worker never even died: not a valid canary run
+deadline = time.monotonic() + 2.0
+while time.monotonic() < deadline:
+    if not b.dead_replicas():
+        break          # "healed" with no supervisor: impossible
+    time.sleep(0.05)
+healed = not b.dead_replicas()
+faultlab.disarm()
+reg.close()
+sys.exit(0 if healed else 1)
+EOF
+  then
+    echo "chaos canary FAILED: a dead replica 'healed' with no supervisor"
+    exit 1
+  fi
+  echo "chaos canary OK: without a supervisor the fleet stays dead"
+  ch_dt=$(( SECONDS - ch_t0 ))
+  echo "chaos stage wall time: ${ch_dt}s (budget 120s)"
+  [ "$ch_dt" -lt 120 ] || { echo "chaos stage took ${ch_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage diagnostics; then

@@ -66,7 +66,7 @@ from collections import namedtuple
 
 from . import config
 from . import telemetry
-from .telemetry import devstats, spans
+from .telemetry import devstats, faultlab, spans
 
 __all__ = ["CacheKey", "cache_key", "AOTCache", "CACHE", "compile_cached",
            "model_id_for", "input_signature", "mesh_sig", "artifact_path",
@@ -586,6 +586,15 @@ def _load_artifact(key, arg_specs):
     if path is None or not os.path.exists(path):
         return None
     try:
+        # faultlab site "aot.artifact_read": artifact_corrupt injects an
+        # unreadable artifact (identical to the real corrupt path — the
+        # caller rebuilds with re-analysis); exception-kind lands in the
+        # except-all below, exercising the same fallback
+        if faultlab.armed and faultlab.fire(
+                "aot.artifact_read", kind=key.kind,
+                model_id=key.model_id) == "artifact_corrupt":
+            _LOG.debug("aot artifact read for %s: injected corrupt", path)
+            return None
         import jax
         import jax.export  # jax>=0.4.30 does not re-export lazily
         with open(path, "rb") as f:

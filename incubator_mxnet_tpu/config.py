@@ -199,6 +199,54 @@ ENV_VARS = {
         int, 8080,
         "Default port for serving.ServingServer's HTTP front-end "
         "(serving/server.py); 0 picks an ephemeral port (tests)."),
+    "MXTPU_FAULTLAB": (
+        str, None,
+        "Faultlab arming spec applied at import (telemetry/faultlab.py): "
+        "';'-separated 'site:kind[:key=value...]' entries, kind in "
+        "{exception, replica_kill, slow_ms, kv_oom, nan_poison, "
+        "artifact_corrupt}, keys stride=/p=/seed=/budget=/ms=. Unset = "
+        "disarmed (hot-path fault points are near-zero-cost no-ops). "
+        "Runtime arming via POST /debug/faults (docs/RESILIENCE.md)."),
+    "MXTPU_RESILIENCE_RETRY": (
+        bool, True,
+        "Single bounded retry of idempotent predict requests that failed "
+        "because their replica worker died (serving/resilience.py): the "
+        "request re-enters the router once, still under its original "
+        "deadline; a second death fails it. Counted on "
+        "mxtpu_retries_total{model}. Off = replica death fails the batch "
+        "immediately."),
+    "MXTPU_RESILIENCE_ROLLBACK": (
+        bool, True,
+        "Last-known-good rollback (serving/registry.py): when a live "
+        "version flips to degraded (shadow breach, numerics storm, "
+        "hlolint refusal) and a previous healthy version is still "
+        "resident, repoint to it instead of serving degraded — flightrec "
+        "'rolled_back_to' + sticky describe() provenance. Off = degraded "
+        "is sticky until a human reloads (pre-resilience behavior)."),
+    "MXTPU_RESILIENCE_BACKOFF_BASE_S": (
+        float, 0.1,
+        "Supervisor respawn backoff base in seconds "
+        "(serving/resilience.py): the Nth consecutive death of a replica "
+        "waits base * 2^(N-1) (+ seeded jitter) before respawn, capped at "
+        "MXTPU_RESILIENCE_BACKOFF_CAP_S."),
+    "MXTPU_RESILIENCE_BACKOFF_CAP_S": (
+        float, 5.0,
+        "Upper bound on the supervisor's exponential respawn backoff."),
+    "MXTPU_RESILIENCE_CRASH_N": (
+        int, 5,
+        "Crash-loop circuit breaker: a replica that dies this many times "
+        "within MXTPU_RESILIENCE_CRASH_WINDOW_S is PARKED (no further "
+        "respawns, flightrec 'replica_parked', /healthz degraded) instead "
+        "of being respawned into the same crash."),
+    "MXTPU_RESILIENCE_CRASH_WINDOW_S": (
+        float, 30.0,
+        "Sliding window in seconds for the crash-loop circuit breaker's "
+        "death count (MXTPU_RESILIENCE_CRASH_N)."),
+    "MXTPU_RESILIENCE_POLL_S": (
+        float, 0.05,
+        "Supervisor poll interval in seconds (serving/resilience.py): how "
+        "often dead replicas / dead decode loops are scanned for. The "
+        "floor on detection latency; respawn timing adds the backoff."),
     "MXTPU_TELEMETRY_FLUSH_S": (
         float, 0.0,
         "Periodic telemetry flush interval in seconds (telemetry package): "

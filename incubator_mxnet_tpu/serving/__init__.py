@@ -18,6 +18,12 @@ Layers (each importable alone):
   mesh (weights follow parallel.tensor_parallel annotations via
   jax.sharding.NamedSharding), composable with replica groups
   (docs/SERVING.md "Sharded serving").
+- ``resilience`` — Supervisor: self-healing reflexes — dead replica
+  workers respawned under exponential backoff + jitter, crash-looping
+  ones parked by a circuit breaker, dead decode loops resurrected with
+  their in-flight sequences preserved (docs/RESILIENCE.md; pairs with
+  the bounded predict retry in ``batcher`` and last-known-good version
+  rollback in ``registry``).
 - ``metrics``  — ServingMetrics: counters, batch-size histogram,
   p50/p95/p99 latency from a ring buffer; every update is mirrored onto
   the process-wide telemetry registry (docs/OBSERVABILITY.md).
@@ -43,17 +49,19 @@ one registry — put a load balancer in front for fleet serving.
 from __future__ import annotations
 
 from .batcher import (DynamicBatcher, QueueFullError, DeadlineExceededError,
-                      ServingClosedError, default_buckets)
+                      NoReplicasError, ServingClosedError, default_buckets)
 from .metrics import ServingMetrics, percentile
 from .registry import ModelRegistry, BlockServable, ModelNotFoundError
+from .resilience import Supervisor
 from .server import ServingServer, serve
 from .sharded import MeshServable, serving_mesh
 
 __all__ = [
     "DynamicBatcher", "QueueFullError", "DeadlineExceededError",
-    "ServingClosedError", "default_buckets",
+    "NoReplicasError", "ServingClosedError", "default_buckets",
     "ServingMetrics", "percentile",
     "ModelRegistry", "BlockServable", "ModelNotFoundError",
+    "Supervisor",
     "ServingServer", "serve",
     "MeshServable", "serving_mesh",
 ]

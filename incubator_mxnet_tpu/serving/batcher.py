@@ -52,13 +52,22 @@ import queue as _queue
 import numpy as onp
 
 from .. import config
-from ..telemetry import devstats, flightrec, numwatch, spans, watchdog
+from ..telemetry import (devstats, faultlab, flightrec, numwatch, spans,
+                         watchdog)
+from ..telemetry.registry import counter as _counter
 from .metrics import ServingMetrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
-           "ServingClosedError", "default_buckets"]
+           "ServingClosedError", "NoReplicasError", "default_buckets"]
 
 _LOG = logging.getLogger(__name__)
+
+#: Idempotent predict requests re-routed after their replica worker died
+#: (serving/resilience.py retry contract; docs/RESILIENCE.md).
+_RETRIES = _counter(
+    "mxtpu_retries_total",
+    "Predict requests retried once after a replica worker death, by model.",
+    ("model",))
 
 
 class QueueFullError(RuntimeError):
@@ -72,6 +81,14 @@ class DeadlineExceededError(TimeoutError):
 
 class ServingClosedError(RuntimeError):
     """Submit after close(): the batcher is shutting down."""
+
+
+class NoReplicasError(ServingClosedError):
+    """Every replica worker is dead (or parked by the crash-loop
+    breaker): nobody will ever service a submit. HTTP maps this to 503
+    with shed_reason ``no_replicas`` and NO Retry-After — unlike 429
+    queue_full there is no queue that drains; capacity returns only when
+    the supervisor revives a worker (docs/RESILIENCE.md)."""
 
 
 def default_buckets(max_batch_size):
@@ -101,8 +118,8 @@ class _Request:
     """One queued inference item + the completion event its client waits on."""
 
     __slots__ = ("inputs", "deadline", "enqueued_at", "request_id",
-                 "span_ctx", "tenant", "dispatch", "_event", "_result",
-                 "_error")
+                 "span_ctx", "tenant", "dispatch", "retried", "_event",
+                 "_result", "_error")
 
     def __init__(self, inputs, deadline, request_id=None, span_ctx=None,
                  tenant=None):
@@ -119,6 +136,10 @@ class _Request:
         # access-log record's batch-stage legs are assembled from; None
         # for requests that never reached a dispatch (shed, expired)
         self.dispatch = None
+        # True once the request has been re-routed after a replica-death
+        # failure: the retry contract is ONE bounded attempt, so a second
+        # death fails it for good (serving/resilience.py)
+        self.retried = False
         self.enqueued_at = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -224,6 +245,9 @@ class DynamicBatcher:
             self.metrics.bind_bucket_depth(b, self._bucket_depth_reader(b))
         self._closed = False
         self._paused = False
+        # one bounded retry for requests orphaned by a dying worker
+        # (docs/RESILIENCE.md "Retry idempotency contract")
+        self._retry_on_death = bool(config.get_env("MXTPU_RESILIENCE_RETRY"))
         # per-item (shape, dtype) signature of the most recently dispatched
         # request — what a hot-reload prewarm synthesizes warm batches
         # from (registry.load); written by workers, read by warm/load
@@ -282,8 +306,10 @@ class DynamicBatcher:
                        span_ctx=spans.current_context(), tenant=tenant)
         order = self._route()
         if not order:
-            # every replica worker died: nobody will ever service this
-            raise ServingClosedError(
+            # every replica worker died: nobody will ever service this —
+            # a 503 (no_replicas), NOT a 429: there is no queue that
+            # drains, so advertising retryability would be a lie
+            raise NoReplicasError(
                 "batcher %r has no live replica workers" % self.name)
         routed = None
         for r in order:
@@ -404,6 +430,38 @@ class DynamicBatcher:
     def dead_replicas(self):
         with self._route_lock:
             return sorted(self._dead)
+
+    def respawn_replica(self, replica):
+        """Bring one dead replica worker back: a fresh thread on the
+        SAME queue, a fresh watchdog channel, the depth gauge re-bound,
+        and the replica removed from the router's dead set — the
+        supervisor's repair verb (serving/resilience.py). Returns False
+        (no-op) when the batcher is closed or the replica is not dead."""
+        if self._closed:
+            return False
+        with self._route_lock:
+            if replica not in self._dead:
+                return False
+            self._dead.discard(replica)
+        # fresh heartbeat channel: the dying worker unregistered its old
+        # one, and the watchdog must see the reborn worker's beats under
+        # the same name
+        self._hb_channels[replica] = watchdog.register(
+            "batcher:%s" % self.name if self.replicas == 1
+            else "batcher:%s:r%d" % (self.name, replica))
+        try:
+            self.metrics.bind_replica_depth(
+                replica, self._replica_depth_fns[replica])
+        except Exception:
+            _LOG.debug("replica depth gauge rebind failed", exc_info=True)
+        w = threading.Thread(target=self._run, args=(replica,), daemon=True,
+                             name="mxtpu-batcher-%s-r%d"
+                             % (self.name, replica))
+        self._workers[replica] = w
+        w.start()
+        flightrec.record("replica_respawned", model=self.name,
+                         replica=replica)
+        return True
 
     @property
     def last_item_sig(self):
@@ -531,6 +589,63 @@ class DynamicBatcher:
             if died and not self._closed:
                 self._drain_dead_replica(replica)
 
+    def _fail_or_retry_on_death(self, req, replica, err):
+        """Completion path for a request held by a dying worker: one
+        bounded retry for idempotent predicts (MXTPU_RESILIENCE_RETRY),
+        else fail now.
+
+        The retry re-enters the DYING replica's own queue: the death
+        path's _reroute_queue sweep (the existing drain-back machinery)
+        then carries it to a survivor — or fails it loudly as
+        NoReplicasError when none remain. The request keeps its original
+        deadline, so a retry can never outlive the client's budget, and
+        the ``retried`` flag bounds it to one attempt (a second death
+        fails it for good).
+
+        Deaths that originated INSIDE the servable call are never
+        retried: the request's own content is the prime suspect (a query
+        of death), and re-dispatching it serially kills the survivors —
+        the drains-back contract (test_serving_sharded) is that one
+        poison request costs one replica while every innocent request
+        completes. Only exogenous deaths (the worker killed around the
+        dispatch: injection, runtime faults in the batcher's own
+        machinery) are safe to re-route."""
+        if (self._retry_on_death and not req.retried and not self._closed
+                and not getattr(err, "_mxtpu_died_in_servable", False)
+                and not (req.deadline is not None
+                         and time.monotonic() >= req.deadline)):
+            req.retried = True
+            try:
+                self._queues[replica].put_nowait(req)
+            except _queue.Full:
+                # a dying replica with a FULL queue: nothing to absorb
+                # the retry into without displacing someone — fail below
+                _LOG.debug("retry of request %r dropped: queue full",
+                           req.request_id)
+            else:
+                try:
+                    _RETRIES.inc(model=self.name)
+                except Exception:
+                    _LOG.debug("retry counter update failed", exc_info=True)
+                flightrec.record("request_retried", model=self.name,
+                                 replica=replica,
+                                 request_id=req.request_id)
+                return
+        if getattr(err, "_mxtpu_died_in_servable", False):
+            # query of death: the sender gets the servable's own defect,
+            # raw — the pre-resilience drains-back contract (the HTTP
+            # front-end maps a raw worker-killing BaseException to 503)
+            req.fail(err)
+            return
+        # an exogenous BaseException (injected WorkerKilled, MemoryError
+        # in the batcher's own machinery) must not ride a _Request into
+        # an arbitrary client thread / the HTTP handler's `except
+        # Exception` ladder — surface worker death as the
+        # servable-unavailable error it is
+        req.fail(err if isinstance(err, Exception) else ServingClosedError(
+            "model %r replica %d worker died mid-dispatch (%s)"
+            % (self.name, replica, err)))
+
     def _drain_dead_replica(self, replica):
         """Death path: mark the replica dead so the router skips it,
         detach its depth gauge (a dead replica must not export a frozen
@@ -566,7 +681,7 @@ class DynamicBatcher:
                 # no live replica (or all full): fail loudly NOW — a
                 # request must never sit in a dead replica's queue until
                 # its deadline expires it
-                req.fail(ServingClosedError(
+                req.fail(NoReplicasError(
                     "model %r replica %d worker died and no live replica "
                     "could absorb its queue" % (self.name, replica)))
 
@@ -586,10 +701,11 @@ class DynamicBatcher:
                 # a worker-killing defect (BaseException escaping the
                 # per-batch Exception guards) must still answer the batch
                 # it was holding — clients of a dying replica get the
-                # error now, not a timeout at their deadline
+                # error now (or one bounded retry), not a timeout at
+                # their deadline
                 for req in batch:
                     if not req._event.is_set():
-                        req.fail(e)
+                        self._fail_or_retry_on_death(req, replica, e)
                 raise
             finally:
                 with self._route_lock:
@@ -629,6 +745,22 @@ class DynamicBatcher:
         this replica, and deliver results (or one shared error) to every
         waiter — the per-replica dispatch hot path (mxtpulint
         HOT_PATH_PATTERNS covers it)."""
+        if faultlab.armed:
+            # faultlab site "batcher.dispatch": an injected FaultInjected
+            # fails just this group (real-servable-raise semantics, the
+            # worker survives); WorkerKilled and anything else propagate
+            # into _run_loop's worker-death path
+            try:
+                faultlab.fire("batcher.dispatch", model=self.name,
+                              replica=replica)
+            except faultlab.FaultInjected as e:
+                try:
+                    self.metrics.inc("error_count", len(live))
+                except Exception:
+                    _LOG.debug("error_count update failed", exc_info=True)
+                for req in live:
+                    req.fail(e)
+                return
         n = len(live)
         bucket = self._bucket_for(n)
         t0 = time.monotonic()
@@ -732,7 +864,18 @@ class DynamicBatcher:
             # timer brackets the servable call ONLY: host-side pad/stack
             # time belongs to the batch leg, not the device_ms fact
             tc0 = time.monotonic()
-            outs = self._call_servable(stacked, replica, request_ids)
+            try:
+                outs = self._call_servable(stacked, replica, request_ids)
+            except BaseException as e:
+                if not isinstance(e, Exception):
+                    # a worker-killing BaseException escaping the servable
+                    # ITSELF is request-correlated until proven otherwise
+                    # (a query of death): mark it so the death path fails
+                    # this batch instead of retrying the killer onto a
+                    # survivor — one poison request must cost one replica,
+                    # not the fleet
+                    e._mxtpu_died_in_servable = True
+                raise
             call_s = time.monotonic() - tc0
         except Exception as e:  # noqa: BLE001 — forwarded to every waiter
             try:

@@ -70,7 +70,7 @@ from .. import aot, config
 from .. import jit as jit_mod
 from .. import telemetry
 from ..ops import kvcache
-from ..telemetry import flightrec, numwatch, spans, watchdog
+from ..telemetry import faultlab, flightrec, numwatch, spans, watchdog
 from ..telemetry import slo as slo_mod
 from . import accesslog
 from .batcher import DynamicBatcher, QueueFullError, ServingClosedError, \
@@ -85,7 +85,7 @@ _LOG = logging.getLogger(__name__)
 EOS_TOKEN = 0
 
 _FINISH_REASONS = ("eos", "max_tokens", "disconnect", "kv_oom", "error",
-                   "numeric_error")
+                   "numeric_error", "engine_restart")
 
 _TOKENS = telemetry.counter(
     "mxtpu_gen_tokens_total",
@@ -412,6 +412,15 @@ class GenerativeEngine:
         self._pending_cap = max(16, 4 * self.max_batch)
         self._wake = threading.Condition(self._pend_lock)
         self._closed = False
+        # resilience state (serving/resilience.py): _supervised flips the
+        # decode loop's death path from retire-everything to
+        # preserve-for-resurrect; _pool_hazard is True exactly while the
+        # pool is donated to a compiled call — a loop that dies inside
+        # that window lost every active row's KV (resurrect() retires
+        # them as "engine_restart"), a loop that dies outside it left
+        # survivors bit-exactly resumable
+        self._supervised = False
+        self._pool_hazard = False
         self._inflight_fn = lambda: self._inflight_count()
         self._kv_used_fn = lambda: self._alloc.used
         self._kv_total_fn = lambda: self._alloc.total
@@ -747,11 +756,21 @@ class GenerativeEngine:
             table = onp.full(self.max_blocks, self.num_blocks, onp.int32)
             table[:len(blocks)] = blocks
             seq.table = table
+            # adopt into _active BEFORE the donated join: a loop death
+            # inside the write window must find this sequence somewhere
+            # (resurrect()'s hazard path retires it as engine_restart) —
+            # popped-from-pending but not-yet-active would strand it
+            self._active.append(seq)
+            # reviewed cross-thread flag: resurrect() reads this only
+            # AFTER the decode thread is observed dead (is_alive()
+            # false), which is the happens-before edge; a GIL-atomic
+            # bool write needs no lock
+            self._pool_hazard = True  # mxtpulint: disable=R010
             self._pool = self._write_fn()(
                 self._pool, table, seq.k_all, seq.v_all,
                 onp.int32(seq.length))
+            self._pool_hazard = False  # mxtpulint: disable=R010
             seq.k_all = seq.v_all = None
-            self._active.append(seq)
             flightrec.record("gen_join", model=self.name,
                              request_id=seq.request_id, blocks=len(blocks),
                              batch=len(self._active))
@@ -773,6 +792,14 @@ class GenerativeEngine:
         return self.decode_buckets[-1]
 
     def _step(self):
+        if faultlab.armed:
+            # faultlab site "generate.step": fires BEFORE the donated
+            # decode call, so an injected loop death leaves the pool —
+            # and every survivor's KV — intact for a bit-exact
+            # resurrection (the _pool_hazard window below is the real
+            # donation hazard)
+            faultlab.fire("generate.step", model=self.name,
+                          batch=len(self._active))
         act = list(self._active)
         n = len(act)
         B = self._bucket_for(n)
@@ -799,9 +826,14 @@ class GenerativeEngine:
                         bucket=B,
                         request_ids=[s.request_id for s in act
                                      if s.request_id is not None]):
+            # reviewed cross-thread flag: resurrect() reads this only
+            # after the decode thread is observed dead — see _admit's
+            # twin bracket
+            self._pool_hazard = True  # mxtpulint: disable=R010
             self._pool, next_t, row_finite = fn(self._pool, tables, lengths,
                                                 last, seeds, ngen, temps,
                                                 topks, active)
+            self._pool_hazard = False  # mxtpulint: disable=R010
             # reviewed sync point: one host transfer for the whole step's
             # sampled tokens (plus the fused per-row logit-health bools),
             # inside the step span so the span measures true step
@@ -852,20 +884,83 @@ class GenerativeEngine:
         except BaseException as e:
             _LOG.error("gen decode loop for %r died", self.name,
                        exc_info=True)
-            for s in list(self._active):
-                try:
-                    self._retire(s, "error")
-                except Exception:
-                    _LOG.error("retiring %r after decode-loop death failed",
-                               s.request_id, exc_info=True)
-            with self._wake:
-                pend, self._pending = list(self._pending), deque()
-            for s in pend:
-                s.stream._end("error")
+            if self._supervised and not self._closed:
+                # a supervisor owns this corpse: PRESERVE _active and
+                # _pending for resurrect() — survivors continue
+                # bit-exactly from their KV state, and rows the donated
+                # pool took with it are retired there as
+                # "engine_restart". Never preserve without a supervisor:
+                # that would strand every stream forever.
+                flightrec.record("genloop_died", model=self.name,
+                                 active=len(self._active),
+                                 pending=len(self._pending),
+                                 pool_hazard=self._pool_hazard)
+            else:
+                for s in list(self._active):
+                    try:
+                        self._retire(s, "error")
+                    except Exception:
+                        _LOG.error(
+                            "retiring %r after decode-loop death failed",
+                            s.request_id, exc_info=True)
+                with self._wake:
+                    pend, self._pending = list(self._pending), deque()
+                for s in pend:
+                    s.stream._end("error")
             if not isinstance(e, Exception):
                 raise
         finally:
             watchdog.unregister(self._hb)
+
+    # ------------------------------------------------------------ resilience
+    def set_supervised(self, flag=True):
+        """Resilience-contract toggle (serving/resilience.py): with a
+        supervisor attached, a dying decode loop preserves its sequence
+        state for :meth:`resurrect` instead of ending every stream as
+        "error". Only a supervisor that guarantees a resurrection may
+        set this — preserved sequences are otherwise stranded."""
+        self._supervised = bool(flag)
+
+    def resurrect(self):
+        """Rebuild a dead decode loop (the supervisor's repair verb).
+
+        Sequences still in ``_active``/``_pending`` are re-adopted by the
+        fresh thread and continue bit-exactly from their KV state —
+        per-row numerics are batch-composition-independent, and a step
+        interrupted before its donated call re-derives the same
+        ``fold_in(key(seed), n_generated)`` tokens. Rows whose KV went
+        down with a mid-donation pool (``_pool_hazard``) are retired NOW
+        as ``finish_reason="engine_restart"`` — loudly, never silently
+        stranded — and the pool is rebuilt empty for the survivors in
+        ``_pending``. Returns False (no-op) when the engine is closed or
+        the loop is still alive."""
+        if self._closed or self._thread.is_alive():
+            return False
+        retired = 0
+        if self._pool_hazard:
+            for s in list(self._active):
+                try:
+                    self._retire(s, "engine_restart")
+                    retired += 1
+                except Exception:
+                    _LOG.error("engine_restart retirement of %r failed",
+                               s.request_id, exc_info=True)
+            m = self.model
+            self._pool = kvcache.make_pool(
+                self.num_blocks, self.block_size, m.LAYERS, m.HEADS,
+                m.HEAD_DIM)
+            self._pool_hazard = False
+        self._hb = watchdog.register("genloop:%s" % self.name)
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        daemon=True,
+                                        name="mxtpu-gen-%s" % self.name)
+        self._thread.start()
+        with self._wake:
+            self._wake.notify_all()
+        flightrec.record("genloop_resurrected", model=self.name,
+                         survivors=len(self._active),
+                         pending=len(self._pending), retired=retired)
+        return True
 
     # ------------------------------------------------- sequential reference
     def generate_sequential(self, prompt, max_new_tokens=None,

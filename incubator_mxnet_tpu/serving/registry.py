@@ -35,8 +35,8 @@ import threading
 import time
 
 from .. import config
-from ..telemetry import spans
-from .batcher import DynamicBatcher, _accepts_replica
+from ..telemetry import faultlab, flightrec, spans
+from .batcher import DynamicBatcher, ServingClosedError, _accepts_replica
 from .metrics import ServingMetrics
 
 __all__ = ["ModelRegistry", "BlockServable", "ModelNotFoundError"]
@@ -100,6 +100,11 @@ class _ModelEntry:
         self._warming = 0               # active prewarm threads (describe)
         self._warm_target = None        # only THIS version may repoint()
         self._degraded = None           # hlolint refusal reason (describe)
+        # last-known-good rollback state (docs/RESILIENCE.md): versions a
+        # degraded flip quarantined (they may never auto-return to
+        # dispatch) + sticky provenance of the latest rollback
+        self._quarantined = set()
+        self.rollback_info = None
         self.batcher = DynamicBatcher(self._dispatch, name=name,
                                       metrics=self.metrics, **batcher_kw)
 
@@ -161,6 +166,9 @@ class _ModelEntry:
             self.versions[version] = servable
             self._replica_aware[version] = \
                 _accepts_replica(servable.predict_batch)
+            # a fresh servable under a reused number is a new deploy —
+            # its predecessor's quarantine must not shadow it
+            self._quarantined.discard(version)
             self.current_version = version
             # a direct install supersedes any in-flight warm: its stale
             # repoint()s must not drag dispatch back to an older version
@@ -185,6 +193,7 @@ class _ModelEntry:
             self.versions[version] = servable
             self._replica_aware[version] = \
                 _accepts_replica(servable.predict_batch)
+            self._quarantined.discard(version)
             self._warm_target = version
             self._degraded = None
             if self.current_version is None:
@@ -194,17 +203,63 @@ class _ModelEntry:
     def set_degraded(self, reason):
         """Flip this model's health/describe() to degraded with ``reason``
         — the numerics sentinel's shadow-breach callback lands here (the
-        hlolint refusal shape). Sticky until the next install/add_version:
-        a divergence breach is an operator decision, not a flap."""
+        hlolint refusal shape).
+
+        Last-known-good rollback (MXTPU_RESILIENCE_ROLLBACK, default on):
+        when a PRIOR healthy version is still resident, dispatch is
+        repointed to it instead of serving degraded — the bad version is
+        quarantined (it can never auto-return via a late repoint()), the
+        rollback lands on flightrec ``rolled_back_to`` and as sticky
+        ``describe()`` provenance, and the degraded flag clears because
+        traffic is on a healthy version again. With no prior version (or
+        rollback off) the flag is sticky until the next
+        install/add_version: a divergence breach is an operator decision,
+        not a flap."""
+        reason = str(reason)
+        rolled = None
         with self._lock:
-            self._degraded = str(reason)
+            self._degraded = reason
+            bad = self.current_version
+            if bad is not None and self._rollback_enabled():
+                prior = [v for v in self.versions
+                         if v < bad and v not in self._quarantined]
+                if prior:
+                    to = max(prior)
+                    self._quarantined.add(bad)
+                    self.current_version = to
+                    self.rollback_info = {"from_version": bad,
+                                          "to_version": to,
+                                          "reason": reason}
+                    # traffic is back on a known-good version: the model
+                    # is serving healthy again (provenance stays sticky)
+                    self._degraded = None
+                    rolled = (bad, to)
+        if rolled is not None:
+            bad, to = rolled
+            _LOG.warning(
+                "model %r v%s flipped degraded (%s) — ROLLED BACK to "
+                "last known good v%s (v%s quarantined)",
+                self.name, bad, reason, to, bad)
+            flightrec.record("rolled_back_to", model=self.name,
+                             from_version=bad, to_version=to,
+                             reason=reason)
+
+    @staticmethod
+    def _rollback_enabled():
+        try:
+            return bool(config.get_env("MXTPU_RESILIENCE_ROLLBACK"))
+        except Exception:
+            return True
 
     def repoint(self, version):
         """Cut dispatch over to ``version`` — only honored while it is
         still the newest warm target (idempotent; no-op once a newer
-        load()/install() superseded it, or the version was dropped)."""
+        load()/install() superseded it, the version was dropped, or a
+        degraded flip quarantined it: a warm thread finishing after a
+        rollback must not drag dispatch back to the bad version)."""
         with self._lock:
-            if version in self.versions and version == self._warm_target:
+            if (version in self.versions and version == self._warm_target
+                    and version not in self._quarantined):
                 self.current_version = version
 
     def warm(self, servable, version, item_sig):
@@ -236,6 +291,12 @@ class _ModelEntry:
             for b in sorted(set(self.batcher.buckets)):
                 fresh = []
                 try:
+                    # faultlab site "registry.load" (warm stage): an
+                    # injected exception exercises the partial-warm
+                    # fallback below — still swaps, compiles lazily
+                    if faultlab.armed:
+                        faultlab.fire("registry.load", model=self.name,
+                                      stage="warm", bucket=b)
                     synth = [onp.zeros((b,) + tuple(shape),
                                        dtype=onp.dtype(dt))
                              for shape, dt in item_sig]
@@ -344,10 +405,20 @@ class _ModelEntry:
             self.versions.pop(version, None)
             self._replica_aware.pop(version, None)
             self._inflight.pop(version, None)
+            self._quarantined.discard(version)
             self._degraded = "load refused by hlolint: %s" % reason
             if was_current:
                 self.current_version = (max(self.versions)
                                         if self.versions else None)
+                if self.current_version is not None:
+                    # the refusal's built-in last-known-good repoint: the
+                    # same sticky provenance the degraded-flip rollback
+                    # records (the degraded reason stays — the refused
+                    # DEPLOY still needs the operator)
+                    self.rollback_info = {
+                        "from_version": version,
+                        "to_version": self.current_version,
+                        "reason": "load refused by hlolint: %s" % reason}
         _LOG.error(
             "model %r v%s REFUSED by hlolint (%d error finding(s)) — %s: "
             "%s",
@@ -358,10 +429,15 @@ class _ModelEntry:
             if was_current else "dispatch was NOT cut over",
             reason)
         try:
-            from ..telemetry import flightrec
             flightrec.record("hlolint_refused", model=self.name,
                              version=version, reason=reason,
                              rolled_back=was_current)
+            if was_current and self.rollback_info is not None \
+                    and self.rollback_info["from_version"] == version:
+                flightrec.record("rolled_back_to", model=self.name,
+                                 from_version=version,
+                                 to_version=self.rollback_info["to_version"],
+                                 reason="hlolint refusal")
         except Exception:
             _LOG.debug("hlolint_refused flightrec record dropped",
                        exc_info=True)
@@ -420,6 +496,9 @@ class _ModelEntry:
             self.versions.pop(version, None)
             self._inflight.pop(version, None)
             self._replica_aware.pop(version, None)
+            # install()'s max()+1 can reuse a dropped number: a stale
+            # quarantine entry must not poison the future deploy
+            self._quarantined.discard(version)
             if version == self.current_version:
                 self.current_version = (max(self.versions)
                                         if self.versions else None)
@@ -437,6 +516,7 @@ class _ModelEntry:
                     "slos": slos,
                     "warming": self._warming > 0,
                     "degraded": self._degraded,
+                    "rolled_back": self.rollback_info,
                     "queue_depth": self.batcher.queue_depth(),
                     "queue_size": self.batcher.queue_size,
                     "replicas": self.batcher.replicas,
@@ -496,6 +576,11 @@ class ModelRegistry:
         no warm_spec) or prewarm=False, dispatch repoints immediately and
         buckets compile lazily on first dispatch.
         """
+        # faultlab site "registry.load" (load stage): an injected
+        # exception fails this load() loudly at the caller, before any
+        # entry state changes
+        if faultlab.armed:
+            faultlab.fire("registry.load", model=name, stage="load")
         servable = _as_servable(servable)
         # install/add_version happens INSIDE the registry lock: paired
         # with unload()'s locked entry-removal check this makes
@@ -631,7 +716,10 @@ class ModelRegistry:
 
     def generator(self, name):
         """The live engine for ``name`` — ModelNotFoundError (-> 404)
-        when absent or already closed."""
+        when absent or already closed; ServingClosedError (-> 503, NOT
+        429) while the decode loop is DEAD and awaiting the supervisor:
+        the model exists but cannot serve, and advertising queue-full
+        retryability would be a lie."""
         with self._lock:
             engine = self._generators.get(name)
             names = sorted(n for n, e in self._generators.items()
@@ -640,12 +728,33 @@ class ModelRegistry:
             raise ModelNotFoundError("no generator %r loaded (have: %s)"
                                      % (name, names or sorted(
                                          self._generators)))
+        if not engine.alive:
+            raise ServingClosedError(
+                "generator %r decode loop is dead (awaiting supervisor "
+                "revival)" % name)
         return engine
 
     def generators(self):
+        """Describe every generator EXCEPT one whose decode loop died
+        (not alive, not closed): GET /v1/models must not advertise a
+        model that cannot serve — it relists the moment the supervisor
+        resurrects the loop."""
         with self._lock:
             engines = list(self._generators.values())
-        return [e.describe() for e in engines]
+        return [e.describe() for e in engines if e.alive or e.closed]
+
+    # ------------------------------------------------------------ resilience
+    def batchers(self):
+        """{name -> DynamicBatcher} snapshot — the supervisor's replica
+        scan surface (serving/resilience.py)."""
+        with self._lock:
+            return {n: e.batcher for n, e in self._entries.items()}
+
+    def engines(self):
+        """{name -> GenerativeEngine} snapshot — the supervisor's decode
+        loop scan surface (serving/resilience.py)."""
+        with self._lock:
+            return dict(self._generators)
 
     # ------------------------------------------------------------ inference
     def _entry(self, name):

@@ -79,6 +79,11 @@ Routes (TF-Serving REST-shaped):
   (finite fraction / abs-max / rms, storm episodes) and per-model
   shadow divergence (telemetry/numwatch.py; docs/OBSERVABILITY.md
   "Numerical health").
+- ``GET /debug/faults``     — the fault-injection registry's arming
+  state (telemetry/faultlab.py; docs/RESILIENCE.md). ``POST
+  /debug/faults`` with ``{"spec": "<site:kind:key=val;...>"}`` arms it
+  at runtime (chaos drills mid-soak, no restart); an empty/absent spec
+  disarms. Malformed specs are 400 and leave the prior arming intact.
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
@@ -98,6 +103,11 @@ Error contract (the robustness story made visible):
   "queue_full"`` (explicit backpressure; shed load upstream)
 - deadline exceeded -> 504 + ``shed_reason: "deadline"``
 - unknown model     -> 404
+- all replicas dead -> 503 + ``shed_reason: "no_replicas"`` and NO
+  ``Retry-After`` — an outage is not backpressure; no pacing hint is
+  honest until the supervisor restores a worker (docs/RESILIENCE.md)
+- decode loop dead  -> 503 on ``POST /generate`` (and the generator is
+  delisted from ``GET /v1/models`` until resurrected)
 - shutting down     -> 503
 - malformed body    -> 400
 - servable raised   -> 500
@@ -113,8 +123,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .. import config
 from .. import telemetry
 from . import accesslog
-from .batcher import (DeadlineExceededError, QueueFullError,
-                      ServingClosedError)
+from .batcher import (DeadlineExceededError, NoReplicasError,
+                      QueueFullError, ServingClosedError)
 from .metrics import (http_request_finished, http_request_started,
                       request_accounted)
 from .registry import ModelNotFoundError, ModelRegistry
@@ -222,6 +232,11 @@ class _Handler(BaseHTTPRequestHandler):
             # and per-model shadow divergence (telemetry/numwatch.py)
             from ..telemetry import numwatch
             self._send(200, numwatch.describe())
+        elif self.path == "/debug/faults":
+            # the faultlab arming state: armed flag + per-fault
+            # stride/p/budget/fired counters (telemetry/faultlab.py)
+            from ..telemetry import faultlab
+            self._send(200, faultlab.describe())
         elif self.path.split("?", 1)[0] == "/debug/profile":
             self._do_profile()
         elif self.path.split("?", 1)[0] == "/debug/hotspots":
@@ -292,6 +307,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, profstats.hotspots(n))
 
     def do_POST(self):
+        if self.path == "/debug/faults":
+            self._do_faults()
+            return
         if self.path == "/generate":
             req_id = self.headers.get(telemetry.REQUEST_ID_HEADER) \
                 or telemetry.new_request_id()
@@ -387,6 +405,14 @@ class _Handler(BaseHTTPRequestHandler):
         except ModelNotFoundError as e:
             self._finish(name, tenant, req_id, 404, t_start,
                          {"error": str(e)}, breq=breq)
+        except NoReplicasError as e:
+            # every replica worker is dead: this is an OUTAGE, not
+            # backpressure — 503 (not 429) and deliberately NO
+            # Retry-After, because no client-side pacing hint is honest
+            # until the supervisor (or an operator) restores a worker
+            self._finish(name, tenant, req_id, 503, t_start,
+                         {"error": str(e), "shed_reason": "no_replicas"},
+                         shed_reason="no_replicas", breq=breq)
         except ServingClosedError as e:
             self._finish(name, tenant, req_id, 503, t_start,
                          {"error": str(e)}, breq=breq)
@@ -394,10 +420,48 @@ class _Handler(BaseHTTPRequestHandler):
             self._finish(name, tenant, req_id, 500, t_start,
                          {"error": "%s: %s" % (type(e).__name__, e)},
                          breq=breq)
+        except BaseException as e:
+            if (isinstance(e, (KeyboardInterrupt, SystemExit))
+                    and not getattr(e, "_mxtpu_died_in_servable", False)):
+                # a genuine interpreter-exit signal, not a delivered
+                # request error — let it propagate
+                raise
+            # a worker-killing defect delivered raw to the poison
+            # request's future (query of death — docs/RESILIENCE.md)
+            # must not kill the handler thread: at the HTTP boundary it
+            # is a servable outage, 503 — even when the servable's
+            # chosen defect is spelled SystemExit
+            self._finish(name, tenant, req_id, 503, t_start,
+                         {"error": "%s: %s" % (type(e).__name__, e)},
+                         breq=breq)
         else:
             self._finish(name, tenant, req_id, 200, t_start,
                          {"outputs": [onp.asarray(o).tolist()
                                       for o in outs]}, breq=breq)
+
+    def _do_faults(self):
+        """POST /debug/faults — arm (body ``{"spec": "<site:kind:...>"}``)
+        or disarm (empty/absent spec) the process-wide fault-injection
+        registry at runtime. Chaos drills flip faults mid-soak through
+        this without a restart; a malformed spec is a 400 and leaves the
+        previous arming untouched (faultlab.arm validates before it
+        swaps). The response echoes ``faultlab.describe()``."""
+        from ..telemetry import faultlab
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length) or b"{}")
+            spec = req.get("spec") or ""
+            if not isinstance(spec, str):
+                raise ValueError("'spec' must be a string")
+        except Exception as e:  # noqa: BLE001 — anything malformed is a 400
+            self._send(400, {"error": "bad request: %s" % e})
+            return
+        try:
+            faultlab.arm(spec)
+        except ValueError as e:
+            self._send(400, {"error": "bad fault spec: %s" % e})
+            return
+        self._send(200, faultlab.describe())
 
     def _retry_after(self, name):
         """Whole-second Retry-After hint for a 429: at least one batch
@@ -469,6 +533,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._finish(name, tenant, req_id, 404, t_start,
                          {"error": str(e)})
         except ServingClosedError as e:
+            # covers a registry whose decode loop is DEAD (awaiting
+            # supervisor revival) as well as graceful shutdown: a 503
+            # outage signal, never a 429 pacing hint
             self._finish(name, tenant, req_id, 503, t_start,
                          {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — engine failure

@@ -35,6 +35,7 @@ from .trace import (new_request_id, current_request_id,
                     set_current_request_id, request_scope,
                     REQUEST_ID_HEADER)
 from . import devstats
+from . import faultlab
 from . import flightrec
 from . import numwatch
 from . import profstats
@@ -51,8 +52,8 @@ __all__ = [
     "new_request_id", "current_request_id", "set_current_request_id",
     "request_scope", "REQUEST_ID_HEADER",
     "start_periodic_flush", "stop_periodic_flush", "flush_to_file",
-    "devstats", "flightrec", "numwatch", "profstats", "slo", "spans",
-    "watchdog",
+    "devstats", "faultlab", "flightrec", "numwatch", "profstats", "slo",
+    "spans", "watchdog",
     "Span", "SpanContext", "span", "record_span", "current_span",
     "current_context",
 ]

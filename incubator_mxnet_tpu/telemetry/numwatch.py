@@ -63,6 +63,7 @@ import threading
 import numpy as onp
 
 from .registry import counter, gauge
+from . import faultlab
 from . import flightrec
 
 __all__ = ["tap", "note", "shadow_offer", "register_shadow",
@@ -309,6 +310,11 @@ def _shadow_loop(q):
     while True:
         model, stacked, primary = q.get()
         try:
+            # faultlab site "numwatch.shadow": an injected exception here
+            # becomes a DROPPED sample (debug-logged below) — proof that
+            # telemetry failure never fails traffic (R005 discipline)
+            if faultlab.armed:
+                faultlab.fire("numwatch.shadow", model=model)
             _shadow_compare(model, stacked, primary)
         except Exception:
             _LOG.debug("shadow comparison for model %r dropped", model,

@@ -160,8 +160,10 @@ def last_report():
 
 def _emit_report(report, path):
     global _last_report
-    with _report_lock:
-        _last_report = report
+    # file first, in-memory publish last: last_report() flipping
+    # non-None is the signal readers key on, so every other artifact of
+    # the report must already be visible when it does (same ordering
+    # discipline as the stall counter below)
     if path:
         try:
             with open(path, "a") as f:
@@ -169,6 +171,8 @@ def _emit_report(report, path):
         except Exception:
             _LOG.debug("watchdog report write to %r failed", path,
                        exc_info=True)
+    with _report_lock:
+        _last_report = report
     _LOG.error("stall detected — report follows\n%s", report)
 
 

@@ -33,7 +33,7 @@ def rule_ids(findings):
 
 def test_rule_catalog_complete():
     assert {"R001", "R002", "R003", "R004", "R005", "R006",
-            "R007", "R008", "R012"} <= set(RULES)
+            "R007", "R008", "R012", "R013"} <= set(RULES)
     # the whole-program passes live in their own registry (they need the
     # project index, not one file), R001 appearing in both: the per-file
     # rule covers inline hot-path syncs, the pass covers helpers
@@ -527,6 +527,117 @@ def test_r012_donate_argnames_counts_as_donation(tmp_path):
     assert "R012" not in rule_ids(findings)
 
 
+# ------------------------------------------------------------------ R013
+def test_r013_unpaced_retry_loop_positive(tmp_path):
+    findings = run_snippet(tmp_path, "batcher.py", """
+        class Respawner:
+            def _respawn(self, replica):
+                while True:
+                    try:
+                        self._spawn(replica)
+                        return
+                    except RuntimeError:
+                        continue
+    """)
+    assert rule_ids(findings) == ["R013"]
+    assert "no pacing" in findings[0].message
+
+
+def test_r013_unbounded_retry_with_pacing_positive(tmp_path):
+    # pacing alone is not hygiene: `while True` + always-swallow means a
+    # deterministic failure retries forever and never surfaces
+    findings = run_snippet(tmp_path, "resilience.py", """
+        import time
+
+        class Respawner:
+            def _respawn(self, replica):
+                while True:
+                    try:
+                        self._spawn(replica)
+                        return
+                    except RuntimeError:
+                        time.sleep(0.5)
+    """)
+    assert rule_ids(findings) == ["R013"]
+    assert "attempt bound" in findings[0].message
+
+
+def test_r013_clean_cases(tmp_path):
+    cases = {
+        # bounded attempts + backoff pacing: the recommended shape
+        "server.py": """
+            import time
+
+            class Respawner:
+                def _respawn(self, replica):
+                    for attempt in range(5):
+                        try:
+                            self._spawn(replica)
+                            return
+                        except RuntimeError:
+                            time.sleep(0.1 * 2 ** attempt)
+                    raise RuntimeError("replica %d crash-looped" % replica)
+        """,
+        # bounded while + pacing: the loop test carries the attempt cap
+        "batcher.py": """
+            import time
+
+            class Respawner:
+                def _respawn(self, replica):
+                    attempts = 0
+                    while attempts < 5:
+                        try:
+                            self._spawn(replica)
+                            return
+                        except RuntimeError:
+                            attempts += 1
+                            time.sleep(0.1)
+        """,
+        # worker loop, not a retry loop: no success exit in the try —
+        # each iteration pulls NEW work (the drain-queue idiom)
+        "batcher2.py": """
+            class Drainer:
+                def _fail_queued(self, q, err):
+                    while True:
+                        try:
+                            req = q.get_nowait()
+                        except Exception:
+                            break
+                        req.fail(err)
+        """,
+        # handler re-raises: the failure surfaces, nothing to pace
+        "resilience.py": """
+            class Respawner:
+                def _respawn(self, replica):
+                    while True:
+                        try:
+                            self._spawn(replica)
+                            return
+                        except RuntimeError:
+                            raise
+        """,
+    }
+    for name, src in cases.items():
+        findings = run_snippet(tmp_path, name, src)
+        assert "R013" not in rule_ids(findings), (name, findings)
+
+
+def test_r013_scoped_to_serving_modules(tmp_path):
+    # the same unpaced shape OUTSIDE the serving scope is not flagged:
+    # retry hygiene is a request-path concern (a train-data reader
+    # retry is a different policy question)
+    findings = run_snippet(tmp_path, "reader.py", """
+        class Reader:
+            def _read(self, path):
+                while True:
+                    try:
+                        return self._open(path)
+                    except OSError:
+                        continue
+    """)
+    assert "R013" not in rule_ids(findings)
+
+
 # ----------------------------------------------------------- suppression
 def test_per_line_suppression(tmp_path):
     findings = run_snippet(tmp_path, "feature.py", """
@@ -677,5 +788,5 @@ def test_cli_list_rules():
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0
     for rid in ("R001", "R002", "R003", "R004", "R005", "R006", "R007",
-                "R008"):
+                "R008", "R012", "R013"):
         assert rid in r.stdout

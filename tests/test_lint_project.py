@@ -1140,24 +1140,25 @@ def test_r001_interprocedural_depth_is_one(tmp_path):
 
 
 # --------------------------------------------------------- seeded defects
-def test_seeded_defects_exactly_nine():
+def test_seeded_defects_exactly_ten():
     """The regression canary: the fixtures contain one deadlock cycle,
     one unlocked cross-thread write, one jax.jit retrace hazard, one
     AOT-boundary (aot.compile_cached) retrace hazard, one donation-less
     train-step jit (R012 — the source mirror of hlolint H002), one
     host-device sync in the replica dispatch hot path, one per-dispatch
     XLA cost_analysis walk in the servable-call hot path, one
-    per-dispatch profiler-trace parse in the batch hot path, and one
-    per-element host-side finite-check loop in the worker loop
-    (seeded_batcher.py anchors the
+    per-dispatch profiler-trace parse in the batch hot path, one
+    per-element host-side finite-check loop in the worker loop, and one
+    unpaced respawn retry loop (R013 — the source mirror of the
+    supervisor's backoff/park policy) (seeded_batcher.py anchors the
     ``*batcher:DynamicBatcher._dispatch_replica`` / ``._call_servable``
-    / ``._process_batch`` / ``._run_loop`` patterns) — the analyzer
-    must report exactly those nine (ci/run.sh asserts the same thing in
-    the lint stage)."""
+    / ``._process_batch`` / ``._run_loop`` patterns plus the
+    ``*batcher*`` R013 scope) — the analyzer must report exactly those
+    ten (ci/run.sh asserts the same thing in the lint stage)."""
     findings = analyze([SEEDED], root=SEEDED)
     assert rule_ids(findings) == \
         ["R001", "R001", "R001", "R001", "R009", "R010", "R011", "R011",
-         "R012"], findings
+         "R012", "R013"], findings
 
 
 def test_seeded_replica_defects_are_the_r001s(tmp_path):
@@ -1175,6 +1176,11 @@ def test_seeded_replica_defects_are_the_r001s(tmp_path):
     assert "_call_servable" in msgs and "cost_analysis" in msgs
     assert "_process_batch" in msgs and "summarize_capture" in msgs
     assert "_run_loop" in msgs and "isfinite" in msgs
+    # the fifth batcher-fixture finding is the R013 respawn retry loop
+    r013 = [f for f in findings if f.rule == "R013"]
+    assert len(r013) == 1
+    assert r013[0].path.endswith("seeded_batcher.py")
+    assert "no pacing" in r013[0].message
 
 
 def test_seeded_defects_clean_under_repo_gate_profile():

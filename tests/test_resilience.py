@@ -1,0 +1,704 @@
+"""Chaos tier: deterministic fault injection (telemetry/faultlab) and the
+self-healing reflexes it exercises — the batcher's bounded predict retry,
+replica respawn with the supervisor's backoff + crash-loop park, decode-
+loop resurrection with bit-exact survivors vs loud ``engine_restart``
+retirement, last-known-good version rollback — plus the outage half of
+the HTTP error contract (503 ``no_replicas`` with NO Retry-After, dead
+decode loops delisted from GET /v1/models) and the ``/debug/faults``
+arming surface. docs/RESILIENCE.md is the narrative twin."""
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from incubator_mxnet_tpu.telemetry import faultlab, flightrec    # noqa: E402
+from incubator_mxnet_tpu.serving import (                        # noqa: E402
+    DynamicBatcher, ModelRegistry, ServingClosedError, ServingServer,
+    Supervisor, percentile)
+from incubator_mxnet_tpu.serving import batcher as batcher_mod   # noqa: E402
+from incubator_mxnet_tpu.serving.batcher import NoReplicasError  # noqa: E402
+from incubator_mxnet_tpu.serving import generate as gen          # noqa: E402
+
+# same geometry as tests/test_generate.py: the AOT cache is process-wide,
+# so identical shapes compile once across both modules' engines
+GEO = dict(block_size=8, num_blocks=48, max_batch=4, prefill_len=16,
+           max_tokens=16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_lab():
+    """No fault armed in one test may leak into the next."""
+    faultlab.reset()
+    yield
+    faultlab.reset()
+
+
+def _wait(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return bool(pred())
+
+
+def _rows(event, model=None):
+    return [ev for ev in flightrec.snapshot()
+            if ev["event"] == event
+            and (model is None or ev.get("model") == model)]
+
+
+class _Kill(BaseException):
+    """Escapes per-batch ``except Exception`` guards -> worker death."""
+
+
+class _Echo:
+    def __init__(self, bias=0.0):
+        self.bias = float(bias)
+
+    def predict_batch(self, x):
+        return (x + self.bias,)
+
+
+class _AlwaysDie:
+    def predict_batch(self, x):
+        raise _Kill("deterministic crasher")
+
+
+class _DieOncePoisoned:
+    """Kills the worker ONCE on the poison value, then serves normally.
+    A death raised inside the servable is a query of death: the poison
+    request itself is never retried (it would serially kill survivors);
+    the supervisor heals the replica it cost."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.died = False
+
+    def predict_batch(self, x):
+        with self._lock:
+            if not self.died and float(onp.asarray(x).ravel()[0]) == -1.0:
+                self.died = True
+                raise _Kill("poison")
+        return (x,)
+
+
+def _http_post(host, port, path, payload, timeout=30.0):
+    """POST -> (status, lower-cased headers, parsed body)."""
+    c = http.client.HTTPConnection(host, port, timeout=timeout)
+    c.request("POST", path, json.dumps(payload).encode("utf-8"),
+              {"Content-Type": "application/json"})
+    r = c.getresponse()
+    hdrs = {k.lower(): v for k, v in r.getheaders()}
+    body = json.loads(r.read().decode("utf-8"))
+    c.close()
+    return r.status, hdrs, body
+
+
+def _http_get(host, port, path, timeout=30.0):
+    c = http.client.HTTPConnection(host, port, timeout=timeout)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = json.loads(r.read().decode("utf-8"))
+    c.close()
+    return r.status, body
+
+
+def _gen_http(host, port, body, timeout=60.0):
+    """POST /generate -> (status, [parsed NDJSON lines])."""
+    c = http.client.HTTPConnection(host, port, timeout=timeout)
+    c.request("POST", "/generate", json.dumps(body).encode("utf-8"),
+              {"Content-Type": "application/json"})
+    r = c.getresponse()
+    if r.status != 200:
+        payload = [json.loads(r.read().decode("utf-8"))]
+        c.close()
+        return r.status, payload
+    lines = [json.loads(ln) for ln in r.read().decode("utf-8").splitlines()
+             if ln.strip()]
+    c.close()
+    return r.status, lines
+
+
+# ------------------------------------------------------------- faultlab
+def test_faultlab_stride_is_deterministic():
+    faultlab.arm("site.x:exception:stride=3")
+    fired = []
+    for i in range(1, 10):
+        try:
+            faultlab.fire("site.x")
+            fired.append(False)
+        except faultlab.FaultInjected:
+            fired.append(True)
+    assert [i for i, f in zip(range(1, 10), fired) if f] == [3, 6, 9]
+    d = faultlab.describe()
+    assert d["armed"] and d["faults"][0]["calls"] == 9
+    assert d["faults"][0]["fired"] == 3
+
+
+def test_faultlab_seeded_probability_is_replayable():
+    def pattern():
+        faultlab.arm("s:exception:p=0.5:seed=7")
+        out = []
+        for _ in range(32):
+            try:
+                faultlab.fire("s")
+                out.append(0)
+            except faultlab.FaultInjected:
+                out.append(1)
+        return out
+
+    a, b = pattern(), pattern()
+    # same spec -> identical firing pattern in any process: what makes a
+    # chaos run replayable (and the default seed is derived from the
+    # site:kind STRING, not per-process hash randomization)
+    assert a == b
+    assert 0 < sum(a) < 32
+    faultlab.disarm()
+    assert faultlab.parse_spec("q:exception")[0].seed == \
+        faultlab.parse_spec("q:exception")[0].seed
+
+
+def test_faultlab_budget_self_disarms():
+    faultlab.arm("s:exception:stride=1:budget=2")
+    for _ in range(2):
+        with pytest.raises(faultlab.FaultInjected):
+            faultlab.fire("s")
+    # exhausted -> self-disarmed: the fast path is cold again
+    assert faultlab.armed is False
+    faultlab.fire("s")                       # no-op, nothing armed
+    d = faultlab.describe()
+    assert d == {"armed": False, "faults": []}
+
+
+def test_faultlab_malformed_spec_fails_loudly_and_keeps_prior_arming():
+    faultlab.arm("keep:exception:stride=100")
+    for bad in ("noentry", "s:badkind", "s:exception:bogus",
+                "s:exception:nokey=1", "s:exception:stride=2:p=0.5"):
+        with pytest.raises(ValueError):
+            faultlab.arm(bad)
+    # a typo'd re-arm must not silently strip the armed set
+    d = faultlab.describe()
+    assert d["armed"] and d["faults"][0]["site"] == "keep"
+
+
+def test_faultlab_passive_kinds_return_and_telemetry_lands():
+    faultlab.arm("a:nan_poison;b:artifact_corrupt;c:slow_ms:ms=1")
+    assert faultlab.fire("a") == "nan_poison"
+    assert faultlab.fire("b") == "artifact_corrupt"
+    t0 = time.perf_counter()
+    assert faultlab.fire("c") is None        # slept in place
+    assert time.perf_counter() - t0 >= 0.0005
+    assert faultlab.fire("unwired.site") is None
+    assert faultlab._FIRED.value(site="a", kind="nan_poison") >= 1
+    assert [ev for ev in _rows("fault_injected") if ev.get("site") == "a"]
+    assert [ev for ev in _rows("fault_armed") if ev.get("site") == "b"]
+
+
+def test_registry_load_site_fires_before_any_entry_state():
+    faultlab.arm("registry.load:exception:stride=1")
+    reg = ModelRegistry()
+    try:
+        with pytest.raises(faultlab.FaultInjected):
+            reg.load("rz-site", _Echo(1.0), max_batch_size=2,
+                     batch_timeout_ms=1.0, queue_size=4, prewarm=False)
+        assert "rz-site" not in reg.models()
+        faultlab.disarm()
+        reg.load("rz-site", _Echo(1.0), max_batch_size=2,
+                 batch_timeout_ms=1.0, queue_size=4, prewarm=False)
+        out = reg.predict("rz-site", onp.float32([1.0]))
+        assert out[0][0] == 2.0
+    finally:
+        reg.close()
+
+
+def test_disarmed_guard_tax_within_5pct():
+    """The armed-lab dispatch cost (lock + site lookup per batch) must
+    stay within 1.05x of disarmed p99 — paired min-ratio over interleaved
+    repeats (the numerics-sentinel CI template). Since armed strictly
+    dominates the disarmed guard (one module attribute read), this bounds
+    the disarmed tax too."""
+    b = DynamicBatcher(_Echo(), max_batch_size=8, batch_timeout_ms=0.2,
+                       queue_size=64, name="rz-tax")
+    try:
+        x = onp.zeros((4,), "float32")
+        for _ in range(50):                                    # warm-up
+            b.predict(x, timeout=10.0)
+
+        def lats(n=120):
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                b.predict(x, timeout=10.0)
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+            return percentile(lat, 50), percentile(lat, 99)
+
+        def measure_armed():
+            faultlab.arm("batcher.dispatch:exception:stride=1000000000")
+            try:
+                return lats()
+            finally:
+                faultlab.disarm()
+
+        # Paired interleaved rounds on a box we don't control: per-round
+        # ratios are heavy-tailed (scheduler bursts land in one half),
+        # so gate two noise-robust statistics a REAL 5% per-batch tax
+        # (which shifts every sample of every round) still cannot pass:
+        # the MEDIAN of paired p50 ratios (bursts inflate whole rounds,
+        # median discards them) and the MIN of paired p99 ratios (the
+        # interleaved-minima reading — the cleanest round observed must
+        # show a clean tail). Order alternates per round so "armed ran
+        # first" bias cancels. Early-exit once both read clean.
+        r50, r99 = [], []
+        for round_ in range(15):
+            if round_ % 2 == 0:
+                (a50, a99), (d50, d99) = measure_armed(), lats()
+            else:
+                (d50, d99), (a50, a99) = lats(), measure_armed()
+            r50.append(a50 / d50)
+            r99.append(a99 / d99)
+            if (round_ >= 2 and sorted(r50)[len(r50) // 2] <= 1.05
+                    and min(r99) <= 1.05):
+                break
+        assert sorted(r50)[len(r50) // 2] <= 1.05, (r50, r99)
+        assert min(r99) <= 1.05, (r50, r99)
+    finally:
+        b.close()
+
+
+# -------------------------------------------- retry + respawn (batcher)
+def test_injected_kill_retries_once_then_respawn_rebalances():
+    b = DynamicBatcher(_Echo(), max_batch_size=2, batch_timeout_ms=1.0,
+                       queue_size=16, replicas=2, name="rz-respawn")
+    try:
+        x = onp.float32([1.0])
+        b.predict(x, timeout=10.0)
+        r0 = batcher_mod._RETRIES.value(model="rz-respawn")
+        faultlab.arm("batcher.dispatch:replica_kill:stride=1:budget=1")
+        # the poisoned dispatch kills its worker; the request is retried
+        # exactly once onto the survivor and still succeeds
+        out = b.predict(x, timeout=15.0)
+        assert out[0][0] == 1.0
+        assert _wait(lambda: b.dead_replicas(), 10.0), "worker never died"
+        assert batcher_mod._RETRIES.value(model="rz-respawn") == r0 + 1
+        assert _rows("request_retried", "rz-respawn")
+        dead = b.dead_replicas()[0]
+        assert b.respawn_replica(dead) is True
+        assert b.dead_replicas() == []
+        assert b.respawn_replica(dead) is False      # not dead: no-op
+        assert _rows("replica_respawned", "rz-respawn")
+        # the reborn worker takes traffic again (least-depth router)
+        for i in range(24):
+            b.predict(onp.float32([float(i)]), timeout=10.0)
+        counts = b.replica_dispatch_counts()
+        assert min(counts) > 0, counts
+    finally:
+        b.close()
+    assert b.respawn_replica(0) is False             # closed: no-op
+
+
+def test_no_retry_past_deadline():
+    # exogenous slow-then-kill injection (the retryable death shape) so
+    # the deadline check is what blocks the retry, not query-of-death
+    b = DynamicBatcher(_Echo(), max_batch_size=1, batch_timeout_ms=0.5,
+                       queue_size=4, replicas=2, name="rz-deadline")
+    faultlab.arm("batcher.dispatch:slow_ms:ms=100;"
+                 "batcher.dispatch:replica_kill:stride=1:budget=1")
+    try:
+        req = b.submit(onp.float32([1.0]), deadline_ms=30)
+        # dead past its deadline: retrying would serve a result the
+        # client already gave up on — fail instead (worker death is
+        # surfaced as ServingClosedError, never a raw BaseException)
+        with pytest.raises(ServingClosedError):
+            req.result(10.0)
+        assert batcher_mod._RETRIES.value(model="rz-deadline") == 0
+    finally:
+        b.close()
+
+
+def test_retry_disabled_by_env(monkeypatch):
+    # an EXOGENOUS (injected) kill — the retryable shape — with the knob
+    # off: the request must fail instead of riding the drain-back
+    monkeypatch.setenv("MXTPU_RESILIENCE_RETRY", "0")
+    b = DynamicBatcher(_Echo(), max_batch_size=1,
+                       batch_timeout_ms=0.5, queue_size=4, replicas=2,
+                       name="rz-noretry")
+    try:
+        faultlab.arm("batcher.dispatch:replica_kill:stride=1:budget=1")
+        req = b.submit(onp.float32([1.0]))
+        with pytest.raises(ServingClosedError):
+            req.result(10.0)
+        assert batcher_mod._RETRIES.value(model="rz-noretry") == 0
+    finally:
+        b.close()
+
+
+def test_query_of_death_is_never_retried():
+    """A worker-killing BaseException raised INSIDE the servable is
+    request-correlated: retrying it would serially kill the survivors
+    (the failure mode test_serving_sharded's drain-back contract pins).
+    The poison request fails with the servable's own raw defect (the
+    pre-resilience drains-back contract), costs exactly one replica,
+    and every other request keeps completing on the survivor."""
+    b = DynamicBatcher(_DieOncePoisoned(), max_batch_size=1,
+                       batch_timeout_ms=0.5, queue_size=8, replicas=2,
+                       name="rz-qod")
+    try:
+        req = b.submit(onp.float32([-1.0]))
+        with pytest.raises(_Kill):
+            req.result(10.0)
+        assert batcher_mod._RETRIES.value(model="rz-qod") == 0
+        # the corpse is marked dead by the worker thread's drain, which
+        # can lag the request failure by a beat
+        deadline = time.monotonic() + 10.0
+        while not b.dead_replicas() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(b.dead_replicas()) == 1
+        # the survivor still serves normal traffic
+        out = b.predict(onp.float32([5.0]), timeout=10.0)
+        assert out[0][0] == 5.0
+        assert b.alive
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------------ supervisor
+def test_supervisor_thread_respawns_dead_replica():
+    reg = ModelRegistry()
+    sv = _DieOncePoisoned()
+    reg.load("rz-auto", sv, max_batch_size=1, batch_timeout_ms=1.0,
+             queue_size=8, replicas=2, prewarm=False)
+    sup = Supervisor(reg, poll_s=0.01, backoff_base_s=0.01,
+                     backoff_cap_s=0.05, crash_n=5,
+                     crash_window_s=30.0).start()
+    try:
+        assert sup.alive
+        # poison kills one worker and fails with the servable's own raw
+        # defect (query of death — never retried); the supervisor
+        # respawns the corpse unprompted while the survivor keeps serving
+        with pytest.raises(_Kill):
+            reg.predict("rz-auto", onp.float32([-1.0]), timeout=15.0)
+        out = reg.predict("rz-auto", onp.float32([2.0]), timeout=15.0)
+        assert out[0][0] == 2.0
+        b = reg._entry("rz-auto").batcher
+        # wait on the respawn ROW, not on dead_replicas() emptying — the
+        # corpse is marked dead by the worker thread's drain, which can
+        # lag the raw request failure, so the dead set can read empty
+        # before the death is even recorded
+        assert _wait(lambda: _rows("replica_respawned", "rz-auto"), 10.0), \
+            sup.describe()
+        assert _wait(lambda: not b.dead_replicas(), 10.0), \
+            sup.describe()
+        assert reg.health()["status"] == "healthy"
+    finally:
+        sup.stop()
+        reg.close()
+    assert not sup.alive
+
+
+def test_supervisor_parks_crash_loop_and_unpark_revives():
+    reg = ModelRegistry()
+    reg.load("rz-park", _AlwaysDie(), max_batch_size=1,
+             batch_timeout_ms=1.0, queue_size=8, replicas=1, prewarm=False)
+    # no poll thread: the test drives poll_once() so every transition of
+    # the backoff/park state machine is observed deterministically
+    sup = Supervisor(reg, poll_s=0.01, backoff_base_s=0.005,
+                     backoff_cap_s=0.02, crash_n=3, crash_window_s=30.0)
+    b = reg._entry("rz-park").batcher
+    try:
+        deadline = time.monotonic() + 60.0
+        while not sup.parked("rz-park", 0) and \
+                time.monotonic() < deadline:
+            if b.dead_replicas():
+                sup.poll_once()          # record death / schedule / park
+                time.sleep(0.05)         # let the backoff come due
+                sup.poll_once()          # execute the due respawn
+            else:
+                with pytest.raises((_Kill, NoReplicasError)):
+                    # dispatch kills the lone worker (in-servable death:
+                    # a query of death, failed raw rather than retried);
+                    # a request that lands in-queue during the corpse's
+                    # drain window gets NoReplicasError instead
+                    reg.predict("rz-park", onp.float32([1.0]),
+                                timeout=10.0)
+        assert sup.parked("rz-park", 0), sup.describe()
+        assert _rows("replica_parked", "rz-park")
+        # parked stays in the dead set: health keeps reporting bad (a
+        # fully-dead single-replica model reads unhealthy; a partial
+        # death would read degraded)
+        assert b.dead_replicas() == [0]
+        assert reg.health()["status"] in ("degraded", "unhealthy")
+        assert "rz-park:r0" in "".join(sup.describe()["parked"])
+        # parked means parked: further polls do not respawn
+        sup.poll_once()
+        time.sleep(0.05)
+        sup.poll_once()
+        assert b.dead_replicas() == [0]
+        # operator verb: unpark forgets the crash history, next polls
+        # respawn under a fresh backoff
+        assert sup.unpark("rz-park", 0) is True
+        assert sup.unpark("rz-park", 0) is False     # already unparked
+        sup.poll_once()
+        time.sleep(0.05)
+        sup.poll_once()
+        assert _wait(lambda: not b.dead_replicas(), 5.0)
+    finally:
+        reg.close()
+
+
+# --------------------------------------------- decode-loop resurrection
+def test_genloop_kill_resurrect_survivors_bit_exact():
+    e = gen.GenerativeEngine(name="rz-gen", seed=0, **GEO)
+    try:
+        reqs = [dict(prompt=[3, 1, 4], max_new_tokens=10,
+                     temperature=1.0, seed=11),
+                dict(prompt=[2, 7, 1, 8], max_new_tokens=10,
+                     temperature=1.0, seed=22)]
+        refs = [e.generate_sequential(**r) for r in reqs]
+        e.set_supervised(True)
+        # the kill fires BEFORE the donated decode call: the pool is
+        # intact, so every sequence must survive the restart bit-exactly
+        faultlab.arm("generate.step:replica_kill:stride=4:budget=1")
+        streams = [e.submit(**r) for r in reqs]
+        assert _wait(lambda: not e.alive, 30.0), "decode loop never died"
+        died = _rows("genloop_died", "rz-gen")
+        assert died and died[-1]["pool_hazard"] is False
+        # at least one sequence was live at death (the other may have
+        # been prefilled on the caller thread after the loop died — it
+        # waits in _pending and is adopted by the resurrected loop)
+        assert died[-1]["active"] >= 1
+        assert e.resurrect() is True
+        assert e.resurrect() is False            # alive again: no-op
+        for s, (ref_toks, ref_reason) in zip(streams, refs):
+            toks, reason = s.tokens(timeout=120.0)
+            assert toks == ref_toks and reason == ref_reason
+        res = _rows("genloop_resurrected", "rz-gen")
+        assert res and res[-1]["retired"] == 0
+    finally:
+        e.close()
+
+
+def test_mid_donation_kill_retires_engine_restart_and_frees_kv():
+    e = gen.GenerativeEngine(name="rz-hazard", seed=0, **GEO)
+    try:
+        e.set_supervised(True)
+        used0 = e._alloc.used
+        real = e._decode_fn
+
+        def poisoned(bucket):
+            def wrapped(*a):
+                # dies INSIDE the donated call: the pool went down with
+                # it, so this row's KV state is unrecoverable
+                raise _Kill("mid-donation crash")
+            return wrapped
+
+        e._decode_fn = poisoned
+        stream = e.submit([5, 6, 7], max_new_tokens=8, seed=3)
+        assert _wait(lambda: not e.alive, 30.0), "decode loop never died"
+        died = _rows("genloop_died", "rz-hazard")
+        assert died and died[-1]["pool_hazard"] is True
+        e._decode_fn = real
+        assert e.resurrect() is True
+        toks, reason = stream.tokens(timeout=60.0)
+        # loud, attributable retirement — never a silently hung stream
+        assert reason == "engine_restart"
+        assert stream.finish_reason == "engine_restart"
+        assert len(toks) <= 1                    # prefill token at most
+        assert _wait(lambda: e._alloc.used == used0, 10.0)
+        res = _rows("genloop_resurrected", "rz-hazard")
+        assert res and res[-1]["retired"] == 1
+    finally:
+        e.close()
+
+
+# ------------------------------------------------- last-known-good roll
+def test_degraded_rolls_back_to_last_known_good_and_quarantines():
+    reg = ModelRegistry()
+    reg.load("rz-roll", _Echo(1.0), max_batch_size=2, batch_timeout_ms=1.0,
+             queue_size=8, prewarm=False)
+    reg.load("rz-roll", _Echo(100.0), prewarm=False)     # hot reload: v2
+    try:
+        entry = reg._entry("rz-roll")
+        assert reg.predict("rz-roll", onp.float32([1.0]))[0][0] == 101.0
+        entry.set_degraded("shadow divergence breach")
+        d = entry.describe()
+        assert d["current_version"] == 1
+        assert d["degraded"] is None             # serving healthy again
+        assert d["rolled_back"] == {"from_version": 2, "to_version": 1,
+                                    "reason": "shadow divergence breach"}
+        assert reg.predict("rz-roll", onp.float32([1.0]))[0][0] == 2.0
+        assert reg.health()["status"] == "healthy"
+        rows = _rows("rolled_back_to", "rz-roll")
+        assert rows and rows[-1]["from_version"] == 2 \
+            and rows[-1]["to_version"] == 1
+        # the quarantined version can never auto-return
+        entry.repoint(2)
+        assert entry.describe()["current_version"] == 1
+        # no prior version to fall back to: degraded is sticky (an
+        # operator decision, not a flap)
+        reg.load("rz-stick", _Echo(1.0), max_batch_size=2,
+                 batch_timeout_ms=1.0, queue_size=8, prewarm=False)
+        e2 = reg._entry("rz-stick")
+        e2.set_degraded("breach with nowhere to go")
+        assert e2.describe()["degraded"] == "breach with nowhere to go"
+        assert e2.describe()["rolled_back"] is None
+        assert reg.health()["status"] == "degraded"
+    finally:
+        reg.close()
+
+
+def test_rollback_disabled_by_env_is_sticky_degraded(monkeypatch):
+    monkeypatch.setenv("MXTPU_RESILIENCE_ROLLBACK", "0")
+    reg = ModelRegistry()
+    reg.load("rz-noroll", _Echo(1.0), max_batch_size=2,
+             batch_timeout_ms=1.0, queue_size=8, prewarm=False)
+    reg.load("rz-noroll", _Echo(100.0), prewarm=False)
+    try:
+        entry = reg._entry("rz-noroll")
+        entry.set_degraded("breach")
+        d = entry.describe()
+        assert d["current_version"] == 2         # no repoint happened
+        assert d["degraded"] == "breach"
+        assert d["rolled_back"] is None
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------- HTTP outage contract
+def test_http_503_no_replicas_has_no_retry_after():
+    reg = ModelRegistry()
+    reg.load("rz-dead", _AlwaysDie(), max_batch_size=1,
+             batch_timeout_ms=1.0, queue_size=4, replicas=1, prewarm=False)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            b = reg._entry("rz-dead").batcher
+            # first request kills the lone worker
+            st, _h, _b = _http_post(srv.host, srv.port,
+                                    "/v1/models/rz-dead:predict",
+                                    {"inputs": [[1.0]]})
+            assert st == 503
+            assert _wait(lambda: not b.alive, 10.0)
+            st, hdrs, body = _http_post(srv.host, srv.port,
+                                        "/v1/models/rz-dead:predict",
+                                        {"inputs": [[1.0]]})
+            assert st == 503
+            assert body["shed_reason"] == "no_replicas"
+            # unlike 429 queue_full there is NO queue that drains: a
+            # Retry-After pacing hint would be a lie
+            assert "retry-after" not in hdrs, hdrs
+            with pytest.raises(NoReplicasError):
+                reg.submit("rz-dead", onp.float32([1.0]))
+    finally:
+        reg.close()
+
+
+class _ExitOnce:
+    """Servable whose poison defect is spelled SystemExit — the one
+    BaseException the HTTP layer must NOT re-raise when it arrives as a
+    delivered request error (query of death) rather than a genuine
+    interpreter-exit signal."""
+
+    def __init__(self):
+        self.died = False
+
+    def predict_batch(self, x):
+        if not self.died and float(onp.asarray(x).ravel()[0]) == -1.0:
+            self.died = True
+            raise SystemExit("poison spelled SystemExit")
+        return [onp.asarray(x)]
+
+
+def test_http_systemexit_query_of_death_is_503_not_dropped_conn():
+    reg = ModelRegistry()
+    reg.load("rz-exit", _ExitOnce(), max_batch_size=1,
+             batch_timeout_ms=0.5, queue_size=8, replicas=2, prewarm=False)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            # the poison request gets a clean 503, not a handler-thread
+            # death (which would surface as a dropped connection)
+            st, _h, body = _http_post(srv.host, srv.port,
+                                      "/v1/models/rz-exit:predict",
+                                      {"inputs": [[-1.0]]})
+            assert st == 503
+            assert "SystemExit" in body["error"]
+            # the survivor keeps serving over the same server
+            st, _h, body = _http_post(srv.host, srv.port,
+                                      "/v1/models/rz-exit:predict",
+                                      {"inputs": [[4.0]]})
+            assert st == 200 and body["outputs"][0][0] == 4.0
+    finally:
+        reg.close()
+
+
+def test_dead_genloop_delisted_until_resurrected():
+    reg = ModelRegistry()
+    e = reg.load_generator("rz-genb", seed=0, **GEO)
+    srv = ServingServer(reg, port=0).start()
+    try:
+        faultlab.arm("generate.step:replica_kill:stride=1:budget=1")
+        stream = e.submit([1, 2, 3], max_new_tokens=6, seed=1)
+        # UNsupervised death: actives end loudly as "error", never hang
+        toks, reason = stream.tokens(timeout=60.0)
+        assert reason == "error"
+        assert _wait(lambda: not e.alive, 10.0)
+        # delisted everywhere a client could route by
+        assert all(d["name"] != "rz-genb" for d in reg.generators())
+        with pytest.raises(ServingClosedError):
+            reg.generator("rz-genb")
+        st, body = _gen_http(srv.host, srv.port,
+                             {"model": "rz-genb", "prompt": [1],
+                              "max_new_tokens": 2})
+        assert st == 503 and "error" in body[0]
+        st, models = _http_get(srv.host, srv.port, "/v1/models")
+        assert st == 200
+        assert all(g["name"] != "rz-genb" for g in models["generators"])
+        # resurrection relists it and it serves again
+        assert e.resurrect() is True
+        assert _wait(lambda: e.alive, 5.0)
+        assert any(g["name"] == "rz-genb" for g in reg.generators())
+        st, lines = _gen_http(srv.host, srv.port,
+                              {"model": "rz-genb", "prompt": [1],
+                               "max_new_tokens": 2})
+        assert st == 200 and lines[-1].get("done")
+    finally:
+        srv.stop()
+        reg.close()
+
+
+def test_debug_faults_http_roundtrip():
+    reg = ModelRegistry()
+    reg.load("rz-dbg", _Echo(1.0), max_batch_size=2, batch_timeout_ms=1.0,
+             queue_size=4, prewarm=False)
+    try:
+        with ServingServer(reg, port=0) as srv:
+            st, _h, body = _http_post(
+                srv.host, srv.port, "/debug/faults",
+                {"spec": "batcher.dispatch:slow_ms:ms=1:stride=1000000"})
+            assert st == 200 and body["armed"] is True
+            assert body["faults"][0]["site"] == "batcher.dispatch"
+            st, body = _http_get(srv.host, srv.port, "/debug/faults")
+            assert st == 200 and body["armed"] is True
+            # malformed spec -> 400, and the prior arming stays intact
+            st, _h, body = _http_post(srv.host, srv.port, "/debug/faults",
+                                      {"spec": "nonsense"})
+            assert st == 400 and "error" in body
+            assert faultlab.describe()["armed"] is True
+            st, _h, _b = _http_post(srv.host, srv.port, "/debug/faults",
+                                    {"spec": 5})
+            assert st == 400                     # spec must be a string
+            # empty spec is the disarm verb
+            st, _h, body = _http_post(srv.host, srv.port, "/debug/faults",
+                                      {"spec": ""})
+            assert st == 200 and body["armed"] is False
+    finally:
+        reg.close()

@@ -446,9 +446,13 @@ def test_serve_dispatch_spans_carry_replica_and_request_ids():
     b = DynamicBatcher(_Echo(), max_batch_size=2, batch_timeout_ms=1.0,
                        queue_size=8, replicas=2, name="spansrep")
     try:
-        mark = len(spans.snapshot())
+        # reset, don't mark-and-slice: once the bounded ring is at
+        # capacity (a long test session gets it there), len() stays
+        # constant while old records evict, so a [mark:] slice of the
+        # post-predict snapshot would read empty
+        spans.reset()
         b.predict(onp.float32([1.0]), request_id="rid-1", timeout=10.0)
-        recs = [s for s in spans.snapshot()[mark:]
+        recs = [s for s in spans.snapshot()
                 if s["name"] == "serve:dispatch"]
         assert recs, "no serve:dispatch span"
         args = recs[-1]["args"]

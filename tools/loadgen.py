@@ -47,6 +47,15 @@ counts) and the span join additionally attributes ``gen:prefill`` and
 ``gen:decode_step`` time — prompts and sampling seeds are derived
 deterministically from each request id, so a soak is replayable.
 
+Chaos soak (``--faults [STAGE=]SPEC``, repeatable): arm a deterministic
+faultlab spec (``POST /debug/faults``, telemetry/faultlab.py) entering a
+given stage — e.g. ``--faults '1=batcher.dispatch:replica_kill:p=0.02'``
+kills replica workers during stage 1 while the supervisor heals them,
+and the report shows what the outage COST (availability, p99, shed mix)
+per stage under the exact chaos it ran (each stage summary carries its
+``fault_spec``). Whatever is still armed after the last stage is
+disarmed (docs/RESILIENCE.md).
+
 ``--json`` additionally emits the shared CI report shape (``tool`` /
 ``ok`` / ``findings`` / ``counts`` / ``baselined`` — the same parser
 that reads ``python -m tools.mxtpulint --json`` and ``tools/promcheck.py
@@ -260,6 +269,24 @@ class HttpTransport:
         except Exception:
             return ""
 
+    def arm_faults(self, spec):
+        """POST /debug/faults with a faultlab spec ('' disarms) — the
+        chaos-soak verb (--faults; docs/RESILIENCE.md). UNLIKE the scrape
+        endpoints this raises on failure: a soak whose faults silently
+        failed to arm would report a clean run while measuring nothing."""
+        req = urllib.request.Request(
+            self.url + "/debug/faults",
+            data=json.dumps({"spec": spec}).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode("utf-8", "replace")
+            e.close()
+            raise RuntimeError("arming faults %r failed: HTTP %d %s"
+                               % (spec, e.code, body))
+
 
 class GenHttpTransport(HttpTransport):
     """Streaming generative client: one ``POST /generate`` per ``send()``,
@@ -432,6 +459,13 @@ class InProcessTransport:
             return json.dumps(_numwatch.describe())
         except Exception:
             return ""
+
+    def arm_faults(self, spec):
+        """Arm the process-wide faultlab directly (same semantics as the
+        HTTP transport's POST /debug/faults; raises ValueError on a
+        malformed spec — loudly, like the route's 400)."""
+        from incubator_mxnet_tpu.telemetry import faultlab as _faultlab
+        return _faultlab.arm(spec)
 
 
 class _MonotonicClock:
@@ -768,7 +802,7 @@ class LoadGen:
 
     def __init__(self, transport, stages, arrival="poisson", seed=None,
                  max_clients=None, clock=None, settle_s=0.25, run_id=None,
-                 deadline_ms=None, tenants=None):
+                 deadline_ms=None, tenants=None, faults=None):
         self.transport = transport
         self.stages = [{"rps": float(s["rps"]),
                         "duration_s": float(s["duration_s"])}
@@ -794,6 +828,16 @@ class LoadGen:
         self.clock = clock if clock is not None else _MonotonicClock()
         self.settle_s = settle_s
         self.deadline_ms = deadline_ms
+        # chaos soak (--faults; docs/RESILIENCE.md): {stage_index: spec}
+        # armed via transport.arm_faults right before the stage's first
+        # arrival. An arming PERSISTS into later stages until replaced
+        # ('' disarms mid-ramp); whatever is still armed after the last
+        # stage is disarmed, so a soak never leaves a server poisoned.
+        self.faults = ({int(k): str(v) for k, v in faults.items()}
+                       if faults else None)
+        if self.faults and not hasattr(transport, "arm_faults"):
+            raise ValueError("faults configured but transport %r has no "
+                             "arm_faults()" % type(transport).__name__)
         if run_id is None:
             run_id = os.urandom(4).hex()
         self.run_id = run_id
@@ -927,10 +971,15 @@ class LoadGen:
                 w.start()
         summaries = []
         t_run0 = self.clock.now()
+        armed_spec = None
         try:
             prom_before = parse_prom(self.transport.scrape())
             t_scrape = self.clock.now()
             for idx, stage in enumerate(self.stages):
+                if self.faults is not None and idx in self.faults:
+                    spec = self.faults[idx]
+                    self.transport.arm_faults(spec)
+                    armed_spec = spec or None
                 n_offered = self._drive_stage(idx, stage, q, sync)
                 if not sync:
                     self._drain()
@@ -955,6 +1004,11 @@ class LoadGen:
                     # included), so the MFU denominator must too
                     scrape_window_s=now - t_scrape, slo_text=slo_text,
                     numerics_text=numerics_text))
+                if self.faults is not None:
+                    # which faults this stage ran under — the report's
+                    # availability/latency numbers are meaningless
+                    # without the chaos they were measured against
+                    summaries[-1]["fault_spec"] = armed_spec
                 prom_before = prom_after
                 t_scrape = now
         finally:
@@ -962,6 +1016,11 @@ class LoadGen:
                 q.put(None)
             for w in workers:
                 w.join(5.0)
+            if armed_spec is not None:
+                try:
+                    self.transport.arm_faults("")
+                except Exception:
+                    pass    # server gone/unreachable: nothing to disarm
         wall_s = self.clock.now() - t_run0
         report = {
             "schema": REPORT_SCHEMA,
@@ -970,6 +1029,7 @@ class LoadGen:
                        "max_clients": self.max_clients,
                        "deadline_ms": self.deadline_ms,
                        "tenants": self.tenants,
+                       "faults": self.faults,
                        "stages": self.stages},
             "wall_s": wall_s,
             "stages": summaries,
@@ -1062,6 +1122,29 @@ def _parse_stages(text):
     return stages
 
 
+def _parse_faults(parts):
+    """Repeated ``--faults`` values -> {stage_index: spec}.
+
+    Each value is ``STAGE=SPEC`` (arm SPEC entering stage STAGE) or a
+    bare SPEC (stage 0). The forms are unambiguous because a faultlab
+    spec's own ``=`` can only follow a ``site:kind`` prefix, which is
+    never a pure integer. ``STAGE=`` with an empty spec disarms entering
+    that stage (mid-ramp recovery measurement)."""
+    if not parts:
+        return None
+    out = {}
+    for part in parts:
+        head, sep, rest = part.partition("=")
+        if sep and head.strip().isdigit():
+            idx, spec = int(head), rest.strip()
+        else:
+            idx, spec = 0, part.strip()
+        if idx in out:
+            raise ValueError("stage %d given twice in --faults" % idx)
+        out[idx] = spec
+    return out
+
+
 def _parse_tenants(text):
     """'alice:3,bob:1' -> [("alice", 3.0), ("bob", 1.0)]; a bare name
     weighs 1. None/empty -> None (no tenant mix)."""
@@ -1117,6 +1200,14 @@ def main(argv=None):
     ap.add_argument("--max-clients", type=int, default=None,
                     help="in-flight bound (default: "
                          "MXTPU_LOADGEN_MAX_CLIENTS)")
+    ap.add_argument("--faults", action="append", default=None,
+                    metavar="[STAGE=]SPEC",
+                    help="chaos soak: arm this faultlab spec (POST "
+                         "/debug/faults) entering stage STAGE (default "
+                         "0); repeatable, STAGE= with an empty spec "
+                         "disarms mid-ramp, and whatever is still armed "
+                         "is disarmed after the last stage "
+                         "(docs/RESILIENCE.md)")
     ap.add_argument("--out", default=None, help="write the report here")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the shared CI report shape on stdout "
@@ -1144,7 +1235,8 @@ def main(argv=None):
     lg = LoadGen(transport, _parse_stages(args.stages),
                  arrival=args.arrival, seed=args.seed,
                  max_clients=args.max_clients, deadline_ms=args.deadline_ms,
-                 tenants=_parse_tenants(args.tenants))
+                 tenants=_parse_tenants(args.tenants),
+                 faults=_parse_faults(args.faults))
     report = lg.run()
     out_path = args.out or "<stdout>"
     if args.out:

@@ -1,4 +1,4 @@
-"""The framework-aware rule set (R001-R008).
+"""The framework-aware rule set (R001-R008, R012-R013).
 
 Each rule encodes a bug class this codebase has actually hit (or that the
 reference MXNet catches natively with sanitizers / engine dependency
@@ -626,6 +626,94 @@ def r012_train_jit_no_donation(ctx):
             "residency, 2x weight HBM traffic; hlolint H002 is the "
             "compiled-artifact mirror); donate the parameter/optimizer-"
             "state argnums, or gate it behind MXTPU_NO_DONATE" % qual)
+
+
+# --------------------------------------------------------------------- R013
+# Retry-loop hygiene in serving code. The self-healing layer
+# (serving/resilience.py, docs/RESILIENCE.md) makes retry-on-failure a
+# normal idiom — which is exactly when the two degenerate shapes start
+# shipping: a hot retry loop that hammers a failing dependency with zero
+# pacing (a replica dies => the retrier spins a CPU and turns one failure
+# into a thundering herd), and a retry-forever loop with no attempt bound
+# (a deterministic failure => the caller never returns and the failure
+# never surfaces; the supervisor's crash-loop breaker exists because
+# respawning a deterministic crasher forever just burns the error
+# budget). Shape matched: a ``while`` loop whose body is a ``try`` that
+# exits the loop on success (return/break in the try body) with a
+# handler that swallows back into the next attempt (no raise / return /
+# break). Scope: serving-side modules only — the layer whose retries sit
+# on the request path.
+_R013_SCOPE_PATTERNS = ("*serving/*", "*batcher*", "*server*",
+                        "*resilience*")
+#: call names that count as pacing between attempts: time.sleep, an
+#: event .wait, or anything the author NAMED as backoff/jitter/delay
+_R013_PACING_RE = re.compile(r"sleep|backoff|wait|jitter|delay", re.I)
+
+
+def _r013_in_scope(ctx):
+    return any(fnmatch.fnmatch(ctx.modkey, pat)
+               for pat in _R013_SCOPE_PATTERNS)
+
+
+def _exits_control(stmts):
+    """True when any statement (recursively) raises, returns, or breaks —
+    i.e. control can leave the retry cycle through these statements."""
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Raise, ast.Return, ast.Break)):
+                return True
+    return False
+
+
+def _has_pacing(wnode):
+    for sub in ast.walk(wnode):
+        if isinstance(sub, ast.Call) \
+                and _R013_PACING_RE.search(terminal_name(sub.func) or ""):
+            return True
+    return False
+
+
+@rule("R013", "retry loop without backoff pacing or an attempt bound")
+def r013_retry_loop_hygiene(ctx):
+    if not _r013_in_scope(ctx):
+        return
+    for wnode in ctx.walk(ast.While):
+        for tnode in wnode.body:
+            if not isinstance(tnode, ast.Try):
+                continue
+            if not _exits_control(tnode.body):
+                # no success exit inside the try: this is a worker loop
+                # pulling NEW work each iteration, not a retry of the
+                # same operation — out of scope by design
+                continue
+            if not any(not _exits_control(h.body) for h in tnode.handlers):
+                continue          # every handler re-raises/returns/breaks
+            if not _has_pacing(wnode):
+                yield ctx.finding(
+                    tnode, "R013",
+                    "retry loop re-attempts with no pacing between "
+                    "attempts — a dead dependency gets hammered at CPU "
+                    "speed and one failure becomes a thundering herd; "
+                    "sleep an exponential backoff with jitter between "
+                    "attempts (serving/resilience.py is the reference "
+                    "policy), or hand the repair to the Supervisor")
+                break
+            const_true = (isinstance(wnode.test, ast.Constant)
+                          and bool(wnode.test.value))
+            other = [s for s in wnode.body if s is not tnode]
+            bounded = (_exits_control(other)
+                       or _exits_control(tnode.orelse)
+                       or _exits_control(tnode.finalbody))
+            if const_true and not bounded:
+                yield ctx.finding(
+                    tnode, "R013",
+                    "retry loop has pacing but NO attempt bound (`while "
+                    "True` with a handler that always swallows) — a "
+                    "deterministic failure retries forever and never "
+                    "surfaces; cap the attempts (for attempt in "
+                    "range(n)) or park after N failures like the "
+                    "supervisor's crash-loop breaker")
+                break
 
 
 # --------------------------------------------------------------------- R008
