@@ -15,7 +15,19 @@
 #             relaxed R003/R005/R006 profile) — hard fail on any
 #             non-baselined finding, on a >30s wall time, and on the
 #             seeded-defect canary (the testdata fixtures must yield
-#             exactly the ten seeded findings)
+#             exactly the ten seeded findings); runs with
+#             --check-suppressions on: a dead disable comment (X001) or
+#             a stale baseline entry (X002) fails the stage
+#   hlodiff - differential artifact gate (tools/hlodiff/): the five
+#             seeded regression pairs (FLOPs growth, dropped donation,
+#             dtype widening, gained collective, changed bucket ladder
+#             — tools/hlolint/canary.py write_diff_canaries) must each
+#             fire EXACTLY their D-rule while self-diffs stay empty;
+#             then the deploy gate end-to-end: a donation-dropped hot
+#             reload is refused with degraded reason hlodiff:D003 while
+#             the prior version keeps serving zero-error under
+#             concurrent clients, and a byte-identical redeploy cuts
+#             over clean; wall budget 120s
 #   hlolint - compiled-artifact static analysis (tools/hlolint/): trace
 #             the serving-shaped programs the repo actually runs (fp32
 #             dense eval buckets + a native-int8 quantized net) into a
@@ -25,7 +37,9 @@
 #             canary (one fp64 serve program + one donation-less train
 #             module, tools/hlolint/canary.py) must fire exactly
 #             H001+H002; finally the one-parser aggregation: the
-#             mxtpulint / promcheck / hlolint --json reports are merged
+#             mxtpulint / promcheck / hlolint / hlodiff --json reports
+#             (hlodiff's from the byte-identical self-diff of the real
+#             traced artifacts — the empty-diff contract) are merged
 #             into a single per-run artifact and asserted to share the
 #             exact report shape
 #   native  - rebuild libmxtpu.so + libmxtpu_predict.so from src, then a
@@ -144,7 +158,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint native suite serving aot observability devstats profstats loadgen slo generate numerics sharded chaos diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint hlodiff native suite serving aot observability devstats profstats loadgen slo generate numerics sharded chaos diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -161,10 +175,15 @@ if has_stage lint; then
   # human-readably. Wall time is printed and budget-checked: the
   # content-hash AST cache keeps index+rules under 30s — a blowup here
   # is a lint-engine regression, not noise.
+  # --check-suppressions is default-on here: X001 dead disable comments
+  # and X002 stale baseline entries fail the stage (suppression debt is
+  # paid forward, never accumulated)
   LINT_JSON=$(mktemp -t mxtpulint.XXXXXX.json)   # per-run: no clobber
   lint_t0=$SECONDS
-  python -m tools.mxtpulint incubator_mxnet_tpu tools tests --json > "$LINT_JSON" \
-    || { python -m tools.mxtpulint incubator_mxnet_tpu tools tests || true; exit 1; }
+  python -m tools.mxtpulint incubator_mxnet_tpu tools tests \
+      --check-suppressions --json > "$LINT_JSON" \
+    || { python -m tools.mxtpulint incubator_mxnet_tpu tools tests \
+           --check-suppressions || true; exit 1; }
   lint_dt=$(( SECONDS - lint_t0 ))
   python -c "import json,sys; r=json.load(open(sys.argv[1])); \
 print('mxtpulint OK: %d baselined, %ss wall, artifact %s' \
@@ -245,7 +264,7 @@ assert rules == ["H001", "H002"], rules
 assert rep["counts"] == {"H001": 1, "H002": 1}, rep["counts"]
 print("hlolint seeded-defect canary OK: %s" % ", ".join(rules))
 EOF
-  # 3) One-parser aggregation: all three analyzers' --json reports into
+  # 3) One-parser aggregation: all four analyzers' --json reports into
   # a single per-run artifact, asserting the shared report shape
   # (tool/ok/findings/counts/baselined; findings path/line/rule/message)
   # so a downstream consumer can keep using ONE parser for every gate.
@@ -263,11 +282,19 @@ from incubator_mxnet_tpu import telemetry; \
 open('$HL_DIR/metrics.prom', 'w').write(telemetry.export_text())"
   python tools/promcheck.py "$HL_DIR/metrics.prom" --json \
       > "$HL_DIR/promcheck.json"
+  # the hlodiff report: a byte-identical self-diff of the real traced
+  # artifacts — the acceptance contract's "redeploy of identical bytes
+  # diffs EMPTY" — doubles as the 4th one-parser report
+  JAX_PLATFORMS=cpu python -m tools.hlodiff "$HL_DIR/cache" \
+      --base "$HL_DIR/cache" --no-baseline --json \
+      > "$HL_DIR/hlodiff.json" \
+    || { echo "hlodiff self-diff must be empty"; exit 1; }
   python - "$HL_DIR" <<'EOF'
 import json, os, sys
 hl_dir = sys.argv[1]
 reports = [json.load(open(os.path.join(hl_dir, n)))
-           for n in ("mxtpulint.json", "promcheck.json", "hlolint.json")]
+           for n in ("mxtpulint.json", "promcheck.json", "hlolint.json",
+                     "hlodiff.json")]
 keys = {"tool", "ok", "findings", "counts", "baselined"}
 f_keys = {"path", "line", "rule", "message"}
 for rep in reports:
@@ -287,6 +314,92 @@ EOF
   hl_dt=$(( SECONDS - hl_t0 ))
   echo "hlolint stage wall time: ${hl_dt}s (budget 120s)"
   [ "$hl_dt" -lt 120 ] || { echo "hlolint stage took ${hl_dt}s (budget 120s)"; exit 1; }
+fi
+
+if has_stage hlodiff; then
+  echo "=== hlodiff: differential artifact gate (regression vs last-known-good) ==="
+  hd_t0=$SECONDS
+  HD_DIR=$(mktemp -d -t mxtpu_hlodiff.XXXXXX)
+  # 1) Seeded regression pairs (tools/hlolint/canary.py
+  # write_diff_canaries): each candidate diffed against its base must
+  # fire EXACTLY its rule — anything else (more, fewer, different)
+  # hard-fails; and every pair self-diffed must be empty.
+  JAX_PLATFORMS=cpu python - "$HD_DIR" <<'EOF'
+import json, os, subprocess, sys
+hd_dir = sys.argv[1]
+from tools.hlolint.canary import write_diff_canaries
+
+def diff(cand, base):
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.hlodiff", cand, "--base", base,
+         "--no-baseline", "--json"],
+        capture_output=True, text=True, timeout=300)
+    return r.returncode, json.loads(r.stdout)
+
+pairs = write_diff_canaries(os.path.join(hd_dir, "pairs"))
+assert set(pairs) == {"flops", "donation", "widened", "collective",
+                      "ladder"}, sorted(pairs)
+for name, (base_dir, cand_dir, expected) in sorted(pairs.items()):
+    rc, rep = diff(cand_dir, base_dir)
+    rules = {f["rule"] for f in rep["findings"]}
+    assert rc == 1 and rules == expected, (name, rc, sorted(rules))
+    rc, rep = diff(cand_dir, cand_dir)     # byte-identical: empty diff
+    assert rc == 0 and rep["ok"] and rep["findings"] == [], (name, rep)
+print("hlodiff seeded-regression canaries OK: 5 pairs, exact rules, "
+      "self-diffs empty")
+EOF
+  # 2) Deploy gate end-to-end: a donation-dropped v2 hot reload is
+  # REFUSED with degraded reason hlodiff:D003 while v1 keeps serving
+  # (zero client-visible errors), and a byte-identical redeploy cuts
+  # over clean with an empty diff.
+  JAX_PLATFORMS=cpu MXTPU_AOT_CACHE_DIR="$HD_DIR/cache" python - <<'EOF'
+import threading
+import numpy as onp
+from incubator_mxnet_tpu.serving import ModelRegistry
+from tests.test_hlodiff import _ServeServable, _light
+
+reg = ModelRegistry()
+reg.load("ci", _ServeServable("ci-hlodiff-v1", _light, donate=(0,)),
+         warm_spec=[((4, 4), "float32")], max_batch_size=2,
+         batch_timeout_ms=1.0)
+errs, stop = [], threading.Event()
+def client():
+    while not stop.is_set():
+        try:
+            out = reg.predict("ci", onp.ones((4, 4), "float32"),
+                              timeout=30)
+            assert float(out[0][0][0]) == 2.0
+        except Exception as e:
+            errs.append(e)
+            return
+threads = [threading.Thread(target=client) for _ in range(4)]
+for t in threads: t.start()
+try:
+    reg.load("ci", _ServeServable("ci-hlodiff-v2", _light),
+             warm_spec=[((4, 4), "float32")])
+finally:
+    stop.set()
+    for t in threads: t.join(30)
+assert not errs, errs
+desc = [m for m in reg.models() if m["name"] == "ci"][0]
+assert desc["current_version"] == 1, desc
+assert desc["degraded"] and "hlodiff:D003" in desc["degraded"], desc
+out = reg.predict("ci", onp.ones((4, 4), "float32"), timeout=30)
+assert float(out[0][0][0]) == 2.0
+# byte-identical redeploy: all cache hits, empty diff, clean cutover
+v3 = reg.load("ci", _ServeServable("ci-hlodiff-v1", _light,
+                                   donate=(0,)),
+              warm_spec=[((4, 4), "float32")])
+desc = [m for m in reg.models() if m["name"] == "ci"][0]
+assert desc["current_version"] == v3 and desc["degraded"] is None, desc
+reg.close()
+print("hlodiff deploy gate OK: regressed reload refused "
+      "(hlodiff:D003), v1 served 0-error throughout, byte-identical "
+      "redeploy cut over clean")
+EOF
+  hd_dt=$(( SECONDS - hd_t0 ))
+  echo "hlodiff stage wall time: ${hd_dt}s (budget 120s)"
+  [ "$hd_dt" -lt 120 ] || { echo "hlodiff stage took ${hd_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage native; then
@@ -401,6 +514,12 @@ with ServingServer(reg, port=0) as srv:
         legacy = json.loads(r.read())
 types = promcheck.validate(text)
 assert not promcheck.validate_metadata(text), promcheck.validate_metadata(text)
+# P003 naming conventions over the LIVE scrape: counters end _total,
+# lowercase names, base units — only the grandfathered _ms histograms
+# (promcheck.P003_EXEMPT) get a pass, and the exempt list must not
+# have silently grown past its three known names
+assert not promcheck.validate_names(text), promcheck.validate_names(text)
+assert len(promcheck.P003_EXEMPT) == 3, sorted(promcheck.P003_EXEMPT)
 assert types["mxtpu_serving_requests_total"] == "counter", types
 assert types["mxtpu_serving_batch_size"] == "histogram", types
 assert 'mxtpu_serving_ok_total{model="ci"} 16' in text

@@ -70,7 +70,8 @@ from .telemetry import devstats, faultlab, spans
 
 __all__ = ["CacheKey", "cache_key", "AOTCache", "CACHE", "compile_cached",
            "model_id_for", "input_signature", "mesh_sig", "artifact_path",
-           "ARTIFACT_MAGIC", "FORMAT_VERSION", "collect_inserts"]
+           "ARTIFACT_MAGIC", "FORMAT_VERSION", "collect_inserts",
+           "ProgramFactsRef", "program_digest", "facts_for_key"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -641,3 +642,68 @@ def _write_artifact(key, exported, stats=None):
     except Exception:
         _LOG.debug("aot artifact write failed for %s", path, exc_info=True)
         return None
+
+
+# --------------------------------------------------------------------------
+# Per-program fact digests (the hlodiff contract)
+#
+# ``program_digest`` is the stable identity of one artifact's BYTES (magic
+# + header + payload): two byte-identical deploys share it, so the
+# differential analyzer (tools/hlodiff) can prove "empty diff" without
+# walking either module. ``facts_for_key`` resolves a cache key to the
+# persisted artifact's header facts + digest WITHOUT deserializing the
+# payload — the differ and any future planner cost model read device
+# truth from here instead of re-deriving the header parsing.
+
+#: (path, digest, stats): one persisted program's identity + header
+#: device truth. ``digest`` is program_digest of the file bytes; ``stats``
+#: is the v2 header dict ({flops, bytes_accessed, peak_bytes,
+#: output_bytes}) or None for statless artifacts.
+ProgramFactsRef = namedtuple("ProgramFactsRef", ("path", "digest", "stats"))
+
+_FACTS_MEMO = {}                  # path -> (mtime_ns, size, ProgramFactsRef)
+_FACTS_MEMO_LOCK = threading.Lock()
+_FACTS_MEMO_MAX = 512
+
+
+def program_digest(buf):
+    """Stable digest of one artifact's full bytes — the same 32-hex-char
+    width as the cache-key digest in the filename, but content-addressed:
+    it changes iff the deployed bytes change."""
+    return hashlib.sha256(bytes(buf)).hexdigest()[:32]
+
+
+def facts_for_key(key, cache_dir=None):
+    """Header facts for the persisted artifact of ``key`` ->
+    ``ProgramFactsRef(path, digest, stats)``, or None when the key has no
+    readable artifact (train kind, disabled layer, missing/corrupt file).
+    Reads magic + header only — never the jax.export payload — and memos
+    per (path, mtime, size), so a gate that re-checks the routed
+    version's facts on every deploy costs one ``stat()``."""
+    path = artifact_path(key, cache_dir)
+    if path is None:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    with _FACTS_MEMO_LOCK:
+        memo = _FACTS_MEMO.get(path)
+        if memo is not None and memo[0] == st.st_mtime_ns \
+                and memo[1] == st.st_size:
+            return memo[2]
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+        if not buf.startswith(ARTIFACT_MAGIC):
+            return None
+        stats, _off = _unpack_header(buf[len(ARTIFACT_MAGIC):])
+    except Exception:
+        _LOG.debug("aot facts_for_key failed for %s", path, exc_info=True)
+        return None
+    ref = ProgramFactsRef(path, program_digest(buf), stats)
+    with _FACTS_MEMO_LOCK:
+        if len(_FACTS_MEMO) >= _FACTS_MEMO_MAX:
+            _FACTS_MEMO.clear()
+        _FACTS_MEMO[path] = (st.st_mtime_ns, st.st_size, ref)
+    return ref

@@ -312,6 +312,32 @@ ENV_VARS = {
         "devstats per-device-kind capacity table "
         "(telemetry/devstats.py hbm_capacity()); backends the table "
         "doesn't know (CPU) skip H004 entirely."),
+    "MXTPU_HLODIFF_GATE": (
+        bool, True,
+        "Diff freshly prewarmed AOT artifacts against the currently "
+        "ROUTED version's programs (tools/hlodiff D-rules, matched per "
+        "(kind, bucket, mesh_sig)) inside ModelRegistry.load()'s warm "
+        "path, AFTER the hlolint pass: error-severity findings (D001 "
+        "FLOPs growth / D003 donation regression on serve-/decode-kind "
+        "programs) refuse the cutover with degraded reason "
+        "hlodiff:<rule> and ride the last-known-good rollback; warns "
+        "land in flightrec + mxtpu_hlodiff_findings_total{rule}. First "
+        "loads (no routed reference) and byte-identical redeploys "
+        "(cache hit, nothing fresh) skip the diff "
+        "(docs/STATIC_ANALYSIS.md)."),
+    "MXTPU_HLODIFF_FLOPS_TOL": (
+        float, 0.1,
+        "hlodiff D001 tolerance: flag a candidate program whose header "
+        "FLOPs (cost_analysis, persisted at export) exceed its base "
+        "program's by more than this fraction (0.1 = +10%). On "
+        "serve-/decode-kind artifacts the finding is error severity and "
+        "the deploy gate refuses the cutover."),
+    "MXTPU_HLODIFF_PEAK_TOL": (
+        float, 0.1,
+        "hlodiff D002 tolerance: flag a candidate program whose header "
+        "peak_bytes (memory_analysis) exceed its base program's by more "
+        "than this fraction (0.1 = +10%) — predicted HBM headroom "
+        "shrinking deploy over deploy ends in H004/OOM; warn severity."),
     "MXTPU_HLOLINT_PAD_WASTE": (
         float, 0.5,
         "hlolint H005 threshold: flag a compiled shape bucket whose "

@@ -837,9 +837,9 @@ class GenerativeEngine:
             # reviewed sync point: one host transfer for the whole step's
             # sampled tokens (plus the fused per-row logit-health bools),
             # inside the step span so the span measures true step
-            # latency  # mxtpulint: disable=R001
+            # latency
             next_t = onp.asarray(next_t)
-            finite = onp.asarray(row_finite)  # mxtpulint: disable=R001
+            finite = onp.asarray(row_finite)
         # feed the sentinel the step's finite fraction over LIVE rows
         # (note() applies the nonfinite counter + nan_storm hysteresis
         # and never raises; padding rows carry zero activations and

@@ -99,7 +99,13 @@ class _ModelEntry:
         self._replica_aware = {}        # version -> predict_batch(replica=)?
         self._warming = 0               # active prewarm threads (describe)
         self._warm_target = None        # only THIS version may repoint()
-        self._degraded = None           # hlolint refusal reason (describe)
+        self._degraded = None           # hlolint/hlodiff refusal (describe)
+        # version -> the hlolint Programs its warm parsed, retained as
+        # the DIFF BASE for the next deploy's hlodiff gate (a candidate
+        # regresses relative to what is routed, so the routed version's
+        # parsed programs must outlive its warm). A byte-identical
+        # redeploy warms nothing fresh and inherits its base's programs.
+        self._version_programs = {}
         # last-known-good rollback state (docs/RESILIENCE.md): versions a
         # degraded flip quarantined (they may never auto-return to
         # dispatch) + sticky provenance of the latest rollback
@@ -287,9 +293,20 @@ class _ModelEntry:
         with self._lock:
             self._warming += 1
         warmed_programs = []
+        # the hlodiff base: the version traffic is routed to as this warm
+        # begins (its own warm retained its parsed programs). Captured
+        # once up front — the first bucket's early cutover repoints
+        # current_version at the INCOMING version mid-warm, and later
+        # buckets must still diff against the outgoing one.
+        with self._lock:
+            _cur = self.current_version
+            base_programs = (self._version_programs.get(_cur)
+                             if _cur is not None and _cur != version
+                             else None)
         try:
             for b in sorted(set(self.batcher.buckets)):
                 fresh = []
+                n0 = len(warmed_programs)
                 try:
                     # faultlab site "registry.load" (warm stage): an
                     # injected exception exercises the partial-warm
@@ -331,6 +348,10 @@ class _ModelEntry:
                     if not self._hlolint_gate(version, fresh,
                                               warmed_programs):
                         return
+                    if not self._hlodiff_gate(version, fresh,
+                                              warmed_programs[n0:],
+                                              base_programs):
+                        return
                     break
                 # hlolint load gate: the bucket's freshly compiled/loaded
                 # artifacts are linted BEFORE dispatch is repointed at
@@ -342,8 +363,24 @@ class _ModelEntry:
                 # buckets — _hlolint_gate logs which case happened.
                 if not self._hlolint_gate(version, fresh, warmed_programs):
                     return
+                # the differential gate runs strictly AFTER the absolute
+                # one: a program must first be valid in isolation, then
+                # no worse than the version it replaces (gate ordering,
+                # docs/STATIC_ANALYSIS.md)
+                if not self._hlodiff_gate(version, fresh,
+                                          warmed_programs[n0:],
+                                          base_programs):
+                    return
                 self.repoint(version)
             self._hlolint_cross(warmed_programs)
+            self._hlodiff_ladder(warmed_programs, base_programs)
+            with self._lock:
+                if version in self.versions:
+                    # retain this warm's programs as the next deploy's
+                    # diff base; a byte-identical redeploy (all cache
+                    # hits: nothing fresh parsed) inherits its own base
+                    self._version_programs[version] = (
+                        list(warmed_programs) or list(base_programs or []))
         finally:
             self.repoint(version)
             with self._lock:
@@ -389,10 +426,94 @@ class _ModelEntry:
             return True
         reason = "; ".join("%s %s: %s" % (f.rule, f.path, f.message)
                            for f in errors[:3])
-        # evict the refused executables from the process-wide cache: a
-        # retried load must recompile (or re-load the artifact), which
-        # re-inserts and therefore re-gates — a warm cache HIT collects
-        # nothing and would cut the refused program over ungated
+        self._refuse_load(version, entries, "hlolint",
+                          "load refused by hlolint: %s" % reason,
+                          reason, len(errors))
+        return False
+
+    def _hlodiff_gate(self, version, entries, cand_programs,
+                      base_programs):
+        """The DIFFERENTIAL deploy gate (tools/hlodiff): the bucket's
+        freshly warmed programs diff against the programs of the version
+        traffic was routed to when the warm began — runs strictly after
+        the absolute hlolint pass, so only programs already valid in
+        isolation reach it. Error-severity D-findings (D001 FLOPs
+        growth / D003 donation regression on the serve-/decode-kind
+        path) refuse the cutover exactly like an hlolint refusal — the
+        degraded reason is ``load refused by hlodiff:<rule>: ...`` and
+        dispatch rides the same last-known-good rollback. Warn findings
+        publish to flightrec + mxtpu_hlodiff_findings_total and never
+        block. Skips when there is no base (first load, tools-less
+        install, MXTPU_HLODIFF_GATE off) and fails OPEN loudly on any
+        gate-infrastructure error — same contract as _hlolint_gate.
+
+        Runs PAIR rules only: the cross-program set rules (D006 bucket
+        ladder) need the complete candidate set, and mid-warm this
+        bucket's programs are necessarily a partial ladder that would
+        false-fire "lost bucket" against the base on every multi-bucket
+        deploy — _hlodiff_ladder covers them once after the loop."""
+        if not entries or not cand_programs or not base_programs:
+            return True
+        try:
+            if not config.get_env("MXTPU_HLODIFF_GATE"):
+                return True
+            from tools.hlodiff import gate as dgate
+            from tools.hlodiff.rules import RULES as _pair_rules
+        except ImportError:
+            return True         # tools-less install: no gate to run
+        try:
+            errors, warns = dgate.diff_programs(
+                base_programs, cand_programs,
+                only_rules=frozenset(_pair_rules))
+            dgate.publish(errors + warns, model=self.name)
+        except Exception:
+            _LOG.warning("hlodiff gate failed open for model %r — the "
+                         "deploy is cutting over UNDIFFED",
+                         self.name, exc_info=True)
+            return True
+        if not errors:
+            return True
+        reason = "; ".join("%s %s: %s" % (f.rule, f.path, f.message)
+                           for f in errors[:3])
+        self._refuse_load(version, entries, "hlodiff",
+                          "load refused by hlodiff:%s: %s"
+                          % (errors[0].rule, reason),
+                          reason, len(errors))
+        return False
+
+    def _hlodiff_ladder(self, warmed_programs, base_programs):
+        """The cross-program D-rules (D006 bucket-ladder change) over
+        the FULL warmed set, after every bucket gated and repointed —
+        the per-bucket differential gate excludes them because a
+        mid-warm candidate ladder is always partial. Warn severity by
+        construction: publishes to flightrec + the findings counter,
+        never refuses (the version is already serving its buckets)."""
+        if not warmed_programs or not base_programs:
+            return
+        try:
+            if not config.get_env("MXTPU_HLODIFF_GATE"):
+                return
+            from tools.hlodiff import gate as dgate
+            from tools.hlodiff.rules import SET_RULES as _set_rules
+            errors, warns = dgate.diff_programs(
+                base_programs, warmed_programs,
+                only_rules=frozenset(_set_rules))
+            dgate.publish(errors + warns, model=self.name)
+        except Exception:
+            _LOG.debug("hlodiff ladder pass failed open",
+                       exc_info=True)
+
+    def _refuse_load(self, version, entries, tool, degraded_reason,
+                     reason, n_errors):
+        """Shared refusal mechanics for the load gates: evict the
+        refused executables from the process-wide AOT cache (a retried
+        load must recompile/re-load, which re-inserts and therefore
+        re-gates — a warm cache HIT collects nothing and would cut the
+        refused program over ungated), unroute and drop ``version`` with
+        a loud sticky degraded reason, and when the version was already
+        current repoint dispatch at the last known good with the same
+        rollback provenance the degraded-flip path records (the degraded
+        reason stays — the refused DEPLOY still needs the operator)."""
         from .. import aot
         for entry in entries:
             try:
@@ -406,30 +527,26 @@ class _ModelEntry:
             self._replica_aware.pop(version, None)
             self._inflight.pop(version, None)
             self._quarantined.discard(version)
-            self._degraded = "load refused by hlolint: %s" % reason
+            self._version_programs.pop(version, None)
+            self._degraded = degraded_reason
             if was_current:
                 self.current_version = (max(self.versions)
                                         if self.versions else None)
                 if self.current_version is not None:
-                    # the refusal's built-in last-known-good repoint: the
-                    # same sticky provenance the degraded-flip rollback
-                    # records (the degraded reason stays — the refused
-                    # DEPLOY still needs the operator)
                     self.rollback_info = {
                         "from_version": version,
                         "to_version": self.current_version,
-                        "reason": "load refused by hlolint: %s" % reason}
+                        "reason": degraded_reason}
         _LOG.error(
-            "model %r v%s REFUSED by hlolint (%d error finding(s)) — %s: "
-            "%s",
-            self.name, version, len(errors),
+            "model %r v%s REFUSED by %s (%d error finding(s)) — %s: %s",
+            self.name, version, tool, n_errors,
             "dispatch ROLLED BACK (the version was already current — a "
             "first load, or earlier buckets cut over — while warming "
             "continued)"
             if was_current else "dispatch was NOT cut over",
             reason)
         try:
-            flightrec.record("hlolint_refused", model=self.name,
+            flightrec.record("%s_refused" % tool, model=self.name,
                              version=version, reason=reason,
                              rolled_back=was_current)
             if was_current and self.rollback_info is not None \
@@ -437,11 +554,10 @@ class _ModelEntry:
                 flightrec.record("rolled_back_to", model=self.name,
                                  from_version=version,
                                  to_version=self.rollback_info["to_version"],
-                                 reason="hlolint refusal")
+                                 reason="%s refusal" % tool)
         except Exception:
-            _LOG.debug("hlolint_refused flightrec record dropped",
+            _LOG.debug("%s_refused flightrec record dropped", tool,
                        exc_info=True)
-        return False
 
     def _hlolint_cross(self, programs):
         """The cross-program pass (H005 needs the whole bucket ladder) —
@@ -496,6 +612,7 @@ class _ModelEntry:
             self.versions.pop(version, None)
             self._inflight.pop(version, None)
             self._replica_aware.pop(version, None)
+            self._version_programs.pop(version, None)
             # install()'s max()+1 can reuse a dropped number: a stale
             # quarantine entry must not poison the future deploy
             self._quarantined.discard(version)

@@ -387,7 +387,7 @@ def _shadow_compare(model, stacked, primary):
     # reviewed sync point: the shadow worker thread owns this transfer —
     # it is off the serving hot path by construction
     p = onp.asarray(primary[0], dtype=onp.float64)
-    r = onp.asarray(  # mxtpulint: disable=R001
+    r = onp.asarray(
         _leaf_data(ref_outs[0]), dtype=onp.float64)
     if p.shape != r.shape:
         raise ValueError("shadow output shape %s != primary %s"

@@ -58,8 +58,19 @@ _LOG = logging.getLogger(__name__)
 
 SCHEMA = "mxtpu-profstats-summary-v1"
 
+# custom_call target markers the profiler/annotation layer may leave in
+# an EXPORTED module (trace annotations, capture markers, named-scope
+# host hints — e.g. a program traced under an active jax.profiler
+# capture). These are pure metadata: the device never blocks on the
+# host for them, so tools/hlolint's H003 host-round-trip rule exempts
+# any custom_call target containing one of these substrings (imported
+# there as the single source of truth — extend HERE when the profiler
+# grows a new marker, never by loosening the H003 host regex).
+ANNOTATION_TARGET_MARKERS = ("profiler", "annotation", "named_scope")
+
 __all__ = [
-    "SCHEMA", "categorize", "load_trace", "iter_trace_files",
+    "SCHEMA", "ANNOTATION_TARGET_MARKERS",
+    "categorize", "load_trace", "iter_trace_files",
     "summarize_events", "summarize_capture", "summarize_trace",
     "format_table", "capture_and_summarize", "remember", "get_summary",
     "brief",

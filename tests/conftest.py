@@ -18,6 +18,13 @@ import numpy as onp
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: repo-wide analysis passes excluded from the tier-1 run "
+        "(the default invocation is -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import incubator_mxnet_tpu as mx

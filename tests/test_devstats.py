@@ -393,6 +393,6 @@ def test_promcheck_p002_flags_metadata_defects():
         rep = promcheck.report(text)
         assert not rep["ok"]
         assert any(f["rule"] == "P002" for f in rep["findings"])
-    good = "# HELP a doc\n# TYPE a counter\na 1\n"
+    good = "# HELP a_total doc\n# TYPE a_total counter\na_total 1\n"
     assert promcheck.validate_metadata(good) == []
     assert promcheck.report(good)["ok"]

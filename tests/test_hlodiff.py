@@ -1,0 +1,642 @@
+"""hlodiff tier: D-rule positive/negative fixtures on text-built
+program pairs, the (kind, bucket, mesh_sig) pairing with struct-key
+tie-breaks, the CLI contract (exit codes, --base file/dir, --rules,
+baseline round-trip, the shared CI JSON shape), the seeded regression
+canary pairs firing exactly their rule, fresh-subprocess CLI diff vs
+the in-process registry-gate diff byte-identity, and the deploy gate
+end-to-end: a FLOPs-regressed / donation-dropped candidate is refused
+at hot reload with degraded reason ``hlodiff:<rule>`` while the prior
+version keeps serving zero-error under concurrent clients, and a
+byte-identical redeploy produces an empty diff and cuts over clean."""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import hlodiff                                        # noqa: E402
+from tools.hlodiff import facts as dfacts                        # noqa: E402
+from tools.hlodiff import gate as dgate                          # noqa: E402
+from tools.hlolint import program_from_text                      # noqa: E402
+from tools.hlolint import canary as hlolint_canary               # noqa: E402
+
+
+def mk(kind, body_lines, args='%arg0: tensor<8x4xf32> '
+                              'loc("input_datas[0]")',
+       stats=None, path=None, digest=None):
+    text = "module @jit_f {\n  func.func public @main(%s) " \
+           "-> tensor<8x4xf32> {\n%s\n  }\n}\n" % (
+               args, "\n".join("    " + l for l in body_lines))
+    prog = program_from_text(
+        path or ("jax-0/%s-cafe.mxtpu-aot" % kind), kind, text, stats)
+    prog.digest = digest
+    return prog
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+MUL = "%0 = stablehlo.multiply %arg0, %arg0 : (tensor<8x4xf32>, " \
+      "tensor<8x4xf32>) -> tensor<8x4xf32>"
+
+
+# ------------------------------------------------------------------ D001
+def test_d001_flops_growth_fires_and_escalates():
+    base = mk("serve", [MUL], stats={"flops": 100.0},
+              path="jax-0/serve-aaaa.mxtpu-aot")
+    cand = mk("serve", [MUL], stats={"flops": 200.0},
+              path="jax-0/serve-bbbb.mxtpu-aot")
+    out = hlodiff.diff_programs([base], [cand])
+    assert rules_of(out) == ["D001"]
+    assert out[0].path == cand.path
+    assert hlodiff.severity_of("D001", cand.path) == "error"
+    assert hlodiff.severity_of("D001", "jax-0/eval-x.mxtpu-aot") == "warn"
+    assert hlodiff.severity_of("D001") == "warn"     # path-less query
+
+
+def test_d001_within_tolerance_and_missing_stats_clean(monkeypatch):
+    base = mk("serve", [MUL], stats={"flops": 100.0})
+    ok = mk("serve", [MUL], stats={"flops": 105.0},
+            path="jax-0/serve-bbbb.mxtpu-aot")
+    assert hlodiff.diff_programs([base], [ok]) == []
+    nostats = mk("serve", [MUL], path="jax-0/serve-cccc.mxtpu-aot")
+    assert hlodiff.diff_programs([base], [nostats]) == []
+    assert hlodiff.diff_programs([nostats], [base]) == []
+    # the tolerance is env-driven
+    monkeypatch.setenv("MXTPU_HLODIFF_FLOPS_TOL", "1.5")
+    big = mk("serve", [MUL], stats={"flops": 240.0},
+             path="jax-0/serve-dddd.mxtpu-aot")
+    assert hlodiff.diff_programs([base], [big]) == []
+    monkeypatch.setenv("MXTPU_HLODIFF_FLOPS_TOL", "0.1")
+    assert rules_of(hlodiff.diff_programs([base], [big])) == ["D001"]
+
+
+# ------------------------------------------------------------------ D002
+def test_d002_peak_bytes_growth():
+    base = mk("eval", [MUL], stats={"peak_bytes": 1000.0})
+    cand = mk("eval", [MUL], stats={"peak_bytes": 1500.0},
+              path="jax-0/eval-beef.mxtpu-aot")
+    out = hlodiff.diff_programs([base], [cand])
+    assert rules_of(out) == ["D002"]
+    assert hlodiff.severity_of("D002", cand.path) == "warn"
+    ok = mk("eval", [MUL], stats={"peak_bytes": 1050.0},
+            path="jax-0/eval-feed.mxtpu-aot")
+    assert hlodiff.diff_programs([base], [ok]) == []
+
+
+# ------------------------------------------------------------------ D003
+DONATED = '%arg0: tensor<8x4xf32> {tf.aliasing_output = 0 : i32} ' \
+          'loc("input_datas[0]")'
+
+
+def test_d003_donation_regression_fires_and_escalates():
+    base = mk("serve", [MUL], args=DONATED)
+    cand = mk("serve", [MUL], path="jax-0/serve-bbbb.mxtpu-aot")
+    out = hlodiff.diff_programs([base], [cand])
+    assert rules_of(out) == ["D003"]
+    assert "input_datas[0]" in out[0].message
+    assert hlodiff.severity_of("D003", out[0].path) == "error"
+
+
+def test_d003_gained_donation_is_not_a_regression():
+    base = mk("serve", [MUL])
+    cand = mk("serve", [MUL], args=DONATED,
+              path="jax-0/serve-bbbb.mxtpu-aot")
+    assert hlodiff.diff_programs([base], [cand]) == []
+
+
+# ------------------------------------------------------------------ D004
+def test_d004_dtype_widening_fires():
+    b16 = "%0 = stablehlo.dot_general %arg0, %arg0 : (tensor<8x4xbf16>, " \
+          "tensor<8x4xbf16>) -> tensor<8x4xbf16>"
+    f32 = "%0 = stablehlo.dot_general %arg0, %arg0 : (tensor<8x4xf32>, " \
+          "tensor<8x4xf32>) -> tensor<8x4xf32>"
+    base = mk("eval", [b16], args='%arg0: tensor<8x4xbf16> '
+                                  'loc("input_datas[0]")')
+    cand = mk("eval", [f32], path="jax-0/eval-beef.mxtpu-aot")
+    out = hlodiff.diff_programs([base], [cand])
+    assert "D004" in rules_of(out)
+    msg = [f for f in out if f.rule == "D004"][0].message
+    assert "bf16" in msg and "f32" in msg
+    # narrowing (the other direction) is clean
+    assert not any(f.rule == "D004"
+                   for f in hlodiff.diff_programs([cand], [base]))
+
+
+def test_d004_int8_to_fp_notes_kernel_rate():
+    i8 = "%0 = stablehlo.dot_general %arg0, %arg0 : (tensor<8x4xi8>, " \
+         "tensor<8x4xi8>) -> tensor<8x4xi8>"
+    f32 = "%0 = stablehlo.dot_general %arg0, %arg0 : (tensor<8x4xf32>, " \
+          "tensor<8x4xf32>) -> tensor<8x4xf32>"
+    base = mk("eval", [i8], args='%arg0: tensor<8x4xi8> '
+                                 'loc("input_datas[0]")')
+    cand = mk("eval", [f32], path="jax-0/eval-beef.mxtpu-aot")
+    out = [f for f in hlodiff.diff_programs([base], [cand])
+           if f.rule == "D004"]
+    assert out and "int8" in out[0].message
+
+
+def test_d004_op_missing_on_one_side_is_skipped():
+    add = "%0 = stablehlo.add %arg0, %arg0 : (tensor<8x4xf32>, " \
+          "tensor<8x4xf32>) -> tensor<8x4xf32>"
+    dot = "%0 = stablehlo.dot_general %arg0, %arg0 : (tensor<8x4xf64>, " \
+          "tensor<8x4xf64>) -> tensor<8x4xf64>"
+    base = mk("eval", [add])
+    cand = mk("eval", [dot], path="jax-0/eval-beef.mxtpu-aot")
+    assert not any(f.rule == "D004"
+                   for f in hlodiff.diff_programs([base], [cand]))
+
+
+# ------------------------------------------------------------------ D005
+GATHER = '%1 = "stablehlo.all_gather"(%arg0) : (tensor<8x4xf32>) ' \
+         '-> tensor<8x4xf32>'
+
+
+def test_d005_gained_collective_fires_on_sharded_only():
+    base = mk("serve", [MUL])
+    cand = mk("serve", [GATHER, MUL],
+              path="jax-0/serve-bbbb.mxtpu-aot")
+    out = hlodiff.diff_programs([base], [cand])
+    assert rules_of(out) == ["D005"]
+    assert "all_gather" in out[0].message
+    # neither side sharded (no collectives, no sharding attrs): D005
+    # has nothing to compare
+    plain = mk("serve", ["%0 = stablehlo.add %arg0, %arg0 : "
+                         "(tensor<8x4xf32>, tensor<8x4xf32>) -> "
+                         "tensor<8x4xf32>"],
+               path="jax-0/serve-cccc.mxtpu-aot")
+    assert hlodiff.diff_programs([base], [plain]) == []
+
+
+def test_d005_lost_collective_and_reshard_thrash():
+    lost = hlodiff.diff_programs(
+        [mk("serve", [GATHER, MUL])],
+        [mk("serve", [MUL], path="jax-0/serve-bbbb.mxtpu-aot")])
+    assert rules_of(lost) == ["D005"] and "lost" in lost[0].message
+    thrash_cand = mk(
+        "serve",
+        [GATHER,
+         '%2 = "stablehlo.reduce_scatter"(%1) : (tensor<8x4xf32>) '
+         '-> tensor<8x4xf32>'],
+        path="jax-0/serve-bbbb.mxtpu-aot")
+    out = hlodiff.diff_programs([mk("serve", [GATHER])], [thrash_cand])
+    assert any("reshard thrash" in f.message for f in out)
+
+
+def test_d005_sharding_attrs_make_a_program_sharded():
+    sharded_args = ('%arg0: tensor<8x4xf32> {mhlo.sharding = '
+                    '"{devices=[2,1]<=[2]}"} loc("input_datas[0]")')
+    base = mk("serve", [MUL], args=sharded_args)
+    df = dfacts.DiffFacts(base)
+    assert df.sharded and df.mesh_sig == 2
+    plain = dfacts.DiffFacts(mk("serve", [MUL]))
+    assert not plain.sharded and plain.mesh_sig == 1
+
+
+# ------------------------------------------------------------------ D006
+def test_d006_ladder_change_fires():
+    def at(bucket, path, body=MUL):
+        body = body.replace("8x4", "%dx4" % bucket)
+        return mk("eval", [body],
+                  args='%%arg0: tensor<%dx4xf32> loc("input_datas[0]")'
+                       % bucket, path=path)
+    base = [at(8, "jax-0/eval-b8.mxtpu-aot"),
+            at(16, "jax-0/eval-b16.mxtpu-aot")]
+    cand = [at(8, "jax-0/eval-c8.mxtpu-aot")]
+    out = hlodiff.diff_programs(base, cand)
+    assert rules_of(out) == ["D006"]
+    assert "lost bucket(s) [16]" in out[0].message
+    # same ladder: clean; single-sided sets: nothing to compare
+    assert hlodiff.diff_programs(base, [
+        at(8, "jax-0/eval-c8.mxtpu-aot"),
+        at(16, "jax-0/eval-c16.mxtpu-aot")]) == []
+    assert hlodiff.diff_programs([], cand) == []
+
+
+# --------------------------------------------------------------- pairing
+def test_pairing_by_key_and_struct_tiebreak():
+    b_serve = mk("serve", [MUL], path="jax-0/serve-a.mxtpu-aot")
+    b_eval = mk("eval", [MUL], path="jax-0/eval-a.mxtpu-aot")
+    c_serve = mk("serve", [MUL], path="jax-0/serve-b.mxtpu-aot")
+    pairs, ub, uc = hlodiff.pair_programs([b_serve, b_eval], [c_serve])
+    assert len(pairs) == 1
+    assert pairs[0][0].path == b_serve.path
+    assert [d.path for d in ub] == [b_eval.path]
+    assert uc == []
+    # two same-key bases: the struct-identical one wins the tie even
+    # though the other sorts first by path
+    other_struct = mk("serve", [MUL],
+                      args='%arg0: tensor<8x9xf32> loc("input_datas[0]")',
+                      path="jax-0/serve-0.mxtpu-aot")
+    pairs, ub, uc = hlodiff.pair_programs([other_struct, b_serve],
+                                          [c_serve])
+    assert pairs[0][0].path == b_serve.path
+
+
+def test_gate_digest_short_circuit_and_empty_sides():
+    base = mk("serve", [MUL], stats={"flops": 100.0}, digest="d" * 32)
+    cand = mk("serve", [MUL], stats={"flops": 500.0}, digest="d" * 32,
+              path="jax-0/serve-bbbb.mxtpu-aot")
+    # byte-identical digest: the regression in the fabricated stats is
+    # unreachable — the diff short-circuits to empty
+    assert dgate.diff_programs([base], [cand]) == ([], [])
+    fresh = mk("serve", [MUL], stats={"flops": 500.0}, digest="e" * 32,
+               path="jax-0/serve-cccc.mxtpu-aot")
+    errors, warns = dgate.diff_programs([base], [fresh])
+    assert rules_of(errors) == ["D001"] and warns == []
+    assert dgate.diff_programs([], [fresh]) == ([], [])
+    assert dgate.diff_programs([base], []) == ([], [])
+
+
+# ------------------------------------------------------------------- CLI
+def run_cli(*args, env=None):
+    full_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if env:
+        full_env.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.hlodiff"] + list(args),
+        cwd=REPO, env=full_env, capture_output=True, text=True,
+        timeout=300)
+
+
+def test_cli_canary_pairs_fire_exactly_their_rule(tmp_path):
+    """The ci/run.sh hlodiff-stage contract: every seeded regression
+    pair diffs to exactly its one rule, and the baseline round-trip
+    grandfathers it."""
+    pairs = hlolint_canary.write_diff_canaries(str(tmp_path))
+    assert set(pairs) == {"flops", "donation", "widened", "collective",
+                          "ladder"}
+    for name, (base_dir, cand_dir, expected) in sorted(pairs.items()):
+        r = run_cli(cand_dir, "--base", base_dir, "--no-baseline",
+                    "--json")
+        assert r.returncode == 1, (name, r.stdout, r.stderr)
+        rep = json.loads(r.stdout)
+        assert rep["tool"] == "hlodiff" and not rep["ok"]
+        assert {f["rule"] for f in rep["findings"]} == expected, \
+            (name, rep["findings"])
+    # baseline round-trip on one pair
+    base_dir, cand_dir, _ = pairs["donation"]
+    bl = tmp_path / "bl.json"
+    r = run_cli(cand_dir, "--base", base_dir, "--baseline", str(bl),
+                "--update-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = run_cli(cand_dir, "--base", base_dir, "--baseline", str(bl),
+                "--json")
+    assert r.returncode == 0
+    rep = json.loads(r.stdout)
+    assert rep["ok"] and rep["findings"] == [] and rep["baselined"] >= 1
+
+
+def test_cli_self_diff_is_empty(tmp_path):
+    hlolint_canary.write_canary(str(tmp_path / "art"))
+    r = run_cli(str(tmp_path / "art"), "--base", str(tmp_path / "art"),
+                "--no-baseline", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["ok"] and rep["findings"] == []
+
+
+def test_cli_base_can_be_a_single_file(tmp_path):
+    pairs = hlolint_canary.write_diff_canaries(str(tmp_path))
+    base_dir, cand_dir, expected = pairs["donation"]
+    base_files = [os.path.join(dp, f) for dp, _, fs in os.walk(base_dir)
+                  for f in fs]
+    cand_files = [os.path.join(dp, f) for dp, _, fs in os.walk(cand_dir)
+                  for f in fs]
+    assert len(base_files) == 1 and len(cand_files) == 1
+    r = run_cli(cand_files[0], "--base", base_files[0], "--no-baseline",
+                "--json")
+    assert r.returncode == 1
+    assert {f["rule"] for f in json.loads(r.stdout)["findings"]} \
+        == expected
+
+
+def test_cli_rules_filter_and_usage_errors(tmp_path):
+    pairs = hlolint_canary.write_diff_canaries(str(tmp_path))
+    base_dir, cand_dir, _ = pairs["donation"]
+    # --rules narrows: selecting a non-firing rule scans clean
+    r = run_cli(cand_dir, "--base", base_dir, "--no-baseline",
+                "--rules", "D001", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+    r = run_cli(cand_dir, "--base", base_dir, "--no-baseline",
+                "--rules", "D003", "--json")
+    assert r.returncode == 1
+    # usage errors: unknown rule, missing operands, bad combo
+    assert run_cli(cand_dir, "--base", base_dir,
+                   "--rules", "D999").returncode == 2
+    assert run_cli(cand_dir, "--base",
+                   str(tmp_path / "nope")).returncode == 2
+    assert run_cli(str(tmp_path / "nope"), "--base",
+                   base_dir).returncode == 2
+    assert run_cli(cand_dir).returncode == 2            # no --base
+    assert run_cli(cand_dir, "--base", base_dir, "--rules", "D001",
+                   "--update-baseline").returncode == 2
+    env = {k: v for k, v in os.environ.items()
+           if k != "MXTPU_AOT_CACHE_DIR"}
+    r = subprocess.run([sys.executable, "-m", "tools.hlodiff",
+                        "--base", base_dir],
+                       cwd=REPO, env=dict(env, JAX_PLATFORMS="cpu"),
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "candidate" in r.stderr
+
+
+def test_cli_corrupt_side_is_h000_not_a_crash(tmp_path):
+    good = tmp_path / "good" / "jax-0"
+    good.mkdir(parents=True)
+    (good / "serve-feed.mxtpu-aot").write_bytes(b"not an artifact")
+    bad = tmp_path / "bad" / "jax-0"
+    bad.mkdir(parents=True)
+    (bad / "serve-beef.mxtpu-aot").write_bytes(b"also corrupt")
+    r = run_cli(str(tmp_path / "bad"), "--base", str(tmp_path / "good"),
+                "--no-baseline", "--json")
+    assert r.returncode == 1
+    rep = json.loads(r.stdout)
+    assert {f["rule"] for f in rep["findings"]} == {"H000"}
+    assert len(rep["findings"]) == 2            # BOTH sides reported
+
+
+def test_cli_list_rules():
+    r = run_cli("--list-rules")
+    assert r.returncode == 0
+    for rid in ("D001", "D002", "D003", "D004", "D005", "D006"):
+        assert rid in r.stdout
+    assert "cross-program" in r.stdout
+
+
+def test_report_shape_matches_the_other_analyzers():
+    base = mk("serve", [MUL], stats={"flops": 100.0})
+    cand = mk("serve", [MUL], stats={"flops": 500.0},
+              path="jax-0/serve-bbbb.mxtpu-aot")
+    rep = hlodiff.make_report("hlodiff",
+                             hlodiff.diff_programs([base], [cand]))
+    assert set(rep) == {"tool", "ok", "findings", "counts", "baselined"}
+    assert set(rep["findings"][0]) == {"path", "line", "rule", "message"}
+    json.dumps(rep)
+
+
+# --------------------------------------- registry deploy gate end-to-end
+class _ServeServable:
+    """A serve-kind servable exporting ``fn`` through the real AOT
+    artifact layer — the deploy-gate path. ``model_id`` must be unique
+    per test (aot.CACHE is process-wide: a warm cache HIT collects
+    nothing fresh to diff)."""
+
+    def __init__(self, model_id, fn, donate=None):
+        self._model_id = model_id
+        self._fn = fn
+        self._donate = donate
+
+    def predict_batch(self, x):
+        import numpy as onp
+        import jax
+        import jax.numpy as jnp
+        from incubator_mxnet_tpu import aot
+        key = aot.cache_key(self._model_id, aot.input_signature([x]),
+                            kind="serve")
+        specs = [jax.ShapeDtypeStruct(tuple(x.shape), jnp.float32)]
+
+        def build():
+            from jax import export as jax_export
+            jitted = jax.jit(self._fn) if self._donate is None else \
+                jax.jit(self._fn, donate_argnums=self._donate)
+            exported = jax_export.export(jitted)(*specs)
+            return (jax.jit(self._fn).lower(*specs).compile(),
+                    None, exported)
+
+        entry = aot.compile_cached(key, build, exportable=True,
+                                   arg_specs=specs)
+        return (onp.asarray(entry.fn(jnp.asarray(x))),)
+
+
+def _light(a):
+    return a * 2.0
+
+
+def _heavy(a):
+    # the batcher hands (bucket, 4, 4): chained batch matmuls do ~20x
+    # the FLOPs of the base's one elementwise multiply at the same
+    # signature — past the 10% D001 tolerance, and dot_general is
+    # absent in the base so D004's op-site comparison skips it
+    return (a @ a) @ (a @ a)
+
+
+def test_registry_refuses_flops_regressed_hot_reload(tmp_path,
+                                                     monkeypatch):
+    """The acceptance demo: v2 regresses FLOPs past tolerance on the
+    serve path -> the hot reload is REFUSED with degraded reason
+    ``hlodiff:D001``, v1 keeps serving ZERO-ERROR under concurrent
+    clients throughout, and the refusal rode the last-known-good
+    provenance."""
+    import numpy as onp
+    from incubator_mxnet_tpu.serving import ModelRegistry
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", str(tmp_path))
+    before = dgate.findings_total().value(rule="D001")
+    reg = ModelRegistry()
+    try:
+        v1 = reg.load("flm", _ServeServable("hlodiff-d001-v1", _light),
+                      warm_spec=[((4, 4), "float32")], max_batch_size=2,
+                      batch_timeout_ms=1.0)
+        errs, stop = [], threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    out = reg.predict("flm",
+                                      onp.ones((4, 4), "float32"),
+                                      timeout=30)
+                    assert float(out[0][0][0]) == 2.0   # always v1 math
+                except Exception as e:                  # pragma: no cover
+                    errs.append(e)
+                    return
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            reg.load("flm", _ServeServable("hlodiff-d001-v2", _heavy),
+                     warm_spec=[((4, 4), "float32")])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30)
+        assert not errs, errs
+        desc = [m for m in reg.models() if m["name"] == "flm"][0]
+        assert desc["current_version"] == v1
+        assert desc["degraded"] and "hlodiff:D001" in desc["degraded"]
+        assert reg.health()["status"] == "degraded"
+        assert dgate.findings_total().value(rule="D001") > before
+        out = reg.predict("flm", onp.ones((4, 4), "float32"), timeout=30)
+        assert float(out[0][0][0]) == 2.0
+        # a clean (non-regressing) reload then cuts over and clears it
+        v3 = reg.load("flm", _ServeServable("hlodiff-d001-v3", _light),
+                      warm_spec=[((4, 4), "float32")])
+        desc = [m for m in reg.models() if m["name"] == "flm"][0]
+        assert desc["current_version"] == v3 and desc["degraded"] is None
+    finally:
+        reg.close()
+
+
+def test_registry_refuses_donation_dropped_reload(tmp_path, monkeypatch):
+    import numpy as onp
+    from incubator_mxnet_tpu.serving import ModelRegistry
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", str(tmp_path))
+    reg = ModelRegistry()
+    try:
+        v1 = reg.load("don",
+                      _ServeServable("hlodiff-d003-v1", _light,
+                                     donate=(0,)),
+                      warm_spec=[((4, 4), "float32")], max_batch_size=2,
+                      batch_timeout_ms=1.0)
+        reg.load("don", _ServeServable("hlodiff-d003-v2", _light),
+                 warm_spec=[((4, 4), "float32")])
+        desc = [m for m in reg.models() if m["name"] == "don"][0]
+        assert desc["current_version"] == v1
+        assert desc["degraded"] and "hlodiff:D003" in desc["degraded"]
+        out = reg.predict("don", onp.ones((4, 4), "float32"), timeout=30)
+        assert float(out[0][0][0]) == 2.0
+    finally:
+        reg.close()
+
+
+def test_registry_gate_off_is_the_escape_hatch(tmp_path, monkeypatch):
+    import numpy as onp
+    from incubator_mxnet_tpu.serving import ModelRegistry
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_HLODIFF_GATE", "0")
+    reg = ModelRegistry()
+    try:
+        reg.load("esc", _ServeServable("hlodiff-esc-v1", _light),
+                 warm_spec=[((4, 4), "float32")], max_batch_size=2,
+                 batch_timeout_ms=1.0)
+        v2 = reg.load("esc", _ServeServable("hlodiff-esc-v2", _heavy),
+                      warm_spec=[((4, 4), "float32")])
+        desc = [m for m in reg.models() if m["name"] == "esc"][0]
+        assert desc["current_version"] == v2 and desc["degraded"] is None
+        out = reg.predict("esc", onp.ones((4, 4), "float32"), timeout=30)
+        assert out[0].shape == (4, 4)
+    finally:
+        reg.close()
+
+
+def test_byte_identical_redeploy_cuts_over_clean(tmp_path, monkeypatch):
+    """The same servable reloaded is a byte-identical redeploy: every
+    warm is a cache HIT, the diff is empty by construction, and the new
+    version becomes current with no degraded flag."""
+    import numpy as onp
+    from incubator_mxnet_tpu.serving import ModelRegistry
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", str(tmp_path))
+    reg = ModelRegistry()
+    try:
+        reg.load("same", _ServeServable("hlodiff-same", _light),
+                 warm_spec=[((4, 4), "float32")], max_batch_size=2,
+                 batch_timeout_ms=1.0)
+        v2 = reg.load("same", _ServeServable("hlodiff-same", _light),
+                      warm_spec=[((4, 4), "float32")])
+        desc = [m for m in reg.models() if m["name"] == "same"][0]
+        assert desc["current_version"] == v2 and desc["degraded"] is None
+        out = reg.predict("same", onp.ones((4, 4), "float32"),
+                          timeout=30)
+        assert float(out[0][0][0]) == 2.0
+    finally:
+        reg.close()
+
+
+# ------------------------------------- subprocess/in-process equivalence
+def test_fresh_subprocess_cli_matches_registry_gate_diff(tmp_path):
+    """The CLI directory diff and the registry gate's live diff can
+    never diverge: a fresh subprocess hot-reloads a donation-dropped v2
+    (capturing the gate's findings via gate.publish), snapshots the v1
+    artifacts as --base, and the parent's CLI diff of the two
+    directories must be byte-identical to the captured gate findings."""
+    script = textwrap.dedent("""
+        import json, os, shutil, sys
+        sys.path.insert(0, %r)
+        from tests.test_hlodiff import _ServeServable, _light
+        from tools.hlodiff import gate
+        from incubator_mxnet_tpu.serving import ModelRegistry
+
+        cache = os.environ["MXTPU_AOT_CACHE_DIR"]
+        base_copy = os.environ["HLODIFF_BASE_COPY"]
+        captured = []
+        orig_publish = gate.publish
+        gate.publish = lambda findings, model=None: captured.extend(
+            findings)
+        reg = ModelRegistry()
+        # max_batch_size=1: ONE bucket, so the refused v2 leaves a
+        # complete (not partial) ladder on disk and the CLI's full-set
+        # diff sees exactly what the per-bucket gate saw
+        reg.load("sub", _ServeServable("hlodiff-sub-v1", _light,
+                                       donate=(0,)),
+                 warm_spec=[((4, 4), "float32")], max_batch_size=1,
+                 batch_timeout_ms=1.0)
+        # snapshot v1's artifacts: the reference a deploy would diff
+        # against (same relative layout, so labels match)
+        shutil.copytree(cache, base_copy)
+        reg.load("sub", _ServeServable("hlodiff-sub-v2", _light),
+                 warm_spec=[((4, 4), "float32")])
+        reg.close()
+        json.dump(sorted([f.to_json() for f in captured],
+                         key=lambda f: (f["path"], f["line"],
+                                        f["rule"])),
+                  sys.stdout, sort_keys=True)
+    """ % REPO)
+    cache = tmp_path / "cache"
+    base_copy = tmp_path / "base"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_AOT_CACHE_DIR=str(cache),
+               HLODIFF_BASE_COPY=str(base_copy))
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    live = json.loads(r.stdout)
+    assert live, "vacuous equivalence: the gate diffed nothing"
+    assert {f["rule"] for f in live} == {"D003"}
+    cli = run_cli(str(cache), "--base", str(base_copy), "--no-baseline",
+                  "--json")
+    assert cli.returncode == 1, cli.stdout + cli.stderr
+    dir_scan = json.loads(cli.stdout)["findings"]
+    assert json.dumps(dir_scan, sort_keys=True) \
+        == json.dumps(live, sort_keys=True), (dir_scan, live)
+
+
+# ---------------------------------------------------------- aot fact API
+def test_program_digest_and_facts_for_key(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from incubator_mxnet_tpu import aot
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", str(tmp_path))
+    spec = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    key = aot.cache_key("hlodiff-facts",
+                        aot.input_signature([jnp.zeros((8, 4),
+                                                       jnp.float32)]),
+                        kind="serve")
+
+    def build():
+        exported = jax_export.export(jax.jit(_light))(spec)
+        return (jax.jit(_light).lower(spec).compile(), None, exported)
+
+    aot.compile_cached(key, build, exportable=True, arg_specs=[spec])
+    ref = aot.facts_for_key(key)
+    assert ref is not None
+    assert len(ref.digest) == 32 and os.path.exists(ref.path)
+    assert ref.stats.get("flops", 0) > 0
+    # the digest matches what the artifact reader attributes to Programs
+    from tools.hlolint.artifact import read_program
+    assert read_program(ref.path).digest == ref.digest
+    # stable across calls (memoized) and None for keyless misses
+    assert aot.facts_for_key(key).digest == ref.digest
+    miss = aot.cache_key("hlodiff-facts-miss",
+                         aot.input_signature([jnp.zeros((8, 4),
+                                                        jnp.float32)]),
+                         kind="serve")
+    assert aot.facts_for_key(miss) is None

@@ -159,6 +159,62 @@ def test_h003_device_kernels_and_eval_exempt():
     assert "H003" not in rules_of(hlolint.analyze_programs([trainp]))
 
 
+def test_h003_profiler_annotation_targets_exempt():
+    """Annotation/profiler marker targets are exempt EVEN when their
+    name matches the host-callback regex (e.g. '..._host_annotation'):
+    they are metadata the device never blocks on, and refusing them
+    would make every artifact exported during a profiling session
+    undeployable. The marker list is owned by telemetry/profstats.py."""
+    from incubator_mxnet_tpu.telemetry.profstats import \
+        ANNOTATION_TARGET_MARKERS
+    assert ANNOTATION_TARGET_MARKERS == ("profiler", "annotation",
+                                         "named_scope")
+    for target in ("mxtpu_profiler_host_annotation",
+                   "xla_profiler_host_callback_marker",
+                   "host_named_scope_begin"):
+        prog = mk("serve", ['%%0 = stablehlo.custom_call @%s(%%arg0) : '
+                            '(tensor<4x8xf32>) -> tensor<4x8xf32>'
+                            % target])
+        assert "H003" not in rules_of(hlolint.analyze_programs([prog])), \
+            target
+    # the exemption is narrow: a real host callback with no marker in
+    # its name still fires alongside the exempted op
+    mixed = mk("serve", [
+        '%0 = stablehlo.custom_call @mxtpu_profiler_host_annotation'
+        '(%arg0) : (tensor<4x8xf32>) -> tensor<4x8xf32>',
+        '%1 = stablehlo.custom_call @xla_python_cpu_callback(%0) : '
+        '(tensor<4x8xf32>) -> tensor<4x8xf32>'])
+    out = [f for f in hlolint.analyze_programs([mixed])
+           if f.rule == "H003"]
+    assert len(out) == 1 and "xla_python_cpu_callback" in out[0].message
+
+
+def test_h003_artifact_exported_under_profiler_capture_scans_clean(
+        tmp_path):
+    """Regression for the profiling-session scenario: a serve artifact
+    exported while a jax.profiler trace (and named_scope annotations)
+    is active must pass the load gate."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from tools.hlolint.artifact import load_dir
+    from tools.hlolint.canary import _write_artifact
+    spec = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+
+    def fn(x):
+        with jax.named_scope("serve_annotated_block"):
+            return x * 2.0
+
+    trace_dir = tmp_path / "trace"
+    with jax.profiler.trace(str(trace_dir)):
+        exported = jax_export.export(jax.jit(fn))(spec)
+    art_dir = tmp_path / "artifacts"
+    _write_artifact(str(art_dir), "serve", exported)
+    programs, errs = load_dir(str(art_dir))
+    assert not errs and len(programs) == 1
+    assert rules_of(hlolint.analyze_programs(programs)) == []
+
+
 # ------------------------------------------------------------------ H004
 def test_h004_env_budget_drives_the_gate(monkeypatch):
     """The satellite acceptance: H004 driven by the env-override budget

@@ -721,7 +721,8 @@ def test_shared_json_shape_with_promcheck(tmp_path):
     findings = run_snippet(tmp_path, "feature.py",
                            "import os\nX = os.environ.get('MXTPU_FOO')\n")
     lint_rep = make_report("mxtpulint", findings)
-    ok_rep = promcheck.report("# HELP a doc\n# TYPE a counter\na 1\n")
+    ok_rep = promcheck.report(
+        "# HELP a_total doc\n# TYPE a_total counter\na_total 1\n")
     bad_rep = promcheck.report("total{model= 1\n", path="m.prom")
 
     keys = {"tool", "ok", "findings", "counts", "baselined"}
@@ -790,3 +791,212 @@ def test_cli_list_rules():
     for rid in ("R001", "R002", "R003", "R004", "R005", "R006", "R007",
                 "R008", "R012", "R013"):
         assert rid in r.stdout
+
+
+# ------------------------------------------------- suppression audit (X)
+from tools.mxtpulint.core import audit_suppressions       # noqa: E402
+from tools.mxtpulint import analyze                       # noqa: E402
+
+
+def audit_dir(tmp_path, name, src):
+    """One-file audit: the raw (suppression-kept) run feeds the judge,
+    exactly like the CLI's --check-suppressions wiring."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    files = [str(p)]
+    raw = analyze(files, root=str(tmp_path), keep_suppressed=True)
+    live = analyze(files, root=str(tmp_path))
+    return audit_suppressions(files, raw, root=str(tmp_path),
+                              live_findings=live)
+
+
+def test_x001_dead_suppression_fires(tmp_path):
+    audit = audit_dir(tmp_path, "feature.py", """
+        def calc(x):
+            return x + 1  # mxtpulint: disable=R002
+    """)
+    assert rule_ids(audit) == ["X001"]
+    assert "R002" in audit[0].message
+    assert "dead suppression" in audit[0].message
+
+
+def test_x001_live_suppression_is_clean(tmp_path):
+    audit = audit_dir(tmp_path, "feature.py", """
+        import os
+
+        def knob():
+            return os.environ.get("MXTPU_FOO")  # mxtpulint: disable=R002
+    """)
+    assert audit == []
+
+
+def test_x001_partially_dead_list_names_only_the_dead_half(tmp_path):
+    audit = audit_dir(tmp_path, "feature.py", """
+        import os
+
+        def knob():
+            return os.environ.get("X")  # mxtpulint: disable=R002,R003
+    """)
+    assert rule_ids(audit) == ["X001"]
+    assert "R003" in audit[0].message and "R002 " not in audit[0].message
+
+
+def test_x001_disable_all_dead_and_live(tmp_path):
+    audit = audit_dir(tmp_path, "feature.py", """
+        import os
+
+        def knob():
+            a = 1  # mxtpulint: disable=all
+            return os.environ.get("MXTPU_B")  # mxtpulint: disable=all
+    """)
+    assert rule_ids(audit) == ["X001"]
+    assert "disable=all" in audit[0].message
+    assert audit[0].line == 5
+
+
+def test_x001_typod_rule_id_gets_the_typo_note(tmp_path):
+    audit = audit_dir(tmp_path, "feature.py", """
+        def calc(x):
+            return x + 1  # mxtpulint: disable=R999
+    """)
+    assert rule_ids(audit) == ["X001"]
+    assert "typo" in audit[0].message
+
+
+def test_x001_disable_syntax_inside_strings_is_immune(tmp_path):
+    audit = audit_dir(tmp_path, "feature.py", '''
+        DOC = """to silence a reviewed line, append
+        # mxtpulint: disable=R002 to it"""
+        FIXTURE = "x = 1  # mxtpulint: disable=R001"
+    ''')
+    assert audit == []
+
+
+def test_x002_stale_baseline_entry(tmp_path):
+    # grandfather a real finding, then "fix" the file: the baseline key
+    # no longer matches anything live -> X002 at line 0
+    src_bad = """
+        import os
+
+        def knob():
+            return os.environ.get("MXTPU_OLD")
+    """
+    findings = run_snippet(tmp_path, "legacy.py", src_bad)
+    assert rule_ids(findings) == ["R002"]
+    counts = load_baseline(save_baseline(str(tmp_path / "bl.json"),
+                                         findings))
+    (tmp_path / "legacy.py").write_text("def knob():\n    return None\n")
+    files = [str(tmp_path / "legacy.py")]
+    live = analyze(files, root=str(tmp_path))
+    raw = analyze(files, root=str(tmp_path), keep_suppressed=True)
+    audit = audit_suppressions(files, raw, root=str(tmp_path),
+                               live_findings=live, baseline_counts=counts)
+    assert rule_ids(audit) == ["X002"]
+    assert audit[0].line == 0 and "stale baseline" in audit[0].message
+    # a still-live grandfathered finding is NOT stale
+    (tmp_path / "legacy.py").write_text(textwrap.dedent(src_bad))
+    live = analyze(files, root=str(tmp_path))
+    raw = analyze(files, root=str(tmp_path), keep_suppressed=True)
+    audit = audit_suppressions(files, raw, root=str(tmp_path),
+                               live_findings=live, baseline_counts=counts)
+    assert audit == []
+
+
+def test_cli_check_suppressions(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        "def calc(x):\n    return x + 1  # mxtpulint: disable=R002\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", str(tmp_path),
+         "--no-baseline", "--check-suppressions", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["counts"] == {"X001": 1}
+    # fixing the comment turns the audit clean
+    (tmp_path / "mod.py").write_text("def calc(x):\n    return x + 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxtpulint", str(tmp_path),
+         "--no-baseline", "--check-suppressions"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_check_suppressions_refuses_bad_combos(tmp_path):
+    (tmp_path / "mod.py").write_text("X = 1\n")
+    for extra in (["--rules", "R002"], ["--update-baseline"]):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.mxtpulint", str(tmp_path),
+             "--check-suppressions"] + extra,
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 2, (extra, r.stdout, r.stderr)
+        assert "cannot be combined" in r.stderr
+
+
+@pytest.mark.slow
+def test_repo_suppressions_all_live():
+    """Companion to the repo-clean gate: every disable comment in the
+    package still suppresses something real, and no baseline entry is
+    stale — the ci lint stage runs with --check-suppressions on."""
+    from tools.mxtpulint.core import iter_py_files
+    files = sorted(iter_py_files([os.path.join(REPO,
+                                               "incubator_mxnet_tpu")]))
+    raw = analyze(files, root=REPO, keep_suppressed=True)
+    live = analyze(files, root=REPO)
+    audit = audit_suppressions(
+        files, raw, root=REPO, live_findings=live,
+        baseline_counts=load_baseline(DEFAULT_BASELINE))
+    assert audit == [], "\n".join(map(repr, audit))
+
+
+# ---------------------------------------------------------------- P003
+def test_p003_counter_without_total_suffix():
+    text = "# HELP mxtpu_requests doc\n# TYPE mxtpu_requests counter\n" \
+           "mxtpu_requests 1\n"
+    out = promcheck.validate_names(text)
+    assert len(out) == 1 and "_total" in out[1 - 1][1]
+    rep = promcheck.report(text)
+    assert not rep["ok"] and rep["counts"] == {"P003": 1}
+    assert rep["findings"][0]["line"] == 2
+
+
+def test_p003_uppercase_and_non_base_units():
+    text = ("# HELP mxtpu_loadMs doc\n# TYPE mxtpu_loadMs gauge\n"
+            "mxtpu_loadMs 1\n"
+            "# HELP mxtpu_heap_kib doc\n# TYPE mxtpu_heap_kib gauge\n"
+            "mxtpu_heap_kib 2\n"
+            "# HELP mxtpu_wait_ms doc\n# TYPE mxtpu_wait_ms gauge\n"
+            "mxtpu_wait_ms 3\n")
+    msgs = [m for _ln, m in promcheck.validate_names(text)]
+    assert len(msgs) == 3
+    assert any("uppercase" in m for m in msgs)
+    assert any("_bytes" in m for m in msgs)
+    assert any("_seconds" in m for m in msgs)
+
+
+def test_p003_exempt_families_and_clean_names():
+    text = ("# HELP mxtpu_request_latency_ms doc\n"
+            "# TYPE mxtpu_request_latency_ms histogram\n"
+            "mxtpu_request_latency_ms_bucket{le=\"+Inf\"} 1\n"
+            "mxtpu_request_latency_ms_sum 0\n"
+            "mxtpu_request_latency_ms_count 1\n"
+            "# HELP mxtpu_batch_wait_seconds doc\n"
+            "# TYPE mxtpu_batch_wait_seconds gauge\n"
+            "mxtpu_batch_wait_seconds 0.1\n"
+            "# HELP mxtpu_requests_total doc\n"
+            "# TYPE mxtpu_requests_total counter\n"
+            "mxtpu_requests_total 5\n")
+    assert promcheck.validate_names(text) == []
+    # the grandfather list is pinned: growing it needs a deliberate edit
+    assert promcheck.P003_EXEMPT == frozenset((
+        "mxtpu_request_latency_ms", "mxtpu_serving_request_latency_ms",
+        "mxtpu_gen_inter_token_ms"))
+
+
+def test_p003_live_exposition_is_clean():
+    """The observability-stage assertion: the package's real metric
+    names already follow the conventions (modulo the pinned exemptions)."""
+    from incubator_mxnet_tpu import telemetry
+    telemetry.counter("p003_probe_total", "probe", ("k",)).inc(k="v")
+    text = telemetry.export_text()
+    assert promcheck.validate_names(text) == [], \
+        promcheck.validate_names(text)

@@ -1,0 +1,57 @@
+"""hlodiff — differential static analysis over compiled AOT artifacts.
+
+The fourth analyzer. mxtpulint audits the Python source, promcheck the
+metrics exposition, hlolint the compiled program in isolation — and this
+one audits the *change*: a candidate set of v2 jax.export artifacts
+against the reference it would replace (the currently-routed version for
+the same ``(kind, bucket, mesh_sig)`` key, or an explicit ``--base``).
+A program that compiles clean under every absolute H-rule can still be
+a deploy-stopping regression relative to what is serving now — 1.4x the
+FLOPs, donation silently dropped, a fresh all-gather on the dispatch
+path. The predicted-cost-comparison thesis is TVM's (arXiv 1802.04799)
+and the sharded-cost side feeds ROADMAP item 3's planner (the
+cross-replica sharding cost model of arXiv 2004.13336):
+
+  D001  FLOPs growth past MXTPU_HLODIFF_FLOPS_TOL   [warn; ERROR on
+                                                     serve-/decode-kind]
+  D002  peak-bytes growth past MXTPU_HLODIFF_PEAK_TOL
+        / predicted-HBM-headroom shrink             [warn]
+  D003  donation regression — an arg that aliased
+        in the base no longer does (relative H002)  [warn; ERROR on
+                                                     serve-/decode-kind]
+  D004  dtype drift — an op site whose widest dtype
+        class grew (bf16->f32, int8->fp)            [warn]
+  D005  collective-set change on sharded programs
+        (new/removed collectives, reshard thrash)   [warn]
+  D006  bucket-ladder shape change that invalidates
+        prewarm coverage                            [warn, cross-program]
+
+Three consumers, one engine:
+
+- CLI: ``python -m tools.hlodiff CANDIDATE --base BASE --json``
+  (mxtpulint's exit-code/baseline/report contract, one-parser CI shape),
+- registry deploy gate (serving/registry.py + gate.py): freshly warmed
+  programs diff against the routed version AFTER the hlolint pass —
+  error findings refuse cutover with degraded reason ``hlodiff:<rule>``
+  and ride the last-known-good rollback; warns land in flightrec and on
+  ``mxtpu_hlodiff_findings_total{rule}``,
+- seeded canary pairs (tools/hlolint/canary.py ``write_diff_canaries``,
+  ci/run.sh hlodiff): each regression pair must fire exactly its rule.
+
+See docs/STATIC_ANALYSIS.md for the D-rule catalog with before/afters
+and gate ordering, docs/AOT.md for the fact digests this reads
+(``aot.program_digest`` / ``aot.facts_for_key``).
+"""
+from tools.mxtpulint.core import (Finding, apply_baseline, load_baseline,
+                                  make_report, save_baseline)
+
+from .facts import (COLLECTIVE_OPS, DTYPE_WIDTH, DiffFacts, dtype_width,
+                    pair_key, struct_key)
+from .rules import (RULES, SET_RULES, SEVERITY, diff_programs,
+                    pair_programs, severity_of)
+
+__all__ = ["Finding", "DiffFacts", "COLLECTIVE_OPS", "DTYPE_WIDTH",
+           "RULES", "SET_RULES", "SEVERITY", "diff_programs",
+           "pair_programs", "pair_key", "struct_key", "dtype_width",
+           "severity_of", "make_report", "load_baseline", "save_baseline",
+           "apply_baseline"]

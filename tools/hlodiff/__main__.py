@@ -1,0 +1,190 @@
+"""CLI: ``python -m tools.hlodiff CANDIDATE --base BASE [options]``.
+
+Exit codes (the mxtpulint/hlolint contract, shared verbatim):
+  0  clean — the candidate regresses nothing (or every finding is
+     baselined)
+  1  new findings (printed human-readably, or as --json)
+  2  usage error (unknown rule id, missing dir/file, bad combo)
+
+``CANDIDATE`` defaults to MXTPU_AOT_CACHE_DIR — the artifacts a deploy
+would route. ``--base`` names the reference: a directory of v2 artifacts
+(a copy of the currently-routed version's cache) or one artifact file.
+Both sides load through tools/hlolint's reader, so corrupt inputs fail
+as loudly here as they do there. A byte-identical candidate (same
+``aot.program_digest``) short-circuits to an empty diff. ``--json``
+emits the shared report shape (``tool``/``ok``/``findings``/``counts``/
+``baselined``) the one-parser CI aggregation consumes across
+mxtpulint/promcheck/hlolint/hlodiff.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from tools.mxtpulint.core import (apply_baseline, load_baseline,
+                                  make_report, save_baseline)
+from .rules import RULES, SET_RULES, SEVERITY, severity_of
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _default_dir():
+    try:
+        from incubator_mxnet_tpu import config
+        return config.get_env("MXTPU_AOT_CACHE_DIR")
+    except Exception:
+        return os.environ.get("MXTPU_AOT_CACHE_DIR")
+
+
+def _load_side(path, what):
+    """A --base/candidate operand -> (programs, error_findings). A
+    directory loads every artifact under it; a single artifact file
+    loads just that program (labeled by basename, matching how the same
+    file would be labeled inside its directory)."""
+    from tools.hlolint.artifact import (ArtifactError, load_dir,
+                                        read_program)
+    from tools.mxtpulint.core import Finding
+    if os.path.isdir(path):
+        return load_dir(path)
+    label = os.path.basename(path)
+    try:
+        return [read_program(path, label=label)], []
+    except ArtifactError as e:
+        return [], [Finding(label, 0, 0, "H000",
+                            "unreadable AOT artifact (%s side): %s"
+                            % (what, e))]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hlodiff",
+        description="differential static analysis between two sets of "
+                    "compiled StableHLO AOT artifacts: FLOPs/peak-bytes "
+                    "growth, donation regressions, dtype drift, "
+                    "collective-set changes, bucket-ladder changes — the "
+                    "candidate vs the version it would replace",
+        epilog="exit codes: 0 = clean (no regressions, or baselined); "
+               "1 = new findings; 2 = usage error (unknown rule, "
+               "missing dir/file, bad flag combination)")
+    ap.add_argument("candidate", nargs="?", default=None,
+                    help="candidate artifact directory or file "
+                         "(default: MXTPU_AOT_CACHE_DIR)")
+    ap.add_argument("--base", required=False, default=None,
+                    help="reference artifact directory or file (the "
+                         "currently-routed version's programs)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the shared CI report shape on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/hlodiff/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--update-baseline", "--write-baseline",
+                    action="store_true", dest="update_baseline",
+                    help="rewrite the baseline file from the current "
+                         "findings and exit 0 (the goal state is an "
+                         "empty baseline)")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of D-rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog with severities and "
+                         "exit")
+    ap.add_argument("--timing", action="store_true",
+                    help="print diff wall time to stderr (the CI stage "
+                         "budget-checks it)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (title, _fn) in sorted(RULES.items()):
+            print("%s  %s  [%s]" % (rule_id, title, SEVERITY[rule_id]))
+        for rule_id, (title, _fn) in sorted(SET_RULES.items()):
+            print("%s  %s  [%s, cross-program]"
+                  % (rule_id, title, SEVERITY[rule_id]))
+        print("(D001/D003 escalate to error on serve-/decode-kind "
+              "artifacts — the deploy-gated serving path)")
+        return 0
+
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = only - set(RULES) - set(SET_RULES) - {"H000"}
+        if unknown:
+            print("unknown rule(s): %s" % ", ".join(sorted(unknown)),
+                  file=sys.stderr)
+            return 2
+
+    if args.update_baseline and only:
+        print("--update-baseline cannot be combined with --rules: it "
+              "rewrites the whole baseline", file=sys.stderr)
+        return 2
+
+    cand_root = args.candidate or _default_dir()
+    if not cand_root:
+        print("no candidate: pass a dir/file or set MXTPU_AOT_CACHE_DIR",
+              file=sys.stderr)
+        return 2
+    if not args.base:
+        print("no base: pass --base DIR_OR_FILE (the reference the "
+              "candidate diffs against)", file=sys.stderr)
+        return 2
+    for what, path in (("candidate", cand_root), ("base", args.base)):
+        if not os.path.exists(path):
+            # a typo'd/renamed path must fail loudly, not pass a vacuous
+            # empty diff
+            print("%s does not exist: %s" % (what, path), file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    base_programs, base_errs = _load_side(args.base, "base")
+    cand_programs, cand_errs = _load_side(cand_root, "candidate")
+    # the candidate side's H000s are this gate's to report (an unreadable
+    # candidate cannot be proven regression-free); base-side H000s too —
+    # a corrupt reference silently shrinks the comparison
+    findings = [f for f in base_errs + cand_errs
+                if not only or f.rule in only]
+    from .gate import diff_programs as _gated_diff
+    errors, warns = _gated_diff(base_programs, cand_programs,
+                                only_rules=only)
+    findings.extend(errors + warns)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    elapsed = time.perf_counter() - t0
+    if args.timing:
+        print("hlodiff: %s vs %s in %.2fs" % (cand_root, args.base,
+                                              elapsed), file=sys.stderr)
+
+    if args.update_baseline:
+        path = save_baseline(args.baseline, findings)
+        print("wrote %d finding(s) to %s" % (len(findings), path))
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, old = apply_baseline(findings, baseline)
+    report = make_report("hlodiff", new, baselined=len(old))
+
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print("%s:%d: %s[%s] %s" % (f.path, f.line, f.rule,
+                                        severity_of(f.rule, f.path),
+                                        f.message))
+        if new:
+            by_rule = ", ".join("%s=%d" % kv
+                                for kv in sorted(report["counts"].items()))
+            print("hlodiff: %d finding(s) [%s]%s"
+                  % (len(new), by_rule,
+                     " (+%d baselined)" % len(old) if old else ""))
+            print("fix the regression (docs/STATIC_ANALYSIS.md D-rule "
+                  "catalog), or baseline a reviewed exception")
+        else:
+            print("hlodiff OK: empty diff%s"
+                  % (" (+%d baselined)" % len(old) if old else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""Differential facts over one compiled program — what the D-rules compare.
+
+hlolint's ``ModuleFacts`` (tools/hlolint/hlo.py) is the one MLIR walker;
+this module derives the *comparison-shaped* view of a program from it:
+cost facts out of the v2 artifact header (never re-derived — aot.py's
+``facts_for_key`` is the same contract on the live cache), the donation
+map, a per-op-site dtype-width profile, and the sharding facts the
+ROADMAP item 3 planner cost model consumes — per-arg/per-op
+``mhlo.sharding`` specs plus the collective inventory (which
+all-gather/all-reduce/collective-permute ops the partitioner actually
+emitted, and whether a gather is immediately re-scattered: reshard
+thrash).
+
+Pairing: a candidate program diffs against the base program with the
+same ``(kind, bucket, mesh_sig)`` — kind from the artifact filename,
+bucket from the leading input dim, mesh_sig from the module's partition
+count (``mhlo.num_partitions``, falling back to the widest sharding
+spec's device count). The pair key deliberately excludes dtypes and
+non-bucket dims: a dtype-widened or reshaped candidate must still MATCH
+its base (that mismatch is the finding, not a pairing miss). When one
+side has several programs under one key, the dtype-free structural key
+breaks the tie.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["DiffFacts", "COLLECTIVE_OPS", "DTYPE_WIDTH", "dtype_width",
+           "pair_key", "struct_key"]
+
+# The cross-device data-movement ops the GSPMD partitioner emits; D005
+# fires on inventory changes between base and candidate.
+COLLECTIVE_OPS = frozenset((
+    "stablehlo.all_gather", "stablehlo.all_reduce", "stablehlo.all_to_all",
+    "stablehlo.collective_permute", "stablehlo.collective_broadcast",
+    "stablehlo.reduce_scatter"))
+
+# Width classes for the D004 drift rule: int8-class storage, half-width
+# fp/int, single-width, double-width. A candidate op whose widest operand
+# class GREW vs the base runs the MXU/VPU at the wider rate and doubles
+# (or quadruples) its HBM bytes per element — the relative form of
+# hlolint's absolute H001/H006.
+DTYPE_WIDTH = {
+    "i1": 0, "ui1": 0,
+    "i4": 1, "ui4": 1, "i8": 1, "ui8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "i16": 2, "ui16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "i32": 3, "ui32": 3, "u32": 3, "f32": 3,
+    "i64": 4, "ui64": 4, "u64": 4, "f64": 4,
+}
+
+_SHARDING_RE = re.compile(r'mhlo\.sharding\s*=\s*"((?:[^"\\]|\\.)*)"')
+_NUM_PARTITIONS_RE = re.compile(r"mhlo\.num_partitions\s*=\s*(\d+)")
+_DEVICES_RE = re.compile(r"devices=\[([0-9,]+)\]")
+# SSA ids on an op line: results before the '=', operands after it
+_SSA_RE = re.compile(r"%[\w#]+")
+_ARG_ATTR_RE = re.compile(
+    r"%arg(\d+):\s*tensor<[^>]*>"
+    r'(\s*\{(?:[^{}"]|"[^"]*"|\{[^{}]*\})*\})?')
+
+
+def dtype_width(dtype):
+    """Width class of one MLIR element type; unknown types rank widest so
+    a drift INTO them is visible and a drift between two unknowns is
+    not reported as widening."""
+    return DTYPE_WIDTH.get(dtype, 5)
+
+
+def _sharding_devices(spec):
+    """Device count of one sharding spec string ('{devices=[2,1]<=[2]}'
+    -> 2); None for replicated/manual/unparsable specs."""
+    m = _DEVICES_RE.search(spec or "")
+    if not m:
+        return None
+    n = 1
+    for tok in m.group(1).split(","):
+        try:
+            n *= int(tok)
+        except ValueError:
+            return None
+    return n
+
+
+class DiffFacts:
+    """The comparison-shaped view of one hlolint ``Program``."""
+
+    __slots__ = ("program", "kind", "path", "stats", "bucket",
+                 "arg_shardings", "op_shardings", "mesh_sig", "donated",
+                 "collectives", "op_widths", "op_dtype_lines",
+                 "reshard_thrash")
+
+    def __init__(self, program):
+        self.program = program
+        self.kind = program.kind
+        self.path = program.path
+        self.stats = program.stats or {}
+        facts = program.facts
+        self.bucket = facts.bucket()
+
+        # ---- sharding facts (ROADMAP item 3's planner cost-model feed)
+        self.arg_shardings = {}       # arg index -> sharding spec string
+        if facts.main_line:
+            main = facts.lines[facts.main_line - 1]
+            for m in _ARG_ATTR_RE.finditer(main):
+                sh = _SHARDING_RE.search(m.group(2) or "")
+                if sh:
+                    self.arg_shardings[int(m.group(1))] = sh.group(1)
+        self.op_shardings = []        # (lineno, op name, sharding spec)
+        for op in facts.ops:
+            sh = _SHARDING_RE.search(op.text)
+            if sh:
+                self.op_shardings.append((op.lineno, op.name, sh.group(1)))
+        self.mesh_sig = self._mesh_sig(facts)
+
+        # ---- donation map: which args alias an output (name-keyed when
+        # the trace recorded loc names, index-keyed otherwise)
+        self.donated = tuple(sorted(
+            (a.name or "arg%d" % a.index) for a in facts.args if a.aliased))
+
+        # ---- collective inventory + reshard-thrash witnesses
+        self.collectives = {}         # op name -> [lineno, ...]
+        produced_by_gather = set()    # SSA result ids of all_gather ops
+        self.reshard_thrash = []      # (gather_lineno, rescatter_lineno)
+        gather_line = {}              # SSA id -> gather lineno
+        for op in facts.ops:
+            ids = _SSA_RE.findall(op.text)
+            eq = op.text.find("=")
+            results = [i for i in ids
+                       if eq >= 0 and op.text.find(i) < eq]
+            operands = [i for i in ids if i not in results]
+            if op.name in COLLECTIVE_OPS:
+                self.collectives.setdefault(op.name, []).append(op.lineno)
+            if op.name == "stablehlo.all_gather":
+                for rid in results:
+                    produced_by_gather.add(rid)
+                    gather_line[rid] = op.lineno
+            elif op.name in ("stablehlo.reduce_scatter",
+                             "stablehlo.dynamic_slice", "stablehlo.slice"):
+                for oid in operands:
+                    if oid in produced_by_gather:
+                        self.reshard_thrash.append(
+                            (gather_line[oid], op.lineno))
+
+        # ---- per-op-site dtype profile: op name -> (max width, widest
+        # dtype); plus first-line anchors per (op name, dtype) so a D004
+        # finding points at a real widened line
+        self.op_widths = {}
+        self.op_dtype_lines = {}
+        for op in facts.ops:
+            dtypes = op.in_dtypes() + op.out_dtypes()
+            for d in dtypes:
+                self.op_dtype_lines.setdefault((op.name, d), op.lineno)
+            if not dtypes:
+                continue
+            widest = max(dtypes, key=dtype_width)
+            w = dtype_width(widest)
+            if w > self.op_widths.get(op.name, (-1, ""))[0]:
+                self.op_widths[op.name] = (w, widest)
+
+    def _mesh_sig(self, facts):
+        """Partition-count signature of the program's mesh: the module
+        line's ``mhlo.num_partitions`` when jax recorded it, else the
+        widest device count any sharding spec names, else 1 (unsharded
+        single-device program)."""
+        for line in facts.lines[:5]:
+            if line.lstrip().startswith("module"):
+                m = _NUM_PARTITIONS_RE.search(line)
+                if m:
+                    return int(m.group(1))
+                break
+        best = 1
+        for spec in self.arg_shardings.values():
+            n = _sharding_devices(spec)
+            if n:
+                best = max(best, n)
+        for _ln, _op, spec in self.op_shardings:
+            n = _sharding_devices(spec)
+            if n:
+                best = max(best, n)
+        return best
+
+    @property
+    def sharded(self):
+        """Sharded for D005's purposes: carries sharding annotations OR
+        already contains collectives (a 1-partition shard_map export has
+        collectives but no mhlo.sharding attrs)."""
+        return (self.mesh_sig > 1 or bool(self.arg_shardings)
+                or bool(self.op_shardings) or bool(self.collectives))
+
+    def collective_counts(self):
+        return {name: len(lines)
+                for name, lines in sorted(self.collectives.items())}
+
+    def flops(self):
+        try:
+            return float(self.stats.get("flops") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def peak_bytes(self):
+        try:
+            return float(self.stats.get("peak_bytes") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+
+def struct_key(df):
+    """Dtype-free structural identity: every arg's (name, dims with the
+    input bucket dim masked). Used only to break ties when several
+    programs share one pair key — NOT part of the pair key itself, so a
+    reshaped candidate still meets its base."""
+    facts = df.program.facts
+    ins = set(id(a) for a in facts.input_args())
+    parts = []
+    for a in facts.args:
+        dims = list(a.dims)
+        if id(a) in ins and dims:
+            dims[0] = None
+        parts.append((a.name or a.index, tuple(dims)))
+    return tuple(parts)
+
+
+def pair_key(df):
+    """(kind, bucket, mesh_sig): the identity a candidate diffs under —
+    the registry gate's routed-version lookup and the CLI's base-dir
+    matching use the same key."""
+    return (df.kind, df.bucket, df.mesh_sig)

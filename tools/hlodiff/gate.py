@@ -1,0 +1,115 @@
+"""Registry deploy-gate glue: diff freshly-warmed programs against the
+routed version before cutover.
+
+serving/registry.py calls in here AFTER the hlolint pass (gate ordering:
+absolute defects first, then relative regressions — a corrupt or
+fp64-leaking artifact never reaches the differ). The candidate side is
+the warm thread's freshly parsed Programs; the reference side is the
+Programs the registry retained from the currently-routed version's own
+warm, matched per ``(kind, bucket, mesh_sig)``. Severity decides the
+outcome exactly like hlolint's gate:
+
+- **error** (D001 FLOPs growth / D003 donation regression on a
+  serve-/decode-kind artifact): the cutover is refused with degraded
+  reason ``hlodiff:<rule>`` and the refusal rides the last-known-good
+  rollback path — traffic stays on the routed version.
+- **warn** (everything else): traffic cuts over; the finding lands in
+  the flight recorder and on ``mxtpu_hlodiff_findings_total{rule}``.
+
+A byte-identical redeploy never reaches this module at all: identical
+artifacts hit the AOT cache during warm, ``collect_inserts`` collects
+nothing, and both gates skip — the empty diff the acceptance contract
+demands, for free. ``aot.facts_for_key`` provides the digest
+short-circuit for callers that do hold fresh entries for identical
+bytes (a cleared in-process cache over an intact artifact dir).
+"""
+from __future__ import annotations
+
+import logging
+
+from . import rules as _rules
+
+__all__ = ["diff_programs", "diff_entries", "publish", "findings_total"]
+
+_LOG = logging.getLogger(__name__)
+_COUNTER = None
+
+
+def findings_total():
+    """The ``mxtpu_hlodiff_findings_total{rule}`` counter, registered on
+    first use (the CLI path never touches the telemetry registry)."""
+    global _COUNTER
+    if _COUNTER is None:
+        from incubator_mxnet_tpu import telemetry
+        _COUNTER = telemetry.counter(
+            "mxtpu_hlodiff_findings_total",
+            "hlodiff findings surfaced by the registry deploy gate, by "
+            "D-rule (docs/STATIC_ANALYSIS.md catalog). Error-severity "
+            "rules also refuse the candidate-version cutover.", ("rule",))
+    return _COUNTER
+
+
+def _split(findings):
+    # path-aware severity: D001/D003 escalate to error on serve-/decode-
+    errors = [f for f in findings
+              if _rules.severity_of(f.rule, f.path) == "error"]
+    warns = [f for f in findings
+             if _rules.severity_of(f.rule, f.path) != "error"]
+    return errors, warns
+
+
+def diff_programs(base_programs, cand_programs, only_rules=None):
+    """Diff already-parsed Programs -> (error_findings, warn_findings).
+    The registry gate's entry point: both sides were deserialized by the
+    hlolint pass, so the differ never touches the artifact bytes."""
+    if not base_programs or not cand_programs:
+        return [], []
+    # digest short-circuit: identical artifact bytes cannot diff.
+    # Program.digest is set by artifact.read_program; a candidate whose
+    # digest matches some base digest is dropped from both sides.
+    base_digests = {getattr(p, "digest", None) for p in base_programs}
+    base_digests.discard(None)
+    if base_digests:
+        fresh = [p for p in cand_programs
+                 if getattr(p, "digest", None) not in base_digests]
+        if not fresh:
+            return [], []
+        cand_programs = fresh
+    findings = _rules.diff_programs(base_programs, cand_programs,
+                                    only_rules=only_rules)
+    return _split(findings)
+
+
+def diff_entries(base_programs, entries, cache_dir=None, collect=None):
+    """diff_programs over live cache entries on the candidate side, for
+    callers that did not keep the warm's parsed Programs."""
+    from tools.hlolint import artifact as _artifact
+    programs, errs = _artifact.load_cache_entries(entries,
+                                                  cache_dir=cache_dir)
+    if collect is not None:
+        collect.extend(programs)
+    errors, warns = diff_programs(base_programs, programs)
+    # unreadable candidates surfaced as H000 by the loader: the hlolint
+    # gate owns those; here they only mean "nothing to diff"
+    del errs
+    return errors, warns
+
+
+def publish(findings, model=None):
+    """Count every finding and file the warns on the flight recorder —
+    guarded: telemetry trouble must never fail the load that surfaced
+    the finding."""
+    for f in findings:
+        try:
+            findings_total().inc(rule=f.rule)
+        except Exception:
+            _LOG.debug("hlodiff counter update dropped", exc_info=True)
+        if _rules.severity_of(f.rule, f.path) != "error":
+            try:
+                from incubator_mxnet_tpu.telemetry import flightrec
+                flightrec.record("hlodiff_finding", rule=f.rule,
+                                 model=str(model), path=f.path,
+                                 message=f.message)
+            except Exception:
+                _LOG.debug("hlodiff flightrec record dropped",
+                           exc_info=True)

@@ -1,0 +1,325 @@
+"""The D-rule set: relative regressions decidable between two compiled
+programs.
+
+hlolint's H-rules judge one artifact in isolation; every D-rule needs a
+*reference* — the currently-routed version's program for the same
+``(kind, bucket, mesh_sig)`` key, or an explicit ``--base``. A candidate
+that compiles clean under every H-rule can still be a deploy-stopping
+regression: 1.4x the FLOPs, donation silently dropped, a new all-gather.
+That relative judgment is this module (the TVM-style predicted-cost
+comparison of arxiv 1802.04799, applied at the deploy gate).
+
+  D001  FLOPs growth past MXTPU_HLODIFF_FLOPS_TOL     [warn; ERROR on
+                                                       serve-/decode-]
+  D002  peak-bytes growth past MXTPU_HLODIFF_PEAK_TOL
+        / predicted-HBM-headroom shrink               [warn]
+  D003  donation regression — an arg that aliased in
+        the base no longer does (relative H002)       [warn; ERROR on
+                                                       serve-/decode-]
+  D004  dtype drift — an op site whose widest dtype
+        class grew (bf16->f32, int8->fp)              [warn]
+  D005  collective-set change on sharded programs
+        (new/removed collectives; reshard thrash =
+        a gather immediately re-scattered)            [warn]
+  D006  bucket-ladder shape change that invalidates
+        prewarm coverage                              [warn]
+
+Findings reuse ``tools.mxtpulint.core.Finding`` anchored at the
+CANDIDATE artifact (path/line/rule/message + the stripped module line as
+the baseline key) — the same one-parser report shape as the other three
+analyzers.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["RULES", "SET_RULES", "SEVERITY", "pair_rule", "set_rule",
+           "severity_of", "pair_programs", "diff_programs",
+           "flops_tolerance", "peak_tolerance"]
+
+from tools.mxtpulint.core import Finding
+
+from .facts import DiffFacts, pair_key, struct_key
+
+RULES = {}        # rule id -> (title, fn(base_df, cand_df) -> findings)
+SET_RULES = {}    # rule id -> (title, fn(pairs, unmatched_base,
+                  #             unmatched_cand) -> findings)
+SEVERITY = {}
+
+# Serving-path artifact kinds whose D001/D003 regressions the load gate
+# must refuse (the kind is the artifact filename prefix).
+_GATED_PREFIXES = ("serve-", "decode-")
+
+
+def pair_rule(rule_id, title, severity):
+    def deco(fn):
+        RULES[rule_id] = (title, fn)
+        SEVERITY[rule_id] = severity
+        return fn
+    return deco
+
+
+def set_rule(rule_id, title, severity):
+    def deco(fn):
+        SET_RULES[rule_id] = (title, fn)
+        SEVERITY[rule_id] = severity
+        return fn
+    return deco
+
+
+def severity_of(rule_id, path=None):
+    """Severity of one finding. D001 (FLOPs growth) and D003 (donation
+    regression) escalate to ERROR on serve-/decode-kind artifacts: a
+    steady-state serving-path cost regression is exactly what the deploy
+    gate exists to refuse, while the same drift on an eval/train program
+    stays advisory. Rule-only queries (--rules validation, legends) omit
+    the path and get the base severity."""
+    if rule_id in ("D001", "D003") and path is not None \
+            and os.path.basename(str(path)).startswith(_GATED_PREFIXES):
+        return "error"
+    return SEVERITY.get(rule_id, "warn")
+
+
+def flops_tolerance():
+    from incubator_mxnet_tpu import config
+    return float(config.get_env("MXTPU_HLODIFF_FLOPS_TOL"))
+
+
+def peak_tolerance():
+    from incubator_mxnet_tpu import config
+    return float(config.get_env("MXTPU_HLODIFF_PEAK_TOL"))
+
+
+def _finding(cand, lineno, rule_id, message):
+    return Finding(cand.path, lineno, 0, rule_id, message,
+                   cand.program.facts.line_text(lineno))
+
+
+# --------------------------------------------------------------------- D001
+@pair_rule("D001", "FLOPs growth past MXTPU_HLODIFF_FLOPS_TOL", "warn")
+def d001_flops_growth(base, cand):
+    b, c = base.flops(), cand.flops()
+    if b <= 0.0 or c <= 0.0:
+        return                      # no header cost facts on one side
+    tol = flops_tolerance()
+    if c <= b * (1.0 + tol):
+        return
+    yield _finding(
+        cand, cand.program.facts.main_line, "D001",
+        "%s program at bucket %s does %.3g FLOPs where the base does "
+        "%.3g (+%.1f%%, tolerance %.0f%%) — every dispatch pays the "
+        "growth at serving rate; diff the model change that added the "
+        "compute, or raise MXTPU_HLODIFF_FLOPS_TOL deliberately"
+        % (cand.kind, cand.bucket, c, b, 100.0 * (c / b - 1.0),
+           100.0 * tol))
+
+
+# --------------------------------------------------------------------- D002
+@pair_rule("D002", "peak-bytes growth / predicted-HBM-headroom shrink",
+           "warn")
+def d002_peak_growth(base, cand):
+    b, c = base.peak_bytes(), cand.peak_bytes()
+    if b <= 0.0 or c <= 0.0:
+        return
+    tol = peak_tolerance()
+    if c <= b * (1.0 + tol):
+        return
+    headroom = ""
+    try:
+        from tools.hlolint.rules import _hbm_budget
+        budget, source = _hbm_budget()
+        if budget:
+            headroom = ("; predicted HBM headroom shrinks %.2f -> %.2f "
+                        "MiB against the %s budget"
+                        % ((budget - b) / 2 ** 20, (budget - c) / 2 ** 20,
+                           source))
+    except Exception:
+        pass
+    yield _finding(
+        cand, cand.program.facts.main_line, "D002",
+        "%s program at bucket %s peaks at %.0f bytes where the base "
+        "peaks at %.0f (+%.1f%%, tolerance %.0f%%)%s — closer to OOM on "
+        "every deploy that repeats this growth"
+        % (cand.kind, cand.bucket, c, b, 100.0 * (c / b - 1.0),
+           100.0 * tol, headroom))
+
+
+# --------------------------------------------------------------------- D003
+@pair_rule("D003", "donation regression vs the base program", "warn")
+def d003_donation_regression(base, cand):
+    lost = sorted(set(base.donated) - set(cand.donated))
+    if not lost:
+        return
+    yield _finding(
+        cand, cand.program.facts.main_line, "D003",
+        "%s program dropped input-output aliasing on %d donated "
+        "buffer(s) the base aliased (%s) — donation fell off in the "
+        "candidate (a wrapper re-jit, MXTPU_NO_DONATE, or an "
+        "aliasing-defeating dtype change), so those buffers are copied "
+        "in full every dispatch; hlolint H002 is the absolute form of "
+        "this check"
+        % (cand.kind, len(lost), ", ".join(lost)))
+
+
+# --------------------------------------------------------------------- D004
+@pair_rule("D004", "dtype drift — an op site widened vs the base", "warn")
+def d004_dtype_drift(base, cand):
+    from .facts import dtype_width
+    for op_name in sorted(cand.op_widths):
+        if op_name not in base.op_widths:
+            continue
+        bw, bdtype = base.op_widths[op_name]
+        cw, cdtype = cand.op_widths[op_name]
+        if cw <= bw:
+            continue
+        lineno = cand.op_dtype_lines.get(
+            (op_name, cdtype), cand.program.facts.main_line)
+        int8_note = ""
+        if dtype_width(bdtype) <= 1 and cdtype.startswith(("f", "bf")):
+            int8_note = (" — an int8->fp widening forfeits the int8 "
+                         "kernel rate (hlolint H006's absolute form)")
+        yield _finding(
+            cand, lineno, "D004",
+            "%s widened from %s to %s vs the base program%s; the op "
+            "now runs at the wider width's HBM traffic and compute "
+            "rate on every dispatch" % (op_name, bdtype, cdtype,
+                                        int8_note))
+
+
+# --------------------------------------------------------------------- D005
+@pair_rule("D005", "collective-set change on a sharded program", "warn")
+def d005_collective_change(base, cand):
+    if not (base.sharded or cand.sharded):
+        return
+    bc, cc = base.collective_counts(), cand.collective_counts()
+    for op_name in sorted(set(bc) | set(cc)):
+        nb, nc = bc.get(op_name, 0), cc.get(op_name, 0)
+        if nb == nc:
+            continue
+        if nc > nb:
+            lineno = cand.collectives[op_name][0]
+            yield _finding(
+                cand, lineno, "D005",
+                "%s gained %d %s op(s) vs the base (%d -> %d) — new "
+                "cross-device data movement on the dispatch path that "
+                "the base's partitioning did not pay"
+                % (cand.kind, nc - nb, op_name, nb, nc))
+        else:
+            yield _finding(
+                cand, cand.program.facts.main_line, "D005",
+                "%s lost %d %s op(s) vs the base (%d -> %d) — the "
+                "partitioning changed shape; verify the layout change "
+                "was intended (a vanished collective can mean the "
+                "program silently fell back to replicated compute)"
+                % (cand.kind, nb - nc, op_name, nb, nc))
+    base_thrash = len(base.reshard_thrash)
+    if len(cand.reshard_thrash) > base_thrash:
+        g, s = cand.reshard_thrash[base_thrash]
+        yield _finding(
+            cand, g, "D005",
+            "reshard thrash: an all_gather at line %d is immediately "
+            "re-scattered at line %d (%d such pair(s), base had %d) — "
+            "the program materializes the gathered tensor only to "
+            "slice it back apart, paying full-tensor HBM traffic and "
+            "interconnect time for nothing" % (g, s,
+                                               len(cand.reshard_thrash),
+                                               base_thrash))
+
+
+# --------------------------------------------------------------------- D006
+@set_rule("D006", "bucket-ladder shape change that invalidates prewarm "
+                  "coverage", "warn")
+def d006_ladder_change(pairs, unmatched_base, unmatched_cand):
+    """The prewarm contract (docs/AOT.md): every bucket the batcher can
+    dispatch has a compiled artifact. A candidate set whose ladder lost
+    a bucket the base had serves that bucket with a post-cutover compile
+    (the exact window prewarm exists to close); a grown ladder is warn-
+    worthy drift in the other direction (compile time + cache residency
+    the base did not pay)."""
+    ladders = {}        # (kind, mesh_sig) -> {"base": set, "cand": set,
+                        #                      "anchor": DiffFacts|None}
+    def add(df, side):
+        if df.bucket is None:
+            return
+        slot = ladders.setdefault((df.kind, df.mesh_sig),
+                                  {"base": set(), "cand": set(),
+                                   "anchor": None})
+        slot[side].add(df.bucket)
+        if side == "cand" and slot["anchor"] is None:
+            slot["anchor"] = df
+    for b, c in pairs:
+        add(b, "base")
+        add(c, "cand")
+    for b in unmatched_base:
+        add(b, "base")
+    for c in unmatched_cand:
+        add(c, "cand")
+    for (kind, mesh_sig), slot in sorted(ladders.items(),
+                                         key=lambda kv: repr(kv[0])):
+        base_l, cand_l = slot["base"], slot["cand"]
+        if not base_l or not cand_l or base_l == cand_l:
+            continue
+        removed = sorted(base_l - cand_l)
+        added = sorted(cand_l - base_l)
+        anchor = slot["anchor"]
+        parts = []
+        if removed:
+            parts.append("lost bucket(s) %s — requests at those sizes "
+                         "now pad up or compile after cutover"
+                         % removed)
+        if added:
+            parts.append("gained bucket(s) %s" % added)
+        yield _finding(
+            anchor, anchor.program.facts.main_line, "D006",
+            "%s bucket ladder changed %s -> %s: %s; prewarm coverage "
+            "no longer matches the routed version's (align the ladder "
+            "or re-run the warm with the new spec)"
+            % (kind, sorted(base_l), sorted(cand_l), "; ".join(parts)))
+
+
+# ---------------------------------------------------------------- pairing
+def pair_programs(base_programs, cand_programs):
+    """Match candidates to bases on ``pair_key`` (kind, bucket,
+    mesh_sig), breaking same-key ties with the dtype-free structural
+    key. Returns (pairs, unmatched_base, unmatched_cand) as DiffFacts."""
+    base = [DiffFacts(p) for p in base_programs]
+    cand = [DiffFacts(p) for p in cand_programs]
+    by_key = {}
+    for df in sorted(base, key=lambda d: d.path):
+        by_key.setdefault(pair_key(df), []).append(df)
+    pairs, unmatched_cand = [], []
+    for df in sorted(cand, key=lambda d: d.path):
+        pool = by_key.get(pair_key(df))
+        if not pool:
+            unmatched_cand.append(df)
+            continue
+        sk = struct_key(df)
+        best = min(range(len(pool)),
+                   key=lambda i: (struct_key(pool[i]) != sk,
+                                  pool[i].path))
+        pairs.append((pool.pop(best), df))
+    unmatched_base = [df for pool in by_key.values() for df in pool]
+    unmatched_base.sort(key=lambda d: d.path)
+    return pairs, unmatched_base, unmatched_cand
+
+
+# ------------------------------------------------------------------ driver
+def diff_programs(base_programs, cand_programs, only_rules=None):
+    """Run every (selected) D-rule over the paired sets; findings sorted
+    by (path, line, rule) — the same order for the CLI's directory scan
+    and the registry gate's live diff, which is what makes the two
+    byte-comparable."""
+    pairs, unmatched_base, unmatched_cand = pair_programs(
+        base_programs, cand_programs)
+    findings = []
+    for b, c in pairs:
+        for rule_id, (_title, fn) in sorted(RULES.items()):
+            if only_rules and rule_id not in only_rules:
+                continue
+            findings.extend(fn(b, c))
+    for rule_id, (_title, fn) in sorted(SET_RULES.items()):
+        if only_rules and rule_id not in only_rules:
+            continue
+        findings.extend(fn(pairs, unmatched_base, unmatched_cand))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

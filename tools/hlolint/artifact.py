@@ -44,13 +44,16 @@ class ArtifactError(ValueError):
 class Program:
     """One deserialized artifact, ready for the rules."""
 
-    __slots__ = ("path", "kind", "stats", "facts")
+    __slots__ = ("path", "kind", "stats", "facts", "digest")
 
-    def __init__(self, path, kind, stats, facts):
+    def __init__(self, path, kind, stats, facts, digest=None):
         self.path = path            # scan-root-relative label ('/'-sep)
         self.kind = kind            # 'train' | 'eval' | 'serve' | 'decode'
         self.stats = stats          # header device truth dict or None
         self.facts = facts          # hlo.ModuleFacts
+        self.digest = digest        # aot.program_digest of the file bytes
+                                    # (None for text-built programs) —
+                                    # hlodiff's byte-identical short-circuit
 
     def __repr__(self):
         return "Program(%s, kind=%s)" % (self.path, self.kind)
@@ -100,7 +103,8 @@ def read_program(path, label=None):
     except Exception as e:
         raise ArtifactError("payload does not deserialize (%s: %s)"
                             % (type(e).__name__, e))
-    return Program(label or path, kind, stats, ModuleFacts(text))
+    return Program(label or path, kind, stats, ModuleFacts(text),
+                   digest=aot.program_digest(buf))
 
 
 def iter_artifact_files(root):

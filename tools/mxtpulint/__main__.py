@@ -20,9 +20,10 @@ import os
 import sys
 import time
 
-from .core import (RULES, REPO_ROOT, RELAXED_RULES, iter_py_files,
-                   load_baseline, save_baseline, apply_baseline,
-                   make_report, rules_for_path, DEFAULT_BASELINE)
+from .core import (RULES, REPO_ROOT, RELAXED_RULES, audit_suppressions,
+                   iter_py_files, load_baseline, save_baseline,
+                   apply_baseline, make_report, rules_for_path,
+                   DEFAULT_BASELINE)
 from .interproc import PROJECT_RULES, analyze
 
 _RELAXED = "/".join(sorted(RELAXED_RULES))
@@ -56,6 +57,14 @@ def main(argv=None):
                          "state is an empty baseline)")
     ap.add_argument("--rules", default=None,
                     help="comma list of rule ids to run (default: all)")
+    ap.add_argument("--check-suppressions", action="store_true",
+                    dest="check_suppressions",
+                    help="also audit suppression hygiene: X001 flags "
+                         "'# mxtpulint: disable=' comments whose rule no "
+                         "longer fires at that line, X002 flags stale "
+                         "baseline entries whose finding no longer "
+                         "occurs (neither is baselineable; default-on "
+                         "in the CI lint stage)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog (per-file + "
                          "whole-program) and exit")
@@ -99,6 +108,19 @@ def main(argv=None):
         print("--update-baseline cannot be combined with --rules: it "
               "rewrites the whole baseline", file=sys.stderr)
         return 2
+    if args.check_suppressions and only:
+        # a rule-filtered raw run cannot tell a FIXED suppression from a
+        # merely unselected one — the audit would flag live suppressions
+        # of every rule outside the selection
+        print("--check-suppressions cannot be combined with --rules: the "
+              "audit needs the full rule set to know what still fires",
+              file=sys.stderr)
+        return 2
+    if args.check_suppressions and args.update_baseline:
+        print("--check-suppressions cannot be combined with "
+              "--update-baseline: X001/X002 audit findings are not "
+              "baselineable", file=sys.stderr)
+        return 2
 
     if only:
         # explicit rule selection where EVERY file's path profile masks
@@ -128,6 +150,14 @@ def main(argv=None):
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, old = apply_baseline(findings, baseline)
+    if args.check_suppressions:
+        # the audit's raw view re-runs unfiltered (suppressed findings
+        # kept) so each disable comment is judged against what actually
+        # fires; X001/X002 land in ``new`` directly — never baselined
+        raw = analyze(files, keep_suppressed=True)
+        new.extend(audit_suppressions(files, raw, live_findings=findings,
+                                      baseline_counts=baseline))
+        new.sort(key=lambda f: (f.path, f.line, f.rule))
     report = make_report("mxtpulint", new, baselined=len(old))
 
     if args.as_json:

@@ -272,9 +272,11 @@ def iter_py_files(paths):
                     yield os.path.join(dirpath, fn)
 
 
-def lint_file(path, root=None, only_rules=None):
+def lint_file(path, root=None, only_rules=None, keep_suppressed=False):
     """Lint one file; returns non-suppressed findings (suppressed ones are
-    dropped here, before baseline matching)."""
+    dropped here, before baseline matching). ``keep_suppressed=True``
+    skips that drop — the suppression AUDIT needs the raw finding set to
+    decide which disable comments still suppress anything."""
     root = root or REPO_ROOT
     relpath = os.path.relpath(path, root)
     try:
@@ -294,12 +296,14 @@ def lint_file(path, root=None, only_rules=None):
         if only_rules and rule_id not in only_rules:
             continue
         findings.extend(fn(ctx))
-    kept = filter_suppressed(findings, {ctx.relpath: ctx})
-    kept.sort(key=lambda f: (f.path, f.line, f.rule))
-    return kept
+    if not keep_suppressed:
+        findings = filter_suppressed(findings, {ctx.relpath: ctx})
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
 
 
-def lint_paths(paths, root=None, only_rules=None, profiled=False):
+def lint_paths(paths, root=None, only_rules=None, profiled=False,
+               keep_suppressed=False):
     """Per-file rule phase over ``paths``. With ``profiled=True`` each
     file runs only its path profile's rules (tools/ and tests/ get the
     relaxed lock/thread/clock subset — see ``rules_for_path``)."""
@@ -318,7 +322,8 @@ def lint_paths(paths, root=None, only_rules=None, profiled=False):
                     # (a falsy only_rules would mean "no filter" and
                     # run everything the user excluded)
                     continue
-        findings.extend(lint_file(path, root=root, only_rules=only))
+        findings.extend(lint_file(path, root=root, only_rules=only,
+                                  keep_suppressed=keep_suppressed))
     return findings
 
 
@@ -335,6 +340,107 @@ def filter_suppressed(findings, ctx_by_relpath):
             continue
         kept.append(f)
     return kept
+
+
+def _comment_suppression_lines(src_lines):
+    """Lines whose suppression marker sits in a REAL comment token.
+    ``suppressions()`` is regex-over-raw-lines (cheap, and a docstring
+    line never has findings to wrongly swallow), but the AUDIT must not
+    flag doc examples of the disable syntax — or lint-test fixtures
+    embedding it in strings — as dead suppressions. None on a tokenize
+    failure: the caller audits every candidate line rather than none."""
+    import io
+    import tokenize
+    lines = set()
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO("\n".join(src_lines) + "\n").readline):
+            if tok.type == tokenize.COMMENT \
+                    and _SUPPRESS_RE.search(tok.string):
+                lines.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            ValueError):
+        return None
+    return lines
+
+
+def audit_suppressions(files, raw_findings, root=None,
+                       live_findings=None, baseline_counts=None):
+    """The suppression/baseline hygiene audit (``--check-suppressions``):
+
+    - **X001** — a ``# mxtpulint: disable=R00x`` comment naming a rule
+      that no longer fires at that line (the code was fixed, the rule
+      retired, or the id was typo'd), or ``disable=all`` on a line where
+      nothing fires. A dead suppression is a live hazard: it silently
+      masks the NEXT real finding that lands on the line.
+    - **X002** — a baseline entry whose ``(path, rule, text)`` key
+      exceeds the live finding count for that key: grandfathered debt
+      that was actually paid but never collected from the file.
+
+    ``raw_findings`` must be a pre-suppression run (``keep_suppressed``)
+    over the same ``files``; ``live_findings`` the normal filtered run
+    (what the baseline matches against). Both audits are advisory until
+    wired as findings — ci/run.sh runs them default-on in the lint
+    stage, and they are never baselineable themselves."""
+    root = root or REPO_ROOT
+    raw_at = {}
+    for f in raw_findings:
+        raw_at.setdefault(f.path, {}).setdefault(f.line, set()).add(f.rule)
+    known = set(RULES)
+    try:
+        from .interproc import PROJECT_RULES
+        known |= set(PROJECT_RULES)
+    except Exception:
+        pass
+    audit = []
+    for path in files:
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            ctx = get_context(path, root)
+        except (SyntaxError, ValueError, OSError):
+            continue        # lint_file already reported E000 for it
+        real = _comment_suppression_lines(ctx.src_lines)
+        for line, rules_off in sorted(suppressions(ctx.src_lines).items()):
+            if real is not None and line not in real:
+                continue    # disable syntax inside a string literal:
+                            # documentation/fixture, not a suppression
+            fired = raw_at.get(relpath, {}).get(line, set())
+            if "all" in rules_off:
+                if not fired:
+                    audit.append(Finding(
+                        relpath, line, 0, "X001",
+                        "dead suppression: 'disable=all' on a line where "
+                        "no rule fires — delete the comment (left in "
+                        "place it silently masks the next real finding "
+                        "here)", ctx.line_text(line)))
+                continue
+            dead = sorted(r for r in rules_off if r not in fired)
+            if dead:
+                audit.append(Finding(
+                    relpath, line, 0, "X001",
+                    "dead suppression: %s no longer fire(s) at this line "
+                    "— drop %s from the disable comment%s"
+                    % (", ".join(dead), ", ".join(dead),
+                       "" if all(r in known for r in dead)
+                       else " (unknown rule id — typo?)"),
+                    ctx.line_text(line)))
+    if baseline_counts:
+        live = {}
+        for f in live_findings or ():
+            k = f.baseline_key()
+            live[k] = live.get(k, 0) + 1
+        for key in sorted(baseline_counts):
+            path, rule_id, text = key
+            excess = baseline_counts[key] - live.get(key, 0)
+            if excess > 0:
+                audit.append(Finding(
+                    path, 0, 0, "X002",
+                    "stale baseline entry: %d grandfathered %s finding(s) "
+                    "matching %r no longer occur — the debt was paid; "
+                    "shrink the baseline (--update-baseline)"
+                    % (excess, rule_id, (text or "<no text>")[:60])))
+    audit.sort(key=lambda f: (f.path, f.line, f.rule))
+    return audit
 
 
 # ---------------------------------------------------------------- baseline

@@ -472,21 +472,28 @@ def run_project_rules(index, only_rules=None):
     return findings
 
 
-def analyze(paths, root=None, only_rules=None, profiled=True):
+def analyze(paths, root=None, only_rules=None, profiled=True,
+            keep_suppressed=False):
     """The full two-phase run: per-file rules (path-profiled), then the
     whole-program index + interprocedural passes over the full-profile
     files, with per-line suppressions applied to both. Returns the
-    combined, sorted finding list (pre-baseline)."""
+    combined, sorted finding list (pre-baseline).
+    ``keep_suppressed=True`` leaves suppressed findings IN (both phases)
+    — the raw view ``core.audit_suppressions`` diffs disable comments
+    against."""
     from .core import iter_py_files
     root = root or REPO_ROOT
     # materialize the tree walk ONCE; both phases accept file lists
     files = list(iter_py_files(paths))
     findings = lint_paths(files, root=root, only_rules=only_rules,
-                          profiled=profiled)
+                          profiled=profiled,
+                          keep_suppressed=keep_suppressed)
     if only_rules is None or (set(only_rules) & set(PROJECT_RULES)):
         index = build_index(files, root)
         proj = run_project_rules(index, only_rules=only_rules)
-        ctxs = {m.relpath: m.ctx for m in index.modules.values()}
-        findings.extend(filter_suppressed(proj, ctxs))
+        if not keep_suppressed:
+            ctxs = {m.relpath: m.ctx for m in index.modules.values()}
+            proj = filter_suppressed(proj, ctxs)
+        findings.extend(proj)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -25,7 +25,11 @@ Usage::
 --json``, ``tools/loadgen.py --json`` and ``tools/perfgate.py --json``
 (tool/ok/findings/counts/baselined), so CI aggregates every gate with
 one parser; format violations carry rule id ``P001``, metadata-hygiene
-violations carry ``P002``:
+violations carry ``P002``, naming-convention violations carry ``P003``
+(counters end ``_total``; lowercase names; ``_seconds``/``_bytes`` base
+units — with the pre-existing ``_ms`` latency histograms grandfathered
+by name in ``P003_EXEMPT`` because SLOs, dashboards and tests pin
+them):
 
 - every exposed family must carry BOTH ``# HELP`` and ``# TYPE`` lines,
   in canonical order (HELP, then TYPE, then that family's samples) — a
@@ -231,6 +235,75 @@ def validate_metadata(text):
     return out
 
 
+# --------------------------------------------------------- P003: naming
+# Prometheus naming conventions: counters end in ``_total``, family
+# names are lowercase, durations use the base unit ``_seconds`` and
+# sizes ``_bytes``. The ``_ms`` histograms below predate the check and
+# their names are LOAD-BEARING — telemetry/slo.py's latency objectives,
+# the SLO/telemetry/generate test suites and any deployed dashboards
+# address them by name — so they are explicitly grandfathered here
+# (visible, greppable, shrink-only) rather than renamed or silently
+# skipped. New metrics get no such grace: P003 fires on the next
+# ``_ms`` family that shows up on the scrape.
+P003_EXEMPT = frozenset((
+    "mxtpu_request_latency_ms",
+    "mxtpu_serving_request_latency_ms",
+    "mxtpu_gen_inter_token_ms",
+))
+# non-base unit suffix -> the base unit the convention wants
+_NON_BASE_UNITS = (
+    ("_milliseconds", "_seconds"), ("_microseconds", "_seconds"),
+    ("_nanoseconds", "_seconds"), ("_minutes", "_seconds"),
+    ("_hours", "_seconds"), ("_ms", "_seconds"), ("_us", "_seconds"),
+    ("_ns", "_seconds"), ("_kib", "_bytes"), ("_mib", "_bytes"),
+    ("_gib", "_bytes"), ("_kb", "_bytes"), ("_mb", "_bytes"),
+    ("_gb", "_bytes"),
+)
+
+
+def validate_names(text):
+    """P003: metric-name conventions over one exposition. Returns every
+    ``(line_no, message)`` violation, anchored at the family's ``# TYPE``
+    line:
+
+    - a ``counter`` family whose name does not end ``_total``;
+    - a family name containing uppercase (exposition names are
+      conventionally ``snake_case``; mixed case breaks PromQL muscle
+      memory and half the grep pipelines watching the scrape);
+    - a duration/size family using a non-base unit suffix (``_ms``,
+      ``_mb``, ...) instead of ``_seconds``/``_bytes``.
+
+    Families in ``P003_EXEMPT`` are grandfathered by name (see the
+    comment on the constant)."""
+    out = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.startswith("#"):
+            continue
+        parts = line.split(None, 3)
+        if len(parts) < 3 or parts[1] != "TYPE":
+            continue
+        fam, typ = parts[2], (parts[3] if len(parts) > 3 else "")
+        if fam in P003_EXEMPT:
+            continue
+        lower = fam.lower()
+        if fam != lower:
+            out.append((i, "line %d: family %r contains uppercase — "
+                        "exposition names are snake_case by convention"
+                        % (i, fam)))
+        if typ == "counter" and not lower.endswith("_total"):
+            out.append((i, "line %d: counter %r does not end in '_total' "
+                        "— the suffix is how consumers (and rate()) "
+                        "recognize a monotone counter" % (i, fam)))
+        for sfx, base in _NON_BASE_UNITS:
+            if lower.endswith(sfx):
+                out.append((i, "line %d: family %r uses non-base unit "
+                            "%r — express it in %r (Prometheus base "
+                            "units; scale at the edge, not in the name)"
+                            % (i, fam, sfx, base)))
+                break
+    return out
+
+
 _LINE_NO_RE = re.compile(r"line (\d+):")
 
 
@@ -249,6 +322,9 @@ def report(text, path="<stdin>"):
                          "rule": "P001", "message": msg})
     for line_no, msg in validate_metadata(text):
         findings.append({"path": path, "line": line_no, "rule": "P002",
+                         "message": msg})
+    for line_no, msg in validate_names(text):
+        findings.append({"path": path, "line": line_no, "rule": "P003",
                          "message": msg})
     counts = {}
     for f in findings:
@@ -269,9 +345,12 @@ def main(argv):
         return 0 if rep["ok"] else 1
     types = validate(text)
     meta = validate_metadata(text)
-    if meta:
+    names = validate_names(text)
+    if meta or names:
         for _line_no, msg in meta:
             print("P002: %s" % msg)
+        for _line_no, msg in names:
+            print("P003: %s" % msg)
         return 1
     n_hist = sum(1 for t in types.values() if t == "histogram")
     print("promcheck OK: %d metric families (%d histograms)"
