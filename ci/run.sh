@@ -142,6 +142,20 @@
 #             back to the prior version; finally the unsupervised canary
 #             (same kill, no Supervisor) must FAIL the healed check —
 #             proof the gate fires; wall budget 120s
+#   history - metric flight recorder (telemetry/history.py,
+#             docs/OBSERVABILITY.md "Metric history & incident
+#             timelines"): the unit tier (tests/test_history.py — ring
+#             retention, tiered downsampling, recording rules,
+#             pressure_rising / mfu_droop hysteresis, /debug/ index pin,
+#             detach-on-close, and the <= 1.05x self-scrape-tax
+#             paired-p99 gate); then a supervised loadgen soak with a
+#             seeded mid-run replica_kill asserting the incident
+#             timeline carries the fault injection, the queue-depth
+#             excursion, and the respawn in causal order; then the
+#             early-warning e2e — a saturating submit ramp must fire
+#             pressure_rising while the calm phase stays silent; and
+#             the exported JSONL must round-trip byte-stable through
+#             tools/tsq.py; wall budget 120s
 #   diagnostics - the "why is it slow / why is it stuck" layer: span
 #             tracing (nesting, queue-boundary propagation, chrome-trace
 #             parenting, 16-thread race), flight recorder (ring bound,
@@ -158,7 +172,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint hlodiff native suite serving aot observability devstats profstats loadgen slo generate numerics sharded chaos diagnostics smoke large wheel)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(lint hlolint hlodiff native suite serving aot observability devstats profstats loadgen slo generate numerics sharded chaos history diagnostics smoke large wheel)
 
 has_stage() { local s; for s in "${STAGES[@]}"; do [ "$s" = "$1" ] && return 0; done; return 1; }
 
@@ -1544,6 +1558,143 @@ EOF
   ch_dt=$(( SECONDS - ch_t0 ))
   echo "chaos stage wall time: ${ch_dt}s (budget 120s)"
   [ "$ch_dt" -lt 120 ] || { echo "chaos stage took ${ch_dt}s (budget 120s)"; exit 1; }
+fi
+
+if has_stage history; then
+  echo "=== history: metric flight recorder + incident timeline gate ==="
+  hi_t0=$SECONDS
+  # Phase A: the unit tier — retention bounds, tiered downsampling
+  # correctness, recording rules, early-warning hysteresis, the /debug/
+  # index pin, detach-on-close, and the <= 1.05x self-scrape-tax gate.
+  JAX_PLATFORMS=cpu python -m pytest tests/test_history.py -q
+  HI_DIR=$(mktemp -d -t mxtpu_history.XXXXXX)
+  # Phase B: the postmortem e2e. A supervised 2-stage loadgen soak
+  # (calm -> seeded replica kills) with the history daemon self-scraping
+  # at 20ms: the incident timeline around the first kill must carry the
+  # fault injection, the queue-depth excursion it caused, and the
+  # supervisor's respawn — in causal order on the shared monotonic
+  # anchor. Then the early-warning e2e: with the fleet idle the detector
+  # must stay silent across a calm window, and a submit ramp that
+  # genuinely outruns the drain rate must fire pressure_rising (one
+  # event, hysteresis-gated). Every tick also rotates the JSONL export
+  # consumed by phase C.
+  JAX_PLATFORMS=cpu MXTPU_HISTORY_FILE="$HI_DIR/history.jsonl" \
+      python - <<'EOF'
+import time
+import numpy as onp
+from tools import loadgen
+from incubator_mxnet_tpu.serving import ModelRegistry, Supervisor
+from incubator_mxnet_tpu.telemetry import flightrec, history
+
+
+class SlowEcho:
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+    def predict_batch(self, x):
+        time.sleep(self.delay_s)
+        return (x,)
+
+
+reg = ModelRegistry()
+# single-item batches at 20ms: the fleet drains ~200/s, so the calm
+# stage (60 rps) keeps queues near zero while the kill stage (350 rps)
+# genuinely saturates — the queue-depth excursion must land LATE in the
+# kill stage, after the first injected fault, making the causal order
+# fault -> excursion -> deterministic rather than a sampling accident
+reg.load("histsoak", SlowEcho(0.02), max_batch_size=1,
+         batch_timeout_ms=2.0, queue_size=32, replicas=4, prewarm=False)
+sup = Supervisor(reg, poll_s=0.02, backoff_base_s=0.05,
+                 backoff_cap_s=0.2, crash_n=99,
+                 crash_window_s=30.0).start()
+history.start(interval_s=0.02)
+tr = loadgen.InProcessTransport(reg, "histsoak", [0.0, 0.0, 0.0, 0.0],
+                                timeout_s=10.0)
+lg = loadgen.LoadGen(
+    tr, stages=[{"rps": 60, "duration_s": 1.5},
+                {"rps": 350, "duration_s": 2.0}],
+    arrival="poisson", seed=0, max_clients=256,
+    faults={1: "batcher.dispatch:replica_kill:stride=40"})
+report = lg.run()
+from incubator_mxnet_tpu.telemetry import faultlab
+faultlab.disarm()                # the kill must not leak past the soak
+assert report["stages"][0]["errors"] == 0, report["stages"][0]
+
+# stage reports carry the between-stage history block (both transports
+# resolve it; the in-process one reads the store directly)
+hb = report["stages"][1]["history"]
+assert hb and hb["queue_depth"] and hb["queue_depth"]["n"] >= 2, hb
+print("stage history block: depth max %.1f over %d samples"
+      % (hb["queue_depth"]["max"], hb["queue_depth"]["n"]))
+
+# the incident report, windowed around the first injected kill. Event
+# times convert to the sample axis through the constant epoch-mono
+# offset — the same join incident() itself performs.
+from incubator_mxnet_tpu import profiler
+kills = [e for e in flightrec.snapshot()
+         if e["event"] == "fault_injected"
+         and e.get("kind") == "replica_kill"]
+assert kills, "soak injected no kills — nothing to narrate"
+off = profiler.now_us() / 1e6 - time.perf_counter()
+t_kill = kills[0]["mono_us"] / 1e6 + off
+inc = history.incident(around=t_kill, before_s=5.0, after_s=10.0)
+types = [(e["type"], e.get("event"),
+          (e.get("series") or "").split("{", 1)[0])
+         for e in inc["timeline"]]
+i_fault = types.index(("event", "fault_injected", ""))
+i_resp = types.index(("event", "replica_respawned", ""))
+i_exc = types.index(("excursion", None, "mxtpu_serving_queue_depth"))
+assert i_fault < i_resp, (i_fault, i_resp)
+assert i_fault < i_exc, (i_fault, i_exc)
+ts = [e["t"] for e in inc["timeline"]]
+assert ts == sorted(ts), "timeline not causally ordered"
+print("incident OK: fault@%d -> depth excursion@%d -> respawn@%d "
+      "of %d timeline entries" % (i_fault, i_exc, i_resp, len(ts)))
+
+# -- early warnings: silent on calm, loud on a saturating ramp
+history.stop()                   # deterministic ticks from here on
+calm0 = history._WARNINGS.value(kind="pressure_rising")
+for _ in range(10):              # idle fleet: depth flat at 0
+    history.sample_once()
+    time.sleep(0.01)
+assert history._WARNINGS.value(kind="pressure_rising") == calm0, \
+    "pressure_rising fired on a calm window"
+# drain 1.25/s (80ms per 1-item batch) vs 25/s submitted: the queue
+# depth ramps linearly toward capacity 64 and the trend line must call
+# it before it lands
+reg.load("histpress", SlowEcho(0.08), max_batch_size=1,
+         batch_timeout_ms=0.5, queue_size=64, replicas=1, prewarm=False)
+b = reg._entry("histpress").batcher
+pending = []
+for i in range(50):
+    pending.append(b.submit(onp.float32([1.0])))
+    history.sample_once()
+    time.sleep(0.04)
+fired = history._WARNINGS.value(kind="pressure_rising") - calm0
+assert fired >= 1, "saturating ramp never fired pressure_rising"
+evs = [e for e in flightrec.snapshot()
+       if e["event"] == "pressure_rising"
+       and e.get("model") == "histpress"]
+assert evs and evs[0]["slope_per_s"] > 0, evs
+print("early warning OK: pressure_rising fired (eta %.1fs, slope "
+      "%.2f/s), calm window silent"
+      % (evs[0]["eta_s"], evs[0]["slope_per_s"]))
+history.export_jsonl()           # final rotation for phase C
+sup.stop()
+reg.close()
+EOF
+  # Phase C: the offline half. The export must round-trip byte-stable
+  # through tools/tsq.py (the canonical-serialization contract a diff
+  # baseline depends on), and the CI-shaped --json report must agree.
+  python tools/tsq.py roundtrip "$HI_DIR/history.jsonl"
+  python tools/tsq.py roundtrip "$HI_DIR/history.jsonl" --json \
+    | python -c "import json,sys; r=json.load(sys.stdin); \
+assert r['tool']=='tsq' and r['ok'] and not r['findings'], r; \
+print('tsq report shape OK')"
+  # sed drains its input (head would SIGPIPE the tool under pipefail)
+  python tools/tsq.py list "$HI_DIR/history.jsonl" | sed -n '1,5p'
+  hi_dt=$(( SECONDS - hi_t0 ))
+  echo "history stage wall time: ${hi_dt}s (budget 120s)"
+  [ "$hi_dt" -lt 120 ] || { echo "history stage took ${hi_dt}s (budget 120s)"; exit 1; }
 fi
 
 if has_stage diagnostics; then

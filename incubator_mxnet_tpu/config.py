@@ -513,6 +513,82 @@ ENV_VARS = {
         "for GET /debug/hotspots?capture=<id> re-fetch — summaries "
         "outlive the pruned capture dirs themselves "
         "(MXTPU_PROFILE_KEEP)."),
+    "MXTPU_HISTORY": (
+        bool, False,
+        "Autostart the metric-history daemon at package import "
+        "(telemetry/history.py; history.start()/stop() at runtime): "
+        "every MXTPU_HISTORY_INTERVAL_S it self-scrapes the telemetry "
+        "registry into bounded per-series rings, evaluates the "
+        "recording rules (rate(), queue-depth slope, window MFU, "
+        "burn-rate trajectory) and the pressure_rising/mfu_droop early "
+        "warnings, and serves GET /debug/history and /debug/incident "
+        "(docs/OBSERVABILITY.md 'Metric history & incident timelines')."),
+    "MXTPU_HISTORY_INTERVAL_S": (
+        float, 10.0,
+        "Seconds between metric-history self-scrape ticks. Retention is "
+        "a direct function of it: MXTPU_HISTORY_RAW ticks of raw points "
+        "plus MXTPU_HISTORY_COARSE x MXTPU_HISTORY_COARSE_EVERY ticks "
+        "of min/max/mean summaries."),
+    "MXTPU_HISTORY_RAW": (
+        int, 512,
+        "Raw ring length per history series: the newest N (t, value) "
+        "points kept at full scrape resolution (telemetry/history.py). "
+        "At the default 10s interval: ~85 minutes of raw history."),
+    "MXTPU_HISTORY_COARSE": (
+        int, 512,
+        "Coarse ring length per history series: N downsampled "
+        "{t, min, max, mean} points, each folding "
+        "MXTPU_HISTORY_COARSE_EVERY raw samples — the long-horizon tier "
+        "raw points age out into."),
+    "MXTPU_HISTORY_COARSE_EVERY": (
+        int, 8,
+        "Raw samples folded into one coarse min/max/mean point. The "
+        "fold keeps extremes honest: a one-tick queue spike survives "
+        "into the coarse tier as that window's max, never averaged "
+        "away."),
+    "MXTPU_HISTORY_MAX_SERIES": (
+        int, 1024,
+        "Bound on distinct series the history store retains (scraped + "
+        "derived recording-rule series). Past it, NEW series are "
+        "dropped and counted on "
+        "mxtpu_history_store_dropped_series_total; established series "
+        "keep recording — history must never OOM the process it "
+        "observes."),
+    "MXTPU_HISTORY_FILE": (
+        str, None,
+        "When set, every history tick also exports the full store to "
+        "this path as canonical JSONL (atomic tmp+rename rotation) — "
+        "the offline artifact tools/tsq.py queries, diffs, and "
+        "sparkline-renders."),
+    "MXTPU_HISTORY_SLOPE_WINDOW_S": (
+        float, 60.0,
+        "Trailing window for the least-squares slope recording rules "
+        "(queue depth, SLO burn rate) — the trend the pressure_rising "
+        "predictor extrapolates."),
+    "MXTPU_HISTORY_PRESSURE_HORIZON_S": (
+        float, 60.0,
+        "pressure_rising fires when a model's queue-depth trend line "
+        "predicts crossing its capacity within this many seconds; the "
+        "open episode only closes when the prediction retreats past "
+        "twice the horizon (hysteresis) or the slope turns "
+        "non-positive."),
+    "MXTPU_HISTORY_PRESSURE_DEPTH": (
+        float, None,
+        "Fallback saturation depth for pressure_rising when a model "
+        "exports no mxtpu_serving_queue_capacity gauge (the serving "
+        "batcher exports queue_size x replicas automatically). None: "
+        "no capacity, no prediction."),
+    "MXTPU_HISTORY_DROOP_FRAC": (
+        float, 0.7,
+        "mfu_droop fires when the window MFU falls below this fraction "
+        "of its trailing MXTPU_HISTORY_DROOP_WINDOW_S median; the "
+        "episode re-arms only after MFU recovers halfway back to the "
+        "median (hysteresis)."),
+    "MXTPU_HISTORY_DROOP_WINDOW_S": (
+        float, 600.0,
+        "Trailing window whose median window-MFU is the mfu_droop "
+        "baseline (the '10-minute median' the early warning compares "
+        "against)."),
     "MXTPU_LOADGEN_SEED": (
         int, 0,
         "Arrival-process RNG seed for the open-loop load generator "

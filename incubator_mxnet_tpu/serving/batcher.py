@@ -222,6 +222,10 @@ class DynamicBatcher:
         self._queues = [_queue.Queue(maxsize=qsize) for _ in range(n_rep)]
         self.metrics.queue_depth_fn = \
             lambda: sum(q.qsize() for q in self._queues)
+        # the saturation line the history pressure predictor needs: the
+        # trend toward "queue full" is only predictable if capacity is a
+        # metric too
+        self.metrics.set_queue_capacity(qsize * n_rep)
         # router state: per-replica in-dispatch counts, dispatch totals,
         # the dead set, and the tie-break rotation — one leaf lock, never
         # held while acquiring anything else
@@ -528,6 +532,14 @@ class DynamicBatcher:
         try:
             from ..telemetry import slo
             slo.REGISTRY.detach_model(self.name)
+        except Exception:
+            pass
+        # ...and the metric-history rings + trend-episode state: an
+        # unloaded model must not resurface in the next incident report
+        # or pin its per-series rings for process lifetime
+        try:
+            from ..telemetry import history
+            history.detach_model(self.name)
         except Exception:
             pass
 

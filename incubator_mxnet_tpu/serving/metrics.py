@@ -84,6 +84,12 @@ _LATENCY_MS = telemetry.histogram(
 _QUEUE_DEPTH = telemetry.gauge(
     "mxtpu_serving_queue_depth",
     "Requests currently waiting in the model's bounded queue.", ("model",))
+_QUEUE_CAPACITY = telemetry.gauge(
+    "mxtpu_serving_queue_capacity",
+    "Aggregate queue capacity of the model (per-replica bound x "
+    "replicas) — the saturation line the metric-history pressure_rising "
+    "predictor extrapolates mxtpu_serving_queue_depth toward "
+    "(telemetry/history.py; docs/OBSERVABILITY.md).", ("model",))
 _BUCKET_DEPTH = telemetry.gauge(
     "mxtpu_serving_bucket_queue_depth",
     "Requests gathered into this batch bucket and not yet completed "
@@ -186,6 +192,7 @@ class ServingMetrics:
         self._queue_depth_fn = None   # injected by the batcher
         self._bucket_depth_fns = []   # per-bucket samplers, ditto
         self._replica_depth_fns = []  # per-replica samplers, ditto
+        self._capacity_fn = None      # constant sampler, ditto
 
     # ------------------------------------------------------------------
     @property
@@ -198,6 +205,16 @@ class ServingMetrics:
         if fn is not None:
             # sampled at scrape time — depth is a point-in-time gauge
             _QUEUE_DEPTH.set_function(fn, model=self.model)
+
+    def set_queue_capacity(self, capacity):
+        """Publish the model's aggregate queue capacity (batcher init).
+        Bound as a constant CALLBACK, not a set() value, so teardown can
+        remove it by identity like every other per-instance series —
+        immune to the hot-reload remove-by-label race detach_telemetry
+        documents."""
+        cap = float(capacity)
+        self._capacity_fn = lambda: cap
+        _QUEUE_CAPACITY.set_function(self._capacity_fn, model=self.model)
 
     def bind_bucket_depth(self, bucket, fn):
         """Register ``fn() -> depth`` as the sampler for one batch bucket
@@ -243,6 +260,7 @@ class ServingMetrics:
         found. Counters/histograms stay — they are process-lifetime
         cumulative by Prometheus convention."""
         _QUEUE_DEPTH.remove_function(self._queue_depth_fn)
+        _QUEUE_CAPACITY.remove_function(self._capacity_fn)
         for fn in self._bucket_depth_fns:
             _BUCKET_DEPTH.remove_function(fn)
         with self._lock:

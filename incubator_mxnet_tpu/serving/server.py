@@ -84,6 +84,17 @@ Routes (TF-Serving REST-shaped):
   /debug/faults`` with ``{"spec": "<site:kind:key=val;...>"}`` arms it
   at runtime (chaos drills mid-soak, no restart); an empty/absent spec
   disarms. Malformed specs are 400 and leave the prior arming intact.
+- ``GET /debug/``           — machine-readable index of every debug
+  route (path + one-line description, the DEBUG_ROUTES table) — the
+  first page a runbook loads mid-incident.
+- ``GET /debug/history?series=&since=&step=`` — the metric-history
+  store's raw + coarse rings and recording-rule series
+  (telemetry/history.py; docs/OBSERVABILITY.md "Metric history &
+  incident timelines").
+- ``GET /debug/incident?around=<ts>`` — flightrec events, SLO alert
+  transitions, and metric excursions around a timestamp merged into
+  one causally-ordered timeline (``?before_s=`` / ``?after_s=`` bound
+  the window).
 
 Tracing: every predict request gets a request ID (client-supplied
 ``X-Request-Id`` wins, else one is generated), echoed on the response
@@ -131,10 +142,37 @@ from .registry import ModelNotFoundError, ModelRegistry
 
 _LOG = logging.getLogger(__name__)
 
-__all__ = ["ServingServer", "serve"]
+__all__ = ["ServingServer", "serve", "DEBUG_ROUTES"]
 
 _PREDICT_SUFFIX = ":predict"
 _MODELS_PREFIX = "/v1/models"
+
+#: Every debug endpoint this server exposes, served machine-readably at
+#: ``GET /debug/``. Adding a ``/debug/*`` route WITHOUT listing it here
+#: fails tests/test_history.py::test_debug_index_lists_every_route — an
+#: undiscoverable diagnostic endpoint is a diagnostic endpoint nobody
+#: reaches during the incident it was built for.
+DEBUG_ROUTES = (
+    ("/debug/", "index of every debug route (this listing)"),
+    ("/debug/stacks", "all-thread stacks + heartbeat ages + newest "
+     "watchdog stall report (text)"),
+    ("/debug/flightrec", "flight-recorder event ring as JSONL"),
+    ("/debug/spans", "finished-span ring as JSONL"),
+    ("/debug/aot", "process-wide AOT executable cache entries"),
+    ("/debug/requests", "structured access log, newest n terminal "
+     "outcomes as JSONL (?n=)"),
+    ("/debug/slo", "per-SLO budgets, burn rates, and alert states"),
+    ("/debug/numerics", "numerics sentinel: tap stats, storm episodes, "
+     "shadow divergence"),
+    ("/debug/faults", "faultlab arming state (GET) / arm-disarm (POST)"),
+    ("/debug/profile", "on-demand device-profiler capture (?seconds=)"),
+    ("/debug/hotspots", "ranked per-op hotspot table (?n=, ?capture=)"),
+    ("/debug/history", "metric-history rings: raw + coarse time series "
+     "and recording rules (?series=&since=&step=)"),
+    ("/debug/incident", "incident timeline: flightrec events, SLO alert "
+     "transitions, and metric excursions around a timestamp "
+     "(?around=&before_s=&after_s=)"),
+)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -237,6 +275,15 @@ class _Handler(BaseHTTPRequestHandler):
             # stride/p/budget/fired counters (telemetry/faultlab.py)
             from ..telemetry import faultlab
             self._send(200, faultlab.describe())
+        elif self.path.rstrip("/") == "/debug":
+            # machine-readable index of every debug route — the first
+            # page an operator (or a runbook script) loads mid-incident
+            self._send(200, {"routes": [{"path": p, "description": d}
+                                        for p, d in DEBUG_ROUTES]})
+        elif self.path.split("?", 1)[0] == "/debug/history":
+            self._do_history()
+        elif self.path.split("?", 1)[0] == "/debug/incident":
+            self._do_incident()
         elif self.path.split("?", 1)[0] == "/debug/profile":
             self._do_profile()
         elif self.path.split("?", 1)[0] == "/debug/hotspots":
@@ -256,6 +303,47 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, desc)
         else:
             self._send(404, {"error": "no route %r" % self.path})
+
+    def _do_history(self):
+        """GET /debug/history?series=&since=&step= — the metric-history
+        store (telemetry/history.py): raw + coarse rings per series,
+        optionally filtered (series substring / bare metric name),
+        truncated (since=epoch seconds) and re-bucketed (step=seconds
+        of min/max/mean folding)."""
+        from urllib.parse import parse_qs, urlparse
+        from ..telemetry import history
+        q = parse_qs(urlparse(self.path).query)
+        series = q.get("series", [None])[0]
+        try:
+            since = float(q["since"][0]) if "since" in q else None
+            step = float(q["step"][0]) if "step" in q else None
+        except ValueError:
+            self._send(400, {"error": "since/step must be numbers"})
+            return
+        if step is not None and step <= 0:
+            self._send(400, {"error": "step must be > 0"})
+            return
+        self._send(200, history.query(series=series, since=since,
+                                      step=step))
+
+    def _do_incident(self):
+        """GET /debug/incident?around=&before_s=&after_s= — the incident
+        timeline builder: flightrec events, SLO alert transitions, and
+        metric excursions in the window, merged and causally ordered on
+        the shared perf_counter anchor (telemetry/history.py)."""
+        from urllib.parse import parse_qs, urlparse
+        from ..telemetry import history
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            around = float(q["around"][0]) if "around" in q else None
+            before_s = float(q.get("before_s", ["90"])[0])
+            after_s = float(q.get("after_s", ["30"])[0])
+        except ValueError:
+            self._send(400, {"error": "around/before_s/after_s must be "
+                                      "numbers"})
+            return
+        self._send(200, history.incident(around=around, before_s=before_s,
+                                         after_s=after_s))
 
     def _do_profile(self):
         """GET /debug/profile?seconds=N — the on-demand device-profiler

@@ -37,6 +37,7 @@ from .trace import (new_request_id, current_request_id,
 from . import devstats
 from . import faultlab
 from . import flightrec
+from . import history
 from . import numwatch
 from . import profstats
 from . import slo
@@ -52,8 +53,8 @@ __all__ = [
     "new_request_id", "current_request_id", "set_current_request_id",
     "request_scope", "REQUEST_ID_HEADER",
     "start_periodic_flush", "stop_periodic_flush", "flush_to_file",
-    "devstats", "faultlab", "flightrec", "numwatch", "profstats", "slo",
-    "spans", "watchdog",
+    "devstats", "faultlab", "flightrec", "history", "numwatch",
+    "profstats", "slo", "spans", "watchdog",
     "Span", "SpanContext", "span", "record_span", "current_span",
     "current_context",
 ]
@@ -171,5 +172,10 @@ def _maybe_autostart():
     try:
         if config.get_env("MXTPU_PROFSTATS"):
             profstats.start()
+    except Exception:
+        pass
+    try:
+        if config.get_env("MXTPU_HISTORY"):
+            history.start()
     except Exception:
         pass

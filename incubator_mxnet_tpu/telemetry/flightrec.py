@@ -29,11 +29,12 @@ import itertools
 import json
 import sys
 import threading
+import time
 
 from .ringbuf import BoundedRing
 
 __all__ = ["record", "snapshot", "tail", "format_tail", "dump",
-           "install_crash_dump", "reset"]
+           "event_mono_us", "install_crash_dump", "reset"]
 
 _seq = itertools.count(1)
 #: the tape (shared machinery with the span ring)
@@ -49,17 +50,31 @@ def _now_us():
 
 def record(event, **fields):
     """Append one event (``event`` kind + small JSON-able fields; the
-    reserved keys seq/ts_us/event/thread are set here). Never raises into
-    the caller — the recorder must not be able to fail the path it
-    observes."""
+    reserved keys seq/ts_us/mono_us/event/thread are set here). Events
+    carry BOTH clocks: ``ts_us`` is the epoch-anchored profiler clock
+    (human-readable, joins chrome traces), ``mono_us`` is the raw
+    ``perf_counter`` — the NTP-step-immune anchor the metric-history
+    incident builder (telemetry/history.py) orders timelines on. Old
+    dumps without mono_us still parse (readers fall back to ts_us).
+    Never raises into the caller — the recorder must not be able to
+    fail the path it observes."""
     try:
-        ev = {"seq": next(_seq), "ts_us": _now_us(), "event": event,
+        ev = {"seq": next(_seq), "ts_us": _now_us(),
+              "mono_us": time.perf_counter() * 1e6, "event": event,
               "thread": threading.current_thread().name}
         if fields:
             ev.update(fields)
         _ring.append(ev)
     except Exception:
         pass
+
+
+def event_mono_us(ev):
+    """The perf_counter anchor of one recorded event, falling back to
+    ts_us for pre-dual-clock dumps (the two clocks differ by a constant
+    within one process, so ordering is preserved either way)."""
+    v = ev.get("mono_us")
+    return float(v) if v is not None else float(ev.get("ts_us", 0.0))
 
 
 def snapshot():

@@ -250,8 +250,20 @@ class Gauge(_Metric):
 
     def collect(self):
         lines = self._header_lines()
+        for key, val in self._evaluated():
+            lines.append("%s%s %s" % (self.name, self._label_str(key),
+                                      _fmt(val)))
+        return lines
+
+    def _evaluated(self):
+        """[(key_tuple, float)] with set_function callbacks sampled NOW —
+        the one place gauge callbacks are evaluated, shared by text
+        exposition and the programmatic series() walk (evaluating an SLO
+        gauge advances its alert state machine; both consumers must drive
+        it identically)."""
         with self._lock:
             items = [(key, s[0]) for key, s in sorted(self._series.items())]
+        out = []
         for key, raw in items:
             try:
                 if callable(raw):
@@ -259,9 +271,16 @@ class Gauge(_Metric):
                 val = float(raw)
             except Exception:  # a dead/None-returning callback must not
                 val = 0.0      # kill the scrape
-            lines.append("%s%s %s" % (self.name, self._label_str(key),
-                                      _fmt(val)))
-        return lines
+            out.append((key, val))
+        return out
+
+    def series(self):
+        """[(labels_dict, value)] snapshot with callbacks evaluated — the
+        programmatic mirror of Counter.series() for in-process consumers
+        (the history self-scrape reads depth/burn gauges through this
+        instead of re-parsing its own process's exposition text)."""
+        return [(dict(zip(self.labelnames, key)), v)
+                for key, v in self._evaluated()]
 
 
 class Histogram(_Metric):
@@ -303,6 +322,15 @@ class Histogram(_Metric):
         with self._lock:
             s = self._series.get(key)
             return (s["sum"], s["count"]) if s is not None else (0.0, 0)
+
+    def series(self):
+        """[(labels_dict, (sum, count))] snapshot — the programmatic
+        mirror of Counter.series(); the history self-scrape derives
+        per-tick mean latency from the sum/count deltas."""
+        with self._lock:
+            items = [(key, (s["sum"], s["count"]))
+                     for key, s in sorted(self._series.items())]
+        return [(dict(zip(self.labelnames, key)), v) for key, v in items]
 
     def bucket_counts(self, **labels):
         """CUMULATIVE counts per bucket bound (+Inf last) — test hook."""
@@ -387,6 +415,31 @@ class MetricsRegistry:
     def get(self, name):
         with self._lock:
             return self._metrics.get(name)
+
+    def samples(self):
+        """Every numeric sample in the registry as ``(name, kind,
+        labels_dict, value)`` tuples, sorted by metric name — the
+        registry-iteration API the metric-history self-scrape
+        (telemetry/history.py) walks each tick. Counters and gauges
+        yield one sample per label set (gauge callbacks evaluated NOW,
+        exactly like text exposition); histograms yield ``<name>_sum``
+        and ``<name>_count`` samples so rate rules can derive per-tick
+        means without parsing exposition text."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        out = []
+        for m in metrics:
+            kind = m.type_name
+            if isinstance(m, Histogram):
+                for labels, (total, count) in m.series():
+                    out.append((m.name + "_sum", kind, labels,
+                                float(total)))
+                    out.append((m.name + "_count", kind, labels,
+                                float(count)))
+            else:
+                for labels, v in m.series():
+                    out.append((m.name, kind, labels, float(v)))
+        return out
 
     def export_text(self):
         """The full Prometheus text exposition (format version 0.0.4)."""

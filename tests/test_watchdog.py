@@ -39,6 +39,12 @@ def test_flightrec_records_and_tails():
     assert [e["event"] for e in evs] == ["alpha", "beta"]
     assert evs[0]["seq"] < evs[1]["seq"]
     assert evs[0]["ts_us"] <= evs[1]["ts_us"]
+    # dual-clock anchors: mono_us (perf_counter) orders like ts_us and
+    # joins the metric-history timeline exactly; event_mono_us falls
+    # back to ts_us for pre-dual-clock dumps
+    assert evs[0]["mono_us"] <= evs[1]["mono_us"]
+    assert flightrec.event_mono_us(evs[0]) == evs[0]["mono_us"]
+    assert flightrec.event_mono_us({"ts_us": 5.0}) == 5.0
     assert evs[0]["thread"] == threading.current_thread().name
     assert evs[1]["n"] == 2
 

@@ -269,6 +269,21 @@ class HttpTransport:
         except Exception:
             return ""
 
+    def history(self, since=None):
+        """GET /debug/history JSON text, or '' — the metric-history
+        rings (telemetry/history.py) the stage reports reduce to the
+        per-stage ``history`` block (queue depth / MFU / inflight
+        min-max-mean). ``since`` is epoch seconds: the stage's
+        wall-clock start, so the block covers only this stage's
+        samples."""
+        try:
+            path = "/debug/history"
+            if since is not None:
+                path += "?since=%.6f" % since
+            return self._get(path)
+        except Exception:
+            return ""
+
     def arm_faults(self, spec):
         """POST /debug/faults with a faultlab spec ('' disarms) — the
         chaos-soak verb (--faults; docs/RESILIENCE.md). UNLIKE the scrape
@@ -460,6 +475,17 @@ class InProcessTransport:
         except Exception:
             return ""
 
+    def history(self, since=None):
+        """The same /debug/history payload the HTTP route serves, read
+        straight off the process-wide history store (empty until
+        history.start() or sample_once() has run — the soak script owns
+        the daemon lifecycle, the transport only reads)."""
+        from incubator_mxnet_tpu.telemetry import history as _history
+        try:
+            return json.dumps(_history.query(since=since))
+        except Exception:
+            return ""
+
     def arm_faults(self, spec):
         """Arm the process-wide faultlab directly (same semantics as the
         HTTP transport's POST /debug/faults; raises ValueError on a
@@ -481,7 +507,8 @@ class _MonotonicClock:
 # --------------------------------------------------------------- summarizing
 def summarize_stage(stage_cfg, n_offered, results, span_text="",
                     prom_before=None, prom_after=None,
-                    scrape_window_s=None, slo_text="", numerics_text=""):
+                    scrape_window_s=None, slo_text="", numerics_text="",
+                    history_text=""):
     """One stage's report entry from raw per-request results.
 
     ``results``: [{"rid", "status", "latency_ms"}, ...] for every arrival
@@ -496,6 +523,13 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
     ``numerics_text``: /debug/numerics JSON scraped AFTER the stage —
     parsed into the stage's ``numerics`` entry (tap health + shadow
     divergence trajectory, telemetry/numwatch.py).
+    ``history_text``: /debug/history JSON scraped AFTER the stage
+    (``since`` = the stage's wall start) — reduced to the stage's
+    ``history`` block: min/max/mean of queue depth, window MFU, and
+    HTTP inflight over the stage's self-scrape samples
+    (telemetry/history.py). Point-in-time scrapes only see the queue
+    at stage boundaries; the history block sees what it did BETWEEN
+    them.
     ``scrape_window_s``: wall time between the two /metrics scrapes,
     reported as ``server.metrics.mfu_window_s``. It is NOT the MFU
     denominator (that is the chip-seconds delta, topology-exact); it is
@@ -560,11 +594,42 @@ def summarize_stage(stage_cfg, n_offered, results, span_text="",
             out["numerics"] = json.loads(numerics_text)
         except ValueError:
             out["numerics"] = None
+    if history_text:
+        out["history"] = _history_columns(history_text)
     out["server"] = _join_spans(rids, ok_rids, span_text)
     if prom_before is not None and prom_after is not None:
         window = scrape_window_s if scrape_window_s else duration
         out["server"]["metrics"] = _metrics_delta(prom_before, prom_after,
                                                   duration_s=window)
+    return out
+
+
+#: /debug/history series each stage-report history column reduces
+_HISTORY_COLUMNS = (("queue_depth", "mxtpu_serving_queue_depth"),
+                    ("window_mfu", "mxtpu_history_window_mfu"),
+                    ("inflight", "mxtpu_http_inflight_requests"))
+
+
+def _history_columns(history_text):
+    """The /debug/history payload reduced to the stage's ``history``
+    block: {column: {min, max, mean, n} | None} pooling every label set
+    of the column's metric (all models' queue depths together — the
+    per-model split stays queryable on the server). None (not {}) when
+    the payload does not parse, so a broken scrape is visible."""
+    try:
+        series = json.loads(history_text).get("series", {})
+    except (ValueError, AttributeError):
+        return None
+    out = {}
+    for col, base in _HISTORY_COLUMNS:
+        vals = []
+        for sid, entry in series.items():
+            if sid.split("{", 1)[0] != base:
+                continue
+            vals.extend(p[1] for p in entry.get("raw", []))
+        out[col] = ({"min": min(vals), "max": max(vals),
+                     "mean": sum(vals) / len(vals), "n": len(vals)}
+                    if vals else None)
     return out
 
 
@@ -980,6 +1045,10 @@ class LoadGen:
                     spec = self.faults[idx]
                     self.transport.arm_faults(spec)
                     armed_spec = spec or None
+                # wall-clock stage start: /debug/history samples are
+                # epoch-stamped, so the per-stage history block filters
+                # on wall time, not the harness's monotonic clock
+                t_wall0 = time.time()
                 n_offered = self._drive_stage(idx, stage, q, sync)
                 if not sync:
                     self._drain()
@@ -993,6 +1062,9 @@ class LoadGen:
                 slo_text = slo_fn() if slo_fn is not None else ""
                 num_fn = getattr(self.transport, "numerics", None)
                 numerics_text = num_fn() if num_fn is not None else ""
+                hist_fn = getattr(self.transport, "history", None)
+                history_text = hist_fn(since=t_wall0) \
+                    if hist_fn is not None else ""
                 prom_after = parse_prom(self.transport.scrape())
                 now = self.clock.now()
                 with self._lock:
@@ -1003,7 +1075,8 @@ class LoadGen:
                     # the counters cover scrape→scrape (drain + settle
                     # included), so the MFU denominator must too
                     scrape_window_s=now - t_scrape, slo_text=slo_text,
-                    numerics_text=numerics_text))
+                    numerics_text=numerics_text,
+                    history_text=history_text))
                 if self.faults is not None:
                     # which faults this stage ran under — the report's
                     # availability/latency numbers are meaningless
@@ -1067,6 +1140,19 @@ def gate_metrics(report):
     sat = report.get("saturation")
     if sat:
         m["loadgen_saturation_goodput_rps"] = sat["goodput_rps"]
+    # history-block facts: whole-run queue-depth/inflight peaks and mean
+    # window MFU across the stages that carried a history block — the
+    # between-scrape saturation evidence the boundary scrapes can't see
+    hist = [s["history"] for s in stages if s.get("history")]
+    for key, col in (("loadgen_history_queue_depth_max", "queue_depth"),
+                     ("loadgen_history_inflight_max", "inflight")):
+        peaks = [h[col]["max"] for h in hist if h.get(col)]
+        if peaks:
+            m[key] = max(peaks)
+    mfus = [h["window_mfu"]["mean"] for h in hist
+            if h.get("window_mfu")]
+    if mfus:
+        m["loadgen_history_window_mfu_mean"] = sum(mfus) / len(mfus)
     g0 = st0.get("generate")
     if g0:
         # generative-mode facts (docs/GENERATE.md): the tokens/s goodput
